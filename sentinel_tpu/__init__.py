@@ -1,0 +1,106 @@
+"""sentinel-tpu: a TPU-native traffic-governance framework.
+
+Capabilities of the reference framework (alibaba/Sentinel fork — see
+SURVEY.md): resource entry/exit accounting, sliding-window statistics, flow
+rules (reject / warm-up / pacing), circuit breaking, system-adaptive
+protection, hot-parameter limiting, dynamic configuration, an ops/metrics
+plane, and cluster-wide flow control — re-designed TPU-first: all per-
+resource sliding windows live in one HBM-resident tensor updated and
+rule-checked by jitted JAX programs, and the global rate limiter is a
+``psum`` over the device mesh.
+
+Quick start::
+
+    import sentinel_tpu as st
+
+    st.load_flow_rules([st.FlowRule(resource="getUser", count=20)])
+    try:
+        with st.entry("getUser"):
+            do_work()
+    except st.BlockException:
+        fallback()
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+# Millisecond timestamps (epoch) and µs leaky-bucket heads need int64.
+# Every hot tensor is explicitly i32/f32, so this only widens time scalars.
+jax.config.update("jax_enable_x64", True)
+
+from sentinel_tpu.core import constants
+from sentinel_tpu.core.constants import (
+    BlockReason,
+    EntryType,
+    MetricEvent,
+    ResourceType,
+)
+from sentinel_tpu.core.context import enter as context_enter
+from sentinel_tpu.core.context import exit_context, get_context
+from sentinel_tpu.core.engine import EntryHandle, SentinelEngine
+from sentinel_tpu.core.exceptions import (
+    AuthorityException,
+    BlockException,
+    DegradeException,
+    FlowException,
+    ParamFlowException,
+    SystemBlockException,
+)
+from sentinel_tpu.models.flow import FlowRule
+
+__version__ = "0.1.0"
+
+_default_engine: Optional[SentinelEngine] = None
+
+
+def get_engine() -> SentinelEngine:
+    global _default_engine
+    if _default_engine is None:
+        _default_engine = SentinelEngine()
+    return _default_engine
+
+
+def reset(capacity: int = 4096) -> SentinelEngine:
+    """Tear down and rebuild the default engine (tests)."""
+    global _default_engine
+    _default_engine = SentinelEngine(capacity)
+    return _default_engine
+
+
+def entry(resource: str, entry_type: int = EntryType.OUT, count: int = 1,
+          args: Sequence = (), prioritized: bool = False) -> EntryHandle:
+    """``SphU.entry``: raises a BlockException subclass when rejected."""
+    return get_engine().entry(resource, entry_type, count, args, prioritized)
+
+
+def entry_ok(resource: str, entry_type: int = EntryType.OUT, count: int = 1,
+             args: Sequence = ()) -> Optional[EntryHandle]:
+    """``SphO.entry``: boolean variant — None instead of an exception."""
+    try:
+        return get_engine().entry(resource, entry_type, count, args)
+    except BlockException:
+        return None
+
+
+def trace(ex: BaseException) -> None:
+    """``Tracer.trace``: record a business exception on the current entry."""
+    ctx = get_context()
+    if ctx is not None and ctx.cur_entry is not None:
+        ctx.cur_entry.trace(ex)
+
+
+def load_flow_rules(rules) -> None:
+    get_engine().flow_rules.load_rules(list(rules))
+
+
+__all__ = [
+    "AuthorityException", "BlockException", "BlockReason", "DegradeException",
+    "EntryHandle", "EntryType", "FlowException", "FlowRule", "MetricEvent",
+    "ParamFlowException", "ResourceType", "SentinelEngine",
+    "SystemBlockException", "constants", "context_enter", "entry", "entry_ok",
+    "exit_context", "get_context", "get_engine", "load_flow_rules", "reset",
+    "trace",
+]

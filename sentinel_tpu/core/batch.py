@@ -1,0 +1,100 @@
+"""Device-side micro-batch layouts.
+
+The host engine expands each ``entry``/``exit`` call into fixed-width rows of
+these struct-of-arrays batches (padding with row = -1), so the device step is
+a pure function of (state, rules, batch, now) — the TPU-native analog of the
+reference's per-request slot-chain walk (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+
+class EntryBatch(NamedTuple):
+    """One admission micro-batch of N entry attempts (padded).
+
+    Row ids refer to the node registry's stats-tensor rows. ``cluster_row``
+    < 0 marks padding (or a pass-through resource when the registry is
+    full).
+    """
+
+    cluster_row: jax.Array  # int32[N] resource ClusterNode row
+    dn_row: jax.Array       # int32[N] per-(context,resource) DefaultNode row
+    origin_row: jax.Array   # int32[N] per-(resource,origin) row, -1 if none
+    origin_id: jax.Array    # int32[N] interned origin (ORIGIN_ID_NONE if "")
+    origin_named: jax.Array  # bool[N] origin named by some flow rule on res
+    context_id: jax.Array   # int32[N] interned context name
+    count: jax.Array        # int32[N] tokens to acquire
+    prioritized: jax.Array  # bool[N]
+    entry_in: jax.Array     # bool[N] EntryType.IN (system rules apply)
+    param_hash: jax.Array   # uint32[N, MAX_PARAMS] hot-param value hashes
+    param_present: jax.Array  # bool[N, MAX_PARAMS]
+
+    @property
+    def size(self) -> int:
+        return self.cluster_row.shape[0]
+
+
+class ExitBatch(NamedTuple):
+    """One completion micro-batch: rt / success / exception commits."""
+
+    cluster_row: jax.Array  # int32[N]
+    dn_row: jax.Array
+    origin_row: jax.Array
+    entry_in: jax.Array     # bool[N]
+    count: jax.Array        # int32[N]
+    rt_ms: jax.Array        # int32[N] response time
+    success: jax.Array      # bool[N] completed without error
+    error: jax.Array        # bool[N] business exception recorded (Tracer)
+    param_hash: jax.Array   # uint32[N, MAX_PARAMS]
+    param_present: jax.Array  # bool[N, MAX_PARAMS]
+
+
+class Decisions(NamedTuple):
+    """Per-entry verdicts coming back from the device step."""
+
+    reason: jax.Array   # int32[N] BlockReason (0 = pass)
+    wait_us: jax.Array  # int64[N] host must sleep this long before admitting
+
+
+MAX_PARAMS = 4
+
+
+def _np(x, dtype):
+    return np.asarray(x, dtype=dtype)
+
+
+def make_entry_batch_np(n: int):
+    """Host-side numpy staging buffers for an EntryBatch of width n."""
+    return dict(
+        cluster_row=np.full(n, -1, np.int32),
+        dn_row=np.full(n, -1, np.int32),
+        origin_row=np.full(n, -1, np.int32),
+        origin_id=np.full(n, -3, np.int32),
+        origin_named=np.zeros(n, bool),
+        context_id=np.zeros(n, np.int32),
+        count=np.zeros(n, np.int32),
+        prioritized=np.zeros(n, bool),
+        entry_in=np.zeros(n, bool),
+        param_hash=np.zeros((n, MAX_PARAMS), np.uint32),
+        param_present=np.zeros((n, MAX_PARAMS), bool),
+    )
+
+
+def make_exit_batch_np(n: int):
+    return dict(
+        cluster_row=np.full(n, -1, np.int32),
+        dn_row=np.full(n, -1, np.int32),
+        origin_row=np.full(n, -1, np.int32),
+        entry_in=np.zeros(n, bool),
+        count=np.zeros(n, np.int32),
+        rt_ms=np.zeros(n, np.int32),
+        success=np.zeros(n, bool),
+        error=np.zeros(n, bool),
+        param_hash=np.zeros((n, MAX_PARAMS), np.uint32),
+        param_present=np.zeros((n, MAX_PARAMS), bool),
+    )

@@ -1,0 +1,79 @@
+"""Per-thread call context.
+
+Reference: ``core:context/Context.java`` + ``ContextUtil`` (SURVEY.md §2.1).
+A context names the entrance (call chain root) and carries the caller origin;
+entries nest in a stack per thread. Oversized context names yield a
+``NullContext`` → pass-through entries with no protection, exactly like the
+reference (``MAX_CONTEXT_NAME_SIZE``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from sentinel_tpu.core.constants import CONTEXT_DEFAULT_NAME, MAX_CONTEXT_NAME_SIZE
+
+
+class Context:
+    __slots__ = ("name", "origin", "entry_stack", "entrance_row", "is_null",
+                 "auto_created")
+
+    def __init__(self, name: str, origin: str = "", entrance_row: int = -1):
+        self.name = name
+        self.origin = origin
+        self.entrance_row = entrance_row
+        self.entry_stack: List = []
+        self.is_null = False
+        # True when the engine materialized the default context itself; such
+        # contexts are torn down automatically when their last entry exits
+        # (reference: default-context auto-exit in CtEntry.trueExit).
+        self.auto_created = False
+
+    @property
+    def cur_entry(self):
+        return self.entry_stack[-1] if self.entry_stack else None
+
+
+class NullContext(Context):
+    def __init__(self):
+        super().__init__("", "")
+        self.is_null = True
+
+
+_tls = threading.local()
+
+
+def get_context() -> Optional[Context]:
+    return getattr(_tls, "context", None)
+
+
+def enter(name: str = CONTEXT_DEFAULT_NAME, origin: str = "") -> Context:
+    """``ContextUtil.enter``. Idempotent for the same name on one thread."""
+    ctx = get_context()
+    if ctx is not None and not ctx.is_null:
+        return ctx
+    if len(name) > MAX_CONTEXT_NAME_SIZE or not name:
+        ctx = NullContext()
+    else:
+        ctx = Context(name, origin)
+    _tls.context = ctx
+    return ctx
+
+
+def exit_context() -> None:
+    """``ContextUtil.exit``: drop the context if no entries remain."""
+    ctx = get_context()
+    if ctx is not None and not ctx.entry_stack:
+        _tls.context = None
+
+
+def auto_exit_context() -> None:
+    """Drop only an engine-created default context once its entries drain."""
+    ctx = get_context()
+    if ctx is not None and ctx.auto_created and not ctx.entry_stack:
+        _tls.context = None
+
+
+def replace_context(ctx: Optional[Context]) -> None:
+    _tls.context = ctx

@@ -1,0 +1,293 @@
+"""The host engine: entry/exit API over the jitted device step.
+
+This is the analog of the reference's ``CtSph`` + ``SphU`` (SURVEY.md §3.1):
+it owns the node registry, the compiled rule tensors, the device state, and
+the jitted ``entry_step`` / ``exit_step``; each ``entry()`` expands into a
+micro-batch row, runs the step, and translates the decision into a pass,
+a paced sleep, or a typed ``BlockException``.
+
+Batch widths are drawn from a small fixed ladder so jit caches stay warm
+(no dynamic shapes — XLA traces once per width). The synchronous path used
+by the public API submits width-1 batches (correctness / low-rate callers);
+high-rate callers and the bench use :meth:`check_batch` /
+:meth:`complete_batch` directly, and the pipelined engine (M4) will feed
+the same step functions from a background cadence loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core import context as ctx_mod
+from sentinel_tpu.core.batch import (
+    Decisions,
+    EntryBatch,
+    ExitBatch,
+    MAX_PARAMS,
+    make_entry_batch_np,
+    make_exit_batch_np,
+)
+from sentinel_tpu.core.exceptions import BlockException, exception_for_reason
+from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
+from sentinel_tpu.models import flow as F
+from sentinel_tpu.ops import step as S
+from sentinel_tpu.utils import time_util
+
+BATCH_WIDTHS = (1, 8, 64, 512, 2048)
+
+
+class EntryHandle:
+    """A live entry (reference: ``CtEntry``). Use as a context manager."""
+
+    __slots__ = (
+        "engine", "resource", "context", "cluster_row", "dn_row", "origin_row",
+        "entry_in", "count", "created_ms", "error", "exited", "params",
+    )
+
+    def __init__(self, engine, resource, context, cluster_row, dn_row,
+                 origin_row, entry_in, count, params):
+        self.engine = engine
+        self.resource = resource
+        self.context = context
+        self.cluster_row = cluster_row
+        self.dn_row = dn_row
+        self.origin_row = origin_row
+        self.entry_in = entry_in
+        self.count = count
+        self.created_ms = time_util.current_time_millis()
+        self.error = False
+        self.exited = False
+        self.params = params
+
+    def trace(self, ex: Optional[BaseException] = None) -> None:
+        """Record a business exception (reference: ``Tracer.trace``)."""
+        if ex is None or not BlockException.is_block_exception(ex):
+            self.error = True
+
+    def exit(self, count: Optional[int] = None) -> None:
+        if self.exited:
+            return
+        self.exited = True
+        self.engine._do_exit(self, count if count is not None else self.count)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and not BlockException.is_block_exception(exc):
+            self.trace(exc)
+        self.exit()
+        return False
+
+
+class SentinelEngine:
+    """Owns device state + compiled rules; thread-safe via one lock.
+
+    The device step itself is a pure function, so the lock only serializes
+    host-side staging and the state-swap — the TPU analog of the reference's
+    lock-free LeapArray updates is that *all* mutation happens inside one
+    linearized step stream.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.registry = NodeRegistry(capacity)
+        self.capacity = capacity
+        self.flow_rules = F.FlowRuleManager()
+        self.flow_rules.add_listener(self._on_rules_changed)
+        self._lock = threading.RLock()
+        self._state: Optional[S.SentinelState] = None
+        self._rules: Optional[S.RulePack] = None
+        self._named_origins: Dict[str, set] = {}
+        self._rules_dirty = True
+        self._entry_jit = jax.jit(S.entry_step, donate_argnums=(0,))
+        self._exit_jit = jax.jit(S.exit_step, donate_argnums=(0,))
+
+    # -- rule compilation --------------------------------------------------
+
+    def _on_rules_changed(self):
+        with self._lock:
+            self._rules_dirty = True
+
+    def _ensure_compiled(self):
+        """(Re)build rule tensors + state after a config push (§3.2)."""
+        if not self._rules_dirty and self._state is not None:
+            return
+        now = time_util.current_time_millis()
+        ft, named = F.compile_flow_rules(
+            self.flow_rules.get_rules(), self.registry, self.capacity
+        )
+        self._named_origins = {
+            res: set(oids) for res, oids in named.items()
+        }
+        rules = S.RulePack(flow=ft)
+        if self._state is None:
+            self._state = S.make_state(self.capacity, ft.num_rules, now)
+        else:
+            # Stats survive a rule push; controller state is re-created,
+            # matching the reference ("WarmUp state re-created!", §3.2).
+            self._state = self._state._replace(flow=F.make_flow_state(ft.num_rules, now))
+        self._rules = rules
+        self._rules_dirty = False
+
+    # -- public API --------------------------------------------------------
+
+    def entry(
+        self,
+        resource: str,
+        entry_type: int = C.EntryType.OUT,
+        count: int = 1,
+        args: Sequence = (),
+        prioritized: bool = False,
+    ) -> EntryHandle:
+        """``SphU.entry``: admit or raise a ``BlockException`` subclass."""
+        ctx = ctx_mod.get_context()
+        if ctx is None:
+            ctx = ctx_mod.enter(C.CONTEXT_DEFAULT_NAME)
+            ctx.auto_created = True
+        if ctx.is_null:
+            return EntryHandle(self, resource, ctx, -1, -1, -1,
+                               entry_type == C.EntryType.IN, count, ())
+
+        reg = self.registry
+        cluster_row = reg.cluster_row(resource, int(entry_type))
+        if ctx.entrance_row < 0:
+            ctx.entrance_row = reg.entrance_row(ctx.name)
+        parent = ctx.cur_entry.dn_row if ctx.cur_entry else ctx.entrance_row
+        dn_row = reg.default_row(ctx.name, resource, parent)
+        origin_row = reg.origin_row(resource, ctx.origin)
+        origin_id = reg.origin_id(ctx.origin)
+        entry_in = entry_type == C.EntryType.IN
+
+        if cluster_row < 0:
+            # Registry full: pass-through, like the reference's chain cap.
+            return EntryHandle(self, resource, ctx, -1, -1, -1, entry_in, count, ())
+
+        params = tuple(_hash_param(a) for a in args[:MAX_PARAMS])
+        reason, wait_us = self._submit_entry(
+            resource, cluster_row, dn_row, origin_row, origin_id,
+            reg.context_id(ctx.name), count, prioritized, entry_in, params,
+        )
+        if reason > 0 and reason != C.BlockReason.WAIT:
+            # Drop an auto-entered context with no live entries so a fresh
+            # ContextUtil.enter on this thread isn't shadowed by it.
+            ctx_mod.auto_exit_context()
+            raise exception_for_reason(reason, resource)
+        if wait_us > 0:
+            time.sleep(wait_us / 1e6)
+
+        handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
+                             origin_row, entry_in, count, params)
+        ctx.entry_stack.append(handle)
+        return handle
+
+    def _submit_entry(self, resource, cluster_row, dn_row, origin_row,
+                      origin_id, context_id, count, prioritized, entry_in,
+                      params) -> Tuple[int, int]:
+        with self._lock:
+            self._ensure_compiled()
+            buf = make_entry_batch_np(1)
+            buf["cluster_row"][0] = cluster_row
+            buf["dn_row"][0] = dn_row
+            buf["origin_row"][0] = origin_row
+            buf["origin_id"][0] = origin_id
+            buf["origin_named"][0] = origin_id in self._named_origins.get(resource, ())
+            buf["context_id"][0] = context_id
+            buf["count"][0] = count
+            buf["prioritized"][0] = prioritized
+            buf["entry_in"][0] = entry_in
+            for i, h in enumerate(params):
+                buf["param_hash"][0, i] = h
+                buf["param_present"][0, i] = True
+            batch = EntryBatch(**buf)
+            now = time_util.current_time_millis()
+            self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
+            reason = int(dec.reason[0])
+            wait = int(dec.wait_us[0])
+        return reason, wait
+
+    def _do_exit(self, handle: EntryHandle, count: int) -> None:
+        ctx = handle.context
+        if ctx.entry_stack and ctx.entry_stack[-1] is handle:
+            ctx.entry_stack.pop()
+        elif handle in ctx.entry_stack:
+            ctx.entry_stack.remove(handle)
+        if handle.cluster_row < 0:
+            ctx_mod.auto_exit_context()
+            return
+        now = time_util.current_time_millis()
+        rt = max(0, now - handle.created_ms)
+        with self._lock:
+            self._ensure_compiled()
+            buf = make_exit_batch_np(1)
+            buf["cluster_row"][0] = handle.cluster_row
+            buf["dn_row"][0] = handle.dn_row
+            buf["origin_row"][0] = handle.origin_row
+            buf["entry_in"][0] = handle.entry_in
+            buf["count"][0] = count
+            buf["rt_ms"][0] = min(rt, C.DEFAULT_MAX_RT_MS)
+            buf["success"][0] = True
+            buf["error"][0] = handle.error
+            for i, h in enumerate(handle.params):
+                buf["param_hash"][0, i] = h
+                buf["param_present"][0, i] = True
+            batch = ExitBatch(**buf)
+            self._state = self._exit_jit(self._state, self._rules, batch, now)
+        ctx_mod.auto_exit_context()
+
+    # -- batch API (bench / pipelined engine / cluster frontends) ---------
+
+    def check_batch(self, batch: EntryBatch, now_ms: Optional[int] = None) -> Decisions:
+        with self._lock:
+            self._ensure_compiled()
+            now = now_ms if now_ms is not None else time_util.current_time_millis()
+            self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
+            return dec
+
+    def complete_batch(self, batch: ExitBatch, now_ms: Optional[int] = None) -> None:
+        with self._lock:
+            self._ensure_compiled()
+            now = now_ms if now_ms is not None else time_util.current_time_millis()
+            self._state = self._exit_jit(self._state, self._rules, batch, now)
+
+    # -- introspection (ops plane) ----------------------------------------
+
+    def node_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-resource live stats (command-API ``cnode`` source)."""
+        with self._lock:
+            self._ensure_compiled()
+            now = time_util.current_time_millis()
+            w1 = W_rotate_host(self._state.w1, now, S.SPEC_1S)
+            totals = np.asarray(w1.counts.sum(axis=1))
+            threads = np.asarray(self._state.cur_threads)
+        out = {}
+        for res, row in self.registry.resources().items():
+            t = totals[row]
+            succ = max(int(t[C.MetricEvent.SUCCESS]), 1)
+            out[res] = {
+                "passQps": float(t[C.MetricEvent.PASS]),
+                "blockQps": float(t[C.MetricEvent.BLOCK]),
+                "successQps": float(t[C.MetricEvent.SUCCESS]),
+                "exceptionQps": float(t[C.MetricEvent.EXCEPTION]),
+                "avgRt": float(t[C.MetricEvent.RT]) / succ,
+                "curThreadNum": int(threads[row]),
+            }
+        return out
+
+
+def W_rotate_host(win, now_ms, spec):
+    from sentinel_tpu.ops import window as W
+
+    return W.rotate(win, jnp.asarray(now_ms, jnp.int64), spec)
+
+
+def _hash_param(value) -> int:
+    """Stable 32-bit hash of a hot-param value (CMS key)."""
+    h = hash((type(value).__name__, value)) & 0xFFFFFFFF
+    return h if h != 0 else 1

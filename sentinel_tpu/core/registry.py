@@ -1,0 +1,162 @@
+"""Host-side node registry: names -> rows of the stats tensor.
+
+The reference builds a live object graph of nodes (``core:node/``:
+``ClusterNode`` per resource, ``DefaultNode`` per (context, resource),
+per-origin ``StatisticNode``s inside each ClusterNode, ``EntranceNode`` per
+context, plus the global ``Constants.ENTRY_NODE`` — SURVEY.md §1/§2.1).
+
+TPU-native design: every node is simply a *row* of the shared
+``[rows, buckets, events]`` stats tensor. This registry is the host-side
+allocator and name table: it interns resource/context/origin strings, hands
+out rows, and keeps the parent links needed to render the call tree for the
+ops plane (``tree``/``jsonTree`` command handlers).
+
+Capacity is fixed per compile (SURVEY.md §7 hard part #4): when full, new
+resources get row -1, which the engine treats as pass-through — the exact
+semantics of the reference's ``MAX_SLOT_CHAIN_SIZE`` cap in ``CtSph``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from sentinel_tpu.core.constants import EntryType, ResourceType
+
+KIND_ROOT = 0
+KIND_ENTRY = 1  # global ENTRY_NODE (all inbound traffic)
+KIND_ENTRANCE = 2  # per-context entrance node
+KIND_CLUSTER = 3  # per-resource ClusterNode
+KIND_DEFAULT = 4  # per-(context, resource) DefaultNode
+KIND_ORIGIN = 5  # per-(resource, origin) StatisticNode
+
+ORIGIN_ID_NONE = -3
+
+ROOT_ROW = 0
+ENTRY_ROW = 1
+
+
+@dataclass
+class NodeMeta:
+    row: int
+    kind: int
+    resource: str = ""
+    context: str = ""
+    origin: str = ""
+    parent_row: int = -1
+    entry_type: int = int(EntryType.OUT)
+    resource_type: int = int(ResourceType.COMMON)
+    children: List[int] = field(default_factory=list)
+
+
+class NodeRegistry:
+    """Thread-safe allocator of stats-tensor rows for nodes."""
+
+    def __init__(self, capacity: int = 16384):
+        self.capacity = capacity
+        self._lock = threading.RLock()
+        self.meta: List[NodeMeta] = []
+        self._cluster: Dict[str, int] = {}
+        self._default: Dict[Tuple[str, str], int] = {}
+        self._origin: Dict[Tuple[str, str], int] = {}
+        self._entrance: Dict[str, int] = {}
+        self._origin_ids: Dict[str, int] = {}
+        self._context_ids: Dict[str, int] = {}
+        # fixed rows
+        self._alloc(KIND_ROOT, resource="machine-root")
+        self._alloc(KIND_ENTRY, resource="__entry_node__", parent_row=ROOT_ROW)
+        self.version = 0  # bumped on any allocation (for cache invalidation)
+
+    # -- interning ---------------------------------------------------------
+
+    def origin_id(self, origin: str) -> int:
+        if not origin:
+            return ORIGIN_ID_NONE
+        with self._lock:
+            oid = self._origin_ids.get(origin)
+            if oid is None:
+                oid = len(self._origin_ids)
+                self._origin_ids[origin] = oid
+            return oid
+
+    def context_id(self, context: str) -> int:
+        with self._lock:
+            cid = self._context_ids.get(context)
+            if cid is None:
+                cid = len(self._context_ids)
+                self._context_ids[context] = cid
+            return cid
+
+    # -- allocation --------------------------------------------------------
+
+    def _alloc(self, kind: int, **kw) -> int:
+        if len(self.meta) >= self.capacity:
+            return -1
+        row = len(self.meta)
+        self.meta.append(NodeMeta(row=row, kind=kind, **kw))
+        parent = self.meta[row].parent_row
+        if parent >= 0:
+            self.meta[parent].children.append(row)
+        self.version = getattr(self, "version", 0) + 1
+        return row
+
+    def cluster_row(self, resource: str, entry_type: int = int(EntryType.OUT),
+                    resource_type: int = 0) -> int:
+        """ClusterNode row for a resource (created on first touch)."""
+        with self._lock:
+            row = self._cluster.get(resource)
+            if row is None:
+                row = self._alloc(KIND_CLUSTER, resource=resource,
+                                  entry_type=entry_type, resource_type=resource_type)
+                if row >= 0:
+                    self._cluster[resource] = row
+            return row
+
+    def entrance_row(self, context: str) -> int:
+        with self._lock:
+            row = self._entrance.get(context)
+            if row is None:
+                row = self._alloc(KIND_ENTRANCE, resource=context, context=context,
+                                  parent_row=ROOT_ROW)
+                if row >= 0:
+                    self._entrance[context] = row
+            return row
+
+    def default_row(self, context: str, resource: str, parent_row: int) -> int:
+        """DefaultNode row for (context, resource); parent = caller node."""
+        with self._lock:
+            key = (context, resource)
+            row = self._default.get(key)
+            if row is None:
+                row = self._alloc(KIND_DEFAULT, resource=resource, context=context,
+                                  parent_row=parent_row)
+                if row >= 0:
+                    self._default[key] = row
+            return row
+
+    def origin_row(self, resource: str, origin: str) -> int:
+        if not origin:
+            return -1
+        with self._lock:
+            key = (resource, origin)
+            row = self._origin.get(key)
+            if row is None:
+                cluster = self.cluster_row(resource)
+                row = self._alloc(KIND_ORIGIN, resource=resource, origin=origin,
+                                  parent_row=cluster)
+                if row >= 0:
+                    self._origin[key] = row
+            return row
+
+    # -- lookups for the ops plane ----------------------------------------
+
+    def resources(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._cluster)
+
+    def get_cluster_row(self, resource: str) -> Optional[int]:
+        return self._cluster.get(resource)
+
+    def rows_in_use(self) -> int:
+        return len(self.meta)

@@ -1,0 +1,468 @@
+"""Flow rules: QPS / concurrency limiting with four shaping behaviors.
+
+Reference surface being reproduced (SURVEY.md §2.1 "FlowSlot + flow engine"):
+``FlowRule`` (grade, count, strategy, refResource, controlBehavior, warm-up &
+queueing params, limitApp), ``FlowRuleManager`` (wholesale rule swap via the
+property system), ``FlowRuleChecker`` (node selection by requester origin and
+relation strategy), and the ``TrafficShapingController`` family:
+
+  * ``DefaultController``       — fast-fail:  pass iff used + acquire <= count
+  * ``WarmUpController``        — Guava-SmoothWarmingUp-derived token bucket
+                                  (coldFactor 3, warning zone, slope math)
+  * ``RateLimiterController``   — leaky bucket, queue up to maxQueueingTimeMs
+  * ``WarmUpRateLimiter``       — combination
+
+TPU-native design: rules are compiled host-side into struct-of-arrays
+tensors; the checker is one vectorized pure function over the entry
+micro-batch — every request × every rule slot of its resource evaluated with
+``where``-selects instead of virtual dispatch. Arrival-order exactness inside
+a batch is preserved for unit acquires by segmented prefix sums over the
+node rows each request will commit PASS to (see ``ops/segment.py``); for
+cross-resource RELATE rules the within-batch contribution of *other*
+resources' requests is not counted (bounded by one micro-batch; documented
+semantics delta, SURVEY.md §7 hard part #2).
+
+Warm-up state (storedTokens / lastFilledTime) and rate-limiter state
+(latestPassedTime) are per-rule device tensors; like the reference, loading
+new rules re-creates controller state (§3.2: "WarmUp state re-created!").
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import EntryBatch
+from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops.segment import segmented_prefix
+
+
+# ---------------------------------------------------------------------------
+# Rule POJO + manager (host side)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FlowRule:
+    resource: str
+    count: float
+    grade: int = C.FLOW_GRADE_QPS
+    limit_app: str = C.LIMIT_APP_DEFAULT
+    strategy: int = C.FLOW_STRATEGY_DIRECT
+    ref_resource: Optional[str] = None
+    control_behavior: int = C.CONTROL_BEHAVIOR_DEFAULT
+    warm_up_period_sec: int = 10
+    max_queueing_time_ms: int = 500
+    cluster_mode: bool = False
+    cluster_config: Optional[dict] = None
+
+    def is_valid(self) -> bool:
+        if not self.resource or self.count < 0:
+            return False
+        if self.grade not in (C.FLOW_GRADE_QPS, C.FLOW_GRADE_THREAD):
+            return False
+        if self.strategy in (C.FLOW_STRATEGY_RELATE, C.FLOW_STRATEGY_CHAIN) and not self.ref_resource:
+            return False
+        if self.control_behavior == C.CONTROL_BEHAVIOR_WARM_UP and self.warm_up_period_sec <= 0:
+            return False
+        return True
+
+
+class FlowRuleTensors(NamedTuple):
+    """Compiled SoA rule tensors + the per-resource-row rule index."""
+
+    resource_row: jax.Array   # int32[FR] ClusterNode row of rule.resource
+    grade: jax.Array          # int32[FR]
+    threshold: jax.Array      # float32[FR]
+    strategy: jax.Array       # int32[FR]
+    limit_origin: jax.Array   # int32[FR] origin id | ORIGIN_ID_{DEFAULT,OTHER}
+    ref_row: jax.Array        # int32[FR] RELATE target ClusterNode row, -1
+    ref_context: jax.Array    # int32[FR] CHAIN context id, -1
+    behavior: jax.Array       # int32[FR]
+    max_queue_us: jax.Array   # int64[FR] rate-limiter max queueing time (µs)
+    cost_us: jax.Array        # int64[FR] rate-limiter cost per token (µs)
+    warning_token: jax.Array  # float32[FR] warm-up params
+    max_token: jax.Array      # float32[FR]
+    slope: jax.Array          # float32[FR]
+    cluster_mode: jax.Array   # bool[FR]
+    rules_by_row: jax.Array   # int32[R, K] rule ids per ClusterNode row, -1 pad
+
+    @property
+    def num_rules(self) -> int:
+        return self.resource_row.shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.rules_by_row.shape[1]
+
+
+class FlowState(NamedTuple):
+    """Per-rule mutable device state (re-created on rule load)."""
+
+    stored_tokens: jax.Array    # float32[FR] warm-up bucket
+    last_filled_ms: jax.Array   # int64[FR]
+    latest_passed_us: jax.Array  # int64[FR] rate-limiter leaky bucket head
+
+
+def make_flow_state(num_rules: int, now_ms: int) -> FlowState:
+    del now_ms  # kept in the signature for callers that log creation time
+    return FlowState(
+        # lastFilledTime starts at epoch 0 so the first sync refills the
+        # bucket to maxToken — the reference's cold-start state (a cold
+        # system is *throttled* to count/coldFactor until tokens drain).
+        stored_tokens=jnp.zeros((num_rules,), jnp.float32),
+        last_filled_ms=jnp.zeros((num_rules,), jnp.int64),
+        latest_passed_us=jnp.zeros((num_rules,), jnp.int64),
+    )
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((max(n, 1) + m - 1) // m) * m
+
+
+def compile_flow_rules(
+    rules: List[FlowRule],
+    registry: NodeRegistry,
+    num_rows: int,
+    min_slots: int = 1,
+) -> Tuple[FlowRuleTensors, Dict[str, Set[int]]]:
+    """Host-side rule build (reference: ``FlowRuleUtil.buildFlowRuleMap``).
+
+    Returns the tensors plus the per-resource set of origin ids explicitly
+    named by rules (for ``limitApp="other"`` matching).
+    """
+    valid = [r for r in rules if r.is_valid()]
+    fr = _round_up(len(valid), 8)
+    res_row = np.full(fr, -1, np.int32)
+    grade = np.zeros(fr, np.int32)
+    threshold = np.zeros(fr, np.float32)
+    strategy = np.zeros(fr, np.int32)
+    limit_origin = np.full(fr, C.ORIGIN_ID_DEFAULT, np.int32)
+    ref_row = np.full(fr, -1, np.int32)
+    ref_context = np.full(fr, -1, np.int32)
+    behavior = np.zeros(fr, np.int32)
+    max_queue_us = np.zeros(fr, np.int64)
+    cost_us = np.zeros(fr, np.int64)
+    warning_token = np.zeros(fr, np.float32)
+    max_token = np.zeros(fr, np.float32)
+    slope = np.zeros(fr, np.float32)
+    cluster_mode = np.zeros(fr, bool)
+
+    named_origins: Dict[str, Set[int]] = {}
+    by_row: Dict[int, List[int]] = {}
+
+    for i, r in enumerate(valid):
+        row = registry.cluster_row(r.resource)
+        res_row[i] = row
+        grade[i] = r.grade
+        threshold[i] = r.count
+        strategy[i] = r.strategy
+        behavior[i] = r.control_behavior
+        cluster_mode[i] = r.cluster_mode
+        if r.limit_app == C.LIMIT_APP_DEFAULT:
+            limit_origin[i] = C.ORIGIN_ID_DEFAULT
+        elif r.limit_app == C.LIMIT_APP_OTHER:
+            limit_origin[i] = C.ORIGIN_ID_OTHER
+        else:
+            oid = registry.origin_id(r.limit_app)
+            limit_origin[i] = oid
+            named_origins.setdefault(r.resource, set()).add(oid)
+        if r.strategy == C.FLOW_STRATEGY_RELATE:
+            ref_row[i] = registry.cluster_row(r.ref_resource)
+        elif r.strategy == C.FLOW_STRATEGY_CHAIN:
+            ref_context[i] = registry.context_id(r.ref_resource)
+        if r.control_behavior in (C.CONTROL_BEHAVIOR_RATE_LIMITER, C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER):
+            # cost of one token in µs (reference uses ms: round(1/count*1000))
+            cost_us[i] = int(round(1_000_000.0 / max(r.count, 1e-9)))
+            max_queue_us[i] = r.max_queueing_time_ms * 1000
+        if r.control_behavior in (C.CONTROL_BEHAVIOR_WARM_UP, C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER):
+            # Guava SmoothWarmingUp-derived params (WarmUpController ctor).
+            cnt, wp, cold = r.count, r.warm_up_period_sec, C.COLD_FACTOR
+            wt = (wp * cnt) / (cold - 1)
+            mt = wt + 2.0 * wp * cnt / (1 + cold)
+            warning_token[i] = wt
+            max_token[i] = mt
+            slope[i] = (cold - 1.0) / cnt / max(mt - wt, 1e-9)
+        if row >= 0:
+            by_row.setdefault(row, []).append(i)
+
+    k = max(min_slots, max((len(v) for v in by_row.values()), default=1))
+    rules_by_row = np.full((num_rows, k), -1, np.int32)
+    for row, ids in by_row.items():
+        rules_by_row[row, : len(ids)] = ids
+
+    t = FlowRuleTensors(
+        resource_row=jnp.asarray(res_row),
+        grade=jnp.asarray(grade),
+        threshold=jnp.asarray(threshold),
+        strategy=jnp.asarray(strategy),
+        limit_origin=jnp.asarray(limit_origin),
+        ref_row=jnp.asarray(ref_row),
+        ref_context=jnp.asarray(ref_context),
+        behavior=jnp.asarray(behavior),
+        max_queue_us=jnp.asarray(max_queue_us),
+        cost_us=jnp.asarray(cost_us),
+        warning_token=jnp.asarray(warning_token),
+        max_token=jnp.asarray(max_token),
+        slope=jnp.asarray(slope),
+        cluster_mode=jnp.asarray(cluster_mode),
+        rules_by_row=jnp.asarray(rules_by_row),
+    )
+    return t, named_origins
+
+
+class FlowRuleManager:
+    """Registry of flow rules; wholesale swap semantics (§3.2)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rules: List[FlowRule] = []
+        self.version = 0
+        self._listeners = []
+
+    def load_rules(self, rules: List[FlowRule]) -> None:
+        with self._lock:
+            self._rules = [r for r in rules if r.is_valid()]
+            self.version += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def get_rules(self) -> List[FlowRule]:
+        with self._lock:
+            return list(self._rules)
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def has_origin_rules(self) -> bool:
+        return any(r.limit_app != C.LIMIT_APP_DEFAULT for r in self._rules)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized checker (device side)
+# ---------------------------------------------------------------------------
+
+
+class FlowVerdict(NamedTuple):
+    blocked: jax.Array  # bool[N]
+    wait_us: jax.Array  # int64[N] sleep-then-pass (rate limiter)
+    state: FlowState
+
+
+def _gather(arr, idx, fill):
+    return arr.at[W.oob(idx, arr.shape[0])].get(mode="fill", fill_value=fill)
+
+
+def _sync_warmup(rt: FlowRuleTensors, fs: FlowState, prev_bucket_pass: jax.Array, now_ms: jax.Array) -> FlowState:
+    """Vectorized ``WarmUpController.syncToken`` over all rules, 1 Hz/rule.
+
+    ``prev_bucket_pass``: float32[FR] previous-window pass count of each
+    rule's resource (reference passes ``node.previousPassQps()``).
+    """
+    now_sec = (now_ms.astype(jnp.int64) // 1000) * 1000
+    due = now_sec > fs.last_filled_ms
+    is_warm = (rt.behavior == C.CONTROL_BEHAVIOR_WARM_UP) | (
+        rt.behavior == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
+    )
+    active = due & is_warm & (rt.resource_row >= 0)
+
+    elapsed_s = (now_sec - fs.last_filled_ms).astype(jnp.float32) / 1000.0
+    refill = fs.stored_tokens + elapsed_s * rt.threshold
+    below = fs.stored_tokens < rt.warning_token
+    above = fs.stored_tokens > rt.warning_token
+    low_qps = prev_bucket_pass < (rt.threshold / C.COLD_FACTOR)
+    new_tokens = jnp.where(below | (above & low_qps), refill, fs.stored_tokens)
+    new_tokens = jnp.minimum(new_tokens, rt.max_token)
+    new_tokens = jnp.maximum(new_tokens - prev_bucket_pass, 0.0)
+
+    return fs._replace(
+        stored_tokens=jnp.where(active, new_tokens, fs.stored_tokens),
+        last_filled_ms=jnp.where(active, now_sec, fs.last_filled_ms),
+    )
+
+
+def check_flow(
+    rt: FlowRuleTensors,
+    fs: FlowState,
+    w1: W.Window,
+    cur_threads: jax.Array,  # int32[R]
+    batch: EntryBatch,
+    now_ms: jax.Array,
+    already_blocked: jax.Array,  # bool[N] blocked by an earlier slot
+) -> FlowVerdict:
+    """Vectorized ``FlowRuleChecker.checkFlow`` over the micro-batch.
+
+    Evaluates every rule slot of each request's resource; a request is
+    flow-blocked if any applicable rule rejects it. Rate-limiter rules
+    return a wait instead (host sleeps), unless wait exceeds the queue cap.
+
+    Two evaluation passes reproduce the serial rule "blocked requests never
+    increment pass counters": pass 1 computes verdicts with every candidate
+    counted in the prefixes; pass 2 re-evaluates with prefixes restricted to
+    pass-1 survivors, so a request rejected by one rule no longer inflates
+    the usage other requests see (nor consumes leaky-bucket tokens). For a
+    single rule per node this is exactly the serial semantics; with
+    interacting rules the residual error is second-order and bounded by one
+    micro-batch (documented delta, SURVEY.md §7 hard part #2).
+    """
+    spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
+    candidate = (~already_blocked) & (batch.cluster_row >= 0)
+
+    # Warm-up token sync (per rule, once per second).
+    prev_idx = jnp.mod(W.current_index(now_ms, spec) - 1, spec.buckets)
+    prev_pass_all = jnp.take(w1.counts[:, :, C.MetricEvent.PASS], prev_idx, axis=1)
+    rule_prev_pass = _gather(prev_pass_all, rt.resource_row, 0).astype(jnp.float32)
+    fs = _sync_warmup(rt, fs, rule_prev_pass, now_ms)
+
+    blocked1, _, _ = _eval_flow_slots(rt, fs, w1, cur_threads, batch, now_ms, candidate)
+    blocked, wait_us, consumed = _eval_flow_slots(
+        rt, fs, w1, cur_threads, batch, now_ms, candidate, survivors=candidate & (~blocked1)
+    )
+
+    # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
+    now_us = now_ms.astype(jnp.int64) * 1000
+    new_latest = jnp.maximum(fs.latest_passed_us, now_us - rt.cost_us) + consumed * rt.cost_us
+    fs = fs._replace(
+        latest_passed_us=jnp.where(consumed > 0, new_latest, fs.latest_passed_us)
+    )
+    return FlowVerdict(blocked=blocked, wait_us=wait_us, state=fs)
+
+
+def _eval_flow_slots(
+    rt: FlowRuleTensors,
+    fs: FlowState,
+    w1: W.Window,
+    cur_threads: jax.Array,
+    batch: EntryBatch,
+    now_ms: jax.Array,
+    candidate: jax.Array,
+    survivors: Optional[jax.Array] = None,
+):
+    """One vectorized sweep over all rule slots.
+
+    ``survivors`` (defaults to ``candidate``) selects which requests count
+    toward within-batch prefixes — i.e. which are presumed to commit PASS.
+    Verdicts are still produced for every candidate.
+    """
+    n = batch.size
+    if survivors is None:
+        survivors = candidate
+    token_count = jnp.where(survivors, batch.count, 0)
+    entry_count = jnp.where(survivors, 1, 0)  # thread gauge moves 1/entry
+
+    # Within-batch arrival-order prefixes over the rows each request commits
+    # PASS to: [cluster, dn, origin] interleaved request-major. Token-prefix
+    # feeds QPS checks; entry-prefix feeds THREAD (concurrency) checks.
+    rows3 = jnp.stack([batch.cluster_row, batch.dn_row, batch.origin_row], axis=1).reshape(-1)
+    tok3, _ = segmented_prefix(rows3, jnp.repeat(token_count, 3))
+    ent3, _ = segmented_prefix(rows3, jnp.repeat(entry_count, 3))
+    tok3 = tok3.reshape(n, 3)
+    ent3 = ent3.reshape(n, 3)
+
+    blocked = jnp.zeros((n,), bool)
+    wait_us = jnp.zeros((n,), jnp.int64)
+    consumed = jnp.zeros((rt.num_rules,), jnp.int64)  # rate-limiter tokens
+
+    for k in range(rt.slots):
+        rule_id = rt.rules_by_row.at[
+            W.oob(batch.cluster_row, rt.rules_by_row.shape[0]), jnp.full((n,), k)
+        ].get(mode="fill", fill_value=-1)
+        has_rule = rule_id >= 0
+        g = lambda a, fill=0: _gather(a, rule_id, fill)
+
+        strat = g(rt.strategy)
+        lim_o = g(rt.limit_origin, C.ORIGIN_ID_DEFAULT)
+        behavior = g(rt.behavior)
+        grade = g(rt.grade)
+        thr = g(rt.threshold, 0.0)
+
+        # --- node selection (FlowRuleChecker.selectNodeByRequesterAndStrategy)
+        has_origin = batch.origin_id >= 0
+        direct = strat == C.FLOW_STRATEGY_DIRECT
+        sel_specific = direct & (lim_o >= 0) & (batch.origin_id == lim_o)
+        sel_default = direct & (lim_o == C.ORIGIN_ID_DEFAULT)
+        sel_other = direct & (lim_o == C.ORIGIN_ID_OTHER) & has_origin & (~batch.origin_named)
+        relate = strat == C.FLOW_STRATEGY_RELATE
+        chain = (strat == C.FLOW_STRATEGY_CHAIN) & (batch.context_id == g(rt.ref_context, -1))
+
+        applicable = has_rule & candidate & (sel_specific | sel_default | sel_other | relate | chain)
+        sel_row = jnp.where(sel_default, batch.cluster_row, -1)
+        sel_row = jnp.where(sel_specific | sel_other, batch.origin_row, sel_row)
+        sel_row = jnp.where(relate, g(rt.ref_row, -1), sel_row)
+        sel_row = jnp.where(chain, batch.dn_row, sel_row)
+        applicable = applicable & (sel_row >= 0)
+
+        # cluster=[:,0], dn=[:,1], origin=[:,2]; RELATE rows get no
+        # within-batch credit (cross-resource, bounded by one micro-batch).
+        def _sel(prefixes):
+            p = jnp.where(sel_default, prefixes[:, 0], jnp.int64(0))
+            p = jnp.where(sel_specific | sel_other, prefixes[:, 2], p)
+            return jnp.where(chain, prefixes[:, 1], p)
+
+        tok_prefix = _sel(tok3)
+        ent_prefix = _sel(ent3)
+
+        # --- current usage of the selected node
+        totals = W.row_totals(w1, sel_row)  # [N, E]
+        pass_1s = totals[:, C.MetricEvent.PASS].astype(jnp.float32)
+        used_qps = pass_1s + tok_prefix.astype(jnp.float32)
+        used_thr = (
+            _gather(cur_threads, sel_row, 0).astype(jnp.float32)
+            + ent_prefix.astype(jnp.float32)
+        )
+        used = jnp.where(grade == C.FLOW_GRADE_QPS, used_qps, used_thr)
+        acq = jnp.where(grade == C.FLOW_GRADE_QPS, batch.count, 1).astype(jnp.float32)
+
+        # --- DefaultController
+        dflt_ok = used + acq <= thr
+
+        # --- WarmUpController admission (tokens already synced)
+        stored = g(fs.stored_tokens, 0.0)
+        wtok = g(rt.warning_token, 0.0)
+        above_warn = stored >= wtok
+        warning_qps = 1.0 / ((stored - wtok) * g(rt.slope, 0.0) + 1.0 / jnp.maximum(thr, 1e-9))
+        warm_thr = jnp.where(above_warn, warning_qps, thr)
+        warm_ok = used + acq <= warm_thr
+
+        # --- RateLimiterController: leaky-bucket wait. Only survivors
+        # reserve bucket slots in the within-batch prefix.
+        cost = g(rt.cost_us, 0)
+        is_rl = (behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER) | (
+            behavior == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
+        )
+        rl_prefix, _ = segmented_prefix(
+            jnp.where(applicable & is_rl, rule_id, -1),
+            jnp.where(applicable & survivors, batch.count, 0),
+        )
+        now_us = now_ms.astype(jnp.int64) * 1000
+        latest = g(fs.latest_passed_us, 0)
+        expected = latest + (rl_prefix + batch.count).astype(jnp.int64) * cost
+        rl_wait = jnp.maximum(expected - now_us, 0)
+        rl_ok = rl_wait <= g(rt.max_queue_us, 0)
+
+        ok = jnp.where(behavior == C.CONTROL_BEHAVIOR_DEFAULT, dflt_ok, True)
+        ok = jnp.where(behavior == C.CONTROL_BEHAVIOR_WARM_UP, warm_ok, ok)
+        ok = jnp.where(behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER, rl_ok, ok)
+        ok = jnp.where(behavior == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER, warm_ok & rl_ok, ok)
+
+        slot_blocked = applicable & (~ok)
+        blocked = blocked | slot_blocked
+
+        # Bucket tokens are consumed only by requests that survive every
+        # slot (the serial reference never reaches the rate limiter for a
+        # request an earlier rule rejected).
+        admitted_rl = applicable & is_rl & ok & survivors
+        wait_us = jnp.maximum(wait_us, jnp.where(admitted_rl, rl_wait, 0))
+        consumed = consumed.at[W.oob(rule_id, rt.num_rules)].add(
+            jnp.where(admitted_rl, batch.count, 0).astype(jnp.int64), mode="drop"
+        )
+
+    return blocked, wait_us, consumed

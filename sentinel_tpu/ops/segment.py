@@ -1,0 +1,42 @@
+"""Within-batch segmented scans.
+
+The reference admits each request against counters that every *earlier*
+request has already updated (per-request exactness of ``DefaultController``
+/ the token bucket CASes). A micro-batched device step sees N requests at
+once, so to reproduce arrival-order semantics we compute, for every request,
+the sum of candidate counts of earlier requests that target the same node
+row / rule — a segmented exclusive prefix sum in arrival order.
+
+Implementation: stable sort by segment id, cumsum, subtract each segment's
+base, scatter back. O(N log N) on tiny N (micro-batch ≤ 4096), fully on
+device, no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.lax
+import jax.numpy as jnp
+
+
+def segmented_prefix(ids: jnp.ndarray, values: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exclusive prefix sum of ``values`` within equal ``ids``, arrival order.
+
+    Returns (prefix_excl, is_first) both aligned with the input order.
+    ``is_first`` marks the first occurrence of each id (used e.g. to admit a
+    single HALF_OPEN probe per breaker per batch).
+    """
+    n = ids.shape[0]
+    order = jnp.argsort(ids, stable=True)
+    sid = ids[order]
+    sval = values[order]
+    csum = jnp.cumsum(sval)
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    # Exclusive prefix at each segment head; propagate forward with a
+    # running max (csum is nondecreasing for nonnegative values).
+    head_base = jnp.where(first, csum - sval, -1)
+    base = jax.lax.cummax(head_base)
+    prefix_sorted = csum - sval - base
+    inv = jnp.zeros((n,), order.dtype).at[order].set(jnp.arange(n, dtype=order.dtype))
+    return prefix_sorted[inv], first[inv]

@@ -1,0 +1,160 @@
+"""The fused admission/commit step — sentinel-tpu's "forward pass".
+
+This is the TPU-native analog of the reference's slot-chain walk
+(SURVEY.md §3.1): one jitted pure function
+``(state, rules, batch, now) -> (state', decisions)`` that
+
+  1. rotates the shared sliding windows to ``now`` (lazy bucket reset,
+     branchless — ``ops/window.py``),
+  2. runs the rule slots (authority → system → param → flow → degrade, same
+     order as the reference chain; M0 wires flow, the rest join in M1),
+  3. commits statistics exactly like ``StatisticSlot``: thread-count + pass
+     on admit, block counts on reject — *after* the rule verdicts, which is
+     the reference's crucial control-flow inversion ("statistics slot wraps
+     the rule slots").
+
+Every entry commits to up to four node rows (DefaultNode, ClusterNode,
+origin StatisticNode, global ENTRY_NODE for inbound traffic), matching the
+reference's node fan-out.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import Decisions, EntryBatch, ExitBatch
+from sentinel_tpu.core.registry import ENTRY_ROW
+from sentinel_tpu.models import flow as F
+from sentinel_tpu.ops import window as W
+
+SPEC_1S = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
+SPEC_60S = W.WindowSpec(C.MINUTE_WINDOW_MS, C.MINUTE_BUCKETS)
+
+
+class SentinelState(NamedTuple):
+    """All mutable device state. One pytree, donated every step."""
+
+    w1: W.Window          # 1s / 2-bucket window over all node rows
+    w60: W.Window         # 60s / 60-bucket window (metric log source)
+    cur_threads: jax.Array  # int32[R] live concurrency gauge per row
+    flow: F.FlowState
+
+
+class RulePack(NamedTuple):
+    """All compiled rule tensors (host-rebuilt wholesale on config push)."""
+
+    flow: F.FlowRuleTensors
+
+
+def make_state(num_rows: int, flow_rules: int, now_ms: int) -> SentinelState:
+    return SentinelState(
+        w1=W.make_window(num_rows, SPEC_1S),
+        w60=W.make_window(num_rows, SPEC_60S),
+        cur_threads=jnp.zeros((num_rows,), jnp.int32),
+        flow=F.make_flow_state(flow_rules, now_ms),
+    )
+
+
+def _target_rows(cluster_row, dn_row, origin_row, entry_in):
+    """[N, 4] node rows each request commits to (−1 entries are dropped)."""
+    entry_row = jnp.where(entry_in, ENTRY_ROW, -1)
+    return jnp.stack([dn_row, cluster_row, origin_row, entry_row], axis=1)
+
+
+def _commit(win: W.Window, now_ms, rows4, event, values4, spec) -> W.Window:
+    n4 = rows4.reshape(-1)
+    v4 = values4.reshape(-1)
+    ev = jnp.full_like(n4, event)
+    return W.add_events(win, now_ms, n4, ev, v4, spec)
+
+
+def entry_step(
+    state: SentinelState,
+    rules: RulePack,
+    batch: EntryBatch,
+    now_ms: jax.Array,
+) -> Tuple[SentinelState, Decisions]:
+    now_ms = jnp.asarray(now_ms, jnp.int64)
+    w1 = W.rotate(state.w1, now_ms, SPEC_1S)
+    w60 = W.rotate(state.w60, now_ms, SPEC_60S)
+
+    valid = batch.cluster_row >= 0
+    reason = jnp.where(valid, C.BlockReason.PASS, -1).astype(jnp.int32)
+    blocked = jnp.zeros((batch.size,), bool)
+
+    # --- rule slots (order mirrors the reference chain) -------------------
+    fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked)
+    reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
+    blocked = blocked | fv.blocked
+
+    # --- StatisticSlot commit --------------------------------------------
+    rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
+    admit = valid & (~blocked)
+    pass_counts = jnp.where(admit, batch.count, 0)
+    block_counts = jnp.where(valid & blocked, batch.count, 0)
+    pass4 = jnp.broadcast_to(pass_counts[:, None], rows4.shape)
+    block4 = jnp.broadcast_to(block_counts[:, None], rows4.shape)
+
+    w1 = _commit(w1, now_ms, rows4, C.MetricEvent.PASS, pass4, SPEC_1S)
+    w1 = _commit(w1, now_ms, rows4, C.MetricEvent.BLOCK, block4, SPEC_1S)
+    w60 = _commit(w60, now_ms, rows4, C.MetricEvent.PASS, pass4, SPEC_60S)
+    w60 = _commit(w60, now_ms, rows4, C.MetricEvent.BLOCK, block4, SPEC_60S)
+
+    thread_inc = jnp.broadcast_to(jnp.where(admit, 1, 0)[:, None], rows4.shape).reshape(-1)
+    cur_threads = state.cur_threads.at[
+        W.oob(rows4.reshape(-1), state.cur_threads.shape[0])
+    ].add(thread_inc, mode="drop")
+
+    wait_us = jnp.where(admit, fv.wait_us, 0)
+
+    new_state = SentinelState(w1=w1, w60=w60, cur_threads=cur_threads, flow=fv.state)
+    return new_state, Decisions(reason=reason, wait_us=wait_us)
+
+
+def exit_step(
+    state: SentinelState,
+    rules: RulePack,
+    batch: ExitBatch,
+    now_ms: jax.Array,
+) -> SentinelState:
+    """Completion commit: RT + success/exception, thread decrement.
+
+    Mirrors ``StatisticSlot.exit`` + ``Tracer`` exception accounting
+    (SURVEY.md §3.1 "LeapArray write #2").
+    """
+    now_ms = jnp.asarray(now_ms, jnp.int64)
+    w1 = W.rotate(state.w1, now_ms, SPEC_1S)
+    w60 = W.rotate(state.w60, now_ms, SPEC_60S)
+
+    valid = batch.cluster_row >= 0
+    rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
+
+    succ = jnp.where(valid & batch.success, batch.count, 0)
+    exc = jnp.where(valid & batch.error, batch.count, 0)
+    rt = jnp.where(valid & batch.success, batch.rt_ms, 0)
+    succ4 = jnp.broadcast_to(succ[:, None], rows4.shape)
+    exc4 = jnp.broadcast_to(exc[:, None], rows4.shape)
+    rt4 = jnp.broadcast_to(rt[:, None], rows4.shape)
+
+    for win, spec, name in ((w1, SPEC_1S, "w1"), (w60, SPEC_60S, "w60")):
+        win = _commit(win, now_ms, rows4, C.MetricEvent.SUCCESS, succ4, spec)
+        win = _commit(win, now_ms, rows4, C.MetricEvent.EXCEPTION, exc4, spec)
+        win = _commit(win, now_ms, rows4, C.MetricEvent.RT, rt4, spec)
+        win = W.add_min_rt(win, now_ms, rows4.reshape(-1),
+                           jnp.where((valid & batch.success)[:, None], rt4, W.MIN_RT_EMPTY).reshape(-1),
+                           spec)
+        if name == "w1":
+            w1 = win
+        else:
+            w60 = win
+
+    thread_dec = jnp.broadcast_to(jnp.where(valid, -1, 0)[:, None], rows4.shape).reshape(-1)
+    cur_threads = state.cur_threads.at[
+        W.oob(rows4.reshape(-1), state.cur_threads.shape[0])
+    ].add(thread_dec, mode="drop")
+
+    return state._replace(w1=w1, w60=w60, cur_threads=cur_threads)

@@ -1,0 +1,240 @@
+"""Sliding-window statistics as tensor programs.
+
+This module is the TPU-native replacement for the reference's sliding-window
+engine (``core:slots/statistic/base/LeapArray.java`` + ``WindowWrap`` +
+``MetricBucket`` + ``ArrayMetric`` — SURVEY.md §2.1 "Sliding-window engine").
+
+Reference semantics being reproduced:
+  * a ring of B buckets, each covering ``bucket_ms``; bucket for time t is
+    slot ``(t // bucket_ms) % B`` with windowStart ``t - t % bucket_ms``;
+  * a bucket is *deprecated* when its stored windowStart is older than the
+    most recent occurrence of its slot; deprecated buckets are lazily reset
+    (``LeapArray.currentWindow`` CAS / ``resetWindowTo``) and skipped by
+    reads (``values()`` / ``isWindowDeprecated``).
+
+TPU-native design: instead of per-node rings with CAS, ALL node rows share
+one ``[rows, B, E]`` tensor. Because every row uses the same clock, the ring
+geometry is row-independent: ``starts`` is a single ``int64[B]`` vector.
+Rotation normalizes state so that every bucket holds the most recent window
+of its slot (zeroing stale ones in a single masked ``where``), making every
+subsequent read a plain sum — branchless, batched, and fused by XLA. The
+full-tensor write only happens when a bucket boundary was actually crossed
+(``lax.cond``), i.e. at most once per ``bucket_ms`` rather than per request.
+
+A second variant, :class:`RowWindow`, gives each row its own bucket length —
+needed for degrade-rule breakers and param-flow rules whose ``statIntervalMs``
+/ ``durationInSec`` vary per rule (reference keeps a private LeapArray per
+circuit breaker).
+
+Time is an explicit ``now_ms`` argument everywhere: device kernels cannot
+call clocks, and this also fixes the reference's untestable static
+``TimeUtil`` (SURVEY.md §4 takeaways).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core.constants import NUM_EVENTS
+
+# A large sentinel for MIN_RT empty buckets (reference uses maxRt default).
+MIN_RT_EMPTY = jnp.int32(2**31 - 1)
+
+
+def oob(rows: jax.Array, n: int) -> jax.Array:
+    """Map negative row ids to an out-of-bounds index.
+
+    JAX wraps negative indices *before* ``mode="drop"/"fill"`` applies, so a
+    raw -1 would silently hit the last row. Every scatter/gather below must
+    route through this.
+    """
+    return jnp.where(rows < 0, n, rows)
+
+
+class WindowSpec(NamedTuple):
+    """Static geometry of a shared-clock window."""
+
+    interval_ms: int
+    buckets: int
+
+    @property
+    def bucket_ms(self) -> int:
+        return self.interval_ms // self.buckets
+
+
+class Window(NamedTuple):
+    """Device state of one shared-clock sliding window over all node rows.
+
+    counts:  int32[rows, B, NUM_EVENTS] additive event counters
+    min_rt:  int32[rows, B]             per-bucket minimum RT (ms)
+    starts:  int64[B]                   windowStart of each slot (shared)
+    """
+
+    counts: jax.Array
+    min_rt: jax.Array
+    starts: jax.Array
+
+
+def make_window(rows: int, spec: WindowSpec) -> Window:
+    return Window(
+        counts=jnp.zeros((rows, spec.buckets, NUM_EVENTS), jnp.int32),
+        min_rt=jnp.full((rows, spec.buckets), MIN_RT_EMPTY, jnp.int32),
+        # -bucket_ms * B: strictly older than any real window start, so the
+        # first rotation resets everything.
+        starts=jnp.full((spec.buckets,), -spec.interval_ms, jnp.int64),
+    )
+
+
+def expected_starts(now_ms: jax.Array, spec: WindowSpec) -> jax.Array:
+    """windowStart of the most recent occurrence of each slot at ``now_ms``.
+
+    Slot b's latest window ending at-or-before now started at
+    ``cur_start - ((cur_idx - b) % B) * bucket_ms``.
+    """
+    bucket_ms = jnp.int64(spec.bucket_ms)
+    now_ms = now_ms.astype(jnp.int64)
+    cur_start = now_ms - now_ms % bucket_ms
+    cur_idx = (now_ms // bucket_ms) % spec.buckets
+    slots = jnp.arange(spec.buckets, dtype=jnp.int64)
+    offset = jnp.mod(cur_idx - slots, spec.buckets)
+    return cur_start - offset * bucket_ms
+
+
+def rotate(win: Window, now_ms: jax.Array, spec: WindowSpec) -> Window:
+    """Normalize: zero every deprecated bucket, stamp fresh starts.
+
+    Equivalent to running ``LeapArray.currentWindow(now)``'s lazy reset for
+    every slot of every row at once. After this, plain sums over the bucket
+    axis equal the reference's ``values()`` aggregation.
+    """
+    exp = expected_starts(now_ms, spec)
+    stale = win.starts != exp  # bool[B]
+
+    def do_rotate(w: Window) -> Window:
+        keep = ~stale
+        counts = jnp.where(keep[None, :, None], w.counts, 0)
+        min_rt = jnp.where(keep[None, :], w.min_rt, MIN_RT_EMPTY)
+        return Window(counts, min_rt, exp)
+
+    return jax.lax.cond(jnp.any(stale), do_rotate, lambda w: w._replace(starts=exp), win)
+
+
+def current_index(now_ms: jax.Array, spec: WindowSpec) -> jax.Array:
+    return ((now_ms.astype(jnp.int64) // spec.bucket_ms) % spec.buckets).astype(jnp.int32)
+
+
+def add_events(
+    win: Window,
+    now_ms: jax.Array,
+    rows: jax.Array,  # int32[N] node-row ids; negative => dropped
+    events: jax.Array,  # int32[N] MetricEvent index
+    values: jax.Array,  # int32[N] amounts
+    spec: WindowSpec,
+) -> Window:
+    """Scatter-add a batch of (row, event, value) into the current bucket.
+
+    The window must already be rotated to ``now_ms``. Rows < 0 are dropped
+    (used for masked/missing origin rows).
+    """
+    idx = current_index(now_ms, spec)
+    rows = oob(rows, win.counts.shape[0])
+    bucket_idx = jnp.full_like(rows, idx)
+    counts = win.counts.at[rows, bucket_idx, events].add(
+        values, mode="drop", indices_are_sorted=False, unique_indices=False
+    )
+    return win._replace(counts=counts)
+
+
+def add_min_rt(win: Window, now_ms: jax.Array, rows: jax.Array, rt: jax.Array, spec: WindowSpec) -> Window:
+    idx = current_index(now_ms, spec)
+    rows = oob(rows, win.min_rt.shape[0])
+    bucket_idx = jnp.full_like(rows, idx)
+    min_rt = win.min_rt.at[rows, bucket_idx].min(rt.astype(jnp.int32), mode="drop")
+    return win._replace(min_rt=min_rt)
+
+
+def row_totals(win: Window, rows: jax.Array) -> jax.Array:
+    """Sum of each event over all (fresh) buckets for the given rows.
+
+    Returns int32[N, NUM_EVENTS]. Caller must have rotated first.
+    Negative rows yield zeros (mode="fill" with 0 fill).
+    """
+    gathered = win.counts.at[oob(rows, win.counts.shape[0])].get(
+        mode="fill", fill_value=0
+    )  # [N, B, E]
+    return gathered.sum(axis=1)
+
+
+def row_min_rt(win: Window, rows: jax.Array) -> jax.Array:
+    gathered = win.min_rt.at[oob(rows, win.min_rt.shape[0])].get(
+        mode="fill", fill_value=MIN_RT_EMPTY
+    )
+    return gathered.min(axis=1)
+
+
+def all_totals(win: Window) -> jax.Array:
+    """[rows, NUM_EVENTS] totals over the full window (for metric log dump)."""
+    return win.counts.sum(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Per-row-clock window: each row has its own bucket_ms (degrade breakers,
+# param-flow rules). Geometry: starts int64[rows, B]; channel axis C is
+# caller-defined (e.g. total/error/slow for breakers).
+# ---------------------------------------------------------------------------
+
+
+class RowWindow(NamedTuple):
+    counts: jax.Array  # int32[rows, B, C]
+    starts: jax.Array  # int64[rows, B]
+    bucket_ms: jax.Array  # int64[rows] (0 => row unused)
+
+
+def make_row_window(rows: int, buckets: int, channels: int, bucket_ms) -> RowWindow:
+    bucket_ms = jnp.asarray(bucket_ms, jnp.int64)
+    if bucket_ms.ndim == 0:
+        bucket_ms = jnp.full((rows,), bucket_ms, jnp.int64)
+    return RowWindow(
+        counts=jnp.zeros((rows, buckets, channels), jnp.int32),
+        starts=jnp.full((rows, buckets), jnp.int64(-(1 << 40))),
+        bucket_ms=bucket_ms,
+    )
+
+
+def row_expected_starts(rw: RowWindow, now_ms: jax.Array) -> jax.Array:
+    buckets = rw.starts.shape[1]
+    bm = jnp.maximum(rw.bucket_ms, 1)[:, None]  # [rows, 1]
+    now = now_ms.astype(jnp.int64)
+    cur_start = now - now % bm
+    cur_idx = (now // bm) % buckets
+    slots = jnp.arange(buckets, dtype=jnp.int64)[None, :]
+    offset = jnp.mod(cur_idx - slots, buckets)
+    return cur_start - offset * bm
+
+
+def row_rotate(rw: RowWindow, now_ms: jax.Array) -> RowWindow:
+    exp = row_expected_starts(rw, now_ms)
+    keep = rw.starts == exp
+    counts = jnp.where(keep[:, :, None], rw.counts, 0)
+    return RowWindow(counts, exp, rw.bucket_ms)
+
+
+def row_window_add(rw: RowWindow, now_ms: jax.Array, rows: jax.Array, channel: jax.Array, values: jax.Array) -> RowWindow:
+    """Scatter-add into each row's current bucket. Must be rotated."""
+    buckets = rw.starts.shape[1]
+    rows = oob(rows, rw.counts.shape[0])
+    bm = jnp.maximum(rw.bucket_ms.at[rows].get(mode="fill", fill_value=1), 1)
+    idx = ((now_ms.astype(jnp.int64) // bm) % buckets).astype(jnp.int32)
+    counts = rw.counts.at[rows, idx, channel].add(values, mode="drop")
+    return rw._replace(counts=counts)
+
+
+def row_window_totals(rw: RowWindow, rows: jax.Array) -> jax.Array:
+    """int32[N, C] full-window totals for given rows (rotated state)."""
+    gathered = rw.counts.at[oob(rows, rw.counts.shape[0])].get(
+        mode="fill", fill_value=0
+    )
+    return gathered.sum(axis=1)

@@ -1,0 +1,39 @@
+"""Host clock with test override.
+
+Reference: ``core:util/TimeUtil.java`` — a daemon thread caching
+``System.currentTimeMillis()`` into a volatile long to avoid syscall cost on
+the hot path. Python's ``time.time_ns()`` is a vDSO call (~20ns), so no cache
+thread is needed; what we *do* keep is a single choke point so tests can pin
+time (the reference's static clock was untestable — SURVEY.md §4) and so the
+device step receives time as an explicit argument.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+_frozen_ms: Optional[int] = None
+
+
+def current_time_millis() -> int:
+    if _frozen_ms is not None:
+        return _frozen_ms
+    return time.time_ns() // 1_000_000
+
+
+def freeze_time(ms: int) -> None:
+    """Pin the clock (tests only)."""
+    global _frozen_ms
+    _frozen_ms = int(ms)
+
+
+def advance_time(delta_ms: int) -> None:
+    global _frozen_ms
+    assert _frozen_ms is not None, "advance_time requires freeze_time first"
+    _frozen_ms += int(delta_ms)
+
+
+def unfreeze_time() -> None:
+    global _frozen_ms
+    _frozen_ms = None

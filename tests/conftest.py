@@ -1,0 +1,40 @@
+"""Test config: run JAX on a virtual 8-device CPU topology.
+
+Per the build environment contract, tests run on CPU with
+``xla_force_host_platform_device_count=8`` so multi-chip sharding logic is
+exercised without TPU hardware; the bench runs on the real chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.utils import time_util
+
+
+@pytest.fixture()
+def frozen_time():
+    """Pin the clock to a deterministic epoch; yield the controller."""
+    time_util.freeze_time(1_700_000_000_000)
+    yield time_util
+    time_util.unfreeze_time()
+
+
+@pytest.fixture()
+def engine(frozen_time):
+    """Fresh default engine with a pinned clock and a clean context."""
+    from sentinel_tpu.core.context import replace_context
+
+    replace_context(None)
+    eng = st.reset(capacity=512)
+    yield eng
+    replace_context(None)
+    st.reset(capacity=512)

@@ -1,0 +1,115 @@
+"""Pure-Python serial oracle of the reference sliding window + controllers.
+
+A faithful scalar re-implementation of the reference semantics (LeapArray
+lazy rotation, DefaultController, leaky bucket, warm-up token bucket) used
+as ground truth in property tests: the device kernels must agree with this
+oracle on any event sequence (SURVEY.md §4 takeaways: "device results == a
+serial oracle").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class OracleLeapArray:
+    """Scalar LeapArray: B buckets of ``bucket_ms`` each, lazy reset."""
+
+    def __init__(self, interval_ms: int, buckets: int, channels: int):
+        self.interval_ms = interval_ms
+        self.buckets = buckets
+        self.bucket_ms = interval_ms // buckets
+        self.starts = [-interval_ms] * buckets
+        self.data = [[0] * channels for _ in range(buckets)]
+        self.channels = channels
+
+    def _idx(self, now: int) -> int:
+        return (now // self.bucket_ms) % self.buckets
+
+    def _window_start(self, now: int) -> int:
+        return now - now % self.bucket_ms
+
+    def current(self, now: int) -> List[int]:
+        i = self._idx(now)
+        ws = self._window_start(now)
+        if self.starts[i] != ws:
+            self.data[i] = [0] * self.channels
+            self.starts[i] = ws
+        return self.data[i]
+
+    def add(self, now: int, channel: int, value: int) -> None:
+        self.current(now)[channel] += value
+
+    def total(self, now: int, channel: int) -> int:
+        """Sum over non-deprecated buckets (reference ``values()``)."""
+        tot = 0
+        for b in range(self.buckets):
+            exp = self._expected_start(now, b)
+            if self.starts[b] == exp:
+                tot += self.data[b][channel]
+        return tot
+
+    def previous_bucket(self, now: int, channel: int) -> int:
+        prev = now - self.bucket_ms
+        b = self._idx(prev)
+        if self.starts[b] == self._window_start(prev):
+            return self.data[b][channel]
+        return 0
+
+    def _expected_start(self, now: int, b: int) -> int:
+        cur = self._window_start(now)
+        offset = (self._idx(now) - b) % self.buckets
+        return cur - offset * self.bucket_ms
+
+
+PASS, BLOCK, EXCEPTION, SUCCESS, RT, OCCUPIED = range(6)
+
+
+class OracleNode:
+    """StatisticNode: 1s/2-bucket + 60s/60-bucket windows + thread gauge."""
+
+    def __init__(self):
+        self.w1 = OracleLeapArray(1000, 2, 6)
+        self.w60 = OracleLeapArray(60000, 60, 6)
+        self.threads = 0
+
+    def add(self, now, channel, value):
+        self.w1.add(now, channel, value)
+        self.w60.add(now, channel, value)
+
+    def pass_qps(self, now) -> float:
+        return self.w1.total(now, PASS)
+
+
+class OracleFlowChecker:
+    """DefaultController over one resource (QPS or thread grade)."""
+
+    def __init__(self, count: float, grade_qps: bool = True):
+        self.count = count
+        self.grade_qps = grade_qps
+
+    def can_pass(self, node: OracleNode, now: int, acquire: int = 1) -> bool:
+        used = node.pass_qps(now) if self.grade_qps else node.threads
+        return used + acquire <= self.count
+
+
+class OracleRateLimiter:
+    """RateLimiterController: leaky bucket in µs."""
+
+    def __init__(self, count: float, max_queue_ms: int):
+        self.cost_us = int(round(1_000_000.0 / count))
+        self.max_queue_us = max_queue_ms * 1000
+        self.latest_us = 0
+
+    def try_pass(self, now_ms: int, acquire: int = 1):
+        """Returns (ok, wait_us)."""
+        now_us = now_ms * 1000
+        expected = self.latest_us + acquire * self.cost_us
+        if expected <= now_us:
+            self.latest_us = now_us
+            return True, 0
+        wait = expected - now_us
+        if wait > self.max_queue_us:
+            return False, 0
+        self.latest_us += acquire * self.cost_us
+        return True, wait

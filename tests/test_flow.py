@@ -1,0 +1,188 @@
+"""End-to-end flow-rule tests through the public entry API.
+
+Golden behavior parity with the reference demos (SURVEY.md §2.7 / BASELINE
+config #1 ``FlowQpsDemo``): a QPS rule of N admits exactly N entries per
+second window, then throws FlowException; window roll restores quota.
+Deterministic via the frozen clock.
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+
+
+def test_flow_qps_demo_golden(engine, frozen_time):
+    """BASELINE config #1: single-resource QPS rule, count=20."""
+    st.load_flow_rules([st.FlowRule(resource="demo", count=20)])
+    passed = blocked = 0
+    for _ in range(30):
+        try:
+            with st.entry("demo"):
+                passed += 1
+        except st.FlowException:
+            blocked += 1
+    assert passed == 20
+    assert blocked == 10
+    # next second: quota restored
+    frozen_time.advance_time(1000)
+    with st.entry("demo"):
+        pass
+
+
+def test_flow_blocks_register_block_qps(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="r", count=2)])
+    results = []
+    for _ in range(5):
+        e = st.entry_ok("r")
+        results.append(e is not None)
+        if e:
+            e.exit()
+    snap = engine.node_snapshot()["r"]
+    assert snap["passQps"] == 2
+    assert snap["blockQps"] == 3
+
+
+def test_thread_grade_counts_concurrency(engine, frozen_time):
+    st.load_flow_rules([
+        st.FlowRule(resource="t", count=2, grade=C.FLOW_GRADE_THREAD)
+    ])
+    e1 = st.entry("t")
+    e2 = st.entry("t")
+    with pytest.raises(st.FlowException):
+        st.entry("t")
+    e1.exit()  # concurrency drops -> admit again
+    e3 = st.entry("t")
+    e3.exit()
+    e2.exit()
+
+
+def test_no_rules_means_pass(engine, frozen_time):
+    for _ in range(100):
+        with st.entry("free"):
+            pass
+    assert engine.node_snapshot()["free"]["passQps"] == 100
+
+
+def test_zero_count_blocks_everything(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="z", count=0)])
+    with pytest.raises(st.FlowException):
+        st.entry("z")
+
+
+def test_rule_swap_wholesale(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="s", count=1)])
+    with st.entry("s"):
+        pass
+    with pytest.raises(st.FlowException):
+        st.entry("s")
+    # raise the limit: new rule applies immediately; stats survive the push
+    st.load_flow_rules([st.FlowRule(resource="s", count=10)])
+    with st.entry("s"):
+        pass
+    assert engine.node_snapshot()["s"]["passQps"] == 2
+
+
+def test_origin_specific_limit(engine, frozen_time):
+    """limitApp=<origin> only throttles that caller (AuthoritySlot-adjacent
+    origin selection in FlowRuleChecker)."""
+    st.load_flow_rules([
+        st.FlowRule(resource="o", count=1, limit_app="appA"),
+        st.FlowRule(resource="o", count=100),
+    ])
+    st.context_enter("ctx_a", origin="appA")
+    with st.entry("o"):
+        pass
+    with pytest.raises(st.FlowException):
+        st.entry("o")
+    # a different origin is not limited by the appA rule
+    st.exit_context()
+    st.context_enter("ctx_b", origin="appB")
+    for _ in range(5):
+        with st.entry("o"):
+            pass
+    st.exit_context()
+
+
+def test_limit_app_other(engine, frozen_time):
+    """limitApp="other" throttles only origins not explicitly named."""
+    st.load_flow_rules([
+        st.FlowRule(resource="w", count=100, limit_app="vip"),
+        st.FlowRule(resource="w", count=1, limit_app=C.LIMIT_APP_OTHER),
+    ])
+    st.context_enter("cv", origin="vip")
+    for _ in range(10):
+        with st.entry("w"):
+            pass
+    st.exit_context()
+    st.context_enter("cx", origin="riffraff")
+    with st.entry("w"):
+        pass
+    with pytest.raises(st.FlowException):
+        st.entry("w")
+    st.exit_context()
+
+
+def test_rate_limiter_paces(engine, frozen_time):
+    """RateLimiterController: 10 QPS -> 100ms spacing, queue cap honored."""
+    st.load_flow_rules([
+        st.FlowRule(
+            resource="p", count=10,
+            control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+            max_queueing_time_ms=500,
+        )
+    ])
+    waits = []
+    for _ in range(5):
+        reason, wait_us = engine._submit_entry(
+            "p", engine.registry.cluster_row("p"), -1, -1, -3, 0, 1, False,
+            False, ())
+        waits.append((reason, wait_us))
+    # first fits immediately; then 100ms increments
+    assert all(r == 0 or r == C.BlockReason.PASS for r, _ in waits)
+    w = [wu for _, wu in waits]
+    assert w[0] == 0
+    assert w[1] == pytest.approx(100_000, abs=2000)
+    assert w[4] == pytest.approx(400_000, abs=2000)
+    # 6th: wait hits exactly the 500ms cap -> still admitted (reference
+    # blocks only when wait strictly exceeds maxQueueingTimeMs)
+    reason, wait_us = engine._submit_entry(
+        "p", engine.registry.cluster_row("p"), -1, -1, -3, 0, 1, False, False, ())
+    assert reason == 0 and wait_us == pytest.approx(500_000, abs=2000)
+    # 7th: beyond the queue cap -> blocked
+    reason, _ = engine._submit_entry(
+        "p", engine.registry.cluster_row("p"), -1, -1, -3, 0, 1, False, False, ())
+    assert reason == C.BlockReason.FLOW
+
+
+def test_relate_strategy(engine, frozen_time):
+    """RELATE: write_db is throttled when read_db is busy."""
+    st.load_flow_rules([
+        st.FlowRule(resource="write_db", count=3,
+                    strategy=C.FLOW_STRATEGY_RELATE, ref_resource="read_db")
+    ])
+    # read_db idle: write passes
+    with st.entry("write_db"):
+        pass
+    # read_db busy (4 passes in window): write blocked
+    for _ in range(4):
+        with st.entry("read_db"):
+            pass
+    with pytest.raises(st.FlowException):
+        st.entry("write_db")
+
+
+def test_warmup_cold_start_throttles(engine, frozen_time):
+    """WarmUpController: cold system only admits ~count/coldFactor."""
+    st.load_flow_rules([
+        st.FlowRule(resource="wu", count=90,
+                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                    warm_up_period_sec=10)
+    ])
+    frozen_time.advance_time(3000)  # let the bucket fill to maxToken
+    passed = 0
+    for _ in range(90):
+        if st.entry_ok("wu"):
+            passed += 1
+    # cold threshold is count/coldFactor = 30
+    assert passed == pytest.approx(30, abs=1)

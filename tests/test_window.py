@@ -1,0 +1,118 @@
+"""Window-tensor ops vs the serial LeapArray oracle.
+
+Mirrors the reference's highest-value statistics tests (LeapArrayTest:
+rotation, deprecation, lazy reset — SURVEY.md §4) but deterministic: time is
+a parameter.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentinel_tpu.core.constants import NUM_EVENTS
+from sentinel_tpu.ops import window as W
+from tests.oracle import OracleLeapArray
+
+SPEC = W.WindowSpec(1000, 2)
+
+
+@partial(jax.jit, static_argnames="spec")
+def _add_jit(win, now, row, ev, val, spec):
+    win = W.rotate(win, now, spec)
+    return W.add_events(win, now, row, ev, val, spec)
+
+
+@partial(jax.jit, static_argnames="spec")
+def _totals_jit(win, now, row, spec):
+    win = W.rotate(win, now, spec)
+    return W.row_totals(win, row)
+
+
+def _add(win, now, row, ev, val, spec=SPEC):
+    return _add_jit(
+        win, jnp.int64(now),
+        jnp.array([row], jnp.int32), jnp.array([ev], jnp.int32),
+        jnp.array([val], jnp.int32), spec,
+    )
+
+
+def _total(win, now, row, ev, spec=SPEC):
+    return int(_totals_jit(win, jnp.int64(now), jnp.array([row], jnp.int32), spec)[0, ev])
+
+
+def test_single_bucket_accumulates():
+    win = W.make_window(4, SPEC)
+    t0 = 1_700_000_000_000
+    win = _add(win, t0, 2, 0, 5)
+    win = _add(win, t0 + 10, 2, 0, 3)
+    assert _total(win, t0 + 20, 2, 0) == 8
+
+
+def test_rotation_drops_old_buckets():
+    win = W.make_window(4, SPEC)
+    t0 = 1_700_000_000_000  # bucket-aligned
+    win = _add(win, t0, 1, 0, 7)
+    # within the same 1s window: still visible
+    assert _total(win, t0 + 999, 1, 0) == 7
+    # 1 bucket later: first 500ms bucket deprecated
+    assert _total(win, t0 + 1000, 1, 0) == 0
+    # far future: everything gone
+    assert _total(win, t0 + 100_000, 1, 0) == 0
+
+
+def test_partial_rotation_keeps_recent_bucket():
+    win = W.make_window(4, SPEC)
+    t0 = 1_700_000_000_000
+    win = _add(win, t0, 0, 0, 1)        # bucket A [t0, t0+500)
+    win = _add(win, t0 + 600, 0, 0, 10)  # bucket B [t0+500, t0+1000)
+    # at t0+1100: bucket A deprecated, bucket B alive
+    assert _total(win, t0 + 1100, 0, 0) == 10
+
+
+def test_negative_row_dropped():
+    win = W.make_window(4, SPEC)
+    t0 = 1_700_000_000_000
+    win = _add(win, t0, -1, 0, 99)
+    totals = W.all_totals(W.rotate(win, jnp.int64(t0), SPEC))
+    assert int(np.asarray(totals).sum()) == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_window_matches_oracle_random_trace(seed):
+    rng = np.random.default_rng(seed)
+    rows, events = 8, NUM_EVENTS
+    win = W.make_window(rows, SPEC)
+    oracles = [OracleLeapArray(1000, 2, events) for _ in range(rows)]
+    t = 1_700_000_000_000
+    for _ in range(300):
+        t += int(rng.integers(0, 400))
+        row = int(rng.integers(0, rows))
+        ev = int(rng.integers(0, events))
+        val = int(rng.integers(1, 5))
+        win = _add(win, t, row, ev, val)
+        oracles[row].add(t, ev, val)
+        if rng.random() < 0.3:
+            q_row = int(rng.integers(0, rows))
+            q_ev = int(rng.integers(0, events))
+            got = _total(win, t, q_row, q_ev)
+            want = oracles[q_row].total(t, q_ev)
+            assert got == want, (t, q_row, q_ev, got, want)
+
+
+def test_row_window_varying_bucket_len():
+    rw = W.make_row_window(3, 2, 2, [500, 1000, 2000])
+    t = 1_700_000_000_000
+    rw = W.row_rotate(rw, jnp.int64(t))
+    rw = W.row_window_add(rw, jnp.int64(t), jnp.array([0, 1, 2], jnp.int32),
+                          jnp.array([0, 0, 0], jnp.int32),
+                          jnp.array([1, 1, 1], jnp.int32))
+    # After 1.2s: row0 (1s total window) expired, row1 (2s) keeps it,
+    # row2 (4s window) keeps it.
+    rw2 = W.row_rotate(rw, jnp.int64(t + 1200))
+    tot = np.asarray(W.row_window_totals(rw2, jnp.array([0, 1, 2], jnp.int32)))
+    assert tot[0, 0] == 0
+    assert tot[1, 0] == 1
+    assert tot[2, 0] == 1
