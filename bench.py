@@ -1,0 +1,76 @@
+"""Benchmark: rule-checks/sec through the fused admission step.
+
+Measures sustained admission throughput (entries checked + committed per
+second) over a 10k-resource registry with mixed flow rules — the north-star
+config of BASELINE.json ("10k resources, 1M aggregate QPS"). The reference
+repo publishes no numbers (BASELINE.md), so ``vs_baseline`` is the ratio to
+the 1M checks/sec north-star target: 1.0 means the pod sustains the target.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+    from sentinel_tpu.core.registry import NodeRegistry
+    from sentinel_tpu.models import flow as F
+    from sentinel_tpu.ops import step as S
+
+    n_resources = 10_000
+    capacity = 16_384
+    batch_n = 4096
+    now0 = 1_700_000_000_000
+
+    reg = NodeRegistry(capacity)
+    rules = [
+        F.FlowRule(resource=f"res{i}", count=1e9, control_behavior=0)
+        for i in range(0, n_resources, 10)  # every 10th resource ruled
+    ]
+    rows = np.asarray([reg.cluster_row(f"res{i}") for i in range(n_resources)])
+    ft, _ = F.compile_flow_rules(rules, reg, capacity)
+    pack = S.RulePack(flow=ft)
+    state = S.make_state(capacity, ft.num_rules, now0)
+
+    rng = np.random.default_rng(0)
+    buf = make_entry_batch_np(batch_n)
+    buf["cluster_row"][:] = rows[rng.integers(0, n_resources, size=batch_n)]
+    buf["dn_row"][:] = buf["cluster_row"]
+    buf["count"][:] = 1
+    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+    step = jax.jit(S.entry_step, donate_argnums=(0,))
+
+    # Warm-up / compile.
+    state, dec = step(state, pack, batch, jnp.asarray(now0, jnp.int64))
+    jax.block_until_ready(dec)
+
+    # Timed loop: advance the clock 1ms per step so rotation work is real.
+    iters = 200
+    t0 = time.perf_counter()
+    for i in range(1, iters + 1):
+        state, dec = step(state, pack, batch, jnp.asarray(now0 + i, jnp.int64))
+    jax.block_until_ready(dec)
+    dt = time.perf_counter() - t0
+
+    checks_per_sec = iters * batch_n / dt
+    target = 1_000_000.0  # BASELINE.json north star: 1M aggregate QPS
+    print(json.dumps({
+        "metric": "rule_checks_per_sec",
+        "value": round(checks_per_sec, 1),
+        "unit": "entries/s",
+        "vs_baseline": round(checks_per_sec / target, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

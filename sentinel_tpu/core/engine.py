@@ -16,8 +16,10 @@ the same step functions from a background cadence loop.
 
 from __future__ import annotations
 
+import struct
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -288,6 +290,23 @@ def W_rotate_host(win, now_ms, spec):
 
 
 def _hash_param(value) -> int:
-    """Stable 32-bit hash of a hot-param value (CMS key)."""
-    h = hash((type(value).__name__, value)) & 0xFFFFFFFF
+    """Deterministic 32-bit hash of a hot-param value (CMS key).
+
+    Must agree across processes, hosts, and restarts — pod-level param-flow
+    aggregation compares these hashes — so Python's salted ``hash()`` is
+    off-limits. Type-tagged CRC32 keeps 1, 1.0, "1" and True distinct.
+    """
+    if isinstance(value, bool):
+        data = b"b1" if value else b"b0"
+    elif isinstance(value, int):
+        data = b"i" + str(value).encode()  # unbounded ints
+    elif isinstance(value, float):
+        data = b"f" + struct.pack("<d", value)
+    elif isinstance(value, str):
+        data = b"s" + value.encode("utf-8", "surrogatepass")
+    elif isinstance(value, bytes):
+        data = b"y" + value
+    else:
+        data = b"r" + repr(value).encode("utf-8", "backslashreplace")
+    h = zlib.crc32(data) & 0xFFFFFFFF
     return h if h != 0 else 1

@@ -79,6 +79,7 @@ class FlowRuleTensors(NamedTuple):
     """Compiled SoA rule tensors + the per-resource-row rule index."""
 
     resource_row: jax.Array   # int32[FR] ClusterNode row of rule.resource
+    sync_row: jax.Array       # int32[FR] node row warm-up token sync reads
     grade: jax.Array          # int32[FR]
     threshold: jax.Array      # float32[FR]
     strategy: jax.Array       # int32[FR]
@@ -141,6 +142,7 @@ def compile_flow_rules(
     valid = [r for r in rules if r.is_valid()]
     fr = _round_up(len(valid), 8)
     res_row = np.full(fr, -1, np.int32)
+    sync_row = np.full(fr, -1, np.int32)
     grade = np.zeros(fr, np.int32)
     threshold = np.zeros(fr, np.float32)
     strategy = np.zeros(fr, np.int32)
@@ -178,13 +180,32 @@ def compile_flow_rules(
             ref_row[i] = registry.cluster_row(r.ref_resource)
         elif r.strategy == C.FLOW_STRATEGY_CHAIN:
             ref_context[i] = registry.context_id(r.ref_resource)
+        # Warm-up token sync reads the same node admission checks against
+        # (reference: canPass(node).syncToken(node.previousPassQps())):
+        # RELATE -> the referenced resource's ClusterNode; CHAIN -> the
+        # (context, resource) DefaultNode; a named limit_app -> that
+        # origin's StatisticNode; default/"other" -> the ClusterNode
+        # ("other" spans many origins — cluster row is the aggregate).
+        if r.strategy == C.FLOW_STRATEGY_RELATE:
+            sync_row[i] = ref_row[i]
+        elif r.strategy == C.FLOW_STRATEGY_CHAIN:
+            sync_row[i] = registry.default_row(
+                r.ref_resource, r.resource, registry.entrance_row(r.ref_resource)
+            )
+        elif r.limit_app not in (C.LIMIT_APP_DEFAULT, C.LIMIT_APP_OTHER):
+            sync_row[i] = registry.origin_row(r.resource, r.limit_app)
+        else:
+            sync_row[i] = row
         if r.control_behavior in (C.CONTROL_BEHAVIOR_RATE_LIMITER, C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER):
             # cost of one token in µs (reference uses ms: round(1/count*1000))
             cost_us[i] = int(round(1_000_000.0 / max(r.count, 1e-9)))
             max_queue_us[i] = r.max_queueing_time_ms * 1000
         if r.control_behavior in (C.CONTROL_BEHAVIOR_WARM_UP, C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER):
             # Guava SmoothWarmingUp-derived params (WarmUpController ctor).
-            cnt, wp, cold = r.count, r.warm_up_period_sec, C.COLD_FACTOR
+            # count=0 is a valid block-everything rule; epsilon keeps the
+            # slope math finite (warning_qps then collapses to ~0).
+            cnt = max(r.count, 1e-9)
+            wp, cold = r.warm_up_period_sec, C.COLD_FACTOR
             wt = (wp * cnt) / (cold - 1)
             mt = wt + 2.0 * wp * cnt / (1 + cold)
             warning_token[i] = wt
@@ -200,6 +221,7 @@ def compile_flow_rules(
 
     t = FlowRuleTensors(
         resource_row=jnp.asarray(res_row),
+        sync_row=jnp.asarray(sync_row),
         grade=jnp.asarray(grade),
         threshold=jnp.asarray(threshold),
         strategy=jnp.asarray(strategy),
@@ -297,6 +319,7 @@ def check_flow(
     batch: EntryBatch,
     now_ms: jax.Array,
     already_blocked: jax.Array,  # bool[N] blocked by an earlier slot
+    extra_pass: Optional[jax.Array] = None,  # int32[R] other-device pass counts
 ) -> FlowVerdict:
     """Vectorized ``FlowRuleChecker.checkFlow`` over the micro-batch.
 
@@ -316,15 +339,19 @@ def check_flow(
     spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
     candidate = (~already_blocked) & (batch.cluster_row >= 0)
 
-    # Warm-up token sync (per rule, once per second).
+    # Warm-up token sync (per rule, once per second) against the node the
+    # rule admits on (sync_row), not blindly the resource ClusterNode.
     prev_idx = jnp.mod(W.current_index(now_ms, spec) - 1, spec.buckets)
     prev_pass_all = jnp.take(w1.counts[:, :, C.MetricEvent.PASS], prev_idx, axis=1)
-    rule_prev_pass = _gather(prev_pass_all, rt.resource_row, 0).astype(jnp.float32)
+    rule_prev_pass = _gather(prev_pass_all, rt.sync_row, 0).astype(jnp.float32)
     fs = _sync_warmup(rt, fs, rule_prev_pass, now_ms)
 
-    blocked1, _, _ = _eval_flow_slots(rt, fs, w1, cur_threads, batch, now_ms, candidate)
+    blocked1, _, _ = _eval_flow_slots(
+        rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass
+    )
     blocked, wait_us, consumed = _eval_flow_slots(
-        rt, fs, w1, cur_threads, batch, now_ms, candidate, survivors=candidate & (~blocked1)
+        rt, fs, w1, cur_threads, batch, now_ms, candidate,
+        survivors=candidate & (~blocked1), extra_pass=extra_pass,
     )
 
     # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
@@ -345,6 +372,7 @@ def _eval_flow_slots(
     now_ms: jax.Array,
     candidate: jax.Array,
     survivors: Optional[jax.Array] = None,
+    extra_pass: Optional[jax.Array] = None,
 ):
     """One vectorized sweep over all rule slots.
 
@@ -414,6 +442,14 @@ def _eval_flow_slots(
         totals = W.row_totals(w1, sel_row)  # [N, E]
         pass_1s = totals[:, C.MetricEvent.PASS].astype(jnp.float32)
         used_qps = pass_1s + tok_prefix.astype(jnp.float32)
+        if extra_pass is not None:
+            # Cluster-mode rules admit against the POD-global window: add
+            # the psum'd pass counts of the other devices (the TPU-native
+            # token server — SURVEY.md §2.11). Local-mode rules stay local.
+            cm = g(rt.cluster_mode, False)
+            used_qps = used_qps + jnp.where(
+                cm, _gather(extra_pass, sel_row, 0).astype(jnp.float32), 0.0
+            )
         used_thr = (
             _gather(cur_threads, sel_row, 0).astype(jnp.float32)
             + ent_prefix.astype(jnp.float32)
@@ -443,7 +479,12 @@ def _eval_flow_slots(
             jnp.where(applicable & survivors, batch.count, 0),
         )
         now_us = now_ms.astype(jnp.int64) * 1000
-        latest = g(fs.latest_passed_us, 0)
+        # Clamp the bucket head the same way the state advance does: the
+        # reference sets latestPassedTime = NOW for the first pass after an
+        # idle period (not latest + cost), i.e. the effective base is
+        # max(latest, now - cost). Using the raw stale head here would let
+        # a whole micro-batch through unpaced after any idle gap.
+        latest = jnp.maximum(g(fs.latest_passed_us, 0), now_us - cost)
         expected = latest + (rl_prefix + batch.count).astype(jnp.int64) * cost
         rl_wait = jnp.maximum(expected - now_us, 0)
         rl_ok = rl_wait <= g(rt.max_queue_us, 0)

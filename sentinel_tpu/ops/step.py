@@ -77,7 +77,11 @@ def entry_step(
     rules: RulePack,
     batch: EntryBatch,
     now_ms: jax.Array,
+    extra_pass=None,
 ) -> Tuple[SentinelState, Decisions]:
+    """One admission step. ``extra_pass`` (int32[R], optional) is the other
+    devices' pass-count contribution for cluster-mode rules — supplied by
+    the pod-parallel wrapper (``parallel/cluster.py``) from a ``psum``."""
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, SPEC_1S)
     w60 = W.rotate(state.w60, now_ms, SPEC_60S)
@@ -87,7 +91,8 @@ def entry_step(
     blocked = jnp.zeros((batch.size,), bool)
 
     # --- rule slots (order mirrors the reference chain) -------------------
-    fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked)
+    fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked,
+                      extra_pass=extra_pass)
     reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
     blocked = blocked | fv.blocked
 

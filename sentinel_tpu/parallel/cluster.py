@@ -1,0 +1,111 @@
+"""Pod-as-one-rate-limiter: mesh-parallel admission over ICI.
+
+Reference architecture being replaced (SURVEY.md §2.4, §2.11, §3.3): the
+``sentinel-cluster`` token server — a Netty TCP server owning the global
+sliding window, with every client paying one RTT per ``requestToken`` and
+degrading to local checks on failure (``FlowRuleChecker.passClusterCheck`` /
+``fallbackToLocalOrPass``).
+
+TPU-native design: there is no server process. Each device in the mesh holds
+a full-capacity replica of the stats tensors carrying *its own* admitted
+traffic (the reference's "every JVM holds its own full stats" replication,
+§2.10), and the request stream is sharded over the device axis. Cluster-mode
+flow rules admit against the POD-GLOBAL window: a ``psum`` over the mesh
+axis folds every device's pass counts into one view, so the whole pod acts
+as a single token server with zero RTTs — the collective rides ICI inside
+one XLA program.
+
+Exactness: within one micro-step a device sees other devices' counts as of
+the step start, so overshoot is bounded by (devices − 1) × max per-device
+batch admission for one rule — the quantified semantics delta of SURVEY.md
+§7 (hard part #5). The reference's own cluster mode has an analogous window
+(client-side batching + RTT staleness).
+
+Multi-host pods work unchanged: ``jax.make_mesh`` over all devices spans
+hosts, and XLA routes the same ``psum`` over ICI within a slice and DCN
+across slices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import Decisions, EntryBatch, ExitBatch
+from sentinel_tpu.ops import step as S
+from sentinel_tpu.ops import window as W
+
+try:  # jax >= 0.4.35 exposes shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "pod"
+
+
+def _squeeze0(tree):
+    return jax.tree.map(lambda x: jnp.squeeze(x, 0), tree)
+
+
+def _expand0(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def make_pod_state(n_devices: int, capacity: int, flow_rules: int,
+                   now_ms: int) -> S.SentinelState:
+    """Per-device replicated-structure state: leaves shaped [D, ...]."""
+    one = S.make_state(capacity, flow_rules, now_ms)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n_devices,) + x.shape), one
+    )
+
+
+def global_pass_counts(w1: W.Window, axis: str) -> Tuple[jax.Array, jax.Array]:
+    """(extra_pass[R], local_pass[R]): other-device / own pass totals."""
+    local = W.all_totals(w1)[:, C.MetricEvent.PASS]
+    total = jax.lax.psum(local, axis)
+    return total - local, local
+
+
+def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
+               now_ms: jax.Array, *, axis: str) -> Tuple[S.SentinelState, Decisions]:
+    local = _squeeze0(state)
+    w1 = W.rotate(local.w1, now_ms, S.SPEC_1S)
+    extra_pass, _ = global_pass_counts(w1, axis)
+    new_local, dec = S.entry_step(local, rules, batch, now_ms, extra_pass=extra_pass)
+    return _expand0(new_local), dec
+
+
+def _pod_exit(state: S.SentinelState, rules: S.RulePack, batch: ExitBatch,
+              now_ms: jax.Array, *, axis: str) -> S.SentinelState:
+    del axis
+    return _expand0(S.exit_step(_squeeze0(state), rules, batch, now_ms))
+
+
+def make_pod_steps(mesh: Mesh, axis: str = AXIS):
+    """Build (entry_step, exit_step) shard_mapped over ``mesh[axis]``.
+
+    State leaves carry a leading device axis (sharded); batches are sharded
+    over the request axis; rules and ``now_ms`` are replicated. The returned
+    functions are jittable; callers wrap them in ``jax.jit`` with state
+    donation.
+    """
+    entry = _shard_map(
+        functools.partial(_pod_entry, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P()),
+        out_specs=(P(axis), P(axis)),
+    )
+    exit_ = _shard_map(
+        functools.partial(_pod_exit, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P()),
+        out_specs=P(axis),
+    )
+    return entry, exit_

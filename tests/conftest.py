@@ -7,12 +7,19 @@ exercised without TPU hardware; the bench runs on the real chip.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# The image's sitecustomize registers the TPU backend and pins
+# jax_platforms to it regardless of the env var; override via config
+# (must happen before the backend initializes).
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

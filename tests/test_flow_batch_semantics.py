@@ -101,3 +101,45 @@ def test_thread_grade_prefix_counts_entries_not_tokens(engine, frozen_time):
     ]
     dec = engine.check_batch(_batch(engine, rows))
     assert (np.asarray(dec.reason) == C.BlockReason.PASS).all()
+
+
+def test_rate_limiter_batch_paces_after_idle(engine, frozen_time):
+    """After an idle gap a micro-batch must still be paced: the leaky-bucket
+    base clamps to now - cost, so of 8 simultaneous requests at count=10
+    (cost 100ms, queue cap 200ms) exactly 3 fit (waits 0/100/200ms)."""
+    st.load_flow_rules([
+        st.FlowRule(resource="rl", count=10,
+                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                    max_queueing_time_ms=200),
+    ])
+    cl = engine.registry.cluster_row("rl")
+    engine._ensure_compiled()
+    rows = [dict(cluster_row=cl, dn_row=-1, origin_row=-1, count=1)
+            for _ in range(8)]
+    dec = engine.check_batch(_batch(engine, rows))
+    reasons = np.asarray(dec.reason)
+    waits = np.asarray(dec.wait_us)
+    assert (reasons[:3] == C.BlockReason.PASS).all()
+    assert (reasons[3:] == C.BlockReason.FLOW).all()
+    assert list(waits[:3]) == [0, 100_000, 200_000]
+
+
+def test_warmup_zero_count_rule_blocks_without_crash(engine, frozen_time):
+    """count=0 is a valid block-everything config for every behavior; the
+    warm-up slope math must not divide by zero."""
+    st.load_flow_rules([
+        st.FlowRule(resource="wz", count=0,
+                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP),
+    ])
+    with pytest.raises(st.FlowException):
+        st.entry("wz")
+
+
+def test_param_hash_deterministic_and_typed():
+    from sentinel_tpu.core.engine import _hash_param
+
+    assert _hash_param("user-42") == 2811702807  # frozen cross-process value
+    vals = [1, 1.0, "1", True, b"1", None]
+    hashes = [_hash_param(v) for v in vals]
+    assert len(set(hashes)) == len(vals)
+    assert all(0 < h <= 0xFFFFFFFF for h in hashes)
