@@ -36,10 +36,18 @@ def main() -> None:
         F.FlowRule(resource=f"res{i}", count=1e9, control_behavior=0)
         for i in range(0, n_resources, 10)  # every 10th resource ruled
     ]
+    from sentinel_tpu.models import degrade as D
+
+    degrade_rules = [
+        D.DegradeRule(resource=f"res{i}", count=100, grade=i % 3, time_window=10)
+        for i in range(0, n_resources, 20)  # every 20th resource breakered
+    ]
     rows = np.asarray([reg.cluster_row(f"res{i}") for i in range(n_resources)])
     ft, _ = F.compile_flow_rules(rules, reg, capacity)
-    pack = S.RulePack(flow=ft)
-    state = S.make_state(capacity, ft.num_rules, now0)
+    dt, di = D.compile_degrade_rules(degrade_rules, reg, capacity)
+    pack = S.RulePack(flow=ft, degrade=dt)
+    state = S.make_state(capacity, ft.num_rules, now0,
+                         degrade=D.make_degrade_state(dt, di))
 
     rng = np.random.default_rng(0)
     buf = make_entry_batch_np(batch_n)

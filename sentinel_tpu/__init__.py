@@ -49,6 +49,7 @@ from sentinel_tpu.core.exceptions import (
     ParamFlowException,
     SystemBlockException,
 )
+from sentinel_tpu.models.degrade import DegradeRule
 from sentinel_tpu.models.flow import FlowRule
 
 __version__ = "0.1.0"
@@ -96,11 +97,15 @@ def load_flow_rules(rules) -> None:
     get_engine().flow_rules.load_rules(list(rules))
 
 
+def load_degrade_rules(rules) -> None:
+    get_engine().degrade_rules.load_rules(list(rules))
+
+
 __all__ = [
     "AuthorityException", "BlockException", "BlockReason", "DegradeException",
-    "EntryHandle", "EntryType", "FlowException", "FlowRule", "MetricEvent",
-    "ParamFlowException", "ResourceType", "SentinelEngine",
+    "DegradeRule", "EntryHandle", "EntryType", "FlowException", "FlowRule",
+    "MetricEvent", "ParamFlowException", "ResourceType", "SentinelEngine",
     "SystemBlockException", "constants", "context_enter", "entry", "entry_ok",
-    "exit_context", "get_context", "get_engine", "load_flow_rules", "reset",
-    "trace",
+    "exit_context", "get_context", "get_engine", "load_degrade_rules",
+    "load_flow_rules", "reset", "trace",
 ]

@@ -38,6 +38,7 @@ from sentinel_tpu.core.batch import (
 )
 from sentinel_tpu.core.exceptions import BlockException, exception_for_reason
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
+from sentinel_tpu.models import degrade as D
 from sentinel_tpu.models import flow as F
 from sentinel_tpu.ops import step as S
 from sentinel_tpu.utils import time_util
@@ -102,41 +103,59 @@ class SentinelEngine:
         self.registry = NodeRegistry(capacity)
         self.capacity = capacity
         self.flow_rules = F.FlowRuleManager()
-        self.flow_rules.add_listener(self._on_rules_changed)
+        self.flow_rules.add_listener(lambda: self._mark_dirty("flow"))
+        self.degrade_rules = D.DegradeRuleManager()
+        self.degrade_rules.add_listener(lambda: self._mark_dirty("degrade"))
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
         self._named_origins: Dict[str, set] = {}
-        self._rules_dirty = True
+        self._dirty = {"flow": True, "degrade": True}
         self._entry_jit = jax.jit(S.entry_step, donate_argnums=(0,))
         self._exit_jit = jax.jit(S.exit_step, donate_argnums=(0,))
 
     # -- rule compilation --------------------------------------------------
 
-    def _on_rules_changed(self):
+    def _mark_dirty(self, family: str):
         with self._lock:
-            self._rules_dirty = True
+            self._dirty[family] = True
 
     def _ensure_compiled(self):
-        """(Re)build rule tensors + state after a config push (§3.2)."""
-        if not self._rules_dirty and self._state is not None:
+        """(Re)build rule tensors + state after a config push (§3.2).
+
+        Each family rebuilds independently: a flow-rule push re-creates
+        flow controller state (reference: "WarmUp state re-created!") but
+        leaves circuit-breaker state intact, and vice versa. Node stats
+        always survive.
+        """
+        if self._state is None:
+            now = time_util.current_time_millis()
+            ft, named = F.compile_flow_rules(
+                self.flow_rules.get_rules(), self.registry, self.capacity)
+            dt, di = D.compile_degrade_rules(
+                self.degrade_rules.get_rules(), self.registry, self.capacity)
+            self._named_origins = {r: set(o) for r, o in named.items()}
+            self._rules = S.RulePack(flow=ft, degrade=dt)
+            self._state = S.make_state(self.capacity, ft.num_rules, now,
+                                       degrade=D.make_degrade_state(dt, di))
+            self._dirty = {k: False for k in self._dirty}
+            return
+        if not any(self._dirty.values()):
             return
         now = time_util.current_time_millis()
-        ft, named = F.compile_flow_rules(
-            self.flow_rules.get_rules(), self.registry, self.capacity
-        )
-        self._named_origins = {
-            res: set(oids) for res, oids in named.items()
-        }
-        rules = S.RulePack(flow=ft)
-        if self._state is None:
-            self._state = S.make_state(self.capacity, ft.num_rules, now)
-        else:
-            # Stats survive a rule push; controller state is re-created,
-            # matching the reference ("WarmUp state re-created!", §3.2).
+        if self._dirty["flow"]:
+            ft, named = F.compile_flow_rules(
+                self.flow_rules.get_rules(), self.registry, self.capacity)
+            self._named_origins = {r: set(o) for r, o in named.items()}
+            self._rules = self._rules._replace(flow=ft)
             self._state = self._state._replace(flow=F.make_flow_state(ft.num_rules, now))
-        self._rules = rules
-        self._rules_dirty = False
+            self._dirty["flow"] = False
+        if self._dirty["degrade"]:
+            dt, di = D.compile_degrade_rules(
+                self.degrade_rules.get_rules(), self.registry, self.capacity)
+            self._rules = self._rules._replace(degrade=dt)
+            self._state = self._state._replace(degrade=D.make_degrade_state(dt, di))
+            self._dirty["degrade"] = False
 
     # -- public API --------------------------------------------------------
 

@@ -1,0 +1,326 @@
+"""Circuit breaking (degrade rules) as a vectorized state machine.
+
+Reference surface (SURVEY.md §2.1 "DegradeSlot + circuit breaker", 1.8
+semantics): per-rule ``CircuitBreaker`` with CLOSED → OPEN → HALF_OPEN
+states over a private ``statIntervalMs`` sliding window —
+``ResponseTimeCircuitBreaker`` (slow-call ratio: rt > count ⇒ slow; open
+when slowRatio ≥ slowRatioThreshold) and ``ExceptionCircuitBreaker``
+(error ratio / error count). Checked at **entry** (``tryPass``), fed at
+**exit** (``onRequestComplete`` with the completed request's RT + error).
+
+TPU-native design: every breaker is one row of
+  * ``state  int32[DR]``      CLOSED=0 / OPEN=1 / HALF_OPEN=2
+  * ``next_retry_ms int64[DR]``
+  * a :class:`~sentinel_tpu.ops.window.RowWindow` ``[DR, 1, 3]`` (one
+    tumbling ``statIntervalMs`` bucket per rule — the reference breaker
+    LeapArray uses sampleCount 1 — with TOTAL/ERROR/SLOW channels),
+and all transitions are ``where``-selects over the whole rule axis.
+
+Entry semantics: CLOSED passes; OPEN passes a single probe per rule once
+``next_retry_ms`` elapses (the batch's *first* arrival wins — segmented
+first-occurrence flag), flipping the rule to HALF_OPEN; HALF_OPEN blocks.
+Exit semantics: completions feed the window; a completion while HALF_OPEN
+decides the probe verdict (bad ⇒ re-OPEN with a fresh retry window, good ⇒
+CLOSED with stats reset) — including completions of requests admitted
+before the flip, matching the reference's observer behavior; CLOSED rules
+re-evaluate their threshold and may trip OPEN. With several completions of
+one HALF_OPEN rule in a batch, any bad outcome wins (the serial reference's
+final state depends on arrival order; documented delta).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import EntryBatch, ExitBatch
+from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops.segment import segmented_prefix
+from sentinel_tpu.utils.shapes import round_up as _round_up
+
+# RowWindow channels
+CH_TOTAL = 0
+CH_ERROR = 1
+CH_SLOW = 2
+NUM_CH = 3
+
+BREAKER_BUCKETS = 1  # tumbling statIntervalMs bucket (reference sampleCount=1)
+
+
+@dataclass
+class DegradeRule:
+    """Reference: ``DegradeRule.java`` (1.8 field set)."""
+
+    resource: str
+    count: float                      # RT grade: max allowed rt (ms); ratio/count grades: threshold
+    grade: int = C.DEGRADE_GRADE_RT
+    time_window: int = 0              # recovery timeout (seconds)
+    slow_ratio_threshold: float = C.DEGRADE_DEFAULT_SLOW_RATIO_THRESHOLD
+    min_request_amount: int = C.DEGRADE_DEFAULT_MIN_REQUEST_AMOUNT
+    stat_interval_ms: int = C.DEGRADE_DEFAULT_STAT_INTERVAL_MS
+    limit_app: str = C.LIMIT_APP_DEFAULT
+
+    def is_valid(self) -> bool:
+        if not self.resource or self.count < 0 or self.time_window < 0:
+            return False
+        if self.grade not in (C.DEGRADE_GRADE_RT, C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                              C.DEGRADE_GRADE_EXCEPTION_COUNT):
+            return False
+        if self.grade == C.DEGRADE_GRADE_EXCEPTION_RATIO and self.count > 1.0:
+            return False
+        if self.min_request_amount <= 0 or self.stat_interval_ms <= 0:
+            return False
+        return True
+
+
+class DegradeRuleTensors(NamedTuple):
+    resource_row: jax.Array    # int32[DR]
+    grade: jax.Array           # int32[DR]
+    threshold: jax.Array       # float32[DR] (max rt | ratio | count)
+    slow_ratio: jax.Array      # float32[DR]
+    min_request: jax.Array     # int32[DR]
+    time_window_ms: jax.Array  # int64[DR]
+    rules_by_row: jax.Array    # int32[R, K] degrade-rule ids per resource row
+
+    @property
+    def num_rules(self) -> int:
+        return self.resource_row.shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.rules_by_row.shape[1]
+
+
+class DegradeState(NamedTuple):
+    state: jax.Array          # int32[DR] BREAKER_*
+    next_retry_ms: jax.Array  # int64[DR]
+    win: W.RowWindow          # [DR, 1, 3] per-rule statIntervalMs window
+
+
+def make_degrade_state(rt: DegradeRuleTensors, stat_interval_ms: np.ndarray) -> DegradeState:
+    dr = rt.num_rules
+    # Each rule's statIntervalMs rides in the RowWindow bucket_ms vector.
+    return DegradeState(
+        state=jnp.zeros((dr,), jnp.int32),
+        next_retry_ms=jnp.zeros((dr,), jnp.int64),
+        win=W.make_row_window(dr, BREAKER_BUCKETS, NUM_CH, stat_interval_ms),
+    )
+
+
+def compile_degrade_rules(
+    rules: List[DegradeRule], registry: NodeRegistry, num_rows: int,
+) -> Tuple[DegradeRuleTensors, np.ndarray]:
+    """Returns (tensors, per-rule statIntervalMs host array — the window
+    geometry is static per compile and feeds state construction)."""
+    valid = [r for r in rules if r.is_valid()]
+    dr = _round_up(len(valid), 8)
+    res_row = np.full(dr, -1, np.int32)
+    grade = np.zeros(dr, np.int32)
+    threshold = np.zeros(dr, np.float32)
+    slow_ratio = np.ones(dr, np.float32)
+    min_request = np.full(dr, C.DEGRADE_DEFAULT_MIN_REQUEST_AMOUNT, np.int32)
+    time_window_ms = np.zeros(dr, np.int64)
+    stat_interval = np.zeros(dr, np.int64)  # 0 => unused row
+    by_row: Dict[int, List[int]] = {}
+
+    for i, r in enumerate(valid):
+        row = registry.cluster_row(r.resource)
+        res_row[i] = row
+        grade[i] = r.grade
+        threshold[i] = r.count
+        slow_ratio[i] = r.slow_ratio_threshold
+        min_request[i] = r.min_request_amount
+        time_window_ms[i] = r.time_window * 1000
+        stat_interval[i] = r.stat_interval_ms
+        if row >= 0:
+            by_row.setdefault(row, []).append(i)
+
+    k = max(1, max((len(v) for v in by_row.values()), default=1))
+    rules_by_row = np.full((num_rows, k), -1, np.int32)
+    for row, ids in by_row.items():
+        rules_by_row[row, : len(ids)] = ids
+
+    t = DegradeRuleTensors(
+        resource_row=jnp.asarray(res_row),
+        grade=jnp.asarray(grade),
+        threshold=jnp.asarray(threshold),
+        slow_ratio=jnp.asarray(slow_ratio),
+        min_request=jnp.asarray(min_request),
+        time_window_ms=jnp.asarray(time_window_ms),
+        rules_by_row=jnp.asarray(rules_by_row),
+    )
+    return t, stat_interval
+
+
+class DegradeRuleManager:
+    """Wholesale-swap registry (reference: ``DegradeRuleManager``)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rules: List[DegradeRule] = []
+        self._listeners = []
+
+    def load_rules(self, rules: List[DegradeRule]) -> None:
+        with self._lock:
+            self._rules = [r for r in rules if r.is_valid()]
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def get_rules(self) -> List[DegradeRule]:
+        with self._lock:
+            return list(self._rules)
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+
+# ---------------------------------------------------------------------------
+# Device-side check (entry) and feed (exit)
+# ---------------------------------------------------------------------------
+
+
+class DegradeVerdict(NamedTuple):
+    blocked: jax.Array  # bool[N]
+    state: DegradeState
+
+
+def check_degrade(
+    rt: DegradeRuleTensors,
+    ds: DegradeState,
+    batch: EntryBatch,
+    now_ms: jax.Array,
+    candidate: jax.Array,  # bool[N] not blocked by earlier slots
+) -> DegradeVerdict:
+    """Vectorized ``CircuitBreaker.tryPass`` over the micro-batch."""
+    n = batch.size
+    blocked = jnp.zeros((n,), bool)
+    state = ds.state
+    next_retry = ds.next_retry_ms
+    probe_rules = []  # per-slot int32[N]: rule id probed by request i, or -1
+
+    for k in range(rt.slots):
+        rule_id = rt.rules_by_row.at[
+            W.oob(batch.cluster_row, rt.rules_by_row.shape[0]), jnp.full((n,), k)
+        ].get(mode="fill", fill_value=-1)
+        has_rule = (rule_id >= 0) & candidate & (~blocked)
+
+        st = state.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=C.BREAKER_CLOSED)
+        nr = next_retry.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=0)
+
+        is_open = st == C.BREAKER_OPEN
+        is_half = st == C.BREAKER_HALF_OPEN
+        retry_due = is_open & (now_ms >= nr)
+
+        # One probe per rule per batch: first arrival with a due retry.
+        probe_ids = jnp.where(has_rule & retry_due, rule_id, -1)
+        _, is_first = segmented_prefix(probe_ids, jnp.zeros((n,), jnp.int32))
+        probe = has_rule & retry_due & is_first & (probe_ids >= 0)
+
+        blocked_k = has_rule & (is_half | (is_open & ~probe))
+        blocked = blocked | blocked_k
+
+        # OPEN -> HALF_OPEN where a probe was admitted.
+        state = state.at[W.oob(jnp.where(probe, rule_id, -1), rt.num_rules)].set(
+            C.BREAKER_HALF_OPEN, mode="drop"
+        )
+        probe_rules.append(jnp.where(probe, rule_id, -1))
+
+    # A probe granted at one slot whose request another slot then blocked
+    # never completes, so its breaker would be stuck HALF_OPEN forever.
+    # Revert those to OPEN (retry time untouched → re-probe-eligible at
+    # once), the vectorized analog of the reference's terminate-hook
+    # workaround for alibaba/Sentinel#1638.
+    for pr in probe_rules:
+        dead = jnp.where(blocked, pr, -1)
+        state = state.at[W.oob(dead, rt.num_rules)].set(C.BREAKER_OPEN, mode="drop")
+
+    return DegradeVerdict(blocked=blocked, state=ds._replace(state=state))
+
+
+def feed_degrade(
+    rt: DegradeRuleTensors,
+    ds: DegradeState,
+    batch: ExitBatch,
+    now_ms: jax.Array,
+) -> DegradeState:
+    """Vectorized ``onRequestComplete``: window feed + state transitions."""
+    n = batch.cluster_row.shape[0]
+    win = W.row_rotate(ds.win, now_ms)
+    state = ds.state
+    next_retry = ds.next_retry_ms
+
+    valid = batch.cluster_row >= 0
+    err = valid & batch.error
+
+    half_bad = jnp.zeros((rt.num_rules,), bool)
+    half_good = jnp.zeros((rt.num_rules,), bool)
+
+    for k in range(rt.slots):
+        rule_id = rt.rules_by_row.at[
+            W.oob(batch.cluster_row, rt.rules_by_row.shape[0]), jnp.full((n,), k)
+        ].get(mode="fill", fill_value=-1)
+        has_rule = (rule_id >= 0) & valid
+        rid = jnp.where(has_rule, rule_id, -1)
+
+        thr = rt.threshold.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=0.0)
+        grade = rt.grade.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=0)
+        slow = has_rule & (grade == C.DEGRADE_GRADE_RT) & (
+            batch.rt_ms.astype(jnp.float32) > thr
+        )
+        bad = jnp.where(grade == C.DEGRADE_GRADE_RT, slow, err & has_rule)
+
+        cnt = jnp.where(has_rule, batch.count, 0)
+        win = W.row_window_add(win, now_ms, rid, jnp.full((n,), CH_TOTAL), cnt)
+        win = W.row_window_add(win, now_ms, rid, jnp.full((n,), CH_ERROR),
+                               jnp.where(err & has_rule, batch.count, 0))
+        win = W.row_window_add(win, now_ms, rid, jnp.full((n,), CH_SLOW),
+                               jnp.where(slow, batch.count, 0))
+
+        # HALF_OPEN probe verdicts: any completion of the rule votes.
+        st = state.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=-1)
+        on_half = has_rule & (st == C.BREAKER_HALF_OPEN)
+        half_bad = half_bad.at[W.oob(jnp.where(on_half & bad, rule_id, -1), rt.num_rules)].set(True, mode="drop")
+        half_good = half_good.at[W.oob(jnp.where(on_half & ~bad, rule_id, -1), rt.num_rules)].set(True, mode="drop")
+
+    # --- rule-axis transitions -------------------------------------------
+    totals = W.row_window_totals(win, jnp.arange(rt.num_rules))  # [DR, 3]
+    total = totals[:, CH_TOTAL].astype(jnp.float32)
+    error = totals[:, CH_ERROR].astype(jnp.float32)
+    slowc = totals[:, CH_SLOW].astype(jnp.float32)
+    enough = totals[:, CH_TOTAL] >= rt.min_request
+
+    # Strictly-greater comparisons per the reference breakers; the slow-call
+    # breaker additionally trips at ratio == threshold when threshold is 1.0
+    # (a 100% threshold would otherwise never fire).
+    ratio_den = jnp.maximum(total, 1.0)
+    slow_r = slowc / ratio_den
+    err_r = error / ratio_den
+    trip_slow = (slow_r > rt.slow_ratio) | ((rt.slow_ratio >= 1.0) & (slow_r >= 1.0))
+    trip = jnp.where(rt.grade == C.DEGRADE_GRADE_RT, trip_slow, err_r > rt.threshold)
+    trip = jnp.where(rt.grade == C.DEGRADE_GRADE_EXCEPTION_COUNT, error > rt.threshold, trip)
+    trip = trip & enough
+
+    is_closed = state == C.BREAKER_CLOSED
+    is_half = state == C.BREAKER_HALF_OPEN
+
+    # HALF_OPEN verdict: bad wins over good.
+    to_open = (is_closed & trip) | (is_half & half_bad)
+    to_closed = is_half & half_good & (~half_bad)
+
+    state = jnp.where(to_open, C.BREAKER_OPEN, state)
+    state = jnp.where(to_closed, C.BREAKER_CLOSED, state)
+    next_retry = jnp.where(to_open, now_ms + rt.time_window_ms, next_retry)
+
+    # Closing resets the breaker's stats window (reference: resetStat()).
+    win = win._replace(
+        counts=jnp.where(to_closed[:, None, None], 0, win.counts)
+    )
+    return DegradeState(state=state, next_retry_ms=next_retry, win=win)

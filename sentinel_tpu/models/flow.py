@@ -42,6 +42,7 @@ from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.segment import segmented_prefix
+from sentinel_tpu.utils.shapes import round_up as _round_up
 
 
 # ---------------------------------------------------------------------------
@@ -122,10 +123,6 @@ def make_flow_state(num_rules: int, now_ms: int) -> FlowState:
         last_filled_ms=jnp.zeros((num_rules,), jnp.int64),
         latest_passed_us=jnp.zeros((num_rules,), jnp.int64),
     )
-
-
-def _round_up(n: int, m: int) -> int:
-    return ((max(n, 1) + m - 1) // m) * m
 
 
 def compile_flow_rules(

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.batch import Decisions, EntryBatch, ExitBatch
 from sentinel_tpu.core.registry import ENTRY_ROW
+from sentinel_tpu.models import degrade as D
 from sentinel_tpu.models import flow as F
 from sentinel_tpu.ops import window as W
 
@@ -42,20 +43,27 @@ class SentinelState(NamedTuple):
     w60: W.Window         # 60s / 60-bucket window (metric log source)
     cur_threads: jax.Array  # int32[R] live concurrency gauge per row
     flow: F.FlowState
+    degrade: D.DegradeState
 
 
 class RulePack(NamedTuple):
     """All compiled rule tensors (host-rebuilt wholesale on config push)."""
 
     flow: F.FlowRuleTensors
+    degrade: D.DegradeRuleTensors
 
 
-def make_state(num_rows: int, flow_rules: int, now_ms: int) -> SentinelState:
+def make_state(num_rows: int, flow_rules: int, now_ms: int,
+               degrade: D.DegradeState = None) -> SentinelState:
+    if degrade is None:
+        dt, di = D.compile_degrade_rules([], None, num_rows)
+        degrade = D.make_degrade_state(dt, di)
     return SentinelState(
         w1=W.make_window(num_rows, SPEC_1S),
         w60=W.make_window(num_rows, SPEC_60S),
         cur_threads=jnp.zeros((num_rows,), jnp.int32),
         flow=F.make_flow_state(flow_rules, now_ms),
+        degrade=degrade,
     )
 
 
@@ -96,6 +104,10 @@ def entry_step(
     reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
     blocked = blocked | fv.blocked
 
+    dv = D.check_degrade(rules.degrade, state.degrade, batch, now_ms, valid & (~blocked))
+    reason = jnp.where(valid & (~blocked) & dv.blocked, C.BlockReason.DEGRADE, reason)
+    blocked = blocked | dv.blocked
+
     # --- StatisticSlot commit --------------------------------------------
     rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
     admit = valid & (~blocked)
@@ -116,7 +128,8 @@ def entry_step(
 
     wait_us = jnp.where(admit, fv.wait_us, 0)
 
-    new_state = SentinelState(w1=w1, w60=w60, cur_threads=cur_threads, flow=fv.state)
+    new_state = SentinelState(w1=w1, w60=w60, cur_threads=cur_threads,
+                              flow=fv.state, degrade=dv.state)
     return new_state, Decisions(reason=reason, wait_us=wait_us)
 
 
@@ -162,4 +175,6 @@ def exit_step(
         W.oob(rows4.reshape(-1), state.cur_threads.shape[0])
     ].add(thread_dec, mode="drop")
 
-    return state._replace(w1=w1, w60=w60, cur_threads=cur_threads)
+    degrade = D.feed_degrade(rules.degrade, state.degrade, batch, now_ms)
+
+    return state._replace(w1=w1, w60=w60, cur_threads=cur_threads, degrade=degrade)

@@ -57,10 +57,12 @@ def _expand0(tree):
     return jax.tree.map(lambda x: x[None], tree)
 
 
-def make_pod_state(n_devices: int, capacity: int, flow_rules: int,
-                   now_ms: int) -> S.SentinelState:
-    """Per-device replicated-structure state: leaves shaped [D, ...]."""
-    one = S.make_state(capacity, flow_rules, now_ms)
+def make_pod_state(n_devices: int, one: S.SentinelState) -> S.SentinelState:
+    """Per-device replicated-structure state: leaves shaped [D, ...].
+
+    ``one`` is a freshly built single-device state whose geometry matches
+    the rule pack (same capacity / rule counts on every device).
+    """
     return jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (n_devices,) + x.shape), one
     )
@@ -76,9 +78,13 @@ def global_pass_counts(w1: W.Window, axis: str) -> Tuple[jax.Array, jax.Array]:
 def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
                now_ms: jax.Array, *, axis: str) -> Tuple[S.SentinelState, Decisions]:
     local = _squeeze0(state)
+    now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(local.w1, now_ms, S.SPEC_1S)
     extra_pass, _ = global_pass_counts(w1, axis)
-    new_local, dec = S.entry_step(local, rules, batch, now_ms, extra_pass=extra_pass)
+    # Hand the rotated window through so entry_step's own rotate hits the
+    # cheap restamp branch instead of re-sweeping the counts tensor.
+    new_local, dec = S.entry_step(local._replace(w1=w1), rules, batch, now_ms,
+                                  extra_pass=extra_pass)
     return _expand0(new_local), dec
 
 

@@ -1,0 +1,164 @@
+"""Circuit-breaker (degrade rule) state-machine tests.
+
+Reference semantics (SURVEY.md §2.1, 1.8 breaker): CLOSED → OPEN on
+threshold breach (after minRequestAmount), blocked while OPEN, one probe
+admitted after timeWindow (→ HALF_OPEN), probe outcome decides CLOSED vs
+re-OPEN. Deterministic via the frozen clock.
+"""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+
+
+def _hit(resource, error=False, rt_ms=0, tick=None):
+    """One entry/exit cycle; returns True if admitted."""
+    from sentinel_tpu.utils import time_util
+    try:
+        h = st.entry(resource)
+    except st.DegradeException:
+        return False
+    if error:
+        h.trace(ValueError("boom"))
+    if rt_ms and tick:
+        time_util.advance_time(rt_ms)
+    h.exit()
+    return True
+
+
+def test_exception_ratio_opens_and_recovers(engine, frozen_time):
+    st.load_degrade_rules([
+        st.DegradeRule(resource="er", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                       count=0.5, time_window=5, min_request_amount=5),
+    ])
+    # 5 requests, 4 errors -> ratio 0.8 > 0.5 -> OPEN after the 5th exit.
+    for i in range(5):
+        assert _hit("er", error=(i < 4))
+    assert not _hit("er"), "breaker must be OPEN"
+    # Still blocked before the retry window elapses.
+    frozen_time.advance_time(4_000)
+    assert not _hit("er")
+    # After timeWindow: one probe admitted; success -> CLOSED.
+    frozen_time.advance_time(1_001)
+    assert _hit("er", error=False)
+    assert _hit("er"), "breaker must be CLOSED after good probe"
+
+
+def test_probe_failure_reopens(engine, frozen_time):
+    st.load_degrade_rules([
+        st.DegradeRule(resource="pf", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                       count=0.5, time_window=2, min_request_amount=5),
+    ])
+    for i in range(5):
+        _hit("pf", error=True)
+    assert not _hit("pf")
+    frozen_time.advance_time(2_001)
+    assert _hit("pf", error=True), "probe admitted"
+    # Bad probe -> immediately OPEN again with a fresh window.
+    assert not _hit("pf")
+    frozen_time.advance_time(1_500)
+    assert not _hit("pf"), "fresh retry window must apply"
+    frozen_time.advance_time(501)
+    assert _hit("pf", error=False)
+
+
+def test_min_request_amount_gates(engine, frozen_time):
+    st.load_degrade_rules([
+        st.DegradeRule(resource="mr", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                       count=0.1, time_window=5, min_request_amount=10),
+    ])
+    for _ in range(9):
+        assert _hit("mr", error=True), "below minRequestAmount: no trip"
+
+
+def test_exception_count_grade(engine, frozen_time):
+    st.load_degrade_rules([
+        st.DegradeRule(resource="ec", grade=C.DEGRADE_GRADE_EXCEPTION_COUNT,
+                       count=3, time_window=5, min_request_amount=1),
+    ])
+    for i in range(4):
+        assert _hit("ec", error=True)
+    # 4 errors > 3 -> OPEN.
+    assert not _hit("ec")
+
+
+def test_slow_call_ratio_grade(engine, frozen_time):
+    st.load_degrade_rules([
+        st.DegradeRule(resource="sl", grade=C.DEGRADE_GRADE_RT, count=100,
+                       slow_ratio_threshold=0.5, time_window=5,
+                       min_request_amount=4),
+    ])
+    # 4 requests: 3 slow (rt 200ms each) + 1 fast -> ratio 0.75 > 0.5.
+    for i in range(4):
+        h = st.entry("sl")
+        if i < 3:
+            frozen_time.advance_time(200)
+        h.exit()
+    assert not _hit("sl"), "slow-ratio breaker must be OPEN"
+
+
+def test_stat_interval_window_expires(engine, frozen_time):
+    """Errors older than statIntervalMs must not count toward the trip."""
+    st.load_degrade_rules([
+        st.DegradeRule(resource="wi", grade=C.DEGRADE_GRADE_EXCEPTION_COUNT,
+                       count=5, time_window=5, min_request_amount=1,
+                       stat_interval_ms=1000),
+    ])
+    for _ in range(4):
+        assert _hit("wi", error=True)
+    frozen_time.advance_time(1_100)  # tumbling bucket rolls over
+    for _ in range(4):
+        assert _hit("wi", error=True), "old errors must have expired"
+
+
+def test_degrade_blocks_do_not_count_as_errors(engine, frozen_time):
+    """A DegradeException is a block, not a business error: blocked calls
+    must not feed the breaker window (reference: Tracer ignores
+    BlockException)."""
+    st.load_degrade_rules([
+        st.DegradeRule(resource="nb", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                       count=0.5, time_window=3, min_request_amount=5),
+    ])
+    for _ in range(5):
+        _hit("nb", error=True)
+    for _ in range(10):
+        assert not _hit("nb")
+    snap = engine.node_snapshot()
+    assert snap["nb"]["blockQps"] >= 10
+
+
+def test_flow_rule_push_preserves_breaker_state(engine, frozen_time):
+    st.load_degrade_rules([
+        st.DegradeRule(resource="kp", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                       count=0.5, time_window=60, min_request_amount=5),
+    ])
+    for _ in range(5):
+        _hit("kp", error=True)
+    assert not _hit("kp")
+    st.load_flow_rules([st.FlowRule(resource="other", count=100)])
+    assert not _hit("kp"), "flow push must not reset an OPEN breaker"
+
+
+def test_blocked_probe_reverts_to_open(engine, frozen_time):
+    """Two OPEN breakers with different retry windows: rule A's probe gets
+    blocked by rule B, so A must revert HALF_OPEN -> OPEN (the stuck-probe
+    hazard of alibaba/Sentinel#1638) and recover once B's window elapses."""
+    st.load_degrade_rules([
+        st.DegradeRule(resource="tp", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                       count=0.5, time_window=1, min_request_amount=5),
+        st.DegradeRule(resource="tp", grade=C.DEGRADE_GRADE_EXCEPTION_COUNT,
+                       count=3, time_window=60, min_request_amount=1),
+    ])
+    for _ in range(5):
+        _hit("tp", error=True)
+    assert not _hit("tp"), "both breakers OPEN"
+    # A's window elapses; its probe is blocked by B (60s window).
+    frozen_time.advance_time(1_100)
+    assert not _hit("tp")
+    # A must NOT be stuck HALF_OPEN: further attempts keep probing A and
+    # keep being blocked by B, never deadlocked.
+    import numpy as np
+    state = np.asarray(engine._state.degrade.state)
+    assert C.BREAKER_HALF_OPEN not in state[:2], state
