@@ -42,18 +42,35 @@ def main() -> None:
         D.DegradeRule(resource=f"res{i}", count=100, grade=i % 3, time_window=10)
         for i in range(0, n_resources, 20)  # every 20th resource breakered
     ]
+    from sentinel_tpu.models import authority as A
+    from sentinel_tpu.models import param_flow as P
+    from sentinel_tpu.models import system as Y
+
+    param_rules = [
+        P.ParamFlowRule(f"res{i}", param_idx=0, count=1e9)
+        for i in range(0, n_resources, 40)  # every 40th resource param-ruled
+    ]
     rows = np.asarray([reg.cluster_row(f"res{i}") for i in range(n_resources)])
     ft, _ = F.compile_flow_rules(rules, reg, capacity)
     dt, di = D.compile_degrade_rules(degrade_rules, reg, capacity)
-    pack = S.RulePack(flow=ft, degrade=dt)
+    pt = P.compile_param_rules(param_rules, reg, capacity)
+    pack = S.RulePack(
+        flow=ft, degrade=dt,
+        authority=A.compile_authority_rules([], reg, capacity),
+        system=Y.compile_system_rules([Y.SystemRule(qps=1e12)]),
+        param=pt,
+    )
     state = S.make_state(capacity, ft.num_rules, now0,
-                         degrade=D.make_degrade_state(dt, di))
+                         degrade=D.make_degrade_state(dt, di),
+                         param=P.make_param_state(pt.num_rules))
 
     rng = np.random.default_rng(0)
     buf = make_entry_batch_np(batch_n)
     buf["cluster_row"][:] = rows[rng.integers(0, n_resources, size=batch_n)]
     buf["dn_row"][:] = buf["cluster_row"]
     buf["count"][:] = 1
+    buf["param_hash"][:, 0] = rng.integers(1, 1 << 31, size=batch_n)
+    buf["param_present"][:, 0] = True
     batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
 
     step = jax.jit(S.entry_step, donate_argnums=(0,))

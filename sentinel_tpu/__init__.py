@@ -49,8 +49,11 @@ from sentinel_tpu.core.exceptions import (
     ParamFlowException,
     SystemBlockException,
 )
+from sentinel_tpu.models.authority import AuthorityRule
 from sentinel_tpu.models.degrade import DegradeRule
 from sentinel_tpu.models.flow import FlowRule
+from sentinel_tpu.models.param_flow import ParamFlowItem, ParamFlowRule
+from sentinel_tpu.models.system import SystemRule
 
 __version__ = "0.1.0"
 
@@ -67,6 +70,8 @@ def get_engine() -> SentinelEngine:
 def reset(capacity: int = 4096) -> SentinelEngine:
     """Tear down and rebuild the default engine (tests)."""
     global _default_engine
+    if _default_engine is not None:
+        _default_engine.close()
     _default_engine = SentinelEngine(capacity)
     return _default_engine
 
@@ -101,11 +106,25 @@ def load_degrade_rules(rules) -> None:
     get_engine().degrade_rules.load_rules(list(rules))
 
 
+def load_authority_rules(rules) -> None:
+    get_engine().authority_rules.load_rules(list(rules))
+
+
+def load_system_rules(rules) -> None:
+    get_engine().system_rules.load_rules(list(rules))
+
+
+def load_param_flow_rules(rules) -> None:
+    get_engine().param_rules.load_rules(list(rules))
+
+
 __all__ = [
-    "AuthorityException", "BlockException", "BlockReason", "DegradeException",
-    "DegradeRule", "EntryHandle", "EntryType", "FlowException", "FlowRule",
-    "MetricEvent", "ParamFlowException", "ResourceType", "SentinelEngine",
-    "SystemBlockException", "constants", "context_enter", "entry", "entry_ok",
-    "exit_context", "get_context", "get_engine", "load_degrade_rules",
-    "load_flow_rules", "reset", "trace",
+    "AuthorityException", "AuthorityRule", "BlockException", "BlockReason",
+    "DegradeException", "DegradeRule", "EntryHandle", "EntryType",
+    "FlowException", "FlowRule", "MetricEvent", "ParamFlowException",
+    "ParamFlowItem", "ParamFlowRule", "ResourceType", "SentinelEngine",
+    "SystemBlockException", "SystemRule", "constants", "context_enter",
+    "entry", "entry_ok", "exit_context", "get_context", "get_engine",
+    "load_authority_rules", "load_degrade_rules", "load_flow_rules",
+    "load_param_flow_rules", "load_system_rules", "reset", "trace",
 ]

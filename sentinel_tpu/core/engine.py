@@ -16,10 +16,8 @@ the same step functions from a background cadence loop.
 
 from __future__ import annotations
 
-import struct
 import threading
 import time
-import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -38,10 +36,14 @@ from sentinel_tpu.core.batch import (
 )
 from sentinel_tpu.core.exceptions import BlockException, exception_for_reason
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
+from sentinel_tpu.models import authority as A
 from sentinel_tpu.models import degrade as D
 from sentinel_tpu.models import flow as F
+from sentinel_tpu.models import param_flow as P
+from sentinel_tpu.models import system as Y
 from sentinel_tpu.ops import step as S
 from sentinel_tpu.utils import time_util
+from sentinel_tpu.utils.param_hash import hash_param as _hash_param
 
 BATCH_WIDTHS = (1, 8, 64, 512, 2048)
 
@@ -106,11 +108,20 @@ class SentinelEngine:
         self.flow_rules.add_listener(lambda: self._mark_dirty("flow"))
         self.degrade_rules = D.DegradeRuleManager()
         self.degrade_rules.add_listener(lambda: self._mark_dirty("degrade"))
+        self.authority_rules = A.AuthorityRuleManager()
+        self.authority_rules.add_listener(lambda: self._mark_dirty("authority"))
+        self.system_rules = Y.SystemRuleManager()
+        self.system_rules.add_listener(lambda: self._mark_dirty("system"))
+        self.param_rules = P.ParamFlowRuleManager()
+        self.param_rules.add_listener(lambda: self._mark_dirty("param"))
+        self.system_status = Y.SystemStatusListener()
+        self._signals_refreshed_ms = 0
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
         self._named_origins: Dict[str, set] = {}
-        self._dirty = {"flow": True, "degrade": True}
+        self._dirty = {"flow": True, "degrade": True, "authority": True,
+                       "system": True, "param": True}
         self._entry_jit = jax.jit(S.entry_step, donate_argnums=(0,))
         self._exit_jit = jax.jit(S.exit_step, donate_argnums=(0,))
 
@@ -134,11 +145,21 @@ class SentinelEngine:
                 self.flow_rules.get_rules(), self.registry, self.capacity)
             dt, di = D.compile_degrade_rules(
                 self.degrade_rules.get_rules(), self.registry, self.capacity)
+            pt = P.compile_param_rules(
+                self.param_rules.get_rules(), self.registry, self.capacity)
             self._named_origins = {r: set(o) for r, o in named.items()}
-            self._rules = S.RulePack(flow=ft, degrade=dt)
+            self._rules = S.RulePack(
+                flow=ft, degrade=dt,
+                authority=A.compile_authority_rules(
+                    self.authority_rules.get_rules(), self.registry, self.capacity),
+                system=Y.compile_system_rules(self.system_rules.get_rules()),
+                param=pt,
+            )
             self._state = S.make_state(self.capacity, ft.num_rules, now,
-                                       degrade=D.make_degrade_state(dt, di))
+                                       degrade=D.make_degrade_state(dt, di),
+                                       param=P.make_param_state(pt.num_rules))
             self._dirty = {k: False for k in self._dirty}
+            self._maybe_start_system_listener()
             return
         if not any(self._dirty.values()):
             return
@@ -156,6 +177,44 @@ class SentinelEngine:
             self._rules = self._rules._replace(degrade=dt)
             self._state = self._state._replace(degrade=D.make_degrade_state(dt, di))
             self._dirty["degrade"] = False
+        if self._dirty["authority"]:
+            self._rules = self._rules._replace(
+                authority=A.compile_authority_rules(
+                    self.authority_rules.get_rules(), self.registry, self.capacity))
+            self._dirty["authority"] = False
+        if self._dirty["system"]:
+            self._rules = self._rules._replace(
+                system=Y.compile_system_rules(self.system_rules.get_rules()))
+            self._dirty["system"] = False
+            self._maybe_start_system_listener()
+        if self._dirty["param"]:
+            pt = P.compile_param_rules(
+                self.param_rules.get_rules(), self.registry, self.capacity)
+            self._rules = self._rules._replace(param=pt)
+            self._state = self._state._replace(param=P.make_param_state(pt.num_rules))
+            self._dirty["param"] = False
+
+    def _maybe_start_system_listener(self):
+        def is_set(v):
+            return v is not None and v >= 0
+
+        if any(
+            is_set(r.highest_system_load) or is_set(r.highest_cpu_usage)
+            for r in self.system_rules.get_rules()
+        ):
+            self.system_status.start()
+
+    def close(self) -> None:
+        """Stop background workers (host OS sampler)."""
+        self.system_status.stop()
+
+    def _refresh_signals(self, now_ms: int) -> None:
+        """Fold the latest host OS sample into device state (≤ 1 Hz)."""
+        if now_ms - self._signals_refreshed_ms < 1000:
+            return
+        self._signals_refreshed_ms = now_ms
+        self._state = self._state._replace(
+            sys_signals=jnp.asarray(self.system_status.snapshot()))
 
     # -- public API --------------------------------------------------------
 
@@ -228,6 +287,7 @@ class SentinelEngine:
                 buf["param_present"][0, i] = True
             batch = EntryBatch(**buf)
             now = time_util.current_time_millis()
+            self._refresh_signals(now)
             self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
             reason = int(dec.reason[0])
             wait = int(dec.wait_us[0])
@@ -268,6 +328,7 @@ class SentinelEngine:
         with self._lock:
             self._ensure_compiled()
             now = now_ms if now_ms is not None else time_util.current_time_millis()
+            self._refresh_signals(now)
             self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
             return dec
 
@@ -308,24 +369,3 @@ def W_rotate_host(win, now_ms, spec):
     return W.rotate(win, jnp.asarray(now_ms, jnp.int64), spec)
 
 
-def _hash_param(value) -> int:
-    """Deterministic 32-bit hash of a hot-param value (CMS key).
-
-    Must agree across processes, hosts, and restarts — pod-level param-flow
-    aggregation compares these hashes — so Python's salted ``hash()`` is
-    off-limits. Type-tagged CRC32 keeps 1, 1.0, "1" and True distinct.
-    """
-    if isinstance(value, bool):
-        data = b"b1" if value else b"b0"
-    elif isinstance(value, int):
-        data = b"i" + str(value).encode()  # unbounded ints
-    elif isinstance(value, float):
-        data = b"f" + struct.pack("<d", value)
-    elif isinstance(value, str):
-        data = b"s" + value.encode("utf-8", "surrogatepass")
-    elif isinstance(value, bytes):
-        data = b"y" + value
-    else:
-        data = b"r" + repr(value).encode("utf-8", "backslashreplace")
-    h = zlib.crc32(data) & 0xFFFFFFFF
-    return h if h != 0 else 1

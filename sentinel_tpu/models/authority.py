@@ -1,0 +1,161 @@
+"""Authority rules: per-resource origin allow/deny lists.
+
+Reference surface (SURVEY.md §2.1 "AuthoritySlot"): ``AuthorityRule``
+(resource, limitApp = comma-separated origin list, strategy WHITE/BLACK),
+``AuthorityRuleManager``, ``AuthorityRuleChecker.passCheck`` — requests with
+an empty origin always pass; WHITE passes iff the origin is listed, BLACK
+passes iff it is not. Upstream paths: ``core:slots/block/authority/``
+(reference mount was empty; citations are upstream-layout paths).
+
+TPU-native design: origins are interned to int ids host-side (the registry
+already does this for per-origin stats rows), so the device check is a
+vectorized membership test of ``batch.origin_id`` against a padded
+``int32[AR, MAX_ORIGINS]`` id table — no strings on device.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import EntryBatch
+from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.utils.shapes import round_up as _round_up
+
+# Origins beyond this many per rule are kept host-side valid but ignored on
+# device; compile_authority_rules widens the table to fit, so this is only
+# the floor.
+MIN_ORIGIN_SLOTS = 4
+
+_NO_ORIGIN = -100  # padding id that never equals a real interned origin
+
+
+@dataclass
+class AuthorityRule:
+    resource: str
+    limit_app: str  # comma-separated origin names
+    strategy: int = C.AUTHORITY_WHITE
+
+    def is_valid(self) -> bool:
+        return bool(self.resource) and bool(self.limit_app) and self.strategy in (
+            C.AUTHORITY_WHITE,
+            C.AUTHORITY_BLACK,
+        )
+
+    def origins(self) -> List[str]:
+        return [o.strip() for o in self.limit_app.split(",") if o.strip()]
+
+
+class AuthorityRuleTensors(NamedTuple):
+    resource_row: jax.Array  # int32[AR]
+    strategy: jax.Array      # int32[AR]
+    origin_ids: jax.Array    # int32[AR, K] padded with _NO_ORIGIN
+    rules_by_row: jax.Array  # int32[R, S] rule ids per ClusterNode row
+
+    @property
+    def num_rules(self) -> int:
+        return self.resource_row.shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.rules_by_row.shape[1]
+
+
+def compile_authority_rules(
+    rules: List[AuthorityRule],
+    registry: NodeRegistry,
+    num_rows: int,
+) -> AuthorityRuleTensors:
+    valid = [r for r in rules if r.is_valid()]
+    ar = _round_up(len(valid), 8)
+    k = max(
+        MIN_ORIGIN_SLOTS,
+        _round_up(max((len(r.origins()) for r in valid), default=1), 4),
+    )
+    res_row = np.full(ar, -1, np.int32)
+    strategy = np.zeros(ar, np.int32)
+    origin_ids = np.full((ar, k), _NO_ORIGIN, np.int32)
+    by_row: Dict[int, List[int]] = {}
+
+    for i, r in enumerate(valid):
+        row = registry.cluster_row(r.resource)
+        res_row[i] = row
+        strategy[i] = r.strategy
+        for j, origin in enumerate(r.origins()[:k]):
+            origin_ids[i, j] = registry.origin_id(origin)
+        if row >= 0:
+            by_row.setdefault(row, []).append(i)
+
+    s = max(1, max((len(v) for v in by_row.values()), default=1))
+    rules_by_row = np.full((num_rows, s), -1, np.int32)
+    for row, ids in by_row.items():
+        rules_by_row[row, : len(ids)] = ids
+
+    return AuthorityRuleTensors(
+        resource_row=jnp.asarray(res_row),
+        strategy=jnp.asarray(strategy),
+        origin_ids=jnp.asarray(origin_ids),
+        rules_by_row=jnp.asarray(rules_by_row),
+    )
+
+
+class AuthorityRuleManager:
+    """Wholesale-swap rule registry (same shape as FlowRuleManager)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rules: List[AuthorityRule] = []
+        self.version = 0
+        self._listeners = []
+
+    def load_rules(self, rules: List[AuthorityRule]) -> None:
+        with self._lock:
+            self._rules = [r for r in rules if r.is_valid()]
+            self.version += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def get_rules(self) -> List[AuthorityRule]:
+        with self._lock:
+            return list(self._rules)
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+
+def check_authority(
+    rt: AuthorityRuleTensors,
+    batch: EntryBatch,
+    candidate: jax.Array,  # bool[N]
+) -> jax.Array:
+    """Vectorized ``AuthorityRuleChecker.passCheck``: bool[N] blocked."""
+    n = batch.size
+    blocked = jnp.zeros((n,), bool)
+    has_origin = batch.origin_id >= 0
+
+    for k in range(rt.slots):
+        rule_id = rt.rules_by_row.at[
+            W.oob(batch.cluster_row, rt.rules_by_row.shape[0]), jnp.full((n,), k)
+        ].get(mode="fill", fill_value=-1)
+        has_rule = rule_id >= 0
+        ids = rt.origin_ids.at[W.oob(rule_id, rt.num_rules)].get(
+            mode="fill", fill_value=_NO_ORIGIN
+        )  # [N, K]
+        member = jnp.any(ids == batch.origin_id[:, None], axis=1) & has_origin
+        strat = rt.strategy.at[W.oob(rule_id, rt.num_rules)].get(
+            mode="fill", fill_value=C.AUTHORITY_WHITE
+        )
+        ok = jnp.where(strat == C.AUTHORITY_WHITE, member, ~member)
+        # Empty-origin requests always pass (reference checker's early out).
+        applicable = has_rule & candidate & has_origin
+        blocked = blocked | (applicable & (~ok))
+
+    return blocked

@@ -1,0 +1,426 @@
+"""Hot-parameter flow rules: per-argument-value token buckets.
+
+Reference surface (SURVEY.md §2.2 "sentinel-parameter-flow-control"):
+``ParamFlowRule`` (paramIdx, grade QPS/THREAD, count, durationInSec,
+burstCount, controlBehavior DEFAULT/RATE_LIMITER, per-value ``ParamFlowItem``
+exceptions, clusterMode), ``ParamFlowRuleManager``, ``ParamFlowChecker``
+(``passDefaultLocalCheck`` token-bucket CAS over ``tokenCounters`` /
+``timeCounters``; ``passThrottleLocalCheck`` per-value leaky bucket;
+LRU-bounded key space via ``CacheMap``). Upstream paths: ``param:…``
+(reference mount was empty; citations are upstream-layout paths).
+
+TPU-native design: instead of per-value concurrent hash maps, each rule owns
+a fixed direct-mapped slot table on device — ``slot = hash(value) % S`` —
+holding the bucket state (owner key, tokens, refill time, thread gauge).
+A new key landing on an occupied slot *evicts* it and starts a fresh bucket,
+which is the tensor analog of the reference's LRU cache bounding the key
+space (an evicted key restarts fresh there too). Distinct hot keys colliding
+in one slot conflate until one wins; with S ≫ hot-key count this is rare and
+bounded (documented semantics delta). Within a micro-batch, arrival-order
+exactness uses the same segmented-prefix machinery as flow rules.
+
+Per-value exception items compile to an exact-match (hash → threshold)
+side table, checked before the rule-wide threshold — matching
+``ParamFlowItem`` semantics for the value types our host hash covers.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import EntryBatch, ExitBatch, MAX_PARAMS
+from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops.segment import segmented_prefix
+from sentinel_tpu.utils.shapes import round_up as _round_up
+
+DEFAULT_SLOTS = 2048  # per-rule bucket table width (reference LRU cap: 4000)
+MAX_ITEMS = 8         # per-rule exact-value exception slots
+
+
+@dataclass
+class ParamFlowItem:
+    """Per-value threshold exception (reference: ``ParamFlowItem``)."""
+
+    object: object
+    count: float
+    # class_type is implicit: the host hash is type-tagged (engine._hash_param)
+
+
+@dataclass
+class ParamFlowRule:
+    resource: str
+    param_idx: int
+    count: float
+    grade: int = C.PARAM_FLOW_GRADE_QPS
+    duration_in_sec: int = 1
+    burst_count: int = 0
+    control_behavior: int = C.CONTROL_BEHAVIOR_DEFAULT
+    max_queueing_time_ms: int = 0
+    items: List[ParamFlowItem] = field(default_factory=list)
+    cluster_mode: bool = False
+    cluster_config: Optional[dict] = None
+
+    def is_valid(self) -> bool:
+        if not self.resource or self.count < 0 or self.duration_in_sec <= 0:
+            return False
+        if not (0 <= self.param_idx < MAX_PARAMS):
+            return False
+        if self.grade not in (C.PARAM_FLOW_GRADE_QPS, C.PARAM_FLOW_GRADE_THREAD):
+            return False
+        if self.control_behavior not in (
+            C.CONTROL_BEHAVIOR_DEFAULT, C.CONTROL_BEHAVIOR_RATE_LIMITER
+        ):
+            return False
+        return True
+
+
+class ParamRuleTensors(NamedTuple):
+    resource_row: jax.Array  # int32[PR]
+    param_idx: jax.Array     # int32[PR]
+    grade: jax.Array         # int32[PR]
+    threshold: jax.Array     # float32[PR]
+    duration_ms: jax.Array   # int64[PR]
+    burst: jax.Array         # float32[PR]
+    behavior: jax.Array      # int32[PR]
+    max_queue_us: jax.Array  # int64[PR]
+    item_hash: jax.Array     # uint32[PR, MAX_ITEMS] 0 = empty
+    item_count: jax.Array    # float32[PR, MAX_ITEMS]
+    cluster_mode: jax.Array  # bool[PR]
+    rules_by_row: jax.Array  # int32[R, K]
+
+    @property
+    def num_rules(self) -> int:
+        return self.resource_row.shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.rules_by_row.shape[1]
+
+
+class ParamFlowState(NamedTuple):
+    """Per-(rule, hash-slot) bucket table (re-created on rule load)."""
+
+    key: jax.Array        # uint32[PR, S] owner param hash, 0 = empty
+    tokens: jax.Array     # float32[PR, S] remaining tokens (QPS/default)
+    filled_ms: jax.Array  # int64[PR, S] last refill time
+    passed_us: jax.Array  # int64[PR, S] throttle-mode leaky-bucket head
+    threads: jax.Array    # int32[PR, S] concurrency gauge (THREAD grade)
+
+
+def make_param_state(num_rules: int, table_slots: int = DEFAULT_SLOTS) -> ParamFlowState:
+    pr, s = num_rules, table_slots
+    return ParamFlowState(
+        key=jnp.zeros((pr, s), jnp.uint32),
+        tokens=jnp.zeros((pr, s), jnp.float32),
+        filled_ms=jnp.zeros((pr, s), jnp.int64),
+        passed_us=jnp.zeros((pr, s), jnp.int64),
+        threads=jnp.zeros((pr, s), jnp.int32),
+    )
+
+
+def compile_param_rules(
+    rules: List["ParamFlowRule"],
+    registry: NodeRegistry,
+    num_rows: int,
+    hash_fn=None,
+) -> ParamRuleTensors:
+    from sentinel_tpu.utils.param_hash import hash_param
+
+    hash_fn = hash_fn or hash_param
+    valid = [r for r in rules if r.is_valid()]
+    pr = _round_up(len(valid), 8)
+    res_row = np.full(pr, -1, np.int32)
+    param_idx = np.zeros(pr, np.int32)
+    grade = np.zeros(pr, np.int32)
+    threshold = np.zeros(pr, np.float32)
+    duration_ms = np.full(pr, 1000, np.int64)
+    burst = np.zeros(pr, np.float32)
+    behavior = np.zeros(pr, np.int32)
+    max_queue_us = np.zeros(pr, np.int64)
+    item_hash = np.zeros((pr, MAX_ITEMS), np.uint32)
+    item_count = np.zeros((pr, MAX_ITEMS), np.float32)
+    cluster_mode = np.zeros(pr, bool)
+    by_row: Dict[int, List[int]] = {}
+
+    for i, r in enumerate(valid):
+        row = registry.cluster_row(r.resource)
+        res_row[i] = row
+        param_idx[i] = r.param_idx
+        grade[i] = r.grade
+        threshold[i] = r.count
+        duration_ms[i] = r.duration_in_sec * 1000
+        burst[i] = r.burst_count
+        behavior[i] = r.control_behavior
+        max_queue_us[i] = r.max_queueing_time_ms * 1000
+        cluster_mode[i] = r.cluster_mode
+        for j, item in enumerate(r.items[:MAX_ITEMS]):
+            item_hash[i, j] = hash_fn(item.object)
+            item_count[i, j] = item.count
+        if row >= 0:
+            by_row.setdefault(row, []).append(i)
+
+    k = max(1, max((len(v) for v in by_row.values()), default=1))
+    rules_by_row = np.full((num_rows, k), -1, np.int32)
+    for row, ids in by_row.items():
+        rules_by_row[row, : len(ids)] = ids
+
+    return ParamRuleTensors(
+        resource_row=jnp.asarray(res_row),
+        param_idx=jnp.asarray(param_idx),
+        grade=jnp.asarray(grade),
+        threshold=jnp.asarray(threshold),
+        duration_ms=jnp.asarray(duration_ms),
+        burst=jnp.asarray(burst),
+        behavior=jnp.asarray(behavior),
+        max_queue_us=jnp.asarray(max_queue_us),
+        item_hash=jnp.asarray(item_hash),
+        item_count=jnp.asarray(item_count),
+        cluster_mode=jnp.asarray(cluster_mode),
+        rules_by_row=jnp.asarray(rules_by_row),
+    )
+
+
+class ParamFlowRuleManager:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rules: List[ParamFlowRule] = []
+        self.version = 0
+        self._listeners = []
+
+    def load_rules(self, rules: List[ParamFlowRule]) -> None:
+        with self._lock:
+            self._rules = [r for r in rules if r.is_valid()]
+            self.version += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def get_rules(self) -> List[ParamFlowRule]:
+        with self._lock:
+            return list(self._rules)
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+
+class ParamVerdict(NamedTuple):
+    blocked: jax.Array  # bool[N]
+    wait_us: jax.Array  # int64[N] throttle-mode sleep-then-pass
+    state: ParamFlowState
+
+
+def _gather1(arr, idx, fill):
+    return arr.at[W.oob(idx, arr.shape[0])].get(mode="fill", fill_value=fill)
+
+
+def _gather2(arr, r, s, fill):
+    ok = (r >= 0) & (r < arr.shape[0])
+    return jnp.where(ok, arr[jnp.where(ok, r, 0), s], jnp.asarray(fill, arr.dtype))
+
+
+def check_param_flow(
+    rt: ParamRuleTensors,
+    ps: ParamFlowState,
+    batch: EntryBatch,
+    now_ms: jax.Array,
+    candidate: jax.Array,     # bool[N]
+) -> ParamVerdict:
+    """Vectorized ``ParamFlowChecker.passLocalCheck`` over the micro-batch.
+
+    Two evaluation passes (same convention as check_flow): pass 1 computes
+    verdicts with every candidate consuming bucket prefixes; pass 2
+    restricts prefixes to pass-1 survivors and commits bucket state.
+    """
+    pass1 = _eval_param(rt, ps, batch, now_ms, candidate,
+                        survivors=candidate, commit=False)
+    return _eval_param(rt, ps, batch, now_ms, candidate,
+                       survivors=candidate & (~pass1.blocked), commit=True)
+
+
+def _eval_param(
+    rt: ParamRuleTensors,
+    ps: ParamFlowState,
+    batch: EntryBatch,
+    now_ms: jax.Array,
+    candidate: jax.Array,
+    survivors: jax.Array,
+    commit: bool,
+) -> ParamVerdict:
+    n = batch.size
+    table_slots = ps.key.shape[1]
+
+    blocked = jnp.zeros((n,), bool)
+    wait_us = jnp.zeros((n,), jnp.int64)
+    now_us = now_ms.astype(jnp.int64) * 1000
+
+    for k in range(rt.slots):
+        rule_id = rt.rules_by_row.at[
+            W.oob(batch.cluster_row, rt.rules_by_row.shape[0]), jnp.full((n,), k)
+        ].get(mode="fill", fill_value=-1)
+        has_rule = rule_id >= 0
+        g = lambda a, fill=0: _gather1(a, rule_id, fill)
+
+        pidx = g(rt.param_idx)
+        pv_hash = jnp.take_along_axis(batch.param_hash, pidx[:, None], axis=1)[:, 0]
+        pv_present = jnp.take_along_axis(batch.param_present, pidx[:, None], axis=1)[:, 0]
+        applicable = has_rule & candidate & pv_present
+
+        # Per-value exception items (exact hash match) override the rule count.
+        items_h = rt.item_hash.at[W.oob(rule_id, rt.num_rules)].get(
+            mode="fill", fill_value=0
+        )  # [N, MAX_ITEMS]
+        items_c = rt.item_count.at[W.oob(rule_id, rt.num_rules)].get(
+            mode="fill", fill_value=0.0
+        )
+        item_match = (items_h == pv_hash[:, None]) & (items_h != 0)
+        has_item = jnp.any(item_match, axis=1)
+        item_thr = jnp.max(jnp.where(item_match, items_c, -1.0), axis=1)
+        thr = jnp.where(has_item, item_thr, g(rt.threshold, 0.0))
+
+        slot = (pv_hash % jnp.uint32(table_slots)).astype(jnp.int32)
+        srule = jnp.where(applicable, rule_id, -1)
+        stored_key = _gather2(ps.key, srule, slot, 0)
+        fresh = (stored_key != pv_hash)  # empty or evicted -> full bucket
+
+        grade = g(rt.grade)
+        behavior = g(rt.behavior)
+        dur_ms = g(rt.duration_ms, 1000).astype(jnp.int64)
+        max_count = thr + g(rt.burst, 0.0)
+
+        # Group identity for within-batch sequencing: same (rule, slot).
+        gid = jnp.where(applicable, rule_id * table_slots + slot, -1)
+        acq = jnp.where(survivors & applicable, batch.count, 0)
+        tok_prefix, _ = segmented_prefix(gid, acq)
+        ent_prefix, _ = segmented_prefix(gid, jnp.where(survivors & applicable, 1, 0))
+
+        # --- QPS / DEFAULT: windowed token bucket (passDefaultLocalCheck)
+        stored_tokens = _gather2(ps.tokens, srule, slot, 0.0)
+        filled = _gather2(ps.filled_ms, srule, slot, 0)
+        windows = jnp.maximum((now_ms.astype(jnp.int64) - filled) // jnp.maximum(dur_ms, 1), 0)
+        refilled = jnp.minimum(
+            stored_tokens + windows.astype(jnp.float32) * thr, max_count
+        )
+        avail = jnp.where(fresh, max_count, refilled)
+        acqf = batch.count.astype(jnp.float32)
+        qps_ok = (thr > 0) & (tok_prefix.astype(jnp.float32) + acqf <= avail)
+
+        # --- THREAD: concurrency gauge per value
+        gauge = _gather2(ps.threads, srule, slot, 0)
+        gauge = jnp.where(fresh, 0, gauge)
+        thread_ok = (thr > 0) & (
+            gauge.astype(jnp.float32) + ent_prefix.astype(jnp.float32) + 1.0 <= thr
+        )
+
+        # --- RATE_LIMITER (passThrottleLocalCheck): per-value leaky bucket,
+        # cost = duration / threshold per token.
+        cost_us = jnp.where(
+            thr > 0,
+            (dur_ms.astype(jnp.float32) * 1000.0 / jnp.maximum(thr, 1e-9)),
+            jnp.float32(1e18),
+        ).astype(jnp.int64)
+        head0 = _gather2(ps.passed_us, srule, slot, 0)
+        head0 = jnp.where(fresh, 0, head0)
+        latest = jnp.maximum(head0, now_us - cost_us)
+        expected = latest + (tok_prefix + batch.count).astype(jnp.int64) * cost_us
+        rl_wait = jnp.maximum(expected - now_us, 0)
+        rl_ok = (thr > 0) & (rl_wait <= g(rt.max_queue_us, 0))
+
+        is_thread = grade == C.PARAM_FLOW_GRADE_THREAD
+        is_rl = (~is_thread) & (behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER)
+        ok = jnp.where(is_thread, thread_ok, jnp.where(is_rl, rl_ok, qps_ok))
+
+        slot_blocked = applicable & (~ok)
+        blocked = blocked | slot_blocked
+        admitted = applicable & ok & survivors
+        wait_us = jnp.maximum(wait_us, jnp.where(admitted & is_rl, rl_wait, 0))
+
+        if commit:
+            ridx = W.oob(jnp.where(admitted | (applicable & fresh), srule, -1), ps.key.shape[0])
+            # Claim slot ownership (last writer wins on rare collisions) and
+            # stamp refill time for fresh/refilled buckets.
+            ps = ps._replace(
+                key=ps.key.at[ridx, slot].set(pv_hash, mode="drop"),
+            )
+            need_stamp = applicable & (windows >= 1) & (~is_thread) & (~is_rl)
+            tidx = W.oob(jnp.where(need_stamp | (applicable & fresh), srule, -1), ps.key.shape[0])
+            ps = ps._replace(
+                filled_ms=ps.filled_ms.at[tidx, slot].set(
+                    now_ms.astype(jnp.int64), mode="drop"
+                )
+            )
+            # Default-mode token accounting: set bucket to its refilled level
+            # once, then subtract every admitted acquire (scatter-add handles
+            # duplicates within the batch).
+            dflt = applicable & (~is_thread) & (~is_rl)
+            didx = W.oob(jnp.where(dflt, srule, -1), ps.key.shape[0])
+            tokens = ps.tokens.at[didx, slot].set(avail, mode="drop")
+            tokens = tokens.at[
+                W.oob(jnp.where(admitted & (~is_thread) & (~is_rl), srule, -1), ps.key.shape[0]),
+                slot,
+            ].add(-acqf, mode="drop")
+            ps = ps._replace(tokens=jnp.maximum(tokens, 0.0))
+            # Throttle-mode head advance: head' = latest + consumed · cost.
+            # Evicted slots first drop their stale head so .max starts fresh.
+            fresh_rl = W.oob(
+                jnp.where(applicable & is_rl & fresh, srule, -1), ps.key.shape[0]
+            )
+            passed = ps.passed_us.at[fresh_rl, slot].set(0, mode="drop")
+            rlidx = W.oob(jnp.where(admitted & is_rl, srule, -1), ps.key.shape[0])
+            consumed_after, _ = segmented_prefix(gid, jnp.where(admitted & is_rl, batch.count, 0))
+            last_total = consumed_after + jnp.where(admitted & is_rl, batch.count, 0)
+            new_head = latest + last_total.astype(jnp.int64) * cost_us
+            ps = ps._replace(
+                passed_us=passed.at[rlidx, slot].max(new_head, mode="drop")
+            )
+            # Thread gauge: reset evicted buckets, then increment admits.
+            thidx = W.oob(jnp.where(applicable & fresh & is_thread, srule, -1), ps.key.shape[0])
+            threads = ps.threads.at[thidx, slot].set(0, mode="drop")
+            threads = threads.at[
+                W.oob(jnp.where(admitted & is_thread, srule, -1), ps.key.shape[0]), slot
+            ].add(1, mode="drop")
+            ps = ps._replace(threads=threads)
+
+    return ParamVerdict(blocked=blocked, wait_us=wait_us, state=ps)
+
+
+def feed_param_exit(
+    rt: ParamRuleTensors,
+    ps: ParamFlowState,
+    batch: ExitBatch,
+) -> ParamFlowState:
+    """Decrement THREAD-grade gauges on completion (exit callback analog)."""
+    n = batch.cluster_row.shape[0]
+    table_slots = ps.key.shape[1]
+    valid = batch.cluster_row >= 0
+
+    for k in range(rt.slots):
+        rule_id = rt.rules_by_row.at[
+            W.oob(batch.cluster_row, rt.rules_by_row.shape[0]), jnp.full((n,), k)
+        ].get(mode="fill", fill_value=-1)
+        has_rule = rule_id >= 0
+        grade = _gather1(rt.grade, rule_id, 0)
+        pidx = _gather1(rt.param_idx, rule_id, 0)
+        pv_hash = jnp.take_along_axis(batch.param_hash, pidx[:, None], axis=1)[:, 0]
+        pv_present = jnp.take_along_axis(batch.param_present, pidx[:, None], axis=1)[:, 0]
+        slot = (pv_hash % jnp.uint32(table_slots)).astype(jnp.int32)
+        # Only decrement buckets this value still owns (evicted keys already
+        # had their gauge reset).
+        stored_key = _gather2(ps.key, jnp.where(has_rule, rule_id, -1), slot, 0)
+        dec = (
+            valid & has_rule & pv_present
+            & (grade == C.PARAM_FLOW_GRADE_THREAD) & (stored_key == pv_hash)
+        )
+        ridx = W.oob(jnp.where(dec, rule_id, -1), ps.key.shape[0])
+        threads = ps.threads.at[ridx, slot].add(
+            jnp.where(dec, -1, 0), mode="drop"
+        )
+        ps = ps._replace(threads=jnp.maximum(threads, 0))
+    return ps

@@ -28,8 +28,11 @@ import jax.numpy as jnp
 from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.batch import Decisions, EntryBatch, ExitBatch
 from sentinel_tpu.core.registry import ENTRY_ROW
+from sentinel_tpu.models import authority as A
 from sentinel_tpu.models import degrade as D
 from sentinel_tpu.models import flow as F
+from sentinel_tpu.models import param_flow as P
+from sentinel_tpu.models import system as Y
 from sentinel_tpu.ops import window as W
 
 SPEC_1S = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
@@ -44,6 +47,8 @@ class SentinelState(NamedTuple):
     cur_threads: jax.Array  # int32[R] live concurrency gauge per row
     flow: F.FlowState
     degrade: D.DegradeState
+    param: P.ParamFlowState
+    sys_signals: jax.Array  # f32[2] host-sampled [load1, cpu_usage]
 
 
 class RulePack(NamedTuple):
@@ -51,19 +56,27 @@ class RulePack(NamedTuple):
 
     flow: F.FlowRuleTensors
     degrade: D.DegradeRuleTensors
+    authority: A.AuthorityRuleTensors
+    system: Y.SystemRuleTensors
+    param: P.ParamRuleTensors
 
 
 def make_state(num_rows: int, flow_rules: int, now_ms: int,
-               degrade: D.DegradeState = None) -> SentinelState:
+               degrade: D.DegradeState = None,
+               param: P.ParamFlowState = None) -> SentinelState:
     if degrade is None:
         dt, di = D.compile_degrade_rules([], None, num_rows)
         degrade = D.make_degrade_state(dt, di)
+    if param is None:
+        param = P.make_param_state(0)
     return SentinelState(
         w1=W.make_window(num_rows, SPEC_1S),
         w60=W.make_window(num_rows, SPEC_60S),
         cur_threads=jnp.zeros((num_rows,), jnp.int32),
         flow=F.make_flow_state(flow_rules, now_ms),
         degrade=degrade,
+        param=param,
+        sys_signals=jnp.full((Y.NUM_SIGNALS,), -1.0, jnp.float32),
     )
 
 
@@ -98,7 +111,23 @@ def entry_step(
     reason = jnp.where(valid, C.BlockReason.PASS, -1).astype(jnp.int32)
     blocked = jnp.zeros((batch.size,), bool)
 
-    # --- rule slots (order mirrors the reference chain) -------------------
+    # --- rule slots (order mirrors the reference chain: authority →
+    # system → param-flow → flow → degrade) --------------------------------
+    auth_blocked = A.check_authority(rules.authority, batch, valid & (~blocked))
+    reason = jnp.where(valid & (~blocked) & auth_blocked, C.BlockReason.AUTHORITY, reason)
+    blocked = blocked | auth_blocked
+
+    cand = valid & (~blocked)
+    sys_blocked = Y.check_system(rules.system, state.sys_signals, w1, w60,
+                                 state.cur_threads, batch, cand)
+    reason = jnp.where(cand & sys_blocked, C.BlockReason.SYSTEM, reason)
+    blocked = blocked | sys_blocked
+
+    cand = valid & (~blocked)
+    pv = P.check_param_flow(rules.param, state.param, batch, now_ms, cand)
+    reason = jnp.where(cand & pv.blocked, C.BlockReason.PARAM_FLOW, reason)
+    blocked = blocked | pv.blocked
+
     fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked,
                       extra_pass=extra_pass)
     reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
@@ -126,10 +155,11 @@ def entry_step(
         W.oob(rows4.reshape(-1), state.cur_threads.shape[0])
     ].add(thread_inc, mode="drop")
 
-    wait_us = jnp.where(admit, fv.wait_us, 0)
+    wait_us = jnp.where(admit, jnp.maximum(fv.wait_us, pv.wait_us), 0)
 
     new_state = SentinelState(w1=w1, w60=w60, cur_threads=cur_threads,
-                              flow=fv.state, degrade=dv.state)
+                              flow=fv.state, degrade=dv.state, param=pv.state,
+                              sys_signals=state.sys_signals)
     return new_state, Decisions(reason=reason, wait_us=wait_us)
 
 
@@ -176,5 +206,7 @@ def exit_step(
     ].add(thread_dec, mode="drop")
 
     degrade = D.feed_degrade(rules.degrade, state.degrade, batch, now_ms)
+    param = P.feed_param_exit(rules.param, state.param, batch)
 
-    return state._replace(w1=w1, w60=w60, cur_threads=cur_threads, degrade=degrade)
+    return state._replace(w1=w1, w60=w60, cur_threads=cur_threads,
+                          degrade=degrade, param=param)
