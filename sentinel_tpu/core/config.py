@@ -1,0 +1,165 @@
+"""Layered static configuration (reference: ``core:config/SentinelConfig.java``
++ ``SentinelConfigLoader.java`` — SURVEY.md §5 "Config / flag system").
+
+Reference precedence: JVM ``-Dcsp.sentinel.*`` system properties override a
+``sentinel.properties`` file (classpath or ``csp.sentinel.config.file``).
+Python-native equivalent: environment variables (both the literal dotted key
+and the ``CSP_SENTINEL_*`` upper-snake form) override a properties file named
+by ``$CSP_SENTINEL_CONFIG_FILE`` (default ``./sentinel.properties``), which
+overrides programmatic ``set_config`` defaults.
+
+Well-known keys keep the reference's exact dotted names so existing ops
+tooling / documentation transfers directly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+# Well-known keys (reference: SentinelConfig constants).
+APP_NAME = "project.name"
+APP_TYPE = "csp.sentinel.app.type"
+CHARSET = "csp.sentinel.charset"
+SINGLE_METRIC_FILE_SIZE = "csp.sentinel.metric.file.single.size"
+TOTAL_METRIC_FILE_COUNT = "csp.sentinel.metric.file.total.count"
+COLD_FACTOR = "csp.sentinel.flow.cold.factor"
+STATISTIC_MAX_RT = "csp.sentinel.statistic.max.rt"
+SPI_CLASSLOADER = "csp.sentinel.spi.classloader"
+LOG_DIR = "csp.sentinel.log.dir"
+LOG_USE_PID = "csp.sentinel.log.use.pid"
+CONFIG_FILE_ENV = "CSP_SENTINEL_CONFIG_FILE"
+DASHBOARD_SERVER = "csp.sentinel.dashboard.server"
+API_PORT = "csp.sentinel.api.port"
+HEARTBEAT_INTERVAL_MS = "csp.sentinel.heartbeat.interval.ms"
+HEARTBEAT_CLIENT_IP = "csp.sentinel.heartbeat.client.ip"
+
+DEFAULT_CHARSET = "utf-8"
+DEFAULT_SINGLE_METRIC_FILE_SIZE = 50 * 1024 * 1024
+DEFAULT_TOTAL_METRIC_FILE_COUNT = 6
+DEFAULT_COLD_FACTOR = 3
+DEFAULT_STATISTIC_MAX_RT = 4900
+DEFAULT_API_PORT = 8719
+DEFAULT_HEARTBEAT_INTERVAL_MS = 10_000
+DEFAULT_APP_NAME = "sentinel-tpu-app"
+
+
+def _env_key(key: str) -> str:
+    return key.upper().replace(".", "_").replace("-", "_")
+
+
+def _parse_properties(text: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith(("#", "!")):
+            continue
+        for sep in ("=", ":"):
+            if sep in line:
+                k, _, v = line.partition(sep)
+                out[k.strip()] = v.strip()
+                break
+    return out
+
+
+class SentinelConfig:
+    """Process-wide key/value config with the reference's precedence."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._config: Dict[str, str] = {}
+        self._loaded = False
+
+    def _ensure_loaded(self):
+        if self._loaded:
+            return
+        with self._lock:
+            if self._loaded:
+                return
+            self._loaded = True
+            defaults = {
+                CHARSET: DEFAULT_CHARSET,
+                SINGLE_METRIC_FILE_SIZE: str(DEFAULT_SINGLE_METRIC_FILE_SIZE),
+                TOTAL_METRIC_FILE_COUNT: str(DEFAULT_TOTAL_METRIC_FILE_COUNT),
+                COLD_FACTOR: str(DEFAULT_COLD_FACTOR),
+                STATISTIC_MAX_RT: str(DEFAULT_STATISTIC_MAX_RT),
+                API_PORT: str(DEFAULT_API_PORT),
+                HEARTBEAT_INTERVAL_MS: str(DEFAULT_HEARTBEAT_INTERVAL_MS),
+            }
+            for k, v in defaults.items():
+                self._config.setdefault(k, v)
+            path = os.environ.get(CONFIG_FILE_ENV, "sentinel.properties")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    self._config.update(_parse_properties(f.read()))
+            except OSError:
+                pass
+            # Env overrides: literal dotted key or CSP_SENTINEL_* form.
+            for key in list(self._config) + [APP_NAME, DASHBOARD_SERVER, LOG_DIR]:
+                for env in (key, _env_key(key)):
+                    if env in os.environ:
+                        self._config[key] = os.environ[env]
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        self._ensure_loaded()
+        with self._lock:
+            for env in (key, _env_key(key)):
+                if env in os.environ:
+                    return os.environ[env]
+            return self._config.get(key, default)
+
+    def set(self, key: str, value: str) -> None:
+        self._ensure_loaded()
+        with self._lock:
+            self._config[key] = str(value)
+
+    def get_int(self, key: str, default: int) -> int:
+        v = self.get(key)
+        try:
+            return int(v) if v is not None else default
+        except ValueError:
+            return default
+
+    # -- well-known accessors ---------------------------------------------
+
+    def app_name(self) -> str:
+        return self.get(APP_NAME) or DEFAULT_APP_NAME
+
+    def app_type(self) -> int:
+        return self.get_int(APP_TYPE, 0)
+
+    def charset(self) -> str:
+        return self.get(CHARSET) or DEFAULT_CHARSET
+
+    def single_metric_file_size(self) -> int:
+        return self.get_int(SINGLE_METRIC_FILE_SIZE, DEFAULT_SINGLE_METRIC_FILE_SIZE)
+
+    def total_metric_file_count(self) -> int:
+        return self.get_int(TOTAL_METRIC_FILE_COUNT, DEFAULT_TOTAL_METRIC_FILE_COUNT)
+
+    def statistic_max_rt(self) -> int:
+        return self.get_int(STATISTIC_MAX_RT, DEFAULT_STATISTIC_MAX_RT)
+
+    def api_port(self) -> int:
+        return self.get_int(API_PORT, DEFAULT_API_PORT)
+
+    def dashboard_server(self) -> Optional[str]:
+        return self.get(DASHBOARD_SERVER)
+
+    def heartbeat_interval_ms(self) -> int:
+        return self.get_int(HEARTBEAT_INTERVAL_MS, DEFAULT_HEARTBEAT_INTERVAL_MS)
+
+    def log_dir(self) -> str:
+        d = self.get(LOG_DIR)
+        if d:
+            return d
+        return os.path.join(os.path.expanduser("~"), "logs", "csp")
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._config.clear()
+            self._loaded = False
+
+
+config = SentinelConfig()

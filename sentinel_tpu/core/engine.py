@@ -116,6 +116,7 @@ class SentinelEngine:
         self.param_rules.add_listener(lambda: self._mark_dirty("param"))
         self.system_status = Y.SystemStatusListener()
         self._signals_refreshed_ms = 0
+        self._sealed_sec = time_util.current_time_millis() // 1000 - 1
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
@@ -258,7 +259,12 @@ class SentinelEngine:
             # Drop an auto-entered context with no live entries so a fresh
             # ContextUtil.enter on this thread isn't shadowed by it.
             ctx_mod.auto_exit_context()
-            raise exception_for_reason(reason, resource)
+            ex = exception_for_reason(reason, resource)
+            from sentinel_tpu.log.record_log import log_block
+
+            log_block(resource, type(ex).__name__, ctx.origin, count,
+                      time_util.current_time_millis())
+            raise ex
         if wait_us > 0:
             time.sleep(wait_us / 1e6)
 
@@ -337,6 +343,56 @@ class SentinelEngine:
             self._ensure_compiled()
             now = now_ms if now_ms is not None else time_util.current_time_millis()
             self._state = self._exit_jit(self._state, self._rules, batch, now)
+
+    # -- metric log source (ops plane) ------------------------------------
+
+    def seal_metrics(self, now_ms: Optional[int] = None) -> List:
+        """Aggregate sealed (fully elapsed) seconds from the minute window.
+
+        Reference: ``MetricTimerListener`` walking every ClusterNode's
+        minute-window buckets (SURVEY.md §3.5). Here it is one device slice:
+        ``w60.counts[:, sealed_bucket_idx, :]`` for all resources at once.
+        Returns ``MetricNode``s (timestamps set) for seconds not yet sealed
+        by a previous call; all-idle resource-seconds are skipped.
+        """
+        from sentinel_tpu.core.registry import KIND_CLUSTER
+        from sentinel_tpu.metrics.metric_node import MetricNode
+
+        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        now_sec = now // 1000
+        with self._lock:
+            self._ensure_compiled()
+            first = max(self._sealed_sec + 1, now_sec - C.MINUTE_BUCKETS + 1)
+            seconds = list(range(first, now_sec))
+            if not seconds:
+                return []
+            self._sealed_sec = seconds[-1]
+            w60 = W_rotate_host(self._state.w60, now, S.SPEC_60S)
+            idx = np.asarray([s % C.MINUTE_BUCKETS for s in seconds])
+            slices = np.asarray(w60.counts[:, idx, :])       # [R, k, E]
+            threads = np.asarray(self._state.cur_threads)    # [R]
+            metas = [m for m in self.registry.meta if m.kind == KIND_CLUSTER]
+        out = []
+        for k, sec in enumerate(seconds):
+            for m in metas:
+                t = slices[m.row, k]
+                if not (t[C.MetricEvent.PASS] or t[C.MetricEvent.BLOCK]
+                        or t[C.MetricEvent.SUCCESS] or t[C.MetricEvent.EXCEPTION]):
+                    continue
+                succ = int(t[C.MetricEvent.SUCCESS])
+                out.append(MetricNode(
+                    timestamp=sec * 1000,
+                    resource=m.resource,
+                    pass_qps=int(t[C.MetricEvent.PASS]),
+                    block_qps=int(t[C.MetricEvent.BLOCK]),
+                    success_qps=succ,
+                    exception_qps=int(t[C.MetricEvent.EXCEPTION]),
+                    rt=float(t[C.MetricEvent.RT]) / max(succ, 1),
+                    occupied_pass_qps=int(t[C.MetricEvent.OCCUPIED_PASS]),
+                    concurrency=int(threads[m.row]),
+                    classification=m.resource_type,
+                ))
+        return out
 
     # -- introspection (ops plane) ----------------------------------------
 

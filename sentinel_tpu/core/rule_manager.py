@@ -1,0 +1,37 @@
+"""Shared rule-registry base (reference: the ``XxxRuleManager`` pattern —
+SURVEY.md §1 "Rules are data, managers are registries").
+
+Every family keeps a list rebuilt wholesale on load (§3.2 swap semantics),
+filters invalid rules, and fans out to engine listeners for tensor rebuild.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, List, TypeVar
+
+R = TypeVar("R")
+
+
+class RuleManager(Generic[R]):
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rules: List[R] = []
+        self.version = 0
+        self._listeners: List[Callable[[], None]] = []
+
+    def load_rules(self, rules: List[R]) -> None:
+        with self._lock:
+            self._rules = [r for r in rules if r.is_valid()]
+            self.version += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def get_rules(self) -> List[R]:
+        with self._lock:
+            return list(self._rules)
+
+    def add_listener(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)

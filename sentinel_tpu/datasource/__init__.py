@@ -1,0 +1,42 @@
+"""Dynamic rule datasources (reference: ``sentinel-datasource-extension`` —
+SURVEY.md §2.2): pull/push rule configuration into the property system.
+
+``ReadableDataSource`` reads an external source, converts it with a
+``Converter``, and pushes the result into its ``SentinelProperty`` — to which
+a rule manager listens. ``WritableDataSource`` persists rules pushed from the
+ops plane (``setRules`` command handler).
+"""
+
+from sentinel_tpu.datasource.base import (
+    AbstractDataSource,
+    AutoRefreshDataSource,
+    Converter,
+    FileRefreshableDataSource,
+    FileWritableDataSource,
+    ReadableDataSource,
+    WritableDataSource,
+    bind,
+)
+from sentinel_tpu.datasource.converters import (
+    authority_rules_from_json,
+    authority_rules_to_json,
+    degrade_rules_from_json,
+    degrade_rules_to_json,
+    flow_rules_from_json,
+    flow_rules_to_json,
+    param_rules_from_json,
+    param_rules_to_json,
+    system_rules_from_json,
+    system_rules_to_json,
+)
+
+__all__ = [
+    "AbstractDataSource", "AutoRefreshDataSource", "Converter",
+    "FileRefreshableDataSource", "FileWritableDataSource",
+    "ReadableDataSource", "WritableDataSource", "bind",
+    "authority_rules_from_json", "authority_rules_to_json",
+    "degrade_rules_from_json", "degrade_rules_to_json",
+    "flow_rules_from_json", "flow_rules_to_json",
+    "param_rules_from_json", "param_rules_to_json",
+    "system_rules_from_json", "system_rules_to_json",
+]

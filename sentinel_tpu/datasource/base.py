@@ -1,0 +1,187 @@
+"""Datasource framework (reference: ``sentinel-datasource-extension``:
+``ReadableDataSource`` / ``WritableDataSource`` / ``AbstractDataSource`` /
+``AutoRefreshDataSource`` / ``FileRefreshableDataSource`` /
+``FileWritableDataSource`` / ``Converter`` — SURVEY.md §2.2, §3.2).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Generic, Optional, TypeVar
+
+from sentinel_tpu.core.property import (
+    DynamicSentinelProperty,
+    SentinelProperty,
+    SimplePropertyListener,
+)
+
+S = TypeVar("S")
+T = TypeVar("T")
+
+
+def _log_warn(msg: str, *args) -> None:
+    from sentinel_tpu.log.record_log import record_log
+
+    record_log.warn(msg, *args)
+
+# Reference: ``Converter<S, T>`` — a single ``convert`` method, so a plain
+# callable is the Python-native shape.
+Converter = Callable[[S], T]
+
+
+class ReadableDataSource(Generic[S, T]):
+    """Reference: ``ReadableDataSource<S, T>``."""
+
+    def load_config(self) -> Optional[T]:
+        raise NotImplementedError
+
+    def read_source(self) -> S:
+        raise NotImplementedError
+
+    @property
+    def property(self) -> SentinelProperty[T]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class WritableDataSource(Generic[T]):
+    """Reference: ``WritableDataSource<T>``."""
+
+    def write(self, value: T) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class AbstractDataSource(ReadableDataSource[S, T]):
+    """Holds the converter + a ``DynamicSentinelProperty`` fan-out point."""
+
+    def __init__(self, converter: Converter):
+        if converter is None:
+            raise ValueError("converter can't be None")
+        self.converter = converter
+        self._property: DynamicSentinelProperty[T] = DynamicSentinelProperty()
+
+    def load_config(self) -> Optional[T]:
+        return self.converter(self.read_source())
+
+    @property
+    def property(self) -> SentinelProperty[T]:
+        return self._property
+
+
+class AutoRefreshDataSource(AbstractDataSource[S, T]):
+    """Poll loop (reference default 3s): re-read, convert, push on change.
+
+    ``is_modified`` lets subclasses cheaply skip unchanged sources (the
+    file impl checks mtime, mirroring the reference).
+    """
+
+    def __init__(self, converter: Converter, recommend_refresh_ms: int = 3000):
+        super().__init__(converter)
+        self.refresh_ms = recommend_refresh_ms
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "AutoRefreshDataSource":
+        self.first_load()
+        self._thread = threading.Thread(
+            target=self._run, name="sentinel-datasource-auto-refresh", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def first_load(self) -> None:
+        try:
+            value = self.load_config()
+            if value is not None:
+                self._property.update_value(value)
+        except Exception as ex:
+            _log_warn("datasource initial load failed: %r", ex)
+
+    def is_modified(self) -> bool:
+        return True
+
+    def refresh(self) -> None:
+        """One poll iteration (exposed for deterministic tests)."""
+        if not self.is_modified():
+            return
+        value = self.load_config()
+        if value is not None:
+            self._property.update_value(value)
+
+    def _run(self):
+        while not self._stop.wait(self.refresh_ms / 1000.0):
+            try:
+                self.refresh()
+            except Exception as ex:  # poll loop survives, with a trace
+                _log_warn("datasource refresh failed: %r", ex)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+
+class FileRefreshableDataSource(AutoRefreshDataSource[str, T]):
+    """Reference: ``FileRefreshableDataSource`` — mtime-polled file source."""
+
+    def __init__(self, file_path: str, converter: Converter,
+                 recommend_refresh_ms: int = 3000, charset: str = "utf-8"):
+        super().__init__(converter, recommend_refresh_ms)
+        self.file_path = os.path.abspath(file_path)
+        self.charset = charset
+        self._last_mtime = -1.0
+
+    def read_source(self) -> str:
+        with open(self.file_path, "r", encoding=self.charset) as f:
+            return f.read()
+
+    def is_modified(self) -> bool:
+        try:
+            mtime = os.stat(self.file_path).st_mtime
+        except OSError:
+            return False
+        if mtime != self._last_mtime:
+            self._last_mtime = mtime
+            return True
+        return False
+
+    def first_load(self) -> None:
+        try:
+            self._last_mtime = os.stat(self.file_path).st_mtime
+        except OSError:
+            pass
+        super().first_load()
+
+
+class FileWritableDataSource(WritableDataSource[T]):
+    """Reference: ``FileWritableDataSource`` — serialize + atomic rewrite."""
+
+    def __init__(self, file_path: str, encoder: Converter, charset: str = "utf-8"):
+        self.file_path = os.path.abspath(file_path)
+        self.encoder = encoder
+        self.charset = charset
+        self._lock = threading.Lock()
+
+    def write(self, value: T) -> None:
+        text = self.encoder(value)
+        with self._lock:
+            tmp = self.file_path + ".tmp"
+            with open(tmp, "w", encoding=self.charset) as f:
+                f.write(text)
+            os.replace(tmp, self.file_path)
+
+
+def bind(source: ReadableDataSource, load_rules: Callable) -> None:
+    """Attach a datasource to a rule loader (``register2Property`` analog).
+
+    ``load_rules`` is e.g. ``sentinel_tpu.load_flow_rules`` or a manager's
+    ``load_rules`` bound method; every push re-loads the family wholesale
+    (§3.2 swap semantics).
+    """
+    source.property.add_listener(SimplePropertyListener(load_rules))

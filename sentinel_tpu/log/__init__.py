@@ -1,0 +1,7 @@
+"""Record / block logging (reference: ``core:log/`` — ``RecordLog``,
+``LogBase``, plus the block log written by ``LogSlot``; SURVEY.md §2.1, §5).
+"""
+
+from sentinel_tpu.log.record_log import RecordLog, block_log, record_log
+
+__all__ = ["RecordLog", "block_log", "record_log"]

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import NodeRegistry
 from sentinel_tpu.ops import window as W
@@ -106,29 +107,8 @@ def compile_authority_rules(
     )
 
 
-class AuthorityRuleManager:
-    """Wholesale-swap rule registry (same shape as FlowRuleManager)."""
-
-    def __init__(self):
-        self._lock = threading.RLock()
-        self._rules: List[AuthorityRule] = []
-        self.version = 0
-        self._listeners = []
-
-    def load_rules(self, rules: List[AuthorityRule]) -> None:
-        with self._lock:
-            self._rules = [r for r in rules if r.is_valid()]
-            self.version += 1
-            listeners = list(self._listeners)
-        for fn in listeners:
-            fn()
-
-    def get_rules(self) -> List[AuthorityRule]:
-        with self._lock:
-            return list(self._rules)
-
-    def add_listener(self, fn) -> None:
-        self._listeners.append(fn)
+class AuthorityRuleManager(RuleManager):
+    """Wholesale-swap registry (reference: ``AuthorityRuleManager``)."""
 
 
 def check_authority(

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch, ExitBatch
 from sentinel_tpu.core.registry import NodeRegistry
 from sentinel_tpu.ops import window as W
@@ -159,27 +160,8 @@ def compile_degrade_rules(
     return t, stat_interval
 
 
-class DegradeRuleManager:
+class DegradeRuleManager(RuleManager):
     """Wholesale-swap registry (reference: ``DegradeRuleManager``)."""
-
-    def __init__(self):
-        self._lock = threading.RLock()
-        self._rules: List[DegradeRule] = []
-        self._listeners = []
-
-    def load_rules(self, rules: List[DegradeRule]) -> None:
-        with self._lock:
-            self._rules = [r for r in rules if r.is_valid()]
-            listeners = list(self._listeners)
-        for fn in listeners:
-            fn()
-
-    def get_rules(self) -> List[DegradeRule]:
-        with self._lock:
-            return list(self._rules)
-
-    def add_listener(self, fn) -> None:
-        self._listeners.append(fn)
 
 
 # ---------------------------------------------------------------------------

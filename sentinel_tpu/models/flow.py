@@ -40,6 +40,7 @@ import numpy as np
 from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
+from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.segment import segmented_prefix
 from sentinel_tpu.utils.shapes import round_up as _round_up
@@ -237,32 +238,12 @@ def compile_flow_rules(
     return t, named_origins
 
 
-class FlowRuleManager:
+class FlowRuleManager(RuleManager):
     """Registry of flow rules; wholesale swap semantics (§3.2)."""
 
-    def __init__(self):
-        self._lock = threading.RLock()
-        self._rules: List[FlowRule] = []
-        self.version = 0
-        self._listeners = []
-
-    def load_rules(self, rules: List[FlowRule]) -> None:
-        with self._lock:
-            self._rules = [r for r in rules if r.is_valid()]
-            self.version += 1
-            listeners = list(self._listeners)
-        for fn in listeners:
-            fn()
-
-    def get_rules(self) -> List[FlowRule]:
-        with self._lock:
-            return list(self._rules)
-
-    def add_listener(self, fn) -> None:
-        self._listeners.append(fn)
-
     def has_origin_rules(self) -> bool:
-        return any(r.limit_app != C.LIMIT_APP_DEFAULT for r in self._rules)
+        with self._lock:
+            return any(r.limit_app != C.LIMIT_APP_DEFAULT for r in self._rules)
 
 
 # ---------------------------------------------------------------------------

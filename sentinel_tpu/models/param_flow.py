@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch, ExitBatch, MAX_PARAMS
 from sentinel_tpu.core.registry import NodeRegistry
 from sentinel_tpu.ops import window as W
@@ -70,6 +71,8 @@ class ParamFlowRule:
 
     def is_valid(self) -> bool:
         if not self.resource or self.count < 0 or self.duration_in_sec <= 0:
+            return False
+        if self.burst_count < 0 or self.max_queueing_time_ms < 0:
             return False
         if not (0 <= self.param_idx < MAX_PARAMS):
             return False
@@ -188,27 +191,8 @@ def compile_param_rules(
     )
 
 
-class ParamFlowRuleManager:
-    def __init__(self):
-        self._lock = threading.RLock()
-        self._rules: List[ParamFlowRule] = []
-        self.version = 0
-        self._listeners = []
-
-    def load_rules(self, rules: List[ParamFlowRule]) -> None:
-        with self._lock:
-            self._rules = [r for r in rules if r.is_valid()]
-            self.version += 1
-            listeners = list(self._listeners)
-        for fn in listeners:
-            fn()
-
-    def get_rules(self) -> List[ParamFlowRule]:
-        with self._lock:
-            return list(self._rules)
-
-    def add_listener(self, fn) -> None:
-        self._listeners.append(fn)
+class ParamFlowRuleManager(RuleManager):
+    """Wholesale-swap registry (reference: ``ParamFlowRuleManager``)."""
 
 
 class ParamVerdict(NamedTuple):

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import ENTRY_ROW
 from sentinel_tpu.ops import window as W
@@ -93,27 +94,8 @@ def compile_system_rules(rules: List[SystemRule]) -> SystemRuleTensors:
     )
 
 
-class SystemRuleManager:
-    def __init__(self):
-        self._lock = threading.RLock()
-        self._rules: List[SystemRule] = []
-        self.version = 0
-        self._listeners = []
-
-    def load_rules(self, rules: List[SystemRule]) -> None:
-        with self._lock:
-            self._rules = [r for r in rules if r.is_valid()]
-            self.version += 1
-            listeners = list(self._listeners)
-        for fn in listeners:
-            fn()
-
-    def get_rules(self) -> List[SystemRule]:
-        with self._lock:
-            return list(self._rules)
-
-    def add_listener(self, fn) -> None:
-        self._listeners.append(fn)
+class SystemRuleManager(RuleManager):
+    """Wholesale-swap registry (reference: ``SystemRuleManager``)."""
 
 
 def check_system(
@@ -213,6 +195,10 @@ class SystemStatusListener:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread is not None:
+            # Join so a stop()-then-start() can't leave two samplers racing
+            # on the cleared stop event.
+            self._thread.join(timeout=self.interval_s + 1.0)
         self._thread = None
 
     def _run(self) -> None:

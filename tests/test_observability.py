@@ -1,0 +1,226 @@
+"""M2 tests: metric log pipeline, config layer, property system, datasources.
+
+Mirrors the reference's test strategy (SURVEY.md §4): deterministic units
+over the writer/searcher pair and the converter round-trips, plus an
+end-to-end seal (entries -> sealed second -> byte-compatible line).
+"""
+
+import json
+import os
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.config import SentinelConfig
+from sentinel_tpu.core.property import DynamicSentinelProperty, SimplePropertyListener
+from sentinel_tpu.datasource import (
+    FileRefreshableDataSource,
+    FileWritableDataSource,
+    bind,
+    degrade_rules_from_json,
+    degrade_rules_to_json,
+    flow_rules_from_json,
+    flow_rules_to_json,
+    param_rules_from_json,
+    param_rules_to_json,
+)
+from sentinel_tpu.metrics import MetricNode, MetricSearcher, MetricTimerListener, MetricWriter
+
+
+# -- MetricNode line format -------------------------------------------------
+
+def test_metric_node_thin_string_round_trip():
+    node = MetricNode(timestamp=1700000000000, resource="getUser", pass_qps=7,
+                      block_qps=2, success_qps=6, exception_qps=1, rt=12.5,
+                      occupied_pass_qps=0, concurrency=3, classification=1)
+    line = node.to_thin_string()
+    assert line == "1700000000000|getUser|7|2|6|1|12|0|3|1"
+    back = MetricNode.from_thin_string(line)
+    assert back.resource == "getUser"
+    assert back.pass_qps == 7 and back.block_qps == 2
+    assert back.concurrency == 3 and back.classification == 1
+
+
+def test_metric_node_parses_short_lines():
+    back = MetricNode.from_thin_string("1700000000000|r|1|0|1|0|5")
+    assert back.rt == 5.0 and back.concurrency == 0
+
+
+# -- writer + searcher ------------------------------------------------------
+
+def _write_seconds(writer, base_ms, per_second):
+    for k, nodes in enumerate(per_second):
+        writer.write(base_ms + 1000 * k, nodes)
+
+
+def test_writer_searcher_range_and_identity(tmp_path):
+    base = 1700000000000
+    writer = MetricWriter(app="appA", base_dir=str(tmp_path))
+    _write_seconds(writer, base, [
+        [MetricNode(base, "a", pass_qps=1), MetricNode(base, "b", pass_qps=2)],
+        [MetricNode(base, "a", pass_qps=3)],
+        [MetricNode(base, "b", pass_qps=4)],
+    ])
+    writer.close()
+
+    s = MetricSearcher(str(tmp_path), "appA")
+    all_nodes = s.find(base)
+    assert len(all_nodes) == 4
+    only_a = s.find_by_time_and_resource(base, base + 2000, "a")
+    assert [n.pass_qps for n in only_a] == [1, 3]
+    later = s.find_by_time_and_resource(base + 1000, base + 2000, None)
+    assert [n.pass_qps for n in later] == [3, 4]
+
+
+def test_writer_is_idempotent_per_second(tmp_path):
+    base = 1700000000000
+    writer = MetricWriter(app="appA", base_dir=str(tmp_path))
+    writer.write(base, [MetricNode(base, "a", pass_qps=1)])
+    writer.write(base, [MetricNode(base, "a", pass_qps=9)])  # dup second: dropped
+    writer.close()
+    nodes = MetricSearcher(str(tmp_path), "appA").find(base)
+    assert [n.pass_qps for n in nodes] == [1]
+
+
+def test_writer_rolls_by_size_and_trims(tmp_path):
+    base = 1700000000000
+    writer = MetricWriter(app="appA", base_dir=str(tmp_path),
+                          single_file_size=200, total_file_count=2)
+    for k in range(20):
+        writer.write(base + 1000 * k, [MetricNode(0, f"res{k}", pass_qps=k)])
+    writer.close()
+    data_files = [n for n in os.listdir(tmp_path) if not n.endswith(".idx")]
+    assert 0 < len(data_files) <= 2
+    # Newest data still readable (search across remaining files).
+    nodes = MetricSearcher(str(tmp_path), "appA").find(base)
+    assert nodes and nodes[-1].resource == "res19"
+
+
+def test_engine_seal_metrics_end_to_end(engine, frozen_time, tmp_path):
+    st.load_flow_rules([st.FlowRule(resource="sealed", count=3)])
+    for _ in range(5):
+        e = st.entry_ok("sealed")
+        if e:
+            e.exit()
+    frozen_time.advance_time(2000)  # the active second becomes sealed
+    writer = MetricWriter(app="appS", base_dir=str(tmp_path))
+    timer = MetricTimerListener(engine, writer)
+    assert timer.tick(frozen_time.current_time_millis()) >= 1
+    writer.close()
+    nodes = MetricSearcher(str(tmp_path), "appS").find_by_time_and_resource(
+        0, 2**62, "sealed")
+    assert len(nodes) == 1
+    assert nodes[0].pass_qps == 3
+    assert nodes[0].block_qps == 2
+    assert nodes[0].success_qps == 3
+    # Sealing is monotonic: a second tick writes nothing new.
+    assert timer.tick(frozen_time.current_time_millis()) == 0
+
+
+# -- config -----------------------------------------------------------------
+
+def test_config_precedence_env_over_file(tmp_path, monkeypatch):
+    props = tmp_path / "sentinel.properties"
+    props.write_text("project.name=fromFile\ncsp.sentinel.api.port=9999\n")
+    monkeypatch.setenv("CSP_SENTINEL_CONFIG_FILE", str(props))
+    monkeypatch.setenv("PROJECT_NAME", "fromEnv")
+    cfg = SentinelConfig()
+    assert cfg.app_name() == "fromEnv"          # env beats file
+    assert cfg.api_port() == 9999               # file beats default
+    assert cfg.heartbeat_interval_ms() == 10000  # default
+
+
+def test_config_defaults(monkeypatch):
+    monkeypatch.setenv("CSP_SENTINEL_CONFIG_FILE", "/nonexistent/x.properties")
+    cfg = SentinelConfig()
+    assert cfg.api_port() == 8719
+    assert cfg.statistic_max_rt() == 4900
+
+
+# -- property system --------------------------------------------------------
+
+def test_dynamic_property_fanout_and_dedup():
+    prop = DynamicSentinelProperty()
+    seen = []
+    prop.add_listener(SimplePropertyListener(seen.append))
+    assert prop.update_value([1, 2])
+    assert not prop.update_value([1, 2])  # unchanged: no fan-out
+    assert prop.update_value([3])
+    assert seen == [[1, 2], [3]]
+
+
+def test_property_initial_load_on_add():
+    prop = DynamicSentinelProperty(value=["x"])
+    seen = []
+    prop.add_listener(SimplePropertyListener(seen.append))
+    assert seen == [["x"]]
+
+
+# -- converters -------------------------------------------------------------
+
+def test_flow_rule_json_round_trip():
+    src = json.dumps([{
+        "resource": "getUser", "count": 20, "grade": 1, "limitApp": "appB",
+        "strategy": 1, "refResource": "other", "controlBehavior": 2,
+        "maxQueueingTimeMs": 250, "clusterMode": True,
+        "clusterConfig": {"flowId": 42, "thresholdType": 1},
+    }])
+    rules = flow_rules_from_json(src)
+    assert len(rules) == 1
+    r = rules[0]
+    assert r.count == 20 and r.limit_app == "appB"
+    assert r.ref_resource == "other" and r.max_queueing_time_ms == 250
+    assert r.cluster_mode and r.cluster_config["flowId"] == 42
+    back = flow_rules_from_json(flow_rules_to_json(rules))
+    assert back == rules
+
+
+def test_degrade_param_rule_json_round_trip():
+    d = degrade_rules_from_json(json.dumps([{
+        "resource": "r", "grade": 0, "count": 50, "timeWindow": 10,
+        "slowRatioThreshold": 0.5, "minRequestAmount": 8, "statIntervalMs": 2000,
+    }]))
+    assert d[0].slow_ratio_threshold == 0.5 and d[0].stat_interval_ms == 2000
+    assert degrade_rules_from_json(degrade_rules_to_json(d)) == d
+
+    p = param_rules_from_json(json.dumps([{
+        "resource": "r", "paramIdx": 1, "count": 5, "durationInSec": 2,
+        "paramFlowItemList": [
+            {"object": "7", "classType": "int", "count": 100},
+            {"object": "vip", "classType": "String", "count": 200},
+        ],
+    }]))
+    assert p[0].items[0].object == 7       # classType re-typing
+    assert p[0].items[1].object == "vip"
+    assert param_rules_from_json(param_rules_to_json(p)) == p
+
+
+# -- datasources ------------------------------------------------------------
+
+def test_file_datasource_pushes_rules_into_engine(engine, frozen_time, tmp_path):
+    path = tmp_path / "flow-rules.json"
+    path.write_text(json.dumps([{"resource": "dyn", "count": 2, "grade": 1}]))
+    ds = FileRefreshableDataSource(str(path), flow_rules_from_json)
+    bind(ds, st.load_flow_rules)
+    ds.first_load()
+
+    passed = sum(1 for _ in range(4) if st.entry_ok("dyn"))
+    assert passed == 2
+
+    # Config push: rewrite the file, poll once, quota changes wholesale.
+    frozen_time.advance_time(1000)
+    path.write_text(json.dumps([{"resource": "dyn", "count": 4, "grade": 1}]))
+    os.utime(path, (1, 1))  # force a distinct mtime
+    ds.refresh()
+    passed = sum(1 for _ in range(6) if st.entry_ok("dyn"))
+    assert passed == 4
+    ds.close()
+
+
+def test_file_writable_datasource_atomic_write(tmp_path):
+    path = tmp_path / "rules.json"
+    wds = FileWritableDataSource(str(path), flow_rules_to_json)
+    rules = [st.FlowRule(resource="w", count=9)]
+    wds.write(rules)
+    assert flow_rules_from_json(path.read_text()) == rules
