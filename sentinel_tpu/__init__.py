@@ -98,6 +98,44 @@ def trace(ex: BaseException) -> None:
         ctx.cur_entry.trace(ex)
 
 
+_ops_plane = None
+
+
+def init_ops_plane(port: Optional[int] = None):
+    """Boot the ops plane (reference: ``InitExecutor.doInit`` — SURVEY.md
+    §3.4): command HTTP server, heartbeat (when a dashboard is configured),
+    and the 1 Hz metric log timer. Idempotent; returns the started parts.
+    """
+    global _ops_plane
+    if _ops_plane is not None:
+        return _ops_plane
+    from sentinel_tpu.core.config import config as _config
+    from sentinel_tpu.metrics.timer import MetricTimerListener
+    from sentinel_tpu.transport.command_center import CommandCenter
+    from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+    engine = get_engine()
+    center = CommandCenter(engine, port=port).start()
+    timer = MetricTimerListener(engine).start()
+    heartbeat = None
+    if _config.dashboard_server():
+        heartbeat = HeartbeatSender(api_port=center.bound_port).start()
+    _ops_plane = {"command_center": center, "metric_timer": timer,
+                  "heartbeat": heartbeat}
+    return _ops_plane
+
+
+def shutdown_ops_plane() -> None:
+    global _ops_plane
+    if _ops_plane is None:
+        return
+    parts, _ops_plane = _ops_plane, None
+    parts["command_center"].stop()
+    parts["metric_timer"].stop()
+    if parts["heartbeat"] is not None:
+        parts["heartbeat"].stop()
+
+
 def load_flow_rules(rules) -> None:
     get_engine().flow_rules.load_rules(list(rules))
 
@@ -125,6 +163,7 @@ __all__ = [
     "ParamFlowItem", "ParamFlowRule", "ResourceType", "SentinelEngine",
     "SystemBlockException", "SystemRule", "constants", "context_enter",
     "entry", "entry_ok", "exit_context", "get_context", "get_engine",
-    "load_authority_rules", "load_degrade_rules", "load_flow_rules",
-    "load_param_flow_rules", "load_system_rules", "reset", "trace",
+    "init_ops_plane", "load_authority_rules", "load_degrade_rules",
+    "load_flow_rules", "load_param_flow_rules", "load_system_rules", "reset",
+    "shutdown_ops_plane", "trace",
 ]

@@ -104,6 +104,9 @@ class SentinelEngine:
     def __init__(self, capacity: int = 4096):
         self.registry = NodeRegistry(capacity)
         self.capacity = capacity
+        # Global kill switch (reference: Constants.ON via the setSwitch /
+        # getSwitch command handlers). Off => every entry passes unguarded.
+        self.enabled = True
         self.flow_rules = F.FlowRuleManager()
         self.flow_rules.add_listener(lambda: self._mark_dirty("flow"))
         self.degrade_rules = D.DegradeRuleManager()
@@ -233,6 +236,10 @@ class SentinelEngine:
             ctx = ctx_mod.enter(C.CONTEXT_DEFAULT_NAME)
             ctx.auto_created = True
         if ctx.is_null:
+            return EntryHandle(self, resource, ctx, -1, -1, -1,
+                               entry_type == C.EntryType.IN, count, ())
+
+        if not self.enabled:
             return EntryHandle(self, resource, ctx, -1, -1, -1,
                                entry_type == C.EntryType.IN, count, ())
 
@@ -395,6 +402,43 @@ class SentinelEngine:
         return out
 
     # -- introspection (ops plane) ----------------------------------------
+
+    def row_stats(self):
+        """(totals int[R, E] over the 1s window, threads int[R]) as numpy."""
+        with self._lock:
+            self._ensure_compiled()
+            now = time_util.current_time_millis()
+            w1 = W_rotate_host(self._state.w1, now, S.SPEC_1S)
+            return (np.asarray(w1.counts.sum(axis=1)),
+                    np.asarray(self._state.cur_threads))
+
+    def tree_dict(self) -> Dict:
+        """Call tree rooted at machine-root (command API ``jsonTree``/``tree``).
+
+        Reference: ``FetchJsonTreeCommandHandler`` walking ``Constants.ROOT``.
+        """
+        from sentinel_tpu.core.registry import ROOT_ROW
+
+        totals, threads = self.row_stats()
+
+        def render(row: int) -> Dict:
+            m = self.registry.meta[row]
+            t = totals[row]
+            succ = max(int(t[C.MetricEvent.SUCCESS]), 1)
+            return {
+                "id": m.row,
+                "resource": m.resource,
+                "threadNum": int(threads[row]),
+                "passQps": int(t[C.MetricEvent.PASS]),
+                "blockQps": int(t[C.MetricEvent.BLOCK]),
+                "totalQps": int(t[C.MetricEvent.PASS]) + int(t[C.MetricEvent.BLOCK]),
+                "successQps": int(t[C.MetricEvent.SUCCESS]),
+                "exceptionQps": int(t[C.MetricEvent.EXCEPTION]),
+                "averageRt": float(t[C.MetricEvent.RT]) / succ,
+                "children": [render(c) for c in m.children],
+            }
+
+        return render(ROOT_ROW)
 
     def node_snapshot(self) -> Dict[str, Dict[str, float]]:
         """Per-resource live stats (command-API ``cnode`` source)."""

@@ -39,6 +39,7 @@ class MetricTimerListener:
 
     def start(self) -> "MetricTimerListener":
         if self._thread is None:
+            self._stop.clear()  # allow start() after a stop()
             self._thread = threading.Thread(
                 target=self._run, name="sentinel-metrics-record", daemon=True)
             self._thread.start()

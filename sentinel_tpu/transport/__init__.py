@@ -1,0 +1,17 @@
+"""Ops transport plane (reference: ``sentinel-transport/`` — SURVEY.md §2.3):
+an embedded HTTP command center for remote rule CRUD + metric scraping, and
+a heartbeat sender registering with the dashboard.
+"""
+
+from sentinel_tpu.transport.command_center import (
+    CommandCenter,
+    CommandRequest,
+    CommandResponse,
+    command_mapping,
+)
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+__all__ = [
+    "CommandCenter", "CommandRequest", "CommandResponse", "HeartbeatSender",
+    "command_mapping",
+]

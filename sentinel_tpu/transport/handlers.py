@@ -1,0 +1,209 @@
+"""The default command handler set (reference:
+``transport-common:command/handler/*CommandHandler`` — SURVEY.md §2.3).
+
+Command names, parameter names, and response shapes follow the reference so
+the dashboard's ``SentinelApiClient`` calls work unchanged:
+``getRules?type=...`` / ``setRules`` / ``metric`` / ``cnode`` /
+``clusterNode`` / ``jsonTree`` / ``tree`` / ``version`` / ``basicInfo`` /
+``systemStatus`` / ``getSwitch`` / ``setSwitch`` / ``api``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+from sentinel_tpu.core.config import config
+from sentinel_tpu.datasource import converters as CV
+from sentinel_tpu.datasource.base import WritableDataSource
+from sentinel_tpu.metrics.searcher import MetricSearcher
+from sentinel_tpu.transport.command_center import (
+    CommandRequest,
+    CommandResponse,
+    command_mapping,
+    registered_commands,
+)
+
+_writable_datasources: Dict[str, WritableDataSource] = {}
+_searcher_cache: Dict[tuple, MetricSearcher] = {}
+
+
+def register_writable_datasource(rule_type: str, ds: WritableDataSource) -> None:
+    """``WritableDataSourceRegistry`` analog: setRules persists through it."""
+    _writable_datasources[rule_type] = ds
+
+
+_FAMILIES = {
+    # type -> (manager attr, from_json, to_dicts)
+    "flow": ("flow_rules", CV.flow_rules_from_json,
+             lambda rs: [CV.flow_rule_to_dict(r) for r in rs]),
+    "degrade": ("degrade_rules", CV.degrade_rules_from_json,
+                lambda rs: [CV.degrade_rule_to_dict(r) for r in rs]),
+    "system": ("system_rules", CV.system_rules_from_json,
+               lambda rs: [CV.system_rule_to_dict(r) for r in rs]),
+    "authority": ("authority_rules", CV.authority_rules_from_json,
+                  lambda rs: [CV.authority_rule_to_dict(r) for r in rs]),
+    "paramFlow": ("param_rules", CV.param_rules_from_json,
+                  lambda rs: [CV.param_rule_to_dict(r) for r in rs]),
+}
+
+
+@command_mapping("version", "framework version")
+def cmd_version(req: CommandRequest) -> CommandResponse:
+    import sentinel_tpu
+
+    return CommandResponse.of_success(f"sentinel-tpu/{sentinel_tpu.__version__}")
+
+
+@command_mapping("basicInfo", "process + app identity")
+def cmd_basic_info(req: CommandRequest) -> CommandResponse:
+    port = req.center.bound_port if req.center is not None else config.api_port()
+    return CommandResponse.of_success({
+        "appName": config.app_name(),
+        "appType": config.app_type(),
+        "pid": os.getpid(),
+        "port": port,
+    })
+
+
+@command_mapping("getRules", "get active rules by type")
+def cmd_get_rules(req: CommandRequest) -> CommandResponse:
+    rule_type = req.get_param("type")
+    fam = _FAMILIES.get(rule_type or "")
+    if fam is None:
+        return CommandResponse.of_failure("invalid type")
+    manager = getattr(req.engine, fam[0])
+    return CommandResponse.of_success(fam[2](manager.get_rules()))
+
+
+@command_mapping("setRules", "load rules wholesale by type")
+def cmd_set_rules(req: CommandRequest) -> CommandResponse:
+    rule_type = req.get_param("type")
+    fam = _FAMILIES.get(rule_type or "")
+    if fam is None:
+        return CommandResponse.of_failure("invalid type")
+    data = req.get_param("data") or req.body
+    try:
+        rules = fam[1](data or "[]")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(f"parse error: {ex}")
+    getattr(req.engine, fam[0]).load_rules(rules)
+    ds = _writable_datasources.get(rule_type)
+    if ds is not None:
+        try:
+            ds.write(rules)
+        except Exception as ex:
+            return CommandResponse.of_failure(f"store error: {ex!r}")
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("metric", "query the metric log by time range")
+def cmd_metric(req: CommandRequest) -> CommandResponse:
+    try:
+        start = int(req.get_param("startTime", "0"))
+        end_raw = req.get_param("endTime")
+        end = int(end_raw) if end_raw else None
+        max_lines = min(int(req.get_param("maxLines", "6000")), 12000)
+    except ValueError:
+        return CommandResponse.of_failure("invalid time range")
+    identity = req.get_param("identity")
+    key = (config.log_dir(), config.app_name())
+    searcher = _searcher_cache.get(key)
+    if searcher is None:
+        # Cache per (dir, app) — the dashboard polls /metric at ~1 Hz
+        # (reference keeps one SENTINEL_METRIC_SEARCHER for the same reason).
+        searcher = _searcher_cache[key] = MetricSearcher(*key)
+    if end is not None or identity is not None:
+        nodes = searcher.find_by_time_and_resource(
+            start, end if end is not None else 2**62, identity, max_lines)
+    else:
+        nodes = searcher.find(start, max_lines)
+    if not nodes:
+        return CommandResponse.of_success("")
+    return CommandResponse.of_success(
+        "\n".join(n.to_thin_string() for n in nodes) + "\n")
+
+
+@command_mapping("cnode", "per-resource live stats")
+def cmd_cnode(req: CommandRequest) -> CommandResponse:
+    res = req.get_param("id")
+    if not res:
+        return CommandResponse.of_failure("invalid parameter: empty id")
+    snap = req.engine.node_snapshot().get(res)
+    if snap is None:
+        return CommandResponse.of_success("")
+    return CommandResponse.of_success({"resource": res, **snap})
+
+
+@command_mapping("clusterNode", "all resource nodes' live stats")
+def cmd_cluster_node(req: CommandRequest) -> CommandResponse:
+    snap = req.engine.node_snapshot()
+    return CommandResponse.of_success(
+        [{"resource": r, **v} for r, v in sorted(snap.items())])
+
+
+@command_mapping("jsonTree", "call tree as JSON")
+def cmd_json_tree(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_success(req.engine.tree_dict())
+
+
+@command_mapping("tree", "call tree as text")
+def cmd_tree(req: CommandRequest) -> CommandResponse:
+    lines = []
+
+    def walk(node: dict, depth: int):
+        lines.append(
+            "-" * depth
+            + f"{node['resource'] or '(root)'}("
+            + f"T:{node['threadNum']} pq:{node['passQps']} bq:{node['blockQps']}"
+            + f" tq:{node['totalQps']} rt:{node['averageRt']:.1f}"
+            + f" e:{node['exceptionQps']})"
+        )
+        for c in node["children"]:
+            walk(c, depth + 1)
+
+    walk(req.engine.tree_dict(), 0)
+    return CommandResponse.of_success("\n".join(lines) + "\n")
+
+
+@command_mapping("systemStatus", "system protection signals")
+def cmd_system_status(req: CommandRequest) -> CommandResponse:
+    eng = req.engine
+    sig = eng.system_status.snapshot()
+    totals, threads = eng.row_stats()
+    from sentinel_tpu.core import constants as C
+    from sentinel_tpu.core.registry import ENTRY_ROW
+
+    t = totals[ENTRY_ROW]
+    succ = max(int(t[C.MetricEvent.SUCCESS]), 1)
+    return CommandResponse.of_success({
+        "load": float(sig[0]),
+        "cpuUsage": float(sig[1]),
+        "qps": int(t[C.MetricEvent.PASS]),
+        "avgRt": float(t[C.MetricEvent.RT]) / succ,
+        "maxThread": int(threads[ENTRY_ROW]),
+    })
+
+
+@command_mapping("getSwitch", "global protection switch state")
+def cmd_get_switch(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_success(
+        f"Sentinel switch value: {'true' if req.engine.enabled else 'false'}")
+
+
+@command_mapping("setSwitch", "flip the global protection switch")
+def cmd_set_switch(req: CommandRequest) -> CommandResponse:
+    value = (req.get_param("value") or "").lower()
+    if value not in ("true", "false"):
+        return CommandResponse.of_failure("invalid parameter: value")
+    req.engine.enabled = value == "true"
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("api", "list registered commands")
+def cmd_api(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_success([
+        {"url": f"/{name}", "desc": desc}
+        for name, desc in sorted(registered_commands().items())
+    ])

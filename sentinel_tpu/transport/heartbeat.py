@@ -1,0 +1,99 @@
+"""Heartbeat sender (reference: ``SimpleHttpHeartbeatSender`` +
+``HeartbeatSenderInitFunc`` — SURVEY.md §2.3, §3.4): periodic POST to the
+dashboard's ``/registry/machine`` so it discovers this instance and marks it
+healthy. Dashboard list comes from ``csp.sentinel.dashboard.server``
+(comma-separated ``host:port``); failures rotate to the next address.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import urllib.parse
+import urllib.request
+from typing import List, Optional
+
+from sentinel_tpu.core.config import config
+
+
+def _local_ip() -> str:
+    override = config.get("csp.sentinel.heartbeat.client.ip")
+    if override:
+        return override
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+class HeartbeatSender:
+    def __init__(self, dashboards: Optional[List[str]] = None,
+                 interval_ms: Optional[int] = None,
+                 api_port: Optional[int] = None):
+        servers = dashboards
+        if servers is None:
+            raw = config.dashboard_server() or ""
+            servers = [s.strip() for s in raw.split(",") if s.strip()]
+        self.dashboards = servers
+        self.interval_ms = interval_ms or config.heartbeat_interval_ms()
+        self.api_port = api_port or config.api_port()
+        self._idx = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def heartbeat_message(self) -> dict:
+        import sentinel_tpu
+
+        return {
+            "app": config.app_name(),
+            "app_type": str(config.app_type()),
+            "v": sentinel_tpu.__version__,
+            "version": str(int(__import__("time").time() * 1000)),
+            "hostname": socket.gethostname(),
+            "ip": _local_ip(),
+            "port": str(self.api_port),
+            "pid": str(os.getpid()),
+        }
+
+    def send_once(self) -> bool:
+        """One POST to the current dashboard; rotate on failure."""
+        if not self.dashboards:
+            return False
+        target = self.dashboards[self._idx % len(self.dashboards)]
+        url = f"http://{target}/registry/machine"
+        data = urllib.parse.urlencode(self.heartbeat_message()).encode("ascii")
+        try:
+            with urllib.request.urlopen(url, data=data, timeout=3) as resp:
+                return 200 <= resp.status < 300
+        except OSError:
+            self._idx += 1  # try the next dashboard next beat
+            return False
+
+    def start(self) -> "HeartbeatSender":
+        if self._thread is None:
+            self._stop.clear()  # allow start() after a stop()
+            self._thread = threading.Thread(
+                target=self._run, name="sentinel-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self):
+        from sentinel_tpu.log.record_log import record_log
+
+        while not self._stop.wait(self.interval_ms / 1000.0):
+            try:
+                self.send_once()
+            except Exception as ex:
+                record_log.warn("heartbeat failed: %r", ex)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

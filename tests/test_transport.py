@@ -1,0 +1,184 @@
+"""Transport tests: command center HTTP surface + heartbeat.
+
+Reference analog (SURVEY.md §4 "Transport tests"): start on an ephemeral
+port, drive with a bare HTTP client, assert handler semantics.
+"""
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.transport.command_center import CommandCenter
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+
+@pytest.fixture()
+def center(engine):
+    c = CommandCenter(engine, port=0)  # ephemeral port
+    c.start()
+    yield c
+    c.stop()
+
+
+def _get(center, path):
+    url = f"http://127.0.0.1:{center.bound_port}/{path}"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def _post(center, path, body: str):
+    url = f"http://127.0.0.1:{center.bound_port}/{path}"
+    req = urllib.request.Request(url, data=body.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_version_and_basic_info(center):
+    status, body = _get(center, "version")
+    assert status == 200 and body.startswith("sentinel-tpu/")
+    status, body = _get(center, "basicInfo")
+    assert json.loads(body)["pid"] > 0
+
+
+def test_unknown_command_is_400(center):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(center, "noSuchCommand")
+    assert e.value.code == 400
+
+
+def test_get_set_rules_round_trip(center, engine):
+    rules = [{"resource": "api", "count": 7.0, "grade": 1}]
+    status, body = _post(
+        center, "setRules?type=flow", f"data={urllib.parse.quote(json.dumps(rules))}")
+    assert status == 200 and body == "success"
+    # The engine now enforces the pushed rule.
+    passed = sum(1 for _ in range(10) if st.entry_ok("api"))
+    assert passed == 7
+    status, body = _get(center, "getRules?type=flow")
+    got = json.loads(body)
+    assert got[0]["resource"] == "api" and got[0]["count"] == 7.0
+
+
+def test_set_rules_every_family(center, engine):
+    payloads = {
+        "degrade": [{"resource": "d", "grade": 2, "count": 1, "timeWindow": 5}],
+        "system": [{"qps": 1000}],
+        "authority": [{"resource": "a", "limitApp": "x", "strategy": 0}],
+        "paramFlow": [{"resource": "p", "paramIdx": 0, "count": 3}],
+    }
+    for rule_type, rules in payloads.items():
+        status, body = _post(center, f"setRules?type={rule_type}",
+                             f"data={urllib.parse.quote(json.dumps(rules))}")
+        assert (status, body) == (200, "success"), rule_type
+        status, body = _get(center, f"getRules?type={rule_type}")
+        assert len(json.loads(body)) == 1, rule_type
+
+
+def test_cnode_and_cluster_node(center, engine):
+    with st.entry("res1"):
+        pass
+    status, body = _get(center, "cnode?id=res1")
+    node = json.loads(body)
+    assert node["resource"] == "res1" and node["passQps"] == 1
+    status, body = _get(center, "clusterNode")
+    assert any(n["resource"] == "res1" for n in json.loads(body))
+
+
+def test_tree_commands(center, engine):
+    st.context_enter("ctxA")
+    with st.entry("deep"):
+        pass
+    st.exit_context()
+    status, body = _get(center, "jsonTree")
+    tree = json.loads(body)
+    assert tree["resource"] == "machine-root"
+    flat = json.dumps(tree)
+    assert "ctxA" in flat and "deep" in flat
+    status, body = _get(center, "tree")
+    assert "deep(" in body
+
+
+def test_switch_round_trip(center, engine):
+    st.load_flow_rules([st.FlowRule(resource="sw", count=0)])
+    assert st.entry_ok("sw") is None
+    status, body = _get(center, "setSwitch?value=false")
+    assert body == "success"
+    # Switch off: everything passes unguarded.
+    assert st.entry_ok("sw") is not None
+    _get(center, "setSwitch?value=true")
+    assert st.entry_ok("sw") is None
+    status, body = _get(center, "getSwitch")
+    assert "true" in body
+
+
+def test_api_lists_commands(center):
+    status, body = _get(center, "api")
+    urls = {e["url"] for e in json.loads(body)}
+    assert {"/version", "/getRules", "/setRules", "/metric", "/jsonTree",
+            "/cnode", "/clusterNode"} <= urls
+
+
+def test_metric_command_reads_log(center, engine, frozen_time, tmp_path, monkeypatch):
+    from sentinel_tpu.metrics.timer import MetricTimerListener
+    from sentinel_tpu.metrics.writer import MetricWriter
+    from sentinel_tpu.core.config import config
+
+    monkeypatch.setenv("CSP_SENTINEL_LOG_DIR", str(tmp_path))
+    monkeypatch.setenv("PROJECT_NAME", "transportApp")
+    with st.entry("m1"):
+        pass
+    frozen_time.advance_time(2000)
+    timer = MetricTimerListener(
+        engine, MetricWriter(app="transportApp", base_dir=str(tmp_path)))
+    assert timer.tick(frozen_time.current_time_millis()) >= 1
+    timer.writer.close()
+    status, body = _get(center, "metric?startTime=0&identity=m1")
+    assert status == 200
+    assert "|m1|" in body
+
+
+# -- heartbeat --------------------------------------------------------------
+
+class _DashboardStub(BaseHTTPRequestHandler):
+    received = []
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length).decode()
+        _DashboardStub.received.append((self.path, urllib.parse.parse_qs(body)))
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"ok")
+
+
+def test_heartbeat_posts_registry_machine():
+    _DashboardStub.received.clear()
+    server = HTTPServer(("127.0.0.1", 0), _DashboardStub)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        hb = HeartbeatSender(
+            dashboards=[f"127.0.0.1:{server.server_address[1]}"], api_port=8719)
+        assert hb.send_once()
+        path, params = _DashboardStub.received[0]
+        assert path == "/registry/machine"
+        assert params["port"] == ["8719"]
+        assert "app" in params and "ip" in params
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_heartbeat_rotates_on_failure():
+    hb = HeartbeatSender(dashboards=["127.0.0.1:1", "127.0.0.1:2"], api_port=1)
+    assert not hb.send_once()
+    assert hb._idx == 1  # rotated to the second dashboard
