@@ -114,9 +114,11 @@ def init_ops_plane(port: Optional[int] = None):
     from sentinel_tpu.transport.command_center import CommandCenter
     from sentinel_tpu.transport.heartbeat import HeartbeatSender
 
-    engine = get_engine()
-    center = CommandCenter(engine, port=port).start()
-    timer = MetricTimerListener(engine).start()
+    get_engine()
+    # No explicit engine: both follow the live default engine so a later
+    # reset() doesn't leave the ops plane serving a dead one.
+    center = CommandCenter(port=port).start()
+    timer = MetricTimerListener().start()
     heartbeat = None
     if _config.dashboard_server():
         heartbeat = HeartbeatSender(api_port=center.bound_port).start()

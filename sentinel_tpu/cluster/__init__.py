@@ -1,0 +1,35 @@
+"""Cluster flow control (reference: ``sentinel-cluster/`` — SURVEY.md §2.4,
+§2.11, §3.3): a token server owning global sliding windows so N instances
+share one quota, a binary-TLV TCP wire protocol, a token client with
+reconnect + local fallback, and namespace-scoped cluster rule management.
+
+TPU-native split: *within a pod* there is no server at all — cluster-mode
+rules admit against a ``psum``'d global window (``parallel/cluster.py``).
+This package is the *cross-process* surface: the token server batches
+acquire requests from remote (non-pod) clients into jitted device steps over
+one ``[flow_rules, buckets, events]`` window tensor, and the client side
+plugs into the engine's flow checker with the reference's
+``fallbackToLocalOrPass`` semantics.
+"""
+
+from sentinel_tpu.cluster.constants import (
+    ClusterFlowEvent,
+    MSG_FLOW,
+    MSG_PARAM_FLOW,
+    MSG_PING,
+    THRESHOLD_AVG_LOCAL,
+    THRESHOLD_GLOBAL,
+    TokenResultStatus,
+)
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.state import ClusterStateManager
+
+__all__ = [
+    "ClusterFlowEvent", "ClusterFlowRuleManager", "ClusterStateManager",
+    "ClusterTokenClient", "ClusterTokenServer", "DefaultTokenService",
+    "MSG_FLOW", "MSG_PARAM_FLOW", "MSG_PING", "THRESHOLD_AVG_LOCAL",
+    "THRESHOLD_GLOBAL", "TokenResult", "TokenResultStatus",
+]

@@ -1,0 +1,169 @@
+"""The token client (reference: ``cluster-client:DefaultClusterTokenClient``
++ ``netty/NettyTransportClient`` + ``TokenClientPromiseHolder`` — SURVEY.md
+§2.4): one TCP connection, xid-correlated request/response futures, request
+timeouts, scheduled reconnect, and a namespace PING on connect.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.constants import (
+    MSG_FLOW,
+    MSG_PARAM_FLOW,
+    MSG_PING,
+    TokenResultStatus,
+)
+from sentinel_tpu.cluster.token_service import TokenResult
+
+
+class ClusterTokenClient:
+    def __init__(self, host: str, port: int, namespace: str = "default",
+                 request_timeout_s: float = 2.0,
+                 reconnect_interval_s: float = 2.0):
+        self.host = host
+        self.port = port
+        self.namespace = namespace
+        self.request_timeout_s = request_timeout_s
+        self.reconnect_interval_s = reconnect_interval_s
+        self._xid = itertools.count(1)
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()  # serialize frame writes
+        self._sock: Optional[socket.socket] = None
+        self._pending: Dict[int, Tuple[threading.Event, dict]] = {}
+        self._reader: Optional[threading.Thread] = None
+        self._reconnector: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- connection management --------------------------------------------
+
+    def start(self) -> "ClusterTokenClient":
+        self._stop.clear()
+        try:
+            self._connect()
+        except OSError:
+            pass  # reconnector keeps trying
+        self._reconnector = threading.Thread(
+            target=self._reconnect_loop, name="sentinel-token-reconnect",
+            daemon=True)
+        self._reconnector.start()
+        return self
+
+    def _connect(self) -> None:
+        # Dial OUTSIDE the lock: a blackholed server must not stall
+        # is_connected() readers (the entry() fallback path) for the
+        # connect timeout.
+        with self._lock:
+            if self._sock is not None:
+                return
+        sock = socket.create_connection((self.host, self.port), timeout=3)
+        sock.settimeout(None)
+        with self._lock:
+            if self._sock is not None:  # raced with another connect
+                sock.close()
+                return
+            self._sock = sock
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock,),
+            name="sentinel-token-reader", daemon=True)
+        self._reader.start()
+        # Register the namespace (reference: PingRequest on channel active).
+        self._call(MSG_PING, codec.encode_ping(self.namespace))
+
+    def _reconnect_loop(self):
+        while not self._stop.wait(self.reconnect_interval_s):
+            if not self.is_connected():
+                try:
+                    self._connect()
+                except OSError:
+                    continue
+
+    def is_connected(self) -> bool:
+        with self._lock:
+            return self._sock is not None
+
+    def _drop_connection(self):
+        with self._lock:
+            sock, self._sock = self._sock, None
+            pending = list(self._pending.values())
+            self._pending.clear()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for done, box in pending:
+            done.set()  # fail fast: box stays empty -> FAIL
+
+    def _read_loop(self, sock: socket.socket):
+        reader = codec.FrameReader()
+        try:
+            while True:
+                data = sock.recv(65536)
+                if not data:
+                    break
+                for body in reader.feed(data):
+                    resp = codec.decode_response(body)
+                    with self._lock:
+                        entry = self._pending.pop(resp.xid, None)
+                    if entry is not None:
+                        entry[1]["resp"] = resp
+                        entry[0].set()
+        except OSError:
+            pass
+        finally:
+            self._drop_connection()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._drop_connection()
+        if self._reconnector is not None:
+            self._reconnector.join(timeout=1.0)
+            self._reconnector = None
+
+    # -- requests ----------------------------------------------------------
+
+    def _call(self, msg_type: int, entity: bytes) -> Optional[codec.Response]:
+        xid = next(self._xid)
+        done = threading.Event()
+        box: dict = {}
+        with self._lock:
+            sock = self._sock
+            if sock is None:
+                return None
+            self._pending[xid] = (done, box)
+        try:
+            with self._send_lock:  # frames must not interleave on the wire
+                sock.sendall(codec.encode_request(xid, msg_type, entity))
+        except OSError:
+            self._drop_connection()
+            return None
+        if not done.wait(self.request_timeout_s):
+            with self._lock:
+                self._pending.pop(xid, None)
+            return None
+        return box.get("resp")
+
+    def request_token(self, flow_id: int, count: int = 1,
+                      prioritized: bool = False) -> TokenResult:
+        """One acquire; FAIL on disconnect/timeout (caller decides fallback)."""
+        resp = self._call(MSG_FLOW,
+                          codec.encode_flow_request(flow_id, count, prioritized))
+        if resp is None:
+            return TokenResult(TokenResultStatus.FAIL)
+        remaining, wait_ms = codec.decode_flow_response(resp.entity)
+        if resp.status == TokenResultStatus.SHOULD_WAIT:
+            return TokenResult(resp.status, wait_ms=wait_ms)
+        return TokenResult(resp.status, remaining=remaining)
+
+    def request_param_token(self, flow_id: int, count: int,
+                            params: Sequence) -> TokenResult:
+        resp = self._call(
+            MSG_PARAM_FLOW, codec.encode_param_flow_request(flow_id, count, params))
+        if resp is None:
+            return TokenResult(TokenResultStatus.FAIL)
+        return TokenResult(resp.status)

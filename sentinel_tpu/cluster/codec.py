@@ -1,0 +1,172 @@
+"""Binary TLV wire protocol (reference: ``cluster-common:`` request/response
+entities + ``codec/`` writer/decoder registries — SURVEY.md §2.11).
+
+Frame: big-endian ``u16`` length prefix, then the body.
+Request body:  ``xid:i32 | type:u8 | entity``.
+Response body: ``xid:i32 | type:u8 | status:i8 | entity``.
+
+Entities:
+  * PING request: ``u8 len | namespace utf-8``; response: empty.
+  * FLOW request: ``flowId:i64 | count:i32 | priority:u8``;
+    response: ``remaining:i32 | waitMs:i32`` (``FlowTokenResponseData``).
+  * PARAM_FLOW request: ``flowId:i64 | count:i32 | nparams:u16 | params``
+    with each param type-tagged (``u8``: 0=int/1=str/2=bool/3=float);
+    response: empty.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+from sentinel_tpu.cluster.constants import MSG_FLOW, MSG_PARAM_FLOW, MSG_PING
+
+_LEN = struct.Struct(">H")
+_REQ_HEAD = struct.Struct(">iB")
+_RESP_HEAD = struct.Struct(">iBb")
+_FLOW_REQ = struct.Struct(">qiB")
+_FLOW_RESP = struct.Struct(">ii")
+
+PARAM_INT = 0
+PARAM_STR = 1
+PARAM_BOOL = 2
+PARAM_FLOAT = 3
+
+
+class Request(NamedTuple):
+    xid: int
+    msg_type: int
+    entity: bytes
+
+
+class Response(NamedTuple):
+    xid: int
+    msg_type: int
+    status: int
+    entity: bytes
+
+
+def frame(body: bytes) -> bytes:
+    return _LEN.pack(len(body)) + body
+
+
+def encode_request(xid: int, msg_type: int, entity: bytes) -> bytes:
+    return frame(_REQ_HEAD.pack(xid, msg_type) + entity)
+
+
+def encode_response(xid: int, msg_type: int, status: int, entity: bytes = b"") -> bytes:
+    return frame(_RESP_HEAD.pack(xid, msg_type, status) + entity)
+
+
+def decode_request(body: bytes) -> Request:
+    xid, msg_type = _REQ_HEAD.unpack_from(body)
+    return Request(xid, msg_type, body[_REQ_HEAD.size:])
+
+
+def decode_response(body: bytes) -> Response:
+    xid, msg_type, status = _RESP_HEAD.unpack_from(body)
+    return Response(xid, msg_type, status, body[_RESP_HEAD.size:])
+
+
+class FrameReader:
+    """Incremental length-field frame splitter (Netty frame decoder analog)."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf.extend(data)
+        frames = []
+        while True:
+            if len(self._buf) < _LEN.size:
+                break
+            (length,) = _LEN.unpack_from(self._buf)
+            if len(self._buf) < _LEN.size + length:
+                break
+            frames.append(bytes(self._buf[_LEN.size:_LEN.size + length]))
+            del self._buf[:_LEN.size + length]
+        return frames
+
+
+# -- entities -----------------------------------------------------------------
+
+
+def encode_ping(namespace: str) -> bytes:
+    raw = namespace.encode("utf-8")[:255]
+    return bytes([len(raw)]) + raw
+
+
+def decode_ping(entity: bytes) -> str:
+    n = entity[0] if entity else 0
+    return entity[1:1 + n].decode("utf-8")
+
+
+def encode_flow_request(flow_id: int, count: int, prioritized: bool) -> bytes:
+    return _FLOW_REQ.pack(flow_id, count, 1 if prioritized else 0)
+
+
+def decode_flow_request(entity: bytes) -> Tuple[int, int, bool]:
+    flow_id, count, prio = _FLOW_REQ.unpack_from(entity)
+    return flow_id, count, bool(prio)
+
+
+def encode_flow_response(remaining: int, wait_ms: int) -> bytes:
+    return _FLOW_RESP.pack(remaining, wait_ms)
+
+
+def decode_flow_response(entity: bytes) -> Tuple[int, int]:
+    if len(entity) < _FLOW_RESP.size:
+        return 0, 0
+    return _FLOW_RESP.unpack_from(entity)
+
+
+def encode_params(params: Sequence) -> bytes:
+    out = [struct.pack(">H", len(params))]
+    for p in params:
+        if isinstance(p, bool):
+            out.append(struct.pack(">BB", PARAM_BOOL, 1 if p else 0))
+        elif isinstance(p, int):
+            out.append(struct.pack(">Bq", PARAM_INT, p))
+        elif isinstance(p, float):
+            out.append(struct.pack(">Bd", PARAM_FLOAT, p))
+        else:
+            raw = str(p).encode("utf-8")
+            out.append(struct.pack(">BH", PARAM_STR, len(raw)) + raw)
+    return b"".join(out)
+
+
+def decode_params(entity: bytes, offset: int = 0) -> Tuple[list, int]:
+    (n,) = struct.unpack_from(">H", entity, offset)
+    offset += 2
+    params: list = []
+    for _ in range(n):
+        (tag,) = struct.unpack_from(">B", entity, offset)
+        offset += 1
+        if tag == PARAM_BOOL:
+            (v,) = struct.unpack_from(">B", entity, offset)
+            params.append(bool(v))
+            offset += 1
+        elif tag == PARAM_INT:
+            (v,) = struct.unpack_from(">q", entity, offset)
+            params.append(v)
+            offset += 8
+        elif tag == PARAM_FLOAT:
+            (v,) = struct.unpack_from(">d", entity, offset)
+            params.append(v)
+            offset += 8
+        else:
+            (length,) = struct.unpack_from(">H", entity, offset)
+            offset += 2
+            params.append(entity[offset:offset + length].decode("utf-8"))
+            offset += length
+    return params, offset
+
+
+def encode_param_flow_request(flow_id: int, count: int, params: Sequence) -> bytes:
+    return struct.pack(">qi", flow_id, count) + encode_params(params)
+
+
+def decode_param_flow_request(entity: bytes) -> Tuple[int, int, list]:
+    flow_id, count = struct.unpack_from(">qi", entity)
+    params, _ = decode_params(entity, 12)
+    return flow_id, count, params

@@ -1,0 +1,49 @@
+"""Cluster protocol + semantics constants (reference:
+``cluster-common:ClusterConstants.java``, ``core:cluster/TokenResultStatus.java``).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Message types on the wire (reference: ClusterConstants MSG_TYPE_*).
+MSG_PING = 0
+MSG_FLOW = 1
+MSG_PARAM_FLOW = 2
+
+# ClusterFlowConfig.thresholdType (reference: ClusterRuleConstant).
+THRESHOLD_AVG_LOCAL = 0  # effective threshold = count × connected clients
+THRESHOLD_GLOBAL = 1     # effective threshold = count
+
+DEFAULT_SAMPLE_COUNT = 10
+DEFAULT_WINDOW_INTERVAL_MS = 1000
+DEFAULT_MAX_OCCUPY_RATIO = 1.0  # ClusterServerConfigManager default
+DEFAULT_MAX_ALLOWED_QPS = 30_000.0  # GlobalRequestLimiter per-namespace cap
+
+
+class TokenResultStatus(enum.IntEnum):
+    """Reference: ``TokenResultStatus`` (values are wire-visible)."""
+
+    BAD_REQUEST = -4
+    TOO_MANY_REQUEST = -2
+    FAIL = -1
+    OK = 0
+    BLOCKED = 1
+    SHOULD_WAIT = 2
+    NO_RULE_EXISTS = 3
+    NO_REF_RULE_EXISTS = 4
+    NOT_AVAILABLE = 5
+
+
+class ClusterFlowEvent(enum.IntEnum):
+    """Channels of the server-global window (reference: ``ClusterFlowEvent``)."""
+
+    PASS = 0
+    BLOCK = 1
+    PASS_REQUEST = 2
+    BLOCK_REQUEST = 3
+    OCCUPIED_PASS = 4
+    WAITING = 5
+
+
+NUM_CLUSTER_EVENTS = len(ClusterFlowEvent)

@@ -1,0 +1,157 @@
+"""Namespace-scoped cluster rule management (reference:
+``cluster-server:flow/rule/ClusterFlowRuleManager.java`` — namespace →
+property → flowId → rule; SURVEY.md §2.4).
+
+Rules arrive as ordinary :class:`~sentinel_tpu.models.flow.FlowRule`s whose
+``cluster_config`` dict carries the reference's ``ClusterFlowConfig`` fields
+(``flowId``, ``thresholdType``, ``fallbackToLocalWhenFail``, ``sampleCount``,
+``windowIntervalMs``). They compile to SoA tensors + a RowWindow whose
+per-row bucket length encodes each rule's window geometry.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.cluster import constants as CC
+from sentinel_tpu.models.flow import FlowRule
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.utils.shapes import round_up as _round_up
+
+
+class ClusterRuleTensors(NamedTuple):
+    flow_id: jax.Array        # int64[CR]
+    threshold: jax.Array      # f32[CR] raw count
+    threshold_type: jax.Array  # int32[CR] AVG_LOCAL | GLOBAL
+    interval_ms: jax.Array    # int64[CR]
+    namespace_id: jax.Array   # int32[CR] (feeds the per-namespace conn count)
+
+    @property
+    def num_rules(self) -> int:
+        return self.flow_id.shape[0]
+
+
+class ClusterMetricState(NamedTuple):
+    """The server-global sliding windows: one RowWindow row per flow rule."""
+
+    win: W.RowWindow  # [CR, B, NUM_CLUSTER_EVENTS]
+
+
+def make_metric_state(rt: ClusterRuleTensors, bucket_ms: np.ndarray,
+                      buckets: int) -> ClusterMetricState:
+    return ClusterMetricState(
+        win=W.make_row_window(rt.num_rules, buckets, CC.NUM_CLUSTER_EVENTS,
+                              bucket_ms))
+
+
+class ClusterFlowRuleManager:
+    """flowId-keyed registry across namespaces; wholesale swap per namespace."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._by_namespace: Dict[str, List[FlowRule]] = {}
+        self._namespace_ids: Dict[str, int] = {}
+        self.version = 0
+        self._listeners = []
+
+    def namespace_id(self, namespace: str) -> int:
+        with self._lock:
+            nid = self._namespace_ids.get(namespace)
+            if nid is None:
+                nid = len(self._namespace_ids)
+                self._namespace_ids[namespace] = nid
+            return nid
+
+    def namespaces(self) -> List[str]:
+        with self._lock:
+            return list(self._by_namespace)
+
+    def load_rules(self, namespace: str, rules: List[FlowRule]) -> None:
+        """Replace one namespace's rule set (property push semantics)."""
+        valid = []
+        for r in rules:
+            cc = r.cluster_config or {}
+            if r.is_valid() and r.cluster_mode and cc.get("flowId") is not None:
+                valid.append(r)
+        with self._lock:
+            self._by_namespace[namespace] = valid
+            self.namespace_id(namespace)
+            self.version += 1
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn()
+
+    def get_rules(self, namespace: Optional[str] = None) -> List[FlowRule]:
+        with self._lock:
+            if namespace is not None:
+                return list(self._by_namespace.get(namespace, []))
+            return [r for rs in self._by_namespace.values() for r in rs]
+
+    def rule_by_flow_id(self, flow_id: int) -> Optional[FlowRule]:
+        with self._lock:
+            for rs in self._by_namespace.values():
+                for r in rs:
+                    if (r.cluster_config or {}).get("flowId") == flow_id:
+                        return r
+        return None
+
+    def namespace_of_flow_id(self, flow_id: int) -> Optional[str]:
+        with self._lock:
+            for ns, rs in self._by_namespace.items():
+                for r in rs:
+                    if (r.cluster_config or {}).get("flowId") == flow_id:
+                        return ns
+        return None
+
+    def add_listener(self, fn) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    # -- compilation -------------------------------------------------------
+
+    def compile(self) -> Tuple[ClusterRuleTensors, ClusterMetricState, Dict[int, int]]:
+        """-> (tensors, fresh metric state, flowId -> rule-slot map)."""
+        with self._lock:
+            items = [(ns, r) for ns, rs in self._by_namespace.items() for r in rs]
+            ns_ids = dict(self._namespace_ids)
+        cr = _round_up(max(len(items), 1), 8)
+        flow_id = np.full(cr, -1, np.int64)
+        threshold = np.zeros(cr, np.float32)
+        threshold_type = np.zeros(cr, np.int32)
+        interval_ms = np.zeros(cr, np.int64)
+        namespace_id = np.full(cr, -1, np.int32)
+        bucket_ms = np.zeros(cr, np.int64)
+        slot_of: Dict[int, int] = {}
+        max_samples = 1
+        for i, (ns, r) in enumerate(items):
+            cc = r.cluster_config or {}
+            samples = max(1, int(cc.get("sampleCount", CC.DEFAULT_SAMPLE_COUNT)))
+            interval = int(cc.get("windowIntervalMs", CC.DEFAULT_WINDOW_INTERVAL_MS))
+            max_samples = max(max_samples, samples)
+            flow_id[i] = int(cc["flowId"])
+            threshold[i] = r.count
+            threshold_type[i] = int(cc.get("thresholdType", CC.THRESHOLD_AVG_LOCAL))
+            interval_ms[i] = interval
+            namespace_id[i] = ns_ids[ns]
+            slot_of[int(cc["flowId"])] = i
+        # The RowWindow bucket COUNT is shared (= the finest sampleCount);
+        # every rule's span must still equal its own interval, so each row's
+        # bucket length is interval / shared-count. Rules asking for coarser
+        # sampling just get finer buckets — same totals, no over-span.
+        for i, (ns, r) in enumerate(items):
+            cc = r.cluster_config or {}
+            interval = int(cc.get("windowIntervalMs", CC.DEFAULT_WINDOW_INTERVAL_MS))
+            bucket_ms[i] = max(1, interval // max_samples)
+        rt = ClusterRuleTensors(
+            flow_id=jnp.asarray(flow_id),
+            threshold=jnp.asarray(threshold),
+            threshold_type=jnp.asarray(threshold_type),
+            interval_ms=jnp.asarray(interval_ms),
+            namespace_id=jnp.asarray(namespace_id),
+        )
+        return rt, make_metric_state(rt, bucket_ms, max_samples), slot_of

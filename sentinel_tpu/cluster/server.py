@@ -1,0 +1,176 @@
+"""The token server's TCP frontend (reference:
+``cluster-server:netty/NettyTransportServer.java`` + ``TokenServerHandler`` +
+``processor/*RequestProcessor`` — SURVEY.md §2.4).
+
+TPU-native twist: concurrent client requests are *micro-batched* — each
+connection thread enqueues its decoded request and a collector drains the
+queue into one ``DefaultTokenService.request_tokens`` device step, so the
+server's cost per acquire amortizes across clients (SURVEY.md §7 hard part
+#1). Single-request latency still takes at most ``batch_linger_s``.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import socketserver
+import threading
+from typing import Optional, Tuple
+
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.constants import (
+    MSG_FLOW,
+    MSG_PARAM_FLOW,
+    MSG_PING,
+    TokenResultStatus,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+
+class _PendingFlow(Tuple):
+    pass
+
+
+class _Batcher:
+    """Collects flow-token requests into one device step per linger tick."""
+
+    def __init__(self, service: DefaultTokenService, linger_s: float, max_batch: int):
+        self.service = service
+        self.linger_s = linger_s
+        self.max_batch = max_batch
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, flow_id: int, count: int, prioritized: bool):
+        """-> a Future-like event carrying the TokenResult."""
+        done = threading.Event()
+        box = {}
+        self._queue.put((flow_id, count, prioritized, done, box))
+        return done, box
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="sentinel-token-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # Linger briefly so concurrent clients fold into one step.
+            deadline = threading.Event()
+            deadline.wait(self.linger_s)
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            results = self.service.request_tokens(
+                [(b[0], b[1], b[2]) for b in batch])
+            for (_, _, _, done, box), result in zip(batch, results):
+                box["result"] = result
+                done.set()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: "ClusterTokenServer" = self.server.token_server
+        reader = codec.FrameReader()
+        namespace: Optional[str] = None
+        self.request.settimeout(300)
+        try:
+            while True:
+                data = self.request.recv(65536)
+                if not data:
+                    break
+                for body in reader.feed(data):
+                    req = codec.decode_request(body)
+                    namespace = self._process(server, req, namespace)
+        except OSError:
+            pass
+        finally:
+            if namespace is not None:
+                server.service.connections.disconnect(namespace)
+
+    def _process(self, server, req: codec.Request, namespace):
+        if req.msg_type == MSG_PING:
+            ns = codec.decode_ping(req.entity)
+            if namespace is None and ns:
+                server.service.connections.connect(ns)
+                namespace = ns
+            self.request.sendall(codec.encode_response(
+                req.xid, MSG_PING, TokenResultStatus.OK))
+        elif req.msg_type == MSG_FLOW:
+            flow_id, count, prio = codec.decode_flow_request(req.entity)
+            done, box = server.batcher.submit(flow_id, count, prio)
+            done.wait(timeout=5)
+            result = box.get("result")
+            if result is None:
+                self.request.sendall(codec.encode_response(
+                    req.xid, MSG_FLOW, TokenResultStatus.FAIL))
+            else:
+                self.request.sendall(codec.encode_response(
+                    req.xid, MSG_FLOW, result.status,
+                    codec.encode_flow_response(result.remaining, result.wait_ms)))
+        elif req.msg_type == MSG_PARAM_FLOW:
+            flow_id, count, params = codec.decode_param_flow_request(req.entity)
+            result = server.service.request_param_token(flow_id, count, params)
+            self.request.sendall(codec.encode_response(
+                req.xid, MSG_PARAM_FLOW, result.status))
+        else:
+            self.request.sendall(codec.encode_response(
+                req.xid, req.msg_type, TokenResultStatus.BAD_REQUEST))
+        return namespace
+
+
+class _ThreadingTCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ClusterTokenServer:
+    """Embedded-or-standalone token server (``SentinelDefaultTokenServer``)."""
+
+    def __init__(self, service: Optional[DefaultTokenService] = None,
+                 host: str = "0.0.0.0", port: int = 0,
+                 batch_linger_s: float = 0.0005, max_batch: int = 256):
+        self.service = service or DefaultTokenService()
+        self.host = host
+        self.port = port
+        self.batcher = _Batcher(self.service, batch_linger_s, max_batch)
+        self._server: Optional[_ThreadingTCP] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._server.server_address[1] if self._server else self.port
+
+    def start(self) -> "ClusterTokenServer":
+        self._server = _ThreadingTCP((self.host, self.port), _Handler)
+        self._server.token_server = self
+        self.batcher.start()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="sentinel-token-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.batcher.stop()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

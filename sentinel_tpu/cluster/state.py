@@ -1,0 +1,64 @@
+"""Cluster role management (reference: ``core:cluster/ClusterStateManager.java``
+— SURVEY.md §2.4): an instance is NOT_STARTED, a token CLIENT, or an
+(embedded) token SERVER; the ops plane can flip roles at runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+CLUSTER_NOT_STARTED = -1
+CLUSTER_CLIENT = 0
+CLUSTER_SERVER = 1
+
+
+class ClusterStateManager:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.mode = CLUSTER_NOT_STARTED
+        self.token_client = None
+        self.token_server = None
+
+    def set_to_client(self, host: str, port: int,
+                      namespace: str = "default") -> None:
+        """Flip to CLIENT: connect to a remote token server."""
+        from sentinel_tpu.cluster.client import ClusterTokenClient
+
+        with self._lock:
+            self._teardown()
+            self.token_client = ClusterTokenClient(host, port, namespace).start()
+            self.mode = CLUSTER_CLIENT
+
+    def set_to_server(self, host: str = "0.0.0.0", port: int = 0,
+                      service=None) -> "object":
+        """Flip to SERVER: run the embedded token server; returns it."""
+        from sentinel_tpu.cluster.server import ClusterTokenServer
+
+        with self._lock:
+            self._teardown()
+            self.token_server = ClusterTokenServer(
+                service=service, host=host, port=port).start()
+            self.mode = CLUSTER_SERVER
+            return self.token_server
+
+    def _teardown(self):
+        if self.token_client is not None:
+            self.token_client.stop()
+            self.token_client = None
+        if self.token_server is not None:
+            self.token_server.stop()
+            self.token_server = None
+
+    def stop(self) -> None:
+        with self._lock:
+            self._teardown()
+            self.mode = CLUSTER_NOT_STARTED
+
+    def client_if_active(self):
+        """The connected token client, or None (drives the fallback path)."""
+        with self._lock:
+            if (self.mode == CLUSTER_CLIENT and self.token_client is not None
+                    and self.token_client.is_connected()):
+                return self.token_client
+        return None

@@ -1,0 +1,305 @@
+"""The token service: global-quota admission (reference:
+``cluster-server:DefaultTokenService.java`` + ``flow/ClusterFlowChecker.java``
++ ``flow/statistic/*`` + ``connection/ConnectionManager.java`` +
+``flow/statistic/limit/GlobalRequestLimiter.java`` — SURVEY.md §2.4, §3.3).
+
+TPU-native design: all flow rules' global sliding windows live in one
+RowWindow tensor; ``acquire_step`` is a jitted pure function evaluating a
+whole batch of token requests at once (rotation → per-rule usage + within-
+batch arrival prefixes → verdicts → commit). The TCP frontend batches
+concurrent client requests into these steps; per-request semantics follow
+``ClusterFlowChecker.acquireClusterToken``:
+
+  * effective threshold = count (GLOBAL) or count × connected-client count
+    (AVG_LOCAL), compared against the window's per-second pass average;
+  * pass → commit PASS/PASS_REQUEST, status OK;
+  * over + prioritized → if the waiting backlog is under
+    ``maxOccupyRatio × threshold``, commit WAITING and return
+    SHOULD_WAIT(ms until the next bucket);
+  * otherwise commit BLOCK/BLOCK_REQUEST, status BLOCKED;
+  * unknown flowId → NO_RULE_EXISTS (client falls back to local);
+  * namespace over ``maxAllowedQps`` → TOO_MANY_REQUEST (GlobalRequestLimiter).
+
+Param-flow tokens (``requestParamToken``) use per-(flowId, param-hash) QPS
+buckets server-side, mirroring ``ClusterParamFlowChecker``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.cluster import constants as CC
+from sentinel_tpu.cluster.rules import (
+    ClusterFlowRuleManager,
+    ClusterMetricState,
+    ClusterRuleTensors,
+)
+from sentinel_tpu.ops import window as W
+from sentinel_tpu.ops.segment import segmented_prefix
+from sentinel_tpu.utils import time_util
+from sentinel_tpu.utils.param_hash import hash_param
+
+
+class TokenResult(NamedTuple):
+    """Reference: ``TokenResult`` (status + optional wait hint)."""
+
+    status: int
+    remaining: int = 0
+    wait_ms: int = 0
+
+
+class ConnectionManager:
+    """namespace → live client connection count (feeds AVG_LOCAL)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: Dict[str, int] = {}
+
+    def connect(self, namespace: str) -> None:
+        with self._lock:
+            self._groups[namespace] = self._groups.get(namespace, 0) + 1
+
+    def disconnect(self, namespace: str) -> None:
+        with self._lock:
+            n = self._groups.get(namespace, 0) - 1
+            if n <= 0:
+                self._groups.pop(namespace, None)
+            else:
+                self._groups[namespace] = n
+
+    def connected_count(self, namespace: str) -> int:
+        with self._lock:
+            return self._groups.get(namespace, 0)
+
+
+class GlobalRequestLimiter:
+    """Per-namespace QPS self-protection cap on the token server itself."""
+
+    def __init__(self, max_allowed_qps: float = CC.DEFAULT_MAX_ALLOWED_QPS):
+        self.max_allowed_qps = max_allowed_qps
+        self._lock = threading.Lock()
+        self._counts: Dict[str, Tuple[int, int]] = {}  # ns -> (second, count)
+
+    def try_pass(self, namespace: str, now_ms: int) -> bool:
+        sec = now_ms // 1000
+        with self._lock:
+            cur_sec, count = self._counts.get(namespace, (sec, 0))
+            if cur_sec != sec:
+                cur_sec, count = sec, 0
+            if count + 1 > self.max_allowed_qps:
+                self._counts[namespace] = (cur_sec, count)
+                return False
+            self._counts[namespace] = (cur_sec, count + 1)
+            return True
+
+
+# ---------------------------------------------------------------------------
+# Device-side acquire step
+# ---------------------------------------------------------------------------
+
+
+def acquire_step(
+    state: ClusterMetricState,
+    rt: ClusterRuleTensors,
+    conn_counts: jax.Array,   # int32[NS] per-namespace connected clients
+    slots: jax.Array,         # int32[N] rule slot per request (-1 = unknown)
+    counts: jax.Array,        # int32[N]
+    prioritized: jax.Array,   # bool[N]
+    now_ms: jax.Array,
+    max_occupy_ratio: float = CC.DEFAULT_MAX_OCCUPY_RATIO,
+) -> Tuple[ClusterMetricState, jax.Array, jax.Array]:
+    """-> (state', status int32[N], wait_ms int32[N]). Jit-compiled."""
+    now_ms = jnp.asarray(now_ms, jnp.int64)
+    win = W.row_rotate(state.win, now_ms)
+    n = slots.shape[0]
+    known = slots >= 0
+
+    g = lambda a, fill=0: a.at[W.oob(slots, a.shape[0])].get(mode="fill", fill_value=fill)
+
+    # Per-second pass average of each request's rule window + arrival prefix.
+    # WAITING counts (prioritized requests that will pass after their sleep)
+    # are charged as usage too, so waited-through admissions can't let the
+    # next window over-admit beyond the configured threshold.
+    totals = W.row_window_totals(win, slots)  # [N, E]
+    interval = jnp.maximum(g(rt.interval_ms, 1000), 1).astype(jnp.float32)
+    tok_prefix, _ = segmented_prefix(jnp.where(known, slots, -1), counts)
+    passed = (totals[:, CC.ClusterFlowEvent.PASS].astype(jnp.float32)
+              + totals[:, CC.ClusterFlowEvent.WAITING].astype(jnp.float32)
+              + tok_prefix.astype(jnp.float32)) * (1000.0 / interval)
+
+    ns = g(rt.namespace_id, -1)
+    conns = conn_counts.at[W.oob(ns, conn_counts.shape[0])].get(
+        mode="fill", fill_value=0).astype(jnp.float32)
+    thr = jnp.where(
+        g(rt.threshold_type) == CC.THRESHOLD_GLOBAL,
+        g(rt.threshold, 0.0),
+        g(rt.threshold, 0.0) * jnp.maximum(conns, 1.0),
+    )
+
+    ok = passed + counts.astype(jnp.float32) <= thr
+
+    # Occupy branch for prioritized over-quota requests: bounded backlog.
+    waiting = totals[:, CC.ClusterFlowEvent.WAITING].astype(jnp.float32)
+    can_wait = prioritized & (waiting + counts <= max_occupy_ratio * thr)
+    bucket_ms = jnp.maximum(g(win.bucket_ms, 1000), 1)
+    wait_ms = (bucket_ms - jnp.mod(now_ms, bucket_ms)).astype(jnp.int32)
+
+    status = jnp.where(ok, CC.TokenResultStatus.OK, CC.TokenResultStatus.BLOCKED)
+    status = jnp.where(~ok & can_wait, CC.TokenResultStatus.SHOULD_WAIT, status)
+    status = jnp.where(~known, CC.TokenResultStatus.NO_RULE_EXISTS, status)
+    status = status.astype(jnp.int32)
+    wait_ms = jnp.where(status == CC.TokenResultStatus.SHOULD_WAIT, wait_ms, 0)
+
+    # Commit: PASS/BLOCK counts + request tallies + WAITING backlog.
+    def add(win, event, values):
+        return W.row_window_add(win, now_ms, jnp.where(known, slots, -1),
+                                jnp.full((n,), event), values)
+
+    is_ok = status == CC.TokenResultStatus.OK
+    is_blocked = status == CC.TokenResultStatus.BLOCKED
+    is_wait = status == CC.TokenResultStatus.SHOULD_WAIT
+    win = add(win, CC.ClusterFlowEvent.PASS, jnp.where(is_ok, counts, 0))
+    win = add(win, CC.ClusterFlowEvent.PASS_REQUEST, jnp.where(is_ok, 1, 0))
+    win = add(win, CC.ClusterFlowEvent.BLOCK, jnp.where(is_blocked, counts, 0))
+    win = add(win, CC.ClusterFlowEvent.BLOCK_REQUEST, jnp.where(is_blocked, 1, 0))
+    win = add(win, CC.ClusterFlowEvent.WAITING, jnp.where(is_wait, counts, 0))
+
+    remaining = jnp.maximum(thr - passed - counts, 0).astype(jnp.int32)
+    return ClusterMetricState(win=win), status, jnp.where(is_ok, remaining, wait_ms)
+
+
+# ---------------------------------------------------------------------------
+# Host service
+# ---------------------------------------------------------------------------
+
+
+class DefaultTokenService:
+    """The server-side token service over the jitted acquire step."""
+
+    def __init__(self, rules: Optional[ClusterFlowRuleManager] = None,
+                 max_allowed_qps: float = CC.DEFAULT_MAX_ALLOWED_QPS,
+                 max_occupy_ratio: float = CC.DEFAULT_MAX_OCCUPY_RATIO):
+        self.rules = rules or ClusterFlowRuleManager()
+        self.connections = ConnectionManager()
+        self.limiter = GlobalRequestLimiter(max_allowed_qps)
+        self.max_occupy_ratio = max_occupy_ratio
+        self._lock = threading.Lock()
+        self._compiled_version = -1
+        self._rt: Optional[ClusterRuleTensors] = None
+        self._state: Optional[ClusterMetricState] = None
+        self._slot_of: Dict[int, int] = {}
+        self._acquire_jit = jax.jit(
+            acquire_step, static_argnames=("max_occupy_ratio",),
+            donate_argnums=(0,))
+        # Param-flow cluster buckets: (flowId, param_hash) -> (window_start, used)
+        self._param_buckets: Dict[Tuple[int, int], Tuple[int, float]] = {}
+
+    def _ensure_compiled(self):
+        if self._compiled_version != self.rules.version:
+            self._rt, self._state, self._slot_of = self.rules.compile()
+            self._compiled_version = self.rules.version
+
+    def _conn_tensor(self) -> jnp.ndarray:
+        counts = [0] * max(len(self.rules._namespace_ids), 1)
+        for ns, nid in self.rules._namespace_ids.items():
+            counts[nid] = self.connections.connected_count(ns)
+        return jnp.asarray(counts, jnp.int32)
+
+    def request_token(self, flow_id: int, count: int = 1,
+                      prioritized: bool = False,
+                      now_ms: Optional[int] = None) -> TokenResult:
+        results = self.request_tokens([(flow_id, count, prioritized)], now_ms)
+        return results[0]
+
+    def request_tokens(self, requests: Sequence[Tuple[int, int, bool]],
+                       now_ms: Optional[int] = None) -> List[TokenResult]:
+        """Batched acquire — the TCP frontend folds concurrent clients in."""
+        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        with self._lock:
+            self._ensure_compiled()
+            out: List[Optional[TokenResult]] = [None] * len(requests)
+            slots = np.full(len(requests), -1, np.int32)
+            counts = np.zeros(len(requests), np.int32)
+            prio = np.zeros(len(requests), bool)
+            for i, (flow_id, count, prioritized) in enumerate(requests):
+                ns = self.rules.namespace_of_flow_id(flow_id)
+                if ns is not None and not self.limiter.try_pass(ns, now):
+                    out[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
+                    continue
+                slots[i] = self._slot_of.get(flow_id, -1)
+                counts[i] = count
+                prio[i] = prioritized
+            self._state, status, extra = self._acquire_jit(
+                self._state, self._rt, self._conn_tensor(),
+                jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(prio),
+                jnp.asarray(now, jnp.int64),
+                max_occupy_ratio=self.max_occupy_ratio,
+            )
+            status = np.asarray(status)
+            extra = np.asarray(extra)
+            for i in range(len(requests)):
+                if out[i] is None:
+                    s = int(status[i])
+                    if s == CC.TokenResultStatus.SHOULD_WAIT:
+                        out[i] = TokenResult(s, wait_ms=int(extra[i]))
+                    else:
+                        out[i] = TokenResult(s, remaining=int(extra[i]))
+            return out  # type: ignore[return-value]
+
+    def request_param_token(self, flow_id: int, count: int,
+                            params: Sequence, now_ms: Optional[int] = None) -> TokenResult:
+        """Per-(flowId, param) global QPS buckets (``ClusterParamFlowChecker``)."""
+        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        rule = self.rules.rule_by_flow_id(flow_id)
+        if rule is None:
+            return TokenResult(CC.TokenResultStatus.NO_RULE_EXISTS)
+        ns = self.rules.namespace_of_flow_id(flow_id)
+        if ns is not None and not self.limiter.try_pass(ns, now):
+            return TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
+        thr = rule.count
+        window_start = now - now % 1000
+        with self._lock:
+            blocked = False
+            for p in params:
+                key = (flow_id, hash_param(p))
+                start, used = self._param_buckets.get(key, (window_start, 0.0))
+                if start != window_start:
+                    start, used = window_start, 0.0
+                if used + count > thr:
+                    blocked = True
+                self._param_buckets[key] = (start, used)
+            if blocked:
+                return TokenResult(CC.TokenResultStatus.BLOCKED)
+            for p in params:
+                key = (flow_id, hash_param(p))
+                start, used = self._param_buckets[key]
+                self._param_buckets[key] = (start, used + count)
+            if len(self._param_buckets) > 100_000:  # bounded key space
+                self._param_buckets.clear()
+        return TokenResult(CC.TokenResultStatus.OK)
+
+    # -- introspection -----------------------------------------------------
+
+    def metrics_snapshot(self) -> Dict[int, Dict[str, float]]:
+        """Per-flowId window totals (cluster command handlers' data source)."""
+        with self._lock:
+            self._ensure_compiled()
+            now = time_util.current_time_millis()
+            win = W.row_rotate(self._state.win, jnp.asarray(now, jnp.int64))
+            totals = np.asarray(win.counts.sum(axis=1))
+        out = {}
+        for flow_id, slot in self._slot_of.items():
+            t = totals[slot]
+            out[flow_id] = {
+                "pass": float(t[CC.ClusterFlowEvent.PASS]),
+                "block": float(t[CC.ClusterFlowEvent.BLOCK]),
+                "passRequest": float(t[CC.ClusterFlowEvent.PASS_REQUEST]),
+                "blockRequest": float(t[CC.ClusterFlowEvent.BLOCK_REQUEST]),
+                "waiting": float(t[CC.ClusterFlowEvent.WAITING]),
+            }
+        return out

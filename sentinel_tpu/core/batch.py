@@ -31,6 +31,10 @@ class EntryBatch(NamedTuple):
     count: jax.Array        # int32[N] tokens to acquire
     prioritized: jax.Array  # bool[N]
     entry_in: jax.Array     # bool[N] EntryType.IN (system rules apply)
+    skip_cluster: jax.Array  # bool[N] cluster-mode rules already enforced by
+                             # a remote token server for this request
+    pre_blocked: jax.Array   # bool[N] a remote token server already rejected
+                             # this request; commit block stats, skip slots
     param_hash: jax.Array   # uint32[N, MAX_PARAMS] hot-param value hashes
     param_present: jax.Array  # bool[N, MAX_PARAMS]
 
@@ -80,6 +84,8 @@ def make_entry_batch_np(n: int):
         count=np.zeros(n, np.int32),
         prioritized=np.zeros(n, bool),
         entry_in=np.zeros(n, bool),
+        skip_cluster=np.zeros(n, bool),
+        pre_blocked=np.zeros(n, bool),
         param_hash=np.zeros((n, MAX_PARAMS), np.uint32),
         param_present=np.zeros((n, MAX_PARAMS), bool),
     )

@@ -108,7 +108,7 @@ class SentinelEngine:
         # getSwitch command handlers). Off => every entry passes unguarded.
         self.enabled = True
         self.flow_rules = F.FlowRuleManager()
-        self.flow_rules.add_listener(lambda: self._mark_dirty("flow"))
+        self.flow_rules.add_listener(lambda: self._on_rules_changed("flow"))
         self.degrade_rules = D.DegradeRuleManager()
         self.degrade_rules.add_listener(lambda: self._mark_dirty("degrade"))
         self.authority_rules = A.AuthorityRuleManager()
@@ -116,10 +116,17 @@ class SentinelEngine:
         self.system_rules = Y.SystemRuleManager()
         self.system_rules.add_listener(lambda: self._mark_dirty("system"))
         self.param_rules = P.ParamFlowRuleManager()
-        self.param_rules.add_listener(lambda: self._mark_dirty("param"))
+        self.param_rules.add_listener(lambda: self._on_rules_changed("param"))
         self.system_status = Y.SystemStatusListener()
         self._signals_refreshed_ms = 0
         self._sealed_sec = time_util.current_time_millis() // 1000 - 1
+        # Cluster role (client / embedded server) — host-side maps from
+        # resource to its cluster-mode rules' (flowId, fallbackToLocal).
+        from sentinel_tpu.cluster.state import ClusterStateManager
+
+        self.cluster = ClusterStateManager()
+        self._cluster_flow_info: Dict[str, list] = {}
+        self._cluster_param_info: Dict[str, list] = {}
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
@@ -134,6 +141,19 @@ class SentinelEngine:
     def _mark_dirty(self, family: str):
         with self._lock:
             self._dirty[family] = True
+
+    def _on_rules_changed(self, family: str):
+        """Flow/param loads also rebuild the host-side cluster-rule maps
+        eagerly (cheap scans), so the entry() fast path can consult them
+        lock-free: the dicts are replaced wholesale, never mutated."""
+        with self._lock:
+            self._dirty[family] = True
+            if family == "flow":
+                self._cluster_flow_info = self._cluster_info(
+                    self.flow_rules.get_rules())
+            else:
+                self._cluster_param_info = self._cluster_info(
+                    self.param_rules.get_rules(), with_param_idx=True)
 
     def _ensure_compiled(self):
         """(Re)build rule tensors + state after a config push (§3.2).
@@ -209,8 +229,25 @@ class SentinelEngine:
             self.system_status.start()
 
     def close(self) -> None:
-        """Stop background workers (host OS sampler)."""
+        """Stop background workers (host OS sampler, cluster role)."""
         self.system_status.stop()
+        self.cluster.stop()
+
+    @staticmethod
+    def _cluster_info(rules, with_param_idx: bool = False) -> Dict[str, list]:
+        """resource -> [(flowId, fallback[, paramIdx])] for remote-enforced
+        (cluster mode + flowId) rules. Pod-psum cluster rules (no flowId)
+        stay out: they are enforced by the local/pod check."""
+        info: Dict[str, list] = {}
+        for r in rules:
+            cc = getattr(r, "cluster_config", None) or {}
+            if getattr(r, "cluster_mode", False) and cc.get("flowId") is not None:
+                entry = (int(cc["flowId"]),
+                         bool(cc.get("fallbackToLocalWhenFail", True)))
+                if with_param_idx:
+                    entry += (int(r.param_idx),)
+                info.setdefault(r.resource, []).append(entry)
+        return info
 
     def _refresh_signals(self, now_ms: int) -> None:
         """Fold the latest host OS sample into device state (≤ 1 Hz)."""
@@ -258,9 +295,12 @@ class SentinelEngine:
             return EntryHandle(self, resource, ctx, -1, -1, -1, entry_in, count, ())
 
         params = tuple(_hash_param(a) for a in args[:MAX_PARAMS])
+        skip_cluster, pre_blocked = self._cluster_token_check(
+            resource, count, prioritized, args)
         reason, wait_us = self._submit_entry(
             resource, cluster_row, dn_row, origin_row, origin_id,
             reg.context_id(ctx.name), count, prioritized, entry_in, params,
+            skip_cluster=skip_cluster, pre_blocked=pre_blocked,
         )
         if reason > 0 and reason != C.BlockReason.WAIT:
             # Drop an auto-entered context with no live entries so a fresh
@@ -280,9 +320,55 @@ class SentinelEngine:
         ctx.entry_stack.append(handle)
         return handle
 
+    def _cluster_token_check(self, resource, count, prioritized, args) -> Tuple[bool, bool]:
+        """Remote token acquire for cluster-mode rules (``passClusterCheck``).
+
+        Returns (skip_cluster, pre_blocked): with a healthy token client,
+        OK/SHOULD_WAIT verdicts mask the cluster rules out of the local
+        check; BLOCKED pre-decides the entry; FAIL-class statuses keep the
+        local check live when the rule's fallbackToLocalWhenFail is set
+        (= ``fallbackToLocalOrPass``). No client/no cluster rules -> local
+        (or pod-psum) enforcement as-is.
+        """
+        # Lock-free fast path: the info dicts are replaced wholesale on rule
+        # load, and the common no-cluster-rules deployment returns here
+        # without touching the engine lock.
+        flow_info = self._cluster_flow_info.get(resource, ())
+        param_info = self._cluster_param_info.get(resource, ())
+        if not flow_info and not param_info:
+            return False, False
+        client = self.cluster.client_if_active()
+        if client is None:
+            return False, False
+        from sentinel_tpu.cluster.constants import TokenResultStatus
+
+        all_ok = True
+        for flow_id, fallback in flow_info:
+            tr = client.request_token(flow_id, count, prioritized)
+            if tr.status == TokenResultStatus.OK:
+                continue
+            if tr.status == TokenResultStatus.SHOULD_WAIT:
+                time.sleep(tr.wait_ms / 1000.0)
+                continue
+            if tr.status == TokenResultStatus.BLOCKED:
+                return False, True
+            if fallback:  # FAIL / NO_RULE / TOO_MANY_REQUEST -> local check
+                all_ok = False
+        for flow_id, fallback, param_idx in param_info:
+            if param_idx >= len(args):
+                continue  # no such argument: the rule does not apply
+            tr = client.request_param_token(flow_id, count, [args[param_idx]])
+            if tr.status == TokenResultStatus.OK:
+                continue
+            if tr.status == TokenResultStatus.BLOCKED:
+                return False, True
+            if fallback:
+                all_ok = False
+        return all_ok, False
+
     def _submit_entry(self, resource, cluster_row, dn_row, origin_row,
                       origin_id, context_id, count, prioritized, entry_in,
-                      params) -> Tuple[int, int]:
+                      params, skip_cluster=False, pre_blocked=False) -> Tuple[int, int]:
         with self._lock:
             self._ensure_compiled()
             buf = make_entry_batch_np(1)
@@ -295,6 +381,8 @@ class SentinelEngine:
             buf["count"][0] = count
             buf["prioritized"][0] = prioritized
             buf["entry_in"][0] = entry_in
+            buf["skip_cluster"][0] = skip_cluster
+            buf["pre_blocked"][0] = pre_blocked
             for i, h in enumerate(params):
                 buf["param_hash"][0, i] = h
                 buf["param_present"][0, i] = True

@@ -13,13 +13,22 @@ from sentinel_tpu.metrics.writer import MetricWriter
 
 
 class MetricTimerListener:
-    def __init__(self, engine, writer: Optional[MetricWriter] = None,
+    def __init__(self, engine=None, writer: Optional[MetricWriter] = None,
                  period_s: float = 1.0):
-        self.engine = engine
+        # engine=None follows the live default engine (survives reset()).
+        self._engine = engine
         self.writer = writer or MetricWriter()
         self.period_s = period_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def engine(self):
+        if self._engine is not None:
+            return self._engine
+        import sentinel_tpu
+
+        return sentinel_tpu.get_engine()
 
     def tick(self, now_ms: Optional[int] = None) -> int:
         """One aggregation pass (exposed for deterministic tests).

@@ -95,6 +95,8 @@ class FlowRuleTensors(NamedTuple):
     max_token: jax.Array      # float32[FR]
     slope: jax.Array          # float32[FR]
     cluster_mode: jax.Array   # bool[FR]
+    remote_mode: jax.Array    # bool[FR] cluster rule WITH a flowId: enforced
+                              # by a remote token server when one is active
     rules_by_row: jax.Array   # int32[R, K] rule ids per ClusterNode row, -1 pad
 
     @property
@@ -154,6 +156,7 @@ def compile_flow_rules(
     max_token = np.zeros(fr, np.float32)
     slope = np.zeros(fr, np.float32)
     cluster_mode = np.zeros(fr, bool)
+    remote_mode = np.zeros(fr, bool)
 
     named_origins: Dict[str, Set[int]] = {}
     by_row: Dict[int, List[int]] = {}
@@ -166,6 +169,8 @@ def compile_flow_rules(
         strategy[i] = r.strategy
         behavior[i] = r.control_behavior
         cluster_mode[i] = r.cluster_mode
+        remote_mode[i] = (r.cluster_mode
+                          and (r.cluster_config or {}).get("flowId") is not None)
         if r.limit_app == C.LIMIT_APP_DEFAULT:
             limit_origin[i] = C.ORIGIN_ID_DEFAULT
         elif r.limit_app == C.LIMIT_APP_OTHER:
@@ -233,6 +238,7 @@ def compile_flow_rules(
         max_token=jnp.asarray(max_token),
         slope=jnp.asarray(slope),
         cluster_mode=jnp.asarray(cluster_mode),
+        remote_mode=jnp.asarray(remote_mode),
         rules_by_row=jnp.asarray(rules_by_row),
     )
     return t, named_origins
@@ -400,6 +406,12 @@ def _eval_flow_slots(
         chain = (strat == C.FLOW_STRATEGY_CHAIN) & (batch.context_id == g(rt.ref_context, -1))
 
         applicable = has_rule & candidate & (sel_specific | sel_default | sel_other | relate | chain)
+        # Requests whose remote-enforced rules (cluster mode + flowId) were
+        # already checked by a token server skip those rules locally
+        # (reference: passClusterCheck replaces the local check; fallback
+        # requests keep skip_cluster False, which IS fallbackToLocalOrPass's
+        # local branch). Pod-psum cluster rules (no flowId) stay live.
+        applicable = applicable & ~(g(rt.remote_mode, False) & batch.skip_cluster)
         sel_row = jnp.where(sel_default, batch.cluster_row, -1)
         sel_row = jnp.where(sel_specific | sel_other, batch.origin_row, sel_row)
         sel_row = jnp.where(relate, g(rt.ref_row, -1), sel_row)

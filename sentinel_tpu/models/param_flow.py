@@ -97,6 +97,7 @@ class ParamRuleTensors(NamedTuple):
     item_hash: jax.Array     # uint32[PR, MAX_ITEMS] 0 = empty
     item_count: jax.Array    # float32[PR, MAX_ITEMS]
     cluster_mode: jax.Array  # bool[PR]
+    remote_mode: jax.Array   # bool[PR] cluster rule with a flowId (token server)
     rules_by_row: jax.Array  # int32[R, K]
 
     @property
@@ -151,6 +152,7 @@ def compile_param_rules(
     item_hash = np.zeros((pr, MAX_ITEMS), np.uint32)
     item_count = np.zeros((pr, MAX_ITEMS), np.float32)
     cluster_mode = np.zeros(pr, bool)
+    remote_mode = np.zeros(pr, bool)
     by_row: Dict[int, List[int]] = {}
 
     for i, r in enumerate(valid):
@@ -164,6 +166,8 @@ def compile_param_rules(
         behavior[i] = r.control_behavior
         max_queue_us[i] = r.max_queueing_time_ms * 1000
         cluster_mode[i] = r.cluster_mode
+        remote_mode[i] = (r.cluster_mode
+                          and (r.cluster_config or {}).get("flowId") is not None)
         for j, item in enumerate(r.items[:MAX_ITEMS]):
             item_hash[i, j] = hash_fn(item.object)
             item_count[i, j] = item.count
@@ -187,6 +191,7 @@ def compile_param_rules(
         item_hash=jnp.asarray(item_hash),
         item_count=jnp.asarray(item_count),
         cluster_mode=jnp.asarray(cluster_mode),
+        remote_mode=jnp.asarray(remote_mode),
         rules_by_row=jnp.asarray(rules_by_row),
     )
 
@@ -256,6 +261,9 @@ def _eval_param(
         pv_hash = jnp.take_along_axis(batch.param_hash, pidx[:, None], axis=1)[:, 0]
         pv_present = jnp.take_along_axis(batch.param_present, pidx[:, None], axis=1)[:, 0]
         applicable = has_rule & candidate & pv_present
+        # Cluster-mode param rules already enforced remotely are skipped
+        # (reference: ParamFlowChecker cluster branch replaces local check).
+        applicable = applicable & ~(g(rt.remote_mode, False) & batch.skip_cluster)
 
         # Per-value exception items (exact hash match) override the rule count.
         items_h = rt.item_hash.at[W.oob(rule_id, rt.num_rules)].get(

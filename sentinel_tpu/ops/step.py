@@ -109,7 +109,11 @@ def entry_step(
 
     valid = batch.cluster_row >= 0
     reason = jnp.where(valid, C.BlockReason.PASS, -1).astype(jnp.int32)
-    blocked = jnp.zeros((batch.size,), bool)
+    # Remote token-server rejections arrive pre-decided: record the block
+    # (StatisticSlot catches the cluster FlowException the same way) and
+    # skip every local slot.
+    blocked = valid & batch.pre_blocked
+    reason = jnp.where(blocked, C.BlockReason.FLOW, reason)
 
     # --- rule slots (order mirrors the reference chain: authority →
     # system → param-flow → flow → degrade) --------------------------------

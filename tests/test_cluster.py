@@ -1,0 +1,231 @@
+"""Cluster flow-control tests: codec round-trips (the reference's only
+CI-tested cluster surface, SURVEY.md §4) plus what the reference never had —
+deterministic token-service semantics and a real client/server E2E over TCP.
+"""
+
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.constants import (
+    MSG_FLOW,
+    MSG_PARAM_FLOW,
+    MSG_PING,
+    THRESHOLD_AVG_LOCAL,
+    THRESHOLD_GLOBAL,
+    TokenResultStatus,
+)
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+
+def _rule(flow_id, count, threshold_type=THRESHOLD_GLOBAL, **cc):
+    return st.FlowRule(
+        resource=f"res-{flow_id}", count=count, cluster_mode=True,
+        cluster_config={"flowId": flow_id, "thresholdType": threshold_type, **cc},
+    )
+
+
+# -- codec ------------------------------------------------------------------
+
+def test_codec_flow_round_trip():
+    body = codec.encode_request(7, MSG_FLOW, codec.encode_flow_request(42, 3, True))
+    frames = codec.FrameReader().feed(body)
+    assert len(frames) == 1
+    req = codec.decode_request(frames[0])
+    assert (req.xid, req.msg_type) == (7, MSG_FLOW)
+    assert codec.decode_flow_request(req.entity) == (42, 3, True)
+
+    resp_raw = codec.encode_response(7, MSG_FLOW, TokenResultStatus.SHOULD_WAIT,
+                                     codec.encode_flow_response(0, 250))
+    resp = codec.decode_response(codec.FrameReader().feed(resp_raw)[0])
+    assert resp.status == TokenResultStatus.SHOULD_WAIT
+    assert codec.decode_flow_response(resp.entity) == (0, 250)
+
+
+def test_codec_param_round_trip():
+    entity = codec.encode_param_flow_request(9, 2, [5, "user", True, 1.5])
+    flow_id, count, params = codec.decode_param_flow_request(entity)
+    assert (flow_id, count) == (9, 2)
+    assert params == [5, "user", True, 1.5]
+
+
+def test_frame_reader_handles_partial_and_coalesced():
+    a = codec.encode_request(1, MSG_PING, codec.encode_ping("nsA"))
+    b = codec.encode_request(2, MSG_PING, codec.encode_ping("nsB"))
+    r = codec.FrameReader()
+    assert r.feed(a[:3]) == []
+    frames = r.feed(a[3:] + b)  # rest of a + whole b in one read
+    assert len(frames) == 2
+    assert codec.decode_ping(codec.decode_request(frames[1]).entity) == "nsB"
+
+
+# -- token service ----------------------------------------------------------
+
+@pytest.fixture()
+def service(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(100, 5)])
+    return DefaultTokenService(rules)
+
+
+def test_global_quota_exhausts_and_refills(service, frozen_time):
+    got = [service.request_token(100).status for _ in range(8)]
+    assert got.count(TokenResultStatus.OK) == 5
+    assert got.count(TokenResultStatus.BLOCKED) == 3
+    frozen_time.advance_time(1100)  # window rolls -> quota back
+    assert service.request_token(100).status == TokenResultStatus.OK
+
+
+def test_batched_acquire_respects_arrival_order(service, frozen_time):
+    results = service.request_tokens([(100, 1, False)] * 8)
+    ok = [r.status == TokenResultStatus.OK for r in results]
+    assert ok == [True] * 5 + [False] * 3  # earlier arrivals win
+
+
+def test_unknown_flow_id(service):
+    assert service.request_token(999).status == TokenResultStatus.NO_RULE_EXISTS
+
+
+def test_avg_local_threshold_scales_with_connections(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("nsX", [_rule(200, 2, THRESHOLD_AVG_LOCAL)])
+    svc = DefaultTokenService(rules)
+    svc.connections.connect("nsX")
+    svc.connections.connect("nsX")
+    svc.connections.connect("nsX")
+    got = [svc.request_token(200).status for _ in range(8)]
+    assert got.count(TokenResultStatus.OK) == 6  # 2 × 3 clients
+
+
+def test_prioritized_should_wait(service, frozen_time):
+    for _ in range(5):
+        assert service.request_token(100).status == TokenResultStatus.OK
+    r = service.request_token(100, prioritized=True)
+    assert r.status == TokenResultStatus.SHOULD_WAIT
+    assert 0 < r.wait_ms <= 1000
+    # Non-prioritized still blocked.
+    assert service.request_token(100).status == TokenResultStatus.BLOCKED
+
+
+def test_global_request_limiter(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("ns", [_rule(1, 1e9)])
+    svc = DefaultTokenService(rules, max_allowed_qps=3)
+    got = [svc.request_token(1).status for _ in range(5)]
+    assert got.count(TokenResultStatus.TOO_MANY_REQUEST) == 2
+
+
+def test_param_token(service, frozen_time):
+    rules = service.rules
+    rules.load_rules("p", [_rule(300, 2)])
+    got = [service.request_param_token(300, 1, ["hotKey"]).status for _ in range(4)]
+    assert got.count(TokenResultStatus.OK) == 2
+    # A different key has its own global bucket.
+    assert service.request_param_token(300, 1, ["coldKey"]).status == TokenResultStatus.OK
+
+
+def test_metrics_snapshot(service, frozen_time):
+    for _ in range(7):
+        service.request_token(100)
+    snap = service.metrics_snapshot()[100]
+    assert snap["pass"] == 5 and snap["block"] == 2
+    assert snap["passRequest"] == 5 and snap["blockRequest"] == 2
+
+
+# -- TCP client/server E2E --------------------------------------------------
+
+@pytest.fixture()
+def tcp_server(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(500, 4)])
+    server = ClusterTokenServer(
+        DefaultTokenService(rules), host="127.0.0.1", port=0)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_tcp_token_acquire_shares_global_quota(tcp_server):
+    c1 = ClusterTokenClient("127.0.0.1", tcp_server.bound_port, "default").start()
+    c2 = ClusterTokenClient("127.0.0.1", tcp_server.bound_port, "default").start()
+    try:
+        deadline = time.time() + 3
+        while not (c1.is_connected() and c2.is_connected()) and time.time() < deadline:
+            time.sleep(0.02)
+        results = [c1.request_token(500).status, c2.request_token(500).status,
+                   c1.request_token(500).status, c2.request_token(500).status,
+                   c1.request_token(500).status, c2.request_token(500).status]
+        assert results.count(TokenResultStatus.OK) == 4  # one global quota
+        assert results.count(TokenResultStatus.BLOCKED) == 2
+    finally:
+        c1.stop()
+        c2.stop()
+
+
+def test_tcp_client_fail_fast_when_server_down():
+    client = ClusterTokenClient("127.0.0.1", 1, "default",
+                                reconnect_interval_s=30).start()
+    try:
+        assert client.request_token(1).status == TokenResultStatus.FAIL
+    finally:
+        client.stop()
+
+
+def test_tcp_param_token(tcp_server):
+    client = ClusterTokenClient("127.0.0.1", tcp_server.bound_port).start()
+    try:
+        deadline = time.time() + 3
+        while not client.is_connected() and time.time() < deadline:
+            time.sleep(0.02)
+        got = [client.request_param_token(500, 1, ["k"]).status for _ in range(6)]
+        assert got.count(TokenResultStatus.OK) == 4
+    finally:
+        client.stop()
+
+
+# -- engine integration: CLIENT mode + fallback -----------------------------
+
+def test_engine_cluster_client_and_fallback(engine, frozen_time):
+    rule = st.FlowRule(
+        resource="shared", count=100, cluster_mode=True,
+        cluster_config={"flowId": 900, "thresholdType": THRESHOLD_GLOBAL,
+                        "fallbackToLocalWhenFail": True},
+    )
+    st.load_flow_rules([rule])
+
+    server_rules = ClusterFlowRuleManager()
+    server_rules.load_rules("default", [_rule(900, 3)])  # global quota 3
+    server = ClusterTokenServer(
+        DefaultTokenService(server_rules), host="127.0.0.1", port=0).start()
+    try:
+        engine.cluster.set_to_client("127.0.0.1", server.bound_port)
+        deadline = time.time() + 3
+        while engine.cluster.client_if_active() is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert engine.cluster.client_if_active() is not None
+
+        passed = blocked = 0
+        for _ in range(6):
+            h = st.entry_ok("shared")
+            if h:
+                passed += 1
+                h.exit()
+            else:
+                blocked += 1
+        # Remote quota (3) governs, not the local count (100).
+        assert passed == 3 and blocked == 3
+        # Local stats recorded the remote blocks too.
+        snap = engine.node_snapshot()["shared"]
+        assert snap["blockQps"] == 3
+    finally:
+        server.stop()
+        engine.cluster.stop()
+
+    # Server gone -> client inactive -> local rule (count=100) governs.
+    passed = sum(1 for _ in range(10) if st.entry_ok("shared"))
+    assert passed == 10
