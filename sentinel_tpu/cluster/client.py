@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import itertools
 import socket
+import struct
 import threading
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -137,8 +138,14 @@ class ClusterTokenClient:
                 return None
             self._pending[xid] = (done, box)
         try:
+            raw = codec.encode_request(xid, msg_type, entity)
+        except (ValueError, struct.error):  # oversized frame: fail this call
+            with self._lock:
+                self._pending.pop(xid, None)
+            return None
+        try:
             with self._send_lock:  # frames must not interleave on the wire
-                sock.sendall(codec.encode_request(xid, msg_type, entity))
+                sock.sendall(raw)
         except OSError:
             self._drop_connection()
             return None

@@ -47,6 +47,8 @@ class Response(NamedTuple):
 
 
 def frame(body: bytes) -> bytes:
+    if len(body) > 0xFFFF:
+        raise ValueError(f"frame body too large: {len(body)} bytes")
     return _LEN.pack(len(body)) + body
 
 
@@ -130,7 +132,10 @@ def encode_params(params: Sequence) -> bytes:
         elif isinstance(p, float):
             out.append(struct.pack(">Bd", PARAM_FLOAT, p))
         else:
-            raw = str(p).encode("utf-8")
+            # u16 length field: clamp pathological values (identity of a
+            # >64KB param value degrades to its prefix, which is the same
+            # bounded-key-space stance the param tables already take).
+            raw = str(p).encode("utf-8")[:0xFFF0]
             out.append(struct.pack(">BH", PARAM_STR, len(raw)) + raw)
     return b"".join(out)
 

@@ -71,12 +71,20 @@ class ClusterFlowRuleManager:
         with self._lock:
             return list(self._by_namespace)
 
+    def namespace_ids(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._namespace_ids)
+
     def load_rules(self, namespace: str, rules: List[FlowRule]) -> None:
         """Replace one namespace's rule set (property push semantics)."""
         valid = []
         for r in rules:
             cc = r.cluster_config or {}
-            if r.is_valid() and r.cluster_mode and cc.get("flowId") is not None:
+            try:
+                int(cc.get("flowId"))
+            except (TypeError, ValueError):
+                continue  # missing or non-numeric flowId: drop the rule
+            if r.is_valid() and r.cluster_mode:
                 valid.append(r)
         with self._lock:
             self._by_namespace[namespace] = valid
@@ -114,8 +122,9 @@ class ClusterFlowRuleManager:
 
     # -- compilation -------------------------------------------------------
 
-    def compile(self) -> Tuple[ClusterRuleTensors, ClusterMetricState, Dict[int, int]]:
-        """-> (tensors, fresh metric state, flowId -> rule-slot map)."""
+    def compile(self) -> Tuple[ClusterRuleTensors, ClusterMetricState,
+                               Dict[int, int], Dict[int, str]]:
+        """-> (tensors, fresh metric state, flowId -> slot, flowId -> ns)."""
         with self._lock:
             items = [(ns, r) for ns, rs in self._by_namespace.items() for r in rs]
             ns_ids = dict(self._namespace_ids)
@@ -127,6 +136,7 @@ class ClusterFlowRuleManager:
         namespace_id = np.full(cr, -1, np.int32)
         bucket_ms = np.zeros(cr, np.int64)
         slot_of: Dict[int, int] = {}
+        ns_of: Dict[int, str] = {}
         max_samples = 1
         for i, (ns, r) in enumerate(items):
             cc = r.cluster_config or {}
@@ -139,6 +149,7 @@ class ClusterFlowRuleManager:
             interval_ms[i] = interval
             namespace_id[i] = ns_ids[ns]
             slot_of[int(cc["flowId"])] = i
+            ns_of[int(cc["flowId"])] = ns
         # The RowWindow bucket COUNT is shared (= the finest sampleCount);
         # every rule's span must still equal its own interval, so each row's
         # bucket length is interval / shared-count. Rules asking for coarser
@@ -154,4 +165,4 @@ class ClusterFlowRuleManager:
             interval_ms=jnp.asarray(interval_ms),
             namespace_id=jnp.asarray(namespace_id),
         )
-        return rt, make_metric_state(rt, bucket_ms, max_samples), slot_of
+        return rt, make_metric_state(rt, bucket_ms, max_samples), slot_of, ns_of

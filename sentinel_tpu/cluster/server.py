@@ -27,10 +27,6 @@ from sentinel_tpu.cluster.constants import (
 from sentinel_tpu.cluster.token_service import DefaultTokenService
 
 
-class _PendingFlow(Tuple):
-    pass
-
-
 class _Batcher:
     """Collects flow-token requests into one device step per linger tick."""
 
@@ -70,8 +66,16 @@ class _Batcher:
                     batch.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
-            results = self.service.request_tokens(
-                [(b[0], b[1], b[2]) for b in batch])
+            try:
+                results = self.service.request_tokens(
+                    [(b[0], b[1], b[2]) for b in batch])
+            except Exception as ex:  # a poison batch must not kill the loop
+                from sentinel_tpu.log.record_log import record_log
+
+                record_log.warn("token batch failed: %r", ex)
+                for _, _, _, done, box in batch:
+                    done.set()  # empty box -> handler replies FAIL
+                continue
             for (_, _, _, done, box), result in zip(batch, results):
                 box["result"] = result
                 done.set()

@@ -127,10 +127,8 @@ def acquire_step(
     # next window over-admit beyond the configured threshold.
     totals = W.row_window_totals(win, slots)  # [N, E]
     interval = jnp.maximum(g(rt.interval_ms, 1000), 1).astype(jnp.float32)
-    tok_prefix, _ = segmented_prefix(jnp.where(known, slots, -1), counts)
-    passed = (totals[:, CC.ClusterFlowEvent.PASS].astype(jnp.float32)
-              + totals[:, CC.ClusterFlowEvent.WAITING].astype(jnp.float32)
-              + tok_prefix.astype(jnp.float32)) * (1000.0 / interval)
+    base = (totals[:, CC.ClusterFlowEvent.PASS].astype(jnp.float32)
+            + totals[:, CC.ClusterFlowEvent.WAITING].astype(jnp.float32))
 
     ns = g(rt.namespace_id, -1)
     conns = conn_counts.at[W.oob(ns, conn_counts.shape[0])].get(
@@ -141,7 +139,15 @@ def acquire_step(
         g(rt.threshold, 0.0) * jnp.maximum(conns, 1.0),
     )
 
-    ok = passed + counts.astype(jnp.float32) <= thr
+    def verdict(survivors):
+        """Serial semantics: only admitted requests consume the prefix."""
+        tok_prefix, _ = segmented_prefix(
+            jnp.where(known, slots, -1), jnp.where(survivors, counts, 0))
+        passed = (base + tok_prefix.astype(jnp.float32)) * (1000.0 / interval)
+        return passed, passed + counts.astype(jnp.float32) <= thr
+
+    _, ok1 = verdict(known)
+    passed, ok = verdict(known & ok1)
 
     # Occupy branch for prioritized over-quota requests: bounded backlog.
     waiting = totals[:, CC.ClusterFlowEvent.WAITING].astype(jnp.float32)
@@ -193,6 +199,7 @@ class DefaultTokenService:
         self._rt: Optional[ClusterRuleTensors] = None
         self._state: Optional[ClusterMetricState] = None
         self._slot_of: Dict[int, int] = {}
+        self._ns_of: Dict[int, str] = {}
         self._acquire_jit = jax.jit(
             acquire_step, static_argnames=("max_occupy_ratio",),
             donate_argnums=(0,))
@@ -200,13 +207,37 @@ class DefaultTokenService:
         self._param_buckets: Dict[Tuple[int, int], Tuple[int, float]] = {}
 
     def _ensure_compiled(self):
-        if self._compiled_version != self.rules.version:
-            self._rt, self._state, self._slot_of = self.rules.compile()
-            self._compiled_version = self.rules.version
+        if self._compiled_version == self.rules.version:
+            return
+        old_state, old_slots = self._state, self._slot_of
+        self._rt, fresh, self._slot_of, self._ns_of = self.rules.compile()
+        # A rule push must NOT reset surviving flows' windows (the reference
+        # keeps per-flowId ClusterMetrics across updates): carry each
+        # surviving flowId's row over — unless its bucket geometry changed.
+        if old_state is not None and old_slots:
+            counts = np.array(fresh.win.counts)  # writable copies
+            starts = np.array(fresh.win.starts)
+            old_counts = np.asarray(old_state.win.counts)
+            old_starts = np.asarray(old_state.win.starts)
+            old_bucket = np.asarray(old_state.win.bucket_ms)
+            new_bucket = np.asarray(fresh.win.bucket_ms)
+            nbuckets = counts.shape[1]
+            for flow_id, new_slot in self._slot_of.items():
+                old_slot = old_slots.get(flow_id)
+                if (old_slot is None or old_counts.shape[1] != nbuckets
+                        or old_bucket[old_slot] != new_bucket[new_slot]):
+                    continue
+                counts[new_slot] = old_counts[old_slot]
+                starts[new_slot] = old_starts[old_slot]
+            fresh = ClusterMetricState(win=fresh.win._replace(
+                counts=jnp.asarray(counts), starts=jnp.asarray(starts)))
+        self._state = fresh
+        self._compiled_version = self.rules.version
 
     def _conn_tensor(self) -> jnp.ndarray:
-        counts = [0] * max(len(self.rules._namespace_ids), 1)
-        for ns, nid in self.rules._namespace_ids.items():
+        ns_ids = self.rules.namespace_ids()
+        counts = [0] * max(len(ns_ids), 1)
+        for ns, nid in ns_ids.items():
             counts[nid] = self.connections.connected_count(ns)
         return jnp.asarray(counts, jnp.int32)
 
@@ -227,7 +258,7 @@ class DefaultTokenService:
             counts = np.zeros(len(requests), np.int32)
             prio = np.zeros(len(requests), bool)
             for i, (flow_id, count, prioritized) in enumerate(requests):
-                ns = self.rules.namespace_of_flow_id(flow_id)
+                ns = self._ns_of.get(flow_id)
                 if ns is not None and not self.limiter.try_pass(ns, now):
                     out[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
                     continue

@@ -1,0 +1,21 @@
+"""Envoy rate-limit-service front-end (reference:
+``sentinel-cluster-server-envoy-rls`` — SURVEY.md §2.4): implements
+``envoy.service.ratelimit.v2.RateLimitService/ShouldRateLimit`` on top of the
+token service, with descriptor-driven rule generation.
+"""
+
+from sentinel_tpu.envoy_rls.rule import (
+    EnvoyRlsRule,
+    EnvoyRlsRuleManager,
+    KeyValueResource,
+    ResourceDescriptor,
+    descriptor_flow_id,
+    to_cluster_flow_rules,
+)
+from sentinel_tpu.envoy_rls.service import SentinelEnvoyRlsService
+
+__all__ = [
+    "EnvoyRlsRule", "EnvoyRlsRuleManager", "KeyValueResource",
+    "ResourceDescriptor", "SentinelEnvoyRlsService", "descriptor_flow_id",
+    "to_cluster_flow_rules",
+]

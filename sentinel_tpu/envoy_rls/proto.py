@@ -1,0 +1,108 @@
+"""Runtime-built protobuf messages for ``envoy.service.ratelimit.v2``
+(reference ships ``src/main/proto/envoy/service/ratelimit/v2/rls.proto`` +
+generated stubs; this environment has the protobuf runtime but no protoc
+codegen, so the same schema is registered through a hand-built
+``FileDescriptorProto`` — wire-compatible with Envoy's v2 RLS client).
+
+Field numbers mirror the official proto:
+  RateLimitRequest  { domain=1; descriptors=2; hits_addend=3 }
+  RateLimitDescriptor { entries=1 } / Entry { key=1; value=2 }
+  RateLimitResponse { overall_code=1; statuses=2 }
+  DescriptorStatus  { code=1; current_limit=2; limit_remaining=3 }
+  RateLimit         { requests_per_unit=1; unit=2 }
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_PKG = "envoy.service.ratelimit.v2"
+_RL_PKG = "envoy.api.v2.ratelimit"
+
+# Response codes (RateLimitResponse.Code).
+CODE_UNKNOWN = 0
+CODE_OK = 1
+CODE_OVER_LIMIT = 2
+
+# RateLimit.Unit.
+UNIT_UNKNOWN = 0
+UNIT_SECOND = 1
+
+_T = descriptor_pb2.FieldDescriptorProto
+
+
+def _field(name, number, ftype, label=_T.LABEL_OPTIONAL, type_name=None):
+    f = _T(name=name, number=number, type=ftype, label=label)
+    if type_name:
+        f.type_name = type_name
+    return f
+
+
+def _build_pool() -> descriptor_pool.DescriptorPool:
+    pool = descriptor_pool.DescriptorPool()
+
+    rl = descriptor_pb2.FileDescriptorProto(
+        name="envoy/api/v2/ratelimit/ratelimit.proto", package=_RL_PKG)
+    desc = rl.message_type.add(name="RateLimitDescriptor")
+    entry = desc.nested_type.add(name="Entry")
+    entry.field.append(_field("key", 1, _T.TYPE_STRING))
+    entry.field.append(_field("value", 2, _T.TYPE_STRING))
+    desc.field.append(_field(
+        "entries", 1, _T.TYPE_MESSAGE, _T.LABEL_REPEATED,
+        f".{_RL_PKG}.RateLimitDescriptor.Entry"))
+    pool.Add(rl)
+
+    rls = descriptor_pb2.FileDescriptorProto(
+        name="envoy/service/ratelimit/v2/rls.proto", package=_PKG,
+        dependency=["envoy/api/v2/ratelimit/ratelimit.proto"])
+
+    req = rls.message_type.add(name="RateLimitRequest")
+    req.field.append(_field("domain", 1, _T.TYPE_STRING))
+    req.field.append(_field(
+        "descriptors", 2, _T.TYPE_MESSAGE, _T.LABEL_REPEATED,
+        f".{_RL_PKG}.RateLimitDescriptor"))
+    req.field.append(_field("hits_addend", 3, _T.TYPE_UINT32))
+
+    resp = rls.message_type.add(name="RateLimitResponse")
+    code_enum = resp.enum_type.add(name="Code")
+    for n, v in (("UNKNOWN", 0), ("OK", 1), ("OVER_LIMIT", 2)):
+        code_enum.value.add(name=n, number=v)
+    ratelimit = resp.nested_type.add(name="RateLimit")
+    unit_enum = ratelimit.enum_type.add(name="Unit")
+    for n, v in (("UNKNOWN", 0), ("SECOND", 1), ("MINUTE", 2),
+                 ("HOUR", 3), ("DAY", 4)):
+        unit_enum.value.add(name=n, number=v)
+    ratelimit.field.append(_field("requests_per_unit", 1, _T.TYPE_UINT32))
+    ratelimit.field.append(_field(
+        "unit", 2, _T.TYPE_ENUM,
+        type_name=f".{_PKG}.RateLimitResponse.RateLimit.Unit"))
+    status = resp.nested_type.add(name="DescriptorStatus")
+    status.field.append(_field(
+        "code", 1, _T.TYPE_ENUM, type_name=f".{_PKG}.RateLimitResponse.Code"))
+    status.field.append(_field(
+        "current_limit", 2, _T.TYPE_MESSAGE,
+        type_name=f".{_PKG}.RateLimitResponse.RateLimit"))
+    status.field.append(_field("limit_remaining", 3, _T.TYPE_UINT32))
+    resp.field.append(_field(
+        "overall_code", 1, _T.TYPE_ENUM,
+        type_name=f".{_PKG}.RateLimitResponse.Code"))
+    resp.field.append(_field(
+        "statuses", 2, _T.TYPE_MESSAGE, _T.LABEL_REPEATED,
+        f".{_PKG}.RateLimitResponse.DescriptorStatus"))
+    pool.Add(rls)
+    return pool
+
+
+_pool = _build_pool()
+
+
+def _cls(full_name: str):
+    return message_factory.GetMessageClass(_pool.FindMessageTypeByName(full_name))
+
+
+RateLimitDescriptor = _cls(f"{_RL_PKG}.RateLimitDescriptor")
+RateLimitRequest = _cls(f"{_PKG}.RateLimitRequest")
+RateLimitResponse = _cls(f"{_PKG}.RateLimitResponse")
+
+SERVICE_NAME = f"{_PKG}.RateLimitService"
+METHOD_NAME = "ShouldRateLimit"

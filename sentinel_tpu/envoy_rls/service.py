@@ -1,0 +1,99 @@
+"""The RLS service implementation (reference:
+``SentinelEnvoyRlsServiceImpl.java``): each request descriptor resolves to
+its generated cluster rule's flowId and acquires tokens from the token
+service; any over-limit descriptor makes the overall answer OVER_LIMIT.
+
+``SentinelEnvoyRlsService`` is transport-agnostic (plain Python call);
+``serve_grpc`` wraps it in a real gRPC server via a generic handler when
+grpcio is present.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from sentinel_tpu.cluster.constants import TokenResultStatus
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.envoy_rls.rule import EnvoyRlsRuleManager, descriptor_flow_id
+
+
+class SentinelEnvoyRlsService:
+    def __init__(self, rule_manager: Optional[EnvoyRlsRuleManager] = None,
+                 token_service: Optional[DefaultTokenService] = None):
+        self.rules = rule_manager or EnvoyRlsRuleManager()
+        self.token_service = token_service or DefaultTokenService(
+            self.rules.cluster_rules)
+
+    def should_rate_limit(
+        self,
+        domain: str,
+        descriptors: Sequence[Sequence[Tuple[str, str]]],
+        hits_addend: int = 1,
+    ) -> Tuple[int, List[Tuple[int, int]]]:
+        """-> (overall_code, [(code, limit_remaining)] per descriptor).
+
+        Codes are the RLS proto's: 1 = OK, 2 = OVER_LIMIT. Descriptors with
+        no matching rule pass (reference behavior: unknown descriptor = OK).
+        """
+        from sentinel_tpu.envoy_rls import proto
+
+        hits = max(1, int(hits_addend))
+        statuses: List[Tuple[int, int]] = []
+        overall = proto.CODE_OK
+        requests = [(descriptor_flow_id(domain, list(entries)), hits, False)
+                    for entries in descriptors]
+        results = self.token_service.request_tokens(requests)
+        for result in results:
+            if result.status == TokenResultStatus.OK:
+                statuses.append((proto.CODE_OK, result.remaining))
+            elif result.status == TokenResultStatus.NO_RULE_EXISTS:
+                statuses.append((proto.CODE_OK, 0))
+            else:
+                statuses.append((proto.CODE_OVER_LIMIT, 0))
+                overall = proto.CODE_OVER_LIMIT
+        return overall, statuses
+
+    # -- gRPC transport ----------------------------------------------------
+
+    def grpc_should_rate_limit(self, request, context=None):
+        """gRPC method body over the dynamic proto messages."""
+        from sentinel_tpu.envoy_rls import proto
+
+        descriptors = [
+            [(e.key, e.value) for e in d.entries] for d in request.descriptors
+        ]
+        overall, statuses = self.should_rate_limit(
+            request.domain, descriptors, request.hits_addend or 1)
+        resp = proto.RateLimitResponse()
+        resp.overall_code = overall
+        for code, remaining in statuses:
+            s = resp.statuses.add()
+            s.code = code
+            s.limit_remaining = remaining
+        return resp
+
+    def serve_grpc(self, address: str = "0.0.0.0:10245", max_workers: int = 8):
+        """Start a gRPC server exposing RateLimitService; returns it."""
+        import concurrent.futures
+
+        import grpc
+
+        from sentinel_tpu.envoy_rls import proto
+
+        handler = grpc.method_handlers_generic_handler(
+            proto.SERVICE_NAME,
+            {
+                proto.METHOD_NAME: grpc.unary_unary_rpc_method_handler(
+                    self.grpc_should_rate_limit,
+                    request_deserializer=proto.RateLimitRequest.FromString,
+                    response_serializer=proto.RateLimitResponse.SerializeToString,
+                )
+            },
+        )
+        server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=max_workers))
+        server.add_generic_rpc_handlers((handler,))
+        port = server.add_insecure_port(address)
+        server.start()
+        server.bound_port = port
+        return server

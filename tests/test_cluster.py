@@ -229,3 +229,33 @@ def test_engine_cluster_client_and_fallback(engine, frozen_time):
     # Server gone -> client inactive -> local rule (count=100) governs.
     passed = sum(1 for _ in range(10) if st.entry_ok("shared"))
     assert passed == 10
+
+
+def test_blocked_request_does_not_consume_batch_prefix(frozen_time):
+    """Serial semantics: a rejected oversized acquire must not inflate the
+    usage later requests in the same batch see."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(600, 5)])
+    svc = DefaultTokenService(rules)
+    results = svc.request_tokens([(600, 10, False), (600, 1, False)])
+    assert results[0].status == TokenResultStatus.BLOCKED
+    assert results[1].status == TokenResultStatus.OK
+
+
+def test_rule_push_preserves_surviving_flow_windows(frozen_time):
+    """A rule push to one namespace must not reset other flows' windows."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("nsA", [_rule(700, 3)])
+    svc = DefaultTokenService(rules)
+    got = [svc.request_token(700).status for _ in range(4)]
+    assert got.count(TokenResultStatus.OK) == 3
+    rules.load_rules("nsB", [_rule(701, 100)])  # unrelated namespace push
+    assert svc.request_token(700).status == TokenResultStatus.BLOCKED
+
+
+def test_malformed_flow_id_rule_is_dropped(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("ns", [st.FlowRule(
+        resource="x", count=1, cluster_mode=True,
+        cluster_config={"flowId": "abc"})])
+    assert rules.get_rules("ns") == []

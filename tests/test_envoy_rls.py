@@ -1,0 +1,129 @@
+"""Envoy RLS surface tests: rule conversion, ShouldRateLimit semantics, and
+a real gRPC round-trip over the runtime-built proto messages.
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.envoy_rls import (
+    EnvoyRlsRule,
+    EnvoyRlsRuleManager,
+    KeyValueResource,
+    ResourceDescriptor,
+    SentinelEnvoyRlsService,
+    descriptor_flow_id,
+    to_cluster_flow_rules,
+)
+from sentinel_tpu.envoy_rls import proto
+
+
+def _rls_rule(domain="web", key="path", value="/api", count=3):
+    return EnvoyRlsRule(domain, [
+        ResourceDescriptor([KeyValueResource(key, value)], count)])
+
+
+def test_rule_conversion_generates_cluster_rules():
+    rules = to_cluster_flow_rules(_rls_rule())
+    assert len(rules) == 1
+    r = rules[0]
+    assert r.cluster_mode and r.count == 3
+    assert r.resource == "web|path:/api"
+    assert r.cluster_config["flowId"] == descriptor_flow_id(
+        "web", [("path", "/api")])
+    # flowId is stable and descriptor-sensitive.
+    assert descriptor_flow_id("web", [("path", "/api")]) == \
+        descriptor_flow_id("web", [("path", "/api")])
+    assert descriptor_flow_id("web", [("path", "/other")]) != \
+        descriptor_flow_id("web", [("path", "/api")])
+
+
+@pytest.fixture()
+def rls_service(frozen_time):
+    svc = SentinelEnvoyRlsService()
+    svc.rules.load_rules([_rls_rule(count=3)])
+    return svc
+
+
+def test_should_rate_limit_enforces_quota(rls_service, frozen_time):
+    codes = []
+    for _ in range(5):
+        overall, statuses = rls_service.should_rate_limit(
+            "web", [[("path", "/api")]])
+        codes.append(overall)
+    assert codes.count(proto.CODE_OK) == 3
+    assert codes.count(proto.CODE_OVER_LIMIT) == 2
+    frozen_time.advance_time(1100)
+    overall, _ = rls_service.should_rate_limit("web", [[("path", "/api")]])
+    assert overall == proto.CODE_OK
+
+
+def test_unknown_descriptor_passes(rls_service):
+    overall, statuses = rls_service.should_rate_limit(
+        "web", [[("header", "x")]])
+    assert overall == proto.CODE_OK
+
+
+def test_mixed_descriptors_over_limit_wins(rls_service, frozen_time):
+    descriptors = [[("path", "/api")], [("header", "x")]]
+    for _ in range(3):
+        rls_service.should_rate_limit("web", [[("path", "/api")]])
+    overall, statuses = rls_service.should_rate_limit("web", descriptors)
+    assert overall == proto.CODE_OVER_LIMIT
+    assert statuses[0][0] == proto.CODE_OVER_LIMIT
+    assert statuses[1][0] == proto.CODE_OK
+
+
+def test_hits_addend(rls_service, frozen_time):
+    overall, _ = rls_service.should_rate_limit(
+        "web", [[("path", "/api")]], hits_addend=3)
+    assert overall == proto.CODE_OK
+    overall, _ = rls_service.should_rate_limit(
+        "web", [[("path", "/api")]], hits_addend=1)
+    assert overall == proto.CODE_OVER_LIMIT
+
+
+def test_rule_reload_clears_old_domains(frozen_time):
+    mgr = EnvoyRlsRuleManager()
+    mgr.load_rules([_rls_rule(domain="a"), _rls_rule(domain="b")])
+    assert set(mgr.cluster_rules.namespaces()) >= {"a", "b"}
+    mgr.load_rules([_rls_rule(domain="a")])
+    assert mgr.cluster_rules.get_rules("b") == []
+
+
+def test_proto_messages_round_trip():
+    req = proto.RateLimitRequest()
+    req.domain = "web"
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "path", "/api"
+    req.hits_addend = 2
+    raw = req.SerializeToString()
+    back = proto.RateLimitRequest.FromString(raw)
+    assert back.domain == "web"
+    assert back.descriptors[0].entries[0].value == "/api"
+    assert back.hits_addend == 2
+
+
+def test_grpc_round_trip(frozen_time):
+    grpc = pytest.importorskip("grpc")
+    svc = SentinelEnvoyRlsService()
+    svc.rules.load_rules([_rls_rule(count=2)])
+    server = svc.serve_grpc("127.0.0.1:0")
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.bound_port}")
+        call = channel.unary_unary(
+            f"/{proto.SERVICE_NAME}/{proto.METHOD_NAME}",
+            request_serializer=proto.RateLimitRequest.SerializeToString,
+            response_deserializer=proto.RateLimitResponse.FromString,
+        )
+        req = proto.RateLimitRequest()
+        req.domain = "web"
+        d = req.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "path", "/api"
+        codes = [call(req, timeout=5).overall_code for _ in range(4)]
+        assert codes.count(proto.CODE_OK) == 2
+        assert codes.count(proto.CODE_OVER_LIMIT) == 2
+        channel.close()
+    finally:
+        server.stop(0)
