@@ -1,0 +1,29 @@
+"""Framework adapters (reference: ``sentinel-adapter/`` — SURVEY.md §2.5):
+each adapter translates a host-framework request into
+``context_enter(origin) + entry(resource, IN)`` with a block-handler hook.
+
+Python-native adapter set: a decorator (the ``@SentinelResource`` aspect
+analog), WSGI and ASGI middlewares (Servlet / WebFlux analogs), and the API
+gateway common layer (route/API-group rules + param parsing).
+"""
+
+from sentinel_tpu.adapters.annotation import sentinel_resource
+from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
+from sentinel_tpu.adapters.gateway import (
+    ApiDefinition,
+    ApiPredicateItem,
+    GatewayApiDefinitionManager,
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayRuleManager,
+    GatewayRequest,
+    gateway_entry,
+)
+from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+
+__all__ = [
+    "ApiDefinition", "ApiPredicateItem", "GatewayApiDefinitionManager",
+    "GatewayFlowRule", "GatewayParamFlowItem", "GatewayRequest",
+    "GatewayRuleManager", "SentinelASGIMiddleware", "SentinelWSGIMiddleware",
+    "gateway_entry", "sentinel_resource",
+]

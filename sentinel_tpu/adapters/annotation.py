@@ -1,0 +1,96 @@
+"""``@sentinel_resource`` decorator (reference:
+``sentinel-annotation-aspectj``'s ``SentinelResourceAspect`` +
+``AbstractSentinelAspectSupport`` — SURVEY.md §2.2): wrap a function in an
+entry, route ``BlockException`` to the block handler, route traced business
+exceptions to the fallback.
+
+Handler resolution mirrors the aspect: ``block_handler`` gets the original
+arguments plus the exception as a trailing ``ex=`` kwarg; ``fallback``
+likewise. When neither matches, the exception propagates (and business
+exceptions are recorded to the entry via ``Tracer`` semantics unless listed
+in ``exceptions_to_ignore``).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.exceptions import BlockException
+
+
+def sentinel_resource(
+    value: Optional[str] = None,
+    entry_type: int = C.EntryType.OUT,
+    resource_type: int = 0,
+    block_handler: Optional[Callable] = None,
+    fallback: Optional[Callable] = None,
+    default_fallback: Optional[Callable] = None,
+    exceptions_to_ignore: Tuple[Type[BaseException], ...] = (),
+    args_from: Optional[Callable] = None,
+):
+    """Decorator form of ``@SentinelResource``.
+
+    ``args_from(*args, **kwargs)`` optionally derives the hot-param argument
+    list for param-flow rules; by default positional args are used.
+    """
+
+    def deco(fn: Callable) -> Callable:
+        resource = value or f"{fn.__module__}:{fn.__qualname__}"
+
+        def on_blocked(ex, args, kwargs):
+            if block_handler is not None:
+                return block_handler(*args, ex=ex, **kwargs)
+            if default_fallback is not None:
+                return default_fallback(*args, ex=ex, **kwargs)
+            raise ex
+
+        def on_error(entry, ex, args, kwargs):
+            if isinstance(ex, BlockException):
+                # A nested guarded call blocked: route to the block handler,
+                # not the business fallback (reference aspect catches
+                # BlockException around proceed() too).
+                return on_blocked(ex, args, kwargs)
+            if not isinstance(ex, exceptions_to_ignore):
+                entry.trace(ex)
+                handler = fallback or default_fallback
+                if handler is not None:
+                    return handler(*args, ex=ex, **kwargs)
+            raise ex
+
+        if inspect.iscoroutinefunction(fn):
+            @functools.wraps(fn)
+            async def wrapper(*args, **kwargs):
+                params = args_from(*args, **kwargs) if args_from else args
+                try:
+                    entry = st.entry(resource, entry_type=entry_type, args=params)
+                except BlockException as ex:
+                    return on_blocked(ex, args, kwargs)
+                try:
+                    return await fn(*args, **kwargs)
+                except BaseException as ex:
+                    return on_error(entry, ex, args, kwargs)
+                finally:
+                    entry.exit()
+        else:
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                params = args_from(*args, **kwargs) if args_from else args
+                try:
+                    entry = st.entry(resource, entry_type=entry_type, args=params)
+                except BlockException as ex:
+                    return on_blocked(ex, args, kwargs)
+                try:
+                    return fn(*args, **kwargs)
+                except BaseException as ex:
+                    return on_error(entry, ex, args, kwargs)
+                finally:
+                    entry.exit()
+
+        wrapper.__sentinel_resource__ = resource
+        return wrapper
+
+    return deco

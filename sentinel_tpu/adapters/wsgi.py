@@ -1,0 +1,112 @@
+"""WSGI middleware (reference: ``sentinel-web-servlet``'s ``CommonFilter`` +
+``WebCallbackManager`` — SURVEY.md §2.5): each request enters a web context
+with the parsed caller origin and an entry named by the (cleaned) URL path;
+blocked requests get a 429 by default.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.exceptions import BlockException
+
+WEB_CONTEXT_NAME = "sentinel_web_context"
+DEFAULT_BLOCK_BODY = b"Blocked by Sentinel (flow limiting)"
+
+
+class SentinelWSGIMiddleware:
+    def __init__(
+        self,
+        app,
+        url_cleaner: Optional[Callable[[str], str]] = None,
+        origin_parser: Optional[Callable[[dict], str]] = None,
+        block_handler: Optional[Callable] = None,
+        total_resource: Optional[str] = None,
+    ):
+        """``url_cleaner`` maps raw paths to resource names (UrlCleaner);
+        ``origin_parser(environ)`` extracts the caller origin
+        (RequestOriginParser); ``block_handler(environ, start_response, ex)``
+        overrides the 429 response (UrlBlockHandler). ``total_resource``
+        adds a CommonTotalFilter-style aggregate entry when set."""
+        self.app = app
+        self.url_cleaner = url_cleaner or (lambda p: p)
+        self.origin_parser = origin_parser or (lambda environ: "")
+        self.block_handler = block_handler
+        self.total_resource = total_resource
+
+    def __call__(self, environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        resource = self.url_cleaner(path)
+        origin = self.origin_parser(environ)
+        st.context_enter(WEB_CONTEXT_NAME, origin)
+        entries = []
+
+        def cleanup():
+            for e in reversed(entries):
+                e.exit()
+            st.exit_context()
+
+        try:
+            try:
+                if self.total_resource:
+                    entries.append(st.entry(self.total_resource,
+                                            entry_type=C.EntryType.IN))
+                if resource:
+                    entries.append(st.entry(resource, entry_type=C.EntryType.IN))
+            except BlockException as ex:
+                cleanup()  # an earlier entry (total resource) may be live
+                if self.block_handler is not None:
+                    return self.block_handler(environ, start_response, ex)
+                start_response("429 Too Many Requests",
+                               [("Content-Type", "text/plain")])
+                return [DEFAULT_BLOCK_BODY]
+            result = self.app(environ, start_response)
+        except BaseException as ex:
+            for e in entries:
+                e.trace(ex)
+            cleanup()
+            raise
+        else:
+            # Entries stay live while the (possibly streaming) body is
+            # consumed — RT covers body generation and mid-stream errors
+            # are traced (reference CommonFilter completes after the chain).
+            return _GuardedIterable(result, entries, cleanup)
+        finally:
+            if not entries:
+                st.exit_context()
+
+
+class _GuardedIterable:
+    """Wraps the app's response iterable; exits entries on exhaustion/close."""
+
+    def __init__(self, result, entries, cleanup):
+        self._result = result
+        self._entries = entries
+        self._cleanup = cleanup
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._cleanup()
+
+    def __iter__(self):
+        try:
+            for chunk in self._result:
+                yield chunk
+        except BaseException as ex:
+            for e in self._entries:
+                e.trace(ex)
+            raise
+        finally:
+            self._finish()
+
+    def close(self):
+        try:
+            close = getattr(self._result, "close", None)
+            if close is not None:
+                close()
+        finally:
+            self._finish()

@@ -9,7 +9,7 @@ reference (``MAX_CONTEXT_NAME_SIZE``).
 
 from __future__ import annotations
 
-import threading
+import contextvars
 from typing import List, Optional
 
 from sentinel_tpu.core.constants import CONTEXT_DEFAULT_NAME, MAX_CONTEXT_NAME_SIZE
@@ -41,11 +41,16 @@ class NullContext(Context):
         self.is_null = True
 
 
-_tls = threading.local()
+# A ContextVar isolates the call context per thread AND per asyncio task
+# (the reference's ThreadLocal only covers threads; async adapters need
+# task isolation — concurrent requests interleaved on one event-loop
+# thread must not share a context).
+_ctx_var: contextvars.ContextVar[Optional[Context]] = contextvars.ContextVar(
+    "sentinel_context", default=None)
 
 
 def get_context() -> Optional[Context]:
-    return getattr(_tls, "context", None)
+    return _ctx_var.get()
 
 
 def enter(name: str = CONTEXT_DEFAULT_NAME, origin: str = "") -> Context:
@@ -57,7 +62,7 @@ def enter(name: str = CONTEXT_DEFAULT_NAME, origin: str = "") -> Context:
         ctx = NullContext()
     else:
         ctx = Context(name, origin)
-    _tls.context = ctx
+    _ctx_var.set(ctx)
     return ctx
 
 
@@ -65,15 +70,15 @@ def exit_context() -> None:
     """``ContextUtil.exit``: drop the context if no entries remain."""
     ctx = get_context()
     if ctx is not None and not ctx.entry_stack:
-        _tls.context = None
+        _ctx_var.set(None)
 
 
 def auto_exit_context() -> None:
     """Drop only an engine-created default context once its entries drain."""
     ctx = get_context()
     if ctx is not None and ctx.auto_created and not ctx.entry_stack:
-        _tls.context = None
+        _ctx_var.set(None)
 
 
 def replace_context(ctx: Optional[Context]) -> None:
-    _tls.context = ctx
+    _ctx_var.set(ctx)

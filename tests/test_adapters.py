@@ -1,0 +1,364 @@
+"""Adapter tests — the reference's adapter suites are its real integration
+tests (SURVEY.md §4): assert the N+1-th request blocks and the block handler
+fires, per adapter.
+"""
+
+import asyncio
+import io
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.adapters import (
+    ApiDefinition,
+    ApiPredicateItem,
+    GatewayApiDefinitionManager,
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayRequest,
+    GatewayRuleManager,
+    SentinelASGIMiddleware,
+    SentinelWSGIMiddleware,
+    gateway_entry,
+    sentinel_resource,
+)
+from sentinel_tpu.adapters import gateway as G
+from sentinel_tpu.core.exceptions import BlockException, FlowException
+
+
+# -- decorator --------------------------------------------------------------
+
+class TestDecorator:
+    def test_blocks_after_quota_and_routes_to_block_handler(self, engine):
+        calls = []
+
+        def on_block(x, ex=None):
+            calls.append(x)
+            return "blocked"
+
+        @sentinel_resource("deco", block_handler=on_block)
+        def work(x):
+            return x * 2
+
+        st.load_flow_rules([st.FlowRule(resource="deco", count=2)])
+        assert work(1) == 2
+        assert work(2) == 4
+        assert work(3) == "blocked"
+        assert calls == [3]
+
+    def test_fallback_on_business_exception(self, engine):
+        @sentinel_resource("fb", fallback=lambda x, ex=None: f"fb:{x}")
+        def work(x):
+            raise ValueError("boom")
+
+        assert work(7) == "fb:7"
+        # The exception was traced into the stats.
+        snap = engine.node_snapshot()["fb"]
+        assert snap["exceptionQps"] == 1
+
+    def test_ignored_exceptions_propagate_untraced(self, engine):
+        @sentinel_resource("ig", exceptions_to_ignore=(KeyError,),
+                           fallback=lambda ex=None: "fb")
+        def work():
+            raise KeyError("x")
+
+        with pytest.raises(KeyError):
+            work()
+        assert engine.node_snapshot()["ig"]["exceptionQps"] == 0
+
+    def test_default_resource_name(self, engine):
+        @sentinel_resource()
+        def named():
+            return 1
+
+        assert named() == 1
+        assert "named" in named.__sentinel_resource__
+
+    def test_no_handler_raises_block(self, engine):
+        @sentinel_resource("raw")
+        def work():
+            return 1
+
+        st.load_flow_rules([st.FlowRule(resource="raw", count=0)])
+        with pytest.raises(FlowException):
+            work()
+
+
+# -- WSGI -------------------------------------------------------------------
+
+def _wsgi_get(app, path, environ_extra=None):
+    environ = {"PATH_INFO": path, "REQUEST_METHOD": "GET",
+               "wsgi.input": io.BytesIO()}
+    environ.update(environ_extra or {})
+    status_headers = {}
+
+    def start_response(status, headers):
+        status_headers["status"] = status
+
+    body = b"".join(app(environ, start_response))
+    return status_headers["status"], body
+
+
+class TestWSGI:
+    def test_block_returns_429(self, engine):
+        app = SentinelWSGIMiddleware(
+            lambda env, sr: (sr("200 OK", []), [b"ok"])[1])
+        st.load_flow_rules([st.FlowRule(resource="/api", count=2)])
+        results = [_wsgi_get(app, "/api")[0] for _ in range(4)]
+        assert results.count("200 OK") == 2
+        assert results.count("429 Too Many Requests") == 2
+
+    def test_url_cleaner_groups_resources(self, engine):
+        app = SentinelWSGIMiddleware(
+            lambda env, sr: (sr("200 OK", []), [b"ok"])[1],
+            url_cleaner=lambda p: "/users/{id}" if p.startswith("/users/") else p)
+        st.load_flow_rules([st.FlowRule(resource="/users/{id}", count=1)])
+        assert _wsgi_get(app, "/users/1")[0] == "200 OK"
+        assert _wsgi_get(app, "/users/2")[0] == "429 Too Many Requests"
+
+    def test_origin_parser_feeds_authority(self, engine):
+        app = SentinelWSGIMiddleware(
+            lambda env, sr: (sr("200 OK", []), [b"ok"])[1],
+            origin_parser=lambda env: env.get("HTTP_X_ORIGIN", ""))
+        st.load_authority_rules([st.AuthorityRule("/a", "good", 0)])  # whitelist
+        ok = _wsgi_get(app, "/a", {"HTTP_X_ORIGIN": "good"})
+        bad = _wsgi_get(app, "/a", {"HTTP_X_ORIGIN": "evil"})
+        assert ok[0] == "200 OK"
+        assert bad[0] == "429 Too Many Requests"
+
+    def test_custom_block_handler(self, engine):
+        def handler(environ, start_response, ex):
+            start_response("503 Service Unavailable", [])
+            return [b"custom"]
+
+        app = SentinelWSGIMiddleware(
+            lambda env, sr: (sr("200 OK", []), [b"ok"])[1],
+            block_handler=handler)
+        st.load_flow_rules([st.FlowRule(resource="/x", count=0)])
+        status, body = _wsgi_get(app, "/x")
+        assert status == "503 Service Unavailable" and body == b"custom"
+
+    def test_app_exception_traced(self, engine):
+        def bad_app(env, sr):
+            raise RuntimeError("boom")
+
+        app = SentinelWSGIMiddleware(bad_app)
+        with pytest.raises(RuntimeError):
+            _wsgi_get(app, "/err")
+        assert engine.node_snapshot()["/err"]["exceptionQps"] == 1
+
+
+# -- ASGI -------------------------------------------------------------------
+
+async def _asgi_get(app, path):
+    messages = []
+
+    async def receive():
+        return {"type": "http.request"}
+
+    async def send(msg):
+        messages.append(msg)
+
+    await app({"type": "http", "path": path}, receive, send)
+    return messages
+
+
+class TestASGI:
+    def test_block_returns_429(self, engine):
+        async def ok_app(scope, receive, send):
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b"ok"})
+
+        app = SentinelASGIMiddleware(ok_app)
+        st.load_flow_rules([st.FlowRule(resource="/ws", count=1)])
+
+        async def run():
+            first = await _asgi_get(app, "/ws")
+            second = await _asgi_get(app, "/ws")
+            return first, second
+
+        first, second = asyncio.run(run())
+        assert first[0]["status"] == 200
+        assert second[0]["status"] == 429
+
+
+# -- gateway ----------------------------------------------------------------
+
+class TestGateway:
+    def test_route_rule_without_param_item(self, engine):
+        rm = GatewayRuleManager(engine)
+        rm.load_rules([GatewayFlowRule(resource="route-a", count=2)])
+        req = GatewayRequest(path="/any", route="route-a")
+        passed = blocked = 0
+        for _ in range(4):
+            try:
+                entries = gateway_entry(req, rule_manager=rm,
+                                        api_manager=GatewayApiDefinitionManager())
+                passed += 1
+                for e in reversed(entries):
+                    e.exit()
+            except BlockException:
+                blocked += 1
+        assert passed == 2 and blocked == 2
+
+    def test_param_item_per_client_ip(self, engine):
+        rm = GatewayRuleManager(engine)
+        rm.load_rules([GatewayFlowRule(
+            resource="route-b", count=1,
+            param_item=GatewayParamFlowItem(
+                parse_strategy=G.PARAM_PARSE_STRATEGY_CLIENT_IP))])
+        am = GatewayApiDefinitionManager()
+
+        def hit(ip):
+            try:
+                entries = gateway_entry(
+                    GatewayRequest(route="route-b", client_ip=ip),
+                    rule_manager=rm, api_manager=am)
+                for e in reversed(entries):
+                    e.exit()
+                return True
+            except BlockException:
+                return False
+
+        assert hit("1.1.1.1") and not hit("1.1.1.1")  # per-IP quota 1
+        assert hit("2.2.2.2")  # other IP unaffected
+
+    def test_pattern_mismatch_passes_unlimited(self, engine):
+        rm = GatewayRuleManager(engine)
+        rm.load_rules([GatewayFlowRule(
+            resource="route-c", count=1,
+            param_item=GatewayParamFlowItem(
+                parse_strategy=G.PARAM_PARSE_STRATEGY_URL_PARAM,
+                field_name="user", pattern="vip-.*",
+                match_strategy=G.PARAM_MATCH_STRATEGY_REGEX))])
+        am = GatewayApiDefinitionManager()
+
+        def hit(user):
+            try:
+                entries = gateway_entry(
+                    GatewayRequest(route="route-c", params={"user": user}),
+                    rule_manager=rm, api_manager=am)
+                for e in reversed(entries):
+                    e.exit()
+                return True
+            except BlockException:
+                return False
+
+        assert hit("vip-1") and not hit("vip-1")  # matched: limited
+        # Non-matching values ($NM) pass without limit.
+        assert all(hit("pleb") for _ in range(5))
+
+    def test_api_definition_matching(self, engine):
+        am = GatewayApiDefinitionManager()
+        am.load_api_definitions([ApiDefinition("user-api", [
+            ApiPredicateItem("/users/", G.PARAM_MATCH_STRATEGY_PREFIX)])])
+        rm = GatewayRuleManager(engine)
+        rm.load_rules([GatewayFlowRule(
+            resource="user-api", count=1,
+            resource_mode=G.RESOURCE_MODE_CUSTOM_API_NAME)])
+        req = GatewayRequest(path="/users/42")
+        entries = gateway_entry(req, rule_manager=rm, api_manager=am)
+        assert len(entries) == 1
+        for e in entries:
+            e.exit()
+        with pytest.raises(BlockException):
+            gateway_entry(req, rule_manager=rm, api_manager=am)
+        # Unrelated paths map to no API -> no entries, pass.
+        assert gateway_entry(GatewayRequest(path="/other"),
+                             rule_manager=rm, api_manager=am) == []
+
+
+class TestReviewRegressions:
+    def test_async_decorator_instruments_the_await(self, engine):
+        @sentinel_resource("adeco", fallback=lambda ex=None: "fb")
+        async def work():
+            raise ValueError("boom")
+
+        assert asyncio.run(work()) == "fb"
+        assert engine.node_snapshot()["adeco"]["exceptionQps"] == 1
+
+    def test_async_decorator_blocks(self, engine):
+        @sentinel_resource("ablock", block_handler=lambda ex=None: "blocked")
+        async def work():
+            return "ok"
+
+        st.load_flow_rules([st.FlowRule(resource="ablock", count=1)])
+        assert asyncio.run(work()) == "ok"
+        assert asyncio.run(work()) == "blocked"
+
+    def test_nested_block_routes_to_block_handler(self, engine):
+        @sentinel_resource("outer", block_handler=lambda ex=None: "bh",
+                           fallback=lambda ex=None: "fb")
+        def outer():
+            with st.entry("inner"):
+                return "ran"
+
+        st.load_flow_rules([st.FlowRule(resource="inner", count=0)])
+        assert outer() == "bh"  # not the business fallback
+
+    def test_asgi_interleaved_tasks_have_isolated_contexts(self, engine):
+        st.load_authority_rules([st.AuthorityRule("/iso", "good", 0)])
+
+        async def slow_app(scope, receive, send):
+            await asyncio.sleep(0.05)
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b"ok"})
+
+        app = SentinelASGIMiddleware(
+            slow_app, origin_parser=lambda scope: scope.get("origin", ""))
+
+        async def one(origin):
+            messages = []
+
+            async def receive():
+                return {"type": "http.request"}
+
+            async def send(msg):
+                messages.append(msg)
+
+            await app({"type": "http", "path": "/iso", "origin": origin},
+                      receive, send)
+            return messages[0]["status"]
+
+        async def run():
+            return await asyncio.gather(one("good"), one("evil"))
+
+        good_status, evil_status = asyncio.run(run())
+        assert good_status == 200
+        assert evil_status == 429  # evil must NOT inherit good's context
+
+    def test_gateway_and_user_param_rules_coexist(self, engine):
+        st.load_param_flow_rules([st.ParamFlowRule("hot", param_idx=0, count=1)])
+        rm = GatewayRuleManager(engine)
+        rm.load_rules([GatewayFlowRule(resource="route-z", count=1)])
+        # User hot-param rule still enforced after the gateway load.
+        assert st.entry_ok("hot", args=("k",)) is not None
+        assert st.entry_ok("hot", args=("k",)) is None
+        # And the gateway rule is enforced too.
+        req = GatewayRequest(route="route-z")
+        entries = gateway_entry(req, rule_manager=rm,
+                                api_manager=GatewayApiDefinitionManager())
+        for e in entries:
+            e.exit()
+        with pytest.raises(BlockException):
+            gateway_entry(req, rule_manager=rm,
+                          api_manager=GatewayApiDefinitionManager())
+
+    def test_wsgi_streaming_body_keeps_entry_live(self, engine, frozen_time):
+        def streaming_app(env, sr):
+            sr("200 OK", [])
+
+            def gen():
+                frozen_time.advance_time(500)  # body generation takes 500ms
+                yield b"chunk"
+
+            return gen()
+
+        app = SentinelWSGIMiddleware(streaming_app)
+        environ = {"PATH_INFO": "/stream", "REQUEST_METHOD": "GET"}
+        body = app(environ, lambda s, h: None)
+        assert b"".join(body) == b"chunk"
+        snap = engine.node_snapshot()["/stream"]
+        assert snap["avgRt"] >= 500  # RT covers body generation
