@@ -42,10 +42,11 @@ def sentinel_resource(
         resource = value or f"{fn.__module__}:{fn.__qualname__}"
 
         def on_blocked(ex, args, kwargs):
-            if block_handler is not None:
-                return block_handler(*args, ex=ex, **kwargs)
-            if default_fallback is not None:
-                return default_fallback(*args, ex=ex, **kwargs)
+            # Reference resolution order: blockHandler, else the fallback
+            # chain may handle BlockException too.
+            for handler in (block_handler, fallback, default_fallback):
+                if handler is not None:
+                    return handler(*args, ex=ex, **kwargs)
             raise ex
 
         def on_error(entry, ex, args, kwargs):
@@ -61,6 +62,11 @@ def sentinel_resource(
                     return handler(*args, ex=ex, **kwargs)
             raise ex
 
+        async def _maybe_await(value):
+            if inspect.isawaitable(value):  # async handlers are awaited
+                return await value
+            return value
+
         if inspect.iscoroutinefunction(fn):
             @functools.wraps(fn)
             async def wrapper(*args, **kwargs):
@@ -68,11 +74,11 @@ def sentinel_resource(
                 try:
                     entry = st.entry(resource, entry_type=entry_type, args=params)
                 except BlockException as ex:
-                    return on_blocked(ex, args, kwargs)
+                    return await _maybe_await(on_blocked(ex, args, kwargs))
                 try:
                     return await fn(*args, **kwargs)
                 except BaseException as ex:
-                    return on_error(entry, ex, args, kwargs)
+                    return await _maybe_await(on_error(entry, ex, args, kwargs))
                 finally:
                     entry.exit()
         else:

@@ -127,6 +127,7 @@ class SentinelEngine:
         self.cluster = ClusterStateManager()
         self._cluster_flow_info: Dict[str, list] = {}
         self._cluster_param_info: Dict[str, list] = {}
+        self._pipeline = None
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
@@ -149,8 +150,16 @@ class SentinelEngine:
         with self._lock:
             self._dirty[family] = True
             if family == "flow":
-                self._cluster_flow_info = self._cluster_info(
-                    self.flow_rules.get_rules())
+                rules = self.flow_rules.get_rules()
+                self._cluster_flow_info = self._cluster_info(rules)
+                # origin_named is read on entry BEFORE compilation runs, so
+                # the named-origin map must be fresh at load time too.
+                named: Dict[str, set] = {}
+                for r in rules:
+                    if r.limit_app not in (C.LIMIT_APP_DEFAULT, C.LIMIT_APP_OTHER):
+                        named.setdefault(r.resource, set()).add(
+                            self.registry.origin_id(r.limit_app))
+                self._named_origins = named
             else:
                 self._cluster_param_info = self._cluster_info(
                     self.param_rules.get_rules(), with_param_idx=True)
@@ -229,7 +238,8 @@ class SentinelEngine:
             self.system_status.start()
 
     def close(self) -> None:
-        """Stop background workers (host OS sampler, cluster role)."""
+        """Stop background workers (pipeline, host OS sampler, cluster role)."""
+        self.stop_pipeline()
         self.system_status.stop()
         self.cluster.stop()
 
@@ -369,30 +379,74 @@ class SentinelEngine:
     def _submit_entry(self, resource, cluster_row, dn_row, origin_row,
                       origin_id, context_id, count, prioritized, entry_in,
                       params, skip_cluster=False, pre_blocked=False) -> Tuple[int, int]:
+        fields = dict(
+            cluster_row=cluster_row, dn_row=dn_row, origin_row=origin_row,
+            origin_id=origin_id,
+            origin_named=origin_id in self._named_origins.get(resource, ()),
+            context_id=context_id, count=count, prioritized=prioritized,
+            entry_in=entry_in, skip_cluster=skip_cluster,
+            pre_blocked=pre_blocked, params=params,
+        )
+        pipeline = self._pipeline
+        if pipeline is not None:
+            ticket = pipeline.submit_entry(fields)
+            # None / timed-out-after-close: the pipeline shut down around
+            # this submission — fall through to the synchronous path.
+            if ticket is not None:
+                while not ticket.done.wait(timeout=2.0):
+                    if pipeline.closed:
+                        break
+                if ticket.done.is_set():
+                    if ticket.reason == -2:  # cycle error: pass-through
+                        return 0, 0
+                    return ticket.reason, ticket.wait_us
+        with self._lock:
+            buf = make_entry_batch_np(1)
+            for k, v in fields.items():
+                if k == "params":
+                    for i, h in enumerate(v):
+                        buf["param_hash"][0, i] = h
+                        buf["param_present"][0, i] = True
+                else:
+                    buf[k][0] = v
+            dec = self._run_entry_batch_locked(EntryBatch(**buf))
+            return int(dec.reason[0]), int(dec.wait_us[0])
+
+    def _run_entry_batch_locked(self, batch: EntryBatch) -> Decisions:
+        self._ensure_compiled()
+        now = time_util.current_time_millis()
+        self._refresh_signals(now)
+        self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
+        return dec
+
+    def _run_entry_batch(self, batch: EntryBatch) -> Decisions:
+        with self._lock:
+            return self._run_entry_batch_locked(batch)
+
+    def _run_exit_batch(self, batch: ExitBatch) -> None:
         with self._lock:
             self._ensure_compiled()
-            buf = make_entry_batch_np(1)
-            buf["cluster_row"][0] = cluster_row
-            buf["dn_row"][0] = dn_row
-            buf["origin_row"][0] = origin_row
-            buf["origin_id"][0] = origin_id
-            buf["origin_named"][0] = origin_id in self._named_origins.get(resource, ())
-            buf["context_id"][0] = context_id
-            buf["count"][0] = count
-            buf["prioritized"][0] = prioritized
-            buf["entry_in"][0] = entry_in
-            buf["skip_cluster"][0] = skip_cluster
-            buf["pre_blocked"][0] = pre_blocked
-            for i, h in enumerate(params):
-                buf["param_hash"][0, i] = h
-                buf["param_present"][0, i] = True
-            batch = EntryBatch(**buf)
             now = time_util.current_time_millis()
-            self._refresh_signals(now)
-            self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
-            reason = int(dec.reason[0])
-            wait = int(dec.wait_us[0])
-        return reason, wait
+            self._state = self._exit_jit(self._state, self._rules, batch, now)
+
+    # -- pipelined mode ----------------------------------------------------
+
+    def start_pipeline(self, max_batch: int = 2048,
+                       linger_s: float = 0.0001) -> "object":
+        """Switch to micro-batched admission (``core/pipeline.py``):
+        concurrent entries fold into one device step per cycle."""
+        from sentinel_tpu.core.pipeline import Pipeline
+
+        with self._lock:
+            if self._pipeline is None:
+                self._ensure_compiled()  # compile before the loop starts
+                self._pipeline = Pipeline(self, max_batch, linger_s).start()
+            return self._pipeline
+
+    def stop_pipeline(self) -> None:
+        pipeline, self._pipeline = self._pipeline, None
+        if pipeline is not None:
+            pipeline.stop()
 
     def _do_exit(self, handle: EntryHandle, count: int) -> None:
         ctx = handle.context
@@ -405,22 +459,24 @@ class SentinelEngine:
             return
         now = time_util.current_time_millis()
         rt = max(0, now - handle.created_ms)
-        with self._lock:
-            self._ensure_compiled()
+        fields = dict(
+            cluster_row=handle.cluster_row, dn_row=handle.dn_row,
+            origin_row=handle.origin_row, entry_in=handle.entry_in,
+            count=count, rt_ms=min(rt, C.DEFAULT_MAX_RT_MS), success=True,
+            error=handle.error, params=handle.params,
+        )
+        pipeline = self._pipeline
+        submitted = pipeline is not None and pipeline.submit_exit(fields)
+        if not submitted:
             buf = make_exit_batch_np(1)
-            buf["cluster_row"][0] = handle.cluster_row
-            buf["dn_row"][0] = handle.dn_row
-            buf["origin_row"][0] = handle.origin_row
-            buf["entry_in"][0] = handle.entry_in
-            buf["count"][0] = count
-            buf["rt_ms"][0] = min(rt, C.DEFAULT_MAX_RT_MS)
-            buf["success"][0] = True
-            buf["error"][0] = handle.error
-            for i, h in enumerate(handle.params):
-                buf["param_hash"][0, i] = h
-                buf["param_present"][0, i] = True
-            batch = ExitBatch(**buf)
-            self._state = self._exit_jit(self._state, self._rules, batch, now)
+            for k, v in fields.items():
+                if k == "params":
+                    for i, h in enumerate(v):
+                        buf["param_hash"][0, i] = h
+                        buf["param_present"][0, i] = True
+                else:
+                    buf[k][0] = v
+            self._run_exit_batch(ExitBatch(**buf))
         ctx_mod.auto_exit_context()
 
     # -- batch API (bench / pipelined engine / cluster frontends) ---------

@@ -1,0 +1,194 @@
+"""Pipelined admission: micro-batched device steps behind a cadence loop.
+
+SURVEY.md §7 hard part #1: a device dispatch costs ~10-100µs, so per-request
+synchronous steps cap throughput at ~1/dispatch and serialize callers on the
+engine lock. This module runs a collector thread that drains concurrently
+submitted entries/exits into ONE fused step per cycle: p99 latency ≈ queue
+wait + one step, and throughput scales with batch width instead of dispatch
+rate — the host-side half of the reference's "statistics are lock-free"
+property (all mutation rides one linearized step stream).
+
+Ordering guarantees: exits drain BEFORE entries each cycle, and submissions
+are drained FIFO, so a thread's exit→entry program order is preserved
+(THREAD-grade concurrency gauges stay exact). Batch widths come from the
+engine's jit-cache ladder; a cycle never splits one submission.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from sentinel_tpu.core.batch import (
+    EntryBatch,
+    ExitBatch,
+    MAX_PARAMS,
+    make_entry_batch_np,
+    make_exit_batch_np,
+)
+
+LADDER = (1, 8, 64, 512, 2048)
+
+
+def _ladder_width(n: int) -> int:
+    for w in LADDER:
+        if n <= w:
+            return w
+    return LADDER[-1]
+
+
+class _EntryTicket:
+    __slots__ = ("fields", "done", "reason", "wait_us")
+
+    def __init__(self, fields):
+        self.fields = fields  # dict of scalar batch fields (+params tuple)
+        self.done = threading.Event()
+        self.reason = -1
+        self.wait_us = 0
+
+
+class _ExitTicket:
+    __slots__ = ("fields",)
+
+    def __init__(self, fields):
+        self.fields = fields
+
+
+class Pipeline:
+    """The collector loop bound to one engine."""
+
+    def __init__(self, engine, max_batch: int = LADDER[-1],
+                 linger_s: float = 0.0001):
+        self.engine = engine
+        self.max_batch = max_batch
+        self.linger_s = linger_s
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.closed = False
+        self.cycles = 0
+        self.batched = 0
+
+    # -- submission (any thread) ------------------------------------------
+
+    def submit_entry(self, fields) -> Optional[_EntryTicket]:
+        """None once the pipeline is closed (caller takes the sync path)."""
+        if self.closed:
+            return None
+        ticket = _EntryTicket(fields)
+        self._queue.put(ticket)
+        return ticket
+
+    def submit_exit(self, fields) -> bool:
+        if self.closed:
+            return False
+        self._queue.put(_ExitTicket(fields))
+        return True
+
+    # -- the loop ----------------------------------------------------------
+
+    def start(self) -> "Pipeline":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="sentinel-pipeline", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.closed = True  # reject new submissions first
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        while self._drain_cycle():  # flush stragglers that beat the flag
+            pass
+
+    def _run(self):
+        from sentinel_tpu.log.record_log import record_log
+
+        while not self._stop.is_set():
+            try:
+                if not self._drain_cycle():
+                    # Nothing pending: block until the next submission.
+                    try:
+                        item = self._queue.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    self._cycle([item])
+            except Exception as ex:  # keep the loop alive, fail the cycle
+                record_log.warn("pipeline cycle failed: %r", ex)
+
+    def _drain_cycle(self) -> bool:
+        items = []
+        while len(items) < self.max_batch:
+            try:
+                items.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if not items:
+            return False
+        if self.linger_s and len(items) < self.max_batch:
+            # Brief linger folds late-arriving concurrent callers in.
+            deadline = threading.Event()
+            deadline.wait(self.linger_s)
+            while len(items) < self.max_batch:
+                try:
+                    items.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+        self._cycle(items)
+        return True
+
+    def _cycle(self, items: List):
+        exits = [t for t in items if isinstance(t, _ExitTicket)]
+        entries = [t for t in items if isinstance(t, _EntryTicket)]
+        try:
+            # Exits first: program order for exit→entry on one thread.
+            if exits:
+                self._flush_exits(exits)
+            if entries:
+                self._flush_entries(entries)
+        except Exception:
+            for t in entries:
+                t.reason = -2  # engine error: engine treats as pass-through
+                t.done.set()
+            raise
+
+    def _flush_exits(self, exits: List[_ExitTicket]):
+        width = _ladder_width(len(exits))
+        buf = make_exit_batch_np(width)
+        for i, t in enumerate(exits):
+            f = t.fields
+            for k in ("cluster_row", "dn_row", "origin_row", "entry_in",
+                      "count", "rt_ms", "success", "error"):
+                buf[k][i] = f[k]
+            for j, h in enumerate(f.get("params", ())[:MAX_PARAMS]):
+                buf["param_hash"][i, j] = h
+                buf["param_present"][i, j] = True
+        self.engine._run_exit_batch(ExitBatch(**buf))
+
+    def _flush_entries(self, entries: List[_EntryTicket]):
+        width = _ladder_width(len(entries))
+        buf = make_entry_batch_np(width)
+        for i, t in enumerate(entries):
+            f = t.fields
+            for k in ("cluster_row", "dn_row", "origin_row", "origin_id",
+                      "origin_named", "context_id", "count", "prioritized",
+                      "entry_in", "skip_cluster", "pre_blocked"):
+                buf[k][i] = f[k]
+            for j, h in enumerate(f.get("params", ())[:MAX_PARAMS]):
+                buf["param_hash"][i, j] = h
+                buf["param_present"][i, j] = True
+        dec = self.engine._run_entry_batch(EntryBatch(**buf))
+        reasons = np.asarray(dec.reason)
+        waits = np.asarray(dec.wait_us)
+        self.cycles += 1
+        self.batched += len(entries)
+        for i, t in enumerate(entries):
+            t.reason = int(reasons[i])
+            t.wait_us = int(waits[i])
+            t.done.set()

@@ -362,3 +362,22 @@ class TestReviewRegressions:
         assert b"".join(body) == b"chunk"
         snap = engine.node_snapshot()["/stream"]
         assert snap["avgRt"] >= 500  # RT covers body generation
+
+    def test_block_routes_to_fallback_when_no_block_handler(self, engine):
+        @sentinel_resource("fbonly", fallback=lambda ex=None: "fb")
+        def work():
+            return "ok"
+
+        st.load_flow_rules([st.FlowRule(resource="fbonly", count=0)])
+        assert work() == "fb"
+
+    def test_async_handlers_are_awaited(self, engine):
+        async def afb(ex=None):
+            await asyncio.sleep(0)
+            return "async-fb"
+
+        @sentinel_resource("ah", fallback=afb)
+        async def work():
+            raise ValueError("x")
+
+        assert asyncio.run(work()) == "async-fb"

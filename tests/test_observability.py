@@ -224,3 +224,20 @@ def test_file_writable_datasource_atomic_write(tmp_path):
     rules = [st.FlowRule(resource="w", count=9)]
     wds.write(rules)
     assert flow_rules_from_json(path.read_text()) == rules
+
+
+def test_named_origin_rules_fresh_before_first_compile(engine, frozen_time):
+    """origin_named is read on entry before compilation; a fresh rule load
+    must classify a named-origin caller immediately."""
+    from sentinel_tpu.core.context import replace_context
+
+    st.load_flow_rules([
+        st.FlowRule(resource="r", count=1, limit_app="appA"),
+        st.FlowRule(resource="r", count=100),
+    ])
+    replace_context(None)
+    st.context_enter("ctx", origin="appA")
+    assert st.entry_ok("r") is not None
+    # appA's own limit (1) governs, not the default rule's 100.
+    assert st.entry_ok("r") is None
+    st.exit_context()
