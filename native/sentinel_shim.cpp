@@ -1,0 +1,226 @@
+// sentinel_shim: native client shim for the sentinel-tpu token server.
+//
+// Role (SURVEY.md §2.9, §7 M4): the reference is pure Java, so its cluster
+// clients live in-process; our TPU backend serves tokens over the TLV TCP
+// protocol, and THIS library is the bridge by which any host runtime — a
+// JVM via JNI, C++ services, Python via ctypes — talks to it without a
+// Python dependency. It implements:
+//
+//   * the length-framed binary TLV codec (cluster/codec.py is the Python
+//     twin; frame = u16 len | body; request body = i32 xid | u8 type |
+//     entity; response body = i32 xid | u8 type | i8 status | entity),
+//   * a blocking token client with xid correlation over one TCP connection
+//     (PING namespace registration on connect, FLOW / PARAM_FLOW acquires),
+//   * a cached-tick millisecond clock (the reference TimeUtil's dedicated
+//     tick thread — avoids a syscall per hot-path read).
+//
+// C ABI only: every symbol is extern "C" so ctypes/JNI/FFI can bind it.
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <netdb.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t MSG_PING = 0;
+constexpr uint8_t MSG_FLOW = 1;
+
+constexpr int ST_FAIL = -1;
+
+// -- wire helpers (big-endian, matching cluster/codec.py) --------------------
+
+void put_u16(std::vector<uint8_t>& b, uint16_t v) {
+  b.push_back(v >> 8);
+  b.push_back(v & 0xff);
+}
+void put_i32(std::vector<uint8_t>& b, int32_t v) {
+  for (int s = 24; s >= 0; s -= 8) b.push_back((uint32_t(v) >> s) & 0xff);
+}
+void put_i64(std::vector<uint8_t>& b, int64_t v) {
+  for (int s = 56; s >= 0; s -= 8) b.push_back((uint64_t(v) >> s) & 0xff);
+}
+int32_t get_i32(const uint8_t* p) {
+  return (int32_t(p[0]) << 24) | (int32_t(p[1]) << 16) | (int32_t(p[2]) << 8) |
+         int32_t(p[3]);
+}
+
+struct Client {
+  int fd = -1;
+  std::mutex io_mu;  // one in-flight request at a time (blocking client)
+  int32_t next_xid = 1;
+
+  bool send_all(const uint8_t* data, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t w = ::send(fd, data + off, n - off, MSG_NOSIGNAL);
+      if (w <= 0) return false;
+      off += size_t(w);
+    }
+    return true;
+  }
+
+  bool recv_all(uint8_t* data, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = ::recv(fd, data + off, n - off, 0);
+      if (r <= 0) return false;
+      off += size_t(r);
+    }
+    return true;
+  }
+
+  // -> status, fills entity. Returns false on transport failure.
+  bool call(uint8_t type, const std::vector<uint8_t>& entity, int8_t* status,
+            std::vector<uint8_t>* resp_entity) {
+    std::lock_guard<std::mutex> lock(io_mu);
+    int32_t xid = next_xid++;
+    std::vector<uint8_t> body;
+    put_i32(body, xid);
+    body.push_back(type);
+    body.insert(body.end(), entity.begin(), entity.end());
+    std::vector<uint8_t> frame;
+    put_u16(frame, uint16_t(body.size()));
+    frame.insert(frame.end(), body.begin(), body.end());
+    if (!send_all(frame.data(), frame.size())) return false;
+
+    for (;;) {
+      uint8_t lenbuf[2];
+      if (!recv_all(lenbuf, 2)) return false;
+      uint16_t len = (uint16_t(lenbuf[0]) << 8) | lenbuf[1];
+      std::vector<uint8_t> resp(len);
+      if (len > 0 && !recv_all(resp.data(), len)) return false;
+      if (len < 6) continue;  // malformed: skip
+      if (get_i32(resp.data()) != xid) continue;  // stale response: skip
+      *status = int8_t(resp[5]);
+      resp_entity->assign(resp.begin() + 6, resp.end());
+      return true;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// -- token client ------------------------------------------------------------
+
+// Connect + register the namespace via PING. NULL on failure.
+void* st_client_connect(const char* host, int port, const char* ns,
+                        int timeout_ms) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host, port_s.c_str(), &hints, &res) != 0) return nullptr;
+  int fd = -1;
+  for (auto* p = res; p; p = p->ai_next) {
+    fd = ::socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv { timeout_ms / 1000, (timeout_ms % 1000) * 1000 };
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+
+  auto* c = new Client();
+  c->fd = fd;
+  // PING entity: u8 len | namespace.
+  std::vector<uint8_t> entity;
+  std::string nss = ns ? ns : "default";
+  if (nss.size() > 255) nss.resize(255);
+  entity.push_back(uint8_t(nss.size()));
+  entity.insert(entity.end(), nss.begin(), nss.end());
+  int8_t status = ST_FAIL;
+  std::vector<uint8_t> resp;
+  if (!c->call(MSG_PING, entity, &status, &resp)) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+// Acquire tokens. Returns the TokenResultStatus (OK=0, BLOCKED=1,
+// SHOULD_WAIT=2, ...) or -1 on transport failure. out_extra receives
+// remaining (OK) or wait-ms (SHOULD_WAIT) when non-null.
+int st_request_token(void* handle, long long flow_id, int count,
+                     int prioritized, int* out_extra) {
+  if (!handle) return ST_FAIL;
+  auto* c = static_cast<Client*>(handle);
+  std::vector<uint8_t> entity;
+  put_i64(entity, flow_id);
+  put_i32(entity, count);
+  entity.push_back(prioritized ? 1 : 0);
+  int8_t status = ST_FAIL;
+  std::vector<uint8_t> resp;
+  if (!c->call(MSG_FLOW, entity, &status, &resp)) return ST_FAIL;
+  if (out_extra) {
+    *out_extra = 0;
+    if (resp.size() >= 8) {
+      int32_t remaining = get_i32(resp.data());
+      int32_t wait_ms = get_i32(resp.data() + 4);
+      *out_extra = (status == 2) ? wait_ms : remaining;
+    }
+  }
+  return status;
+}
+
+void st_client_close(void* handle) {
+  if (!handle) return;
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+// -- cached-tick clock (reference: core:util/TimeUtil.java) ------------------
+
+namespace {
+std::atomic<long long> g_now_ms{0};
+std::atomic<bool> g_tick_running{false};
+std::thread g_tick_thread;
+
+long long wall_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+void st_time_start(void) {
+  bool expected = false;
+  if (!g_tick_running.compare_exchange_strong(expected, true)) return;
+  g_now_ms.store(wall_ms());
+  g_tick_thread = std::thread([] {
+    while (g_tick_running.load(std::memory_order_relaxed)) {
+      g_now_ms.store(wall_ms(), std::memory_order_relaxed);
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  g_tick_thread.detach();
+}
+
+void st_time_stop(void) { g_tick_running.store(false); }
+
+// Cached when the tick thread runs; falls back to a syscall otherwise.
+long long st_now_ms(void) {
+  long long v = g_now_ms.load(std::memory_order_relaxed);
+  return (v != 0 && g_tick_running.load(std::memory_order_relaxed))
+             ? v
+             : wall_ms();
+}
+
+}  // extern "C"

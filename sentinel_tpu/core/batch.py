@@ -67,6 +67,11 @@ class Decisions(NamedTuple):
 
 MAX_PARAMS = 4
 
+# The shared jit-cache width ladder: every batch submitted to the device is
+# padded to one of these widths so XLA traces each step a bounded number of
+# times. Engine and pipeline must use the same ladder.
+BATCH_WIDTHS = (1, 8, 64, 512, 2048)
+
 
 def _np(x, dtype):
     return np.asarray(x, dtype=dtype)

@@ -27,6 +27,7 @@ import numpy as np
 from sentinel_tpu.core import constants as C
 from sentinel_tpu.core import context as ctx_mod
 from sentinel_tpu.core.batch import (
+    BATCH_WIDTHS,
     Decisions,
     EntryBatch,
     ExitBatch,
@@ -44,9 +45,6 @@ from sentinel_tpu.models import system as Y
 from sentinel_tpu.ops import step as S
 from sentinel_tpu.utils import time_util
 from sentinel_tpu.utils.param_hash import hash_param as _hash_param
-
-BATCH_WIDTHS = (1, 8, 64, 512, 2048)
-
 
 class EntryHandle:
     """A live entry (reference: ``CtEntry``). Use as a context manager."""
@@ -153,13 +151,9 @@ class SentinelEngine:
                 rules = self.flow_rules.get_rules()
                 self._cluster_flow_info = self._cluster_info(rules)
                 # origin_named is read on entry BEFORE compilation runs, so
-                # the named-origin map must be fresh at load time too.
-                named: Dict[str, set] = {}
-                for r in rules:
-                    if r.limit_app not in (C.LIMIT_APP_DEFAULT, C.LIMIT_APP_OTHER):
-                        named.setdefault(r.resource, set()).add(
-                            self.registry.origin_id(r.limit_app))
-                self._named_origins = named
+                # the named-origin map must be fresh at load time too (same
+                # classification helper as the compiler — no drift).
+                self._named_origins = F.named_origin_map(rules, self.registry)
             else:
                 self._cluster_param_info = self._cluster_info(
                     self.param_rules.get_rules(), with_param_idx=True)
@@ -390,16 +384,20 @@ class SentinelEngine:
         pipeline = self._pipeline
         if pipeline is not None:
             ticket = pipeline.submit_entry(fields)
-            # None / timed-out-after-close: the pipeline shut down around
-            # this submission — fall through to the synchronous path.
+            # A submitted ticket is completed exactly once — by a cycle or
+            # by stop()'s straggler drain — so NEVER resubmit it (that
+            # would double-commit the stats). Only a None ticket (closed
+            # before submit) takes the synchronous path.
             if ticket is not None:
                 while not ticket.done.wait(timeout=2.0):
-                    if pipeline.closed:
-                        break
-                if ticket.done.is_set():
-                    if ticket.reason == -2:  # cycle error: pass-through
+                    if pipeline.closed and not ticket.done.wait(timeout=2.0):
+                        # Stop() drained everything it could and the ticket
+                        # never surfaced (collector died mid-cycle): pass
+                        # unguarded rather than risk a double commit.
                         return 0, 0
-                    return ticket.reason, ticket.wait_us
+                if ticket.reason == -2:  # cycle error: pass-through
+                    return 0, 0
+                return ticket.reason, ticket.wait_us
         with self._lock:
             buf = make_entry_batch_np(1)
             for k, v in fields.items():

@@ -23,14 +23,13 @@ from typing import List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from sentinel_tpu.core.batch import (
+    BATCH_WIDTHS as LADDER,
     EntryBatch,
     ExitBatch,
     MAX_PARAMS,
     make_entry_batch_np,
     make_exit_batch_np,
 )
-
-LADDER = (1, 8, 64, 512, 2048)
 
 
 def _ladder_width(n: int) -> int:
@@ -51,10 +50,11 @@ class _EntryTicket:
 
 
 class _ExitTicket:
-    __slots__ = ("fields",)
+    __slots__ = ("fields", "retried")
 
     def __init__(self, fields):
         self.fields = fields
+        self.retried = False
 
 
 class Pipeline:
@@ -113,17 +113,19 @@ class Pipeline:
         while not self._stop.is_set():
             try:
                 if not self._drain_cycle():
-                    # Nothing pending: block until the next submission.
+                    # Nothing pending: block until the next submission, then
+                    # fold it into a normal lingered cycle so a burst's
+                    # first arrival doesn't run as its own width-1 step.
                     try:
                         item = self._queue.get(timeout=0.05)
                     except queue.Empty:
                         continue
-                    self._cycle([item])
+                    self._drain_cycle(initial=[item])
             except Exception as ex:  # keep the loop alive, fail the cycle
                 record_log.warn("pipeline cycle failed: %r", ex)
 
-    def _drain_cycle(self) -> bool:
-        items = []
+    def _drain_cycle(self, initial=None) -> bool:
+        items = list(initial) if initial else []
         while len(items) < self.max_batch:
             try:
                 items.append(self._queue.get_nowait())
@@ -146,17 +148,27 @@ class Pipeline:
     def _cycle(self, items: List):
         exits = [t for t in items if isinstance(t, _ExitTicket)]
         entries = [t for t in items if isinstance(t, _EntryTicket)]
-        try:
-            # Exits first: program order for exit→entry on one thread.
-            if exits:
+        # Exits first: program order for exit→entry on one thread. A failed
+        # exit flush is re-enqueued once — dropping exits would leak the
+        # concurrency gauge permanently.
+        if exits:
+            try:
                 self._flush_exits(exits)
-            if entries:
+            except Exception:
+                retry = [t for t in exits if not t.retried]
+                for t in retry:
+                    t.retried = True
+                    self._queue.put(t)
+                if not retry:  # second failure: give up loudly
+                    raise
+        if entries:
+            try:
                 self._flush_entries(entries)
-        except Exception:
-            for t in entries:
-                t.reason = -2  # engine error: engine treats as pass-through
-                t.done.set()
-            raise
+            except Exception:
+                for t in entries:
+                    t.reason = -2  # engine error: caller passes unguarded
+                    t.done.set()
+                raise
 
     def _flush_exits(self, exits: List[_ExitTicket]):
         width = _ladder_width(len(exits))

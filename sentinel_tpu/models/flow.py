@@ -128,6 +128,19 @@ def make_flow_state(num_rules: int, now_ms: int) -> FlowState:
     )
 
 
+def named_origin_map(rules: List[FlowRule], registry: NodeRegistry) -> Dict[str, Set[int]]:
+    """resource -> origin ids explicitly named by valid rules' limitApp.
+
+    The single source of the ``origin_named`` classification: used at
+    compile time AND eagerly at rule load (entry() reads it pre-compile).
+    """
+    named: Dict[str, Set[int]] = {}
+    for r in rules:
+        if r.is_valid() and r.limit_app not in (C.LIMIT_APP_DEFAULT, C.LIMIT_APP_OTHER):
+            named.setdefault(r.resource, set()).add(registry.origin_id(r.limit_app))
+    return named
+
+
 def compile_flow_rules(
     rules: List[FlowRule],
     registry: NodeRegistry,
@@ -158,7 +171,7 @@ def compile_flow_rules(
     cluster_mode = np.zeros(fr, bool)
     remote_mode = np.zeros(fr, bool)
 
-    named_origins: Dict[str, Set[int]] = {}
+    named_origins = named_origin_map(valid, registry)
     by_row: Dict[int, List[int]] = {}
 
     for i, r in enumerate(valid):
@@ -176,9 +189,7 @@ def compile_flow_rules(
         elif r.limit_app == C.LIMIT_APP_OTHER:
             limit_origin[i] = C.ORIGIN_ID_OTHER
         else:
-            oid = registry.origin_id(r.limit_app)
-            limit_origin[i] = oid
-            named_origins.setdefault(r.resource, set()).add(oid)
+            limit_origin[i] = registry.origin_id(r.limit_app)
         if r.strategy == C.FLOW_STRATEGY_RELATE:
             ref_row[i] = registry.cluster_row(r.ref_resource)
         elif r.strategy == C.FLOW_STRATEGY_CHAIN:

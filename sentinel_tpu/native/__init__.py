@@ -1,0 +1,117 @@
+"""ctypes bindings for the native shim (``native/sentinel_shim.cpp``).
+
+The shim is the language-neutral client path to the token server (C ABI:
+JNI / FFI / ctypes all bind it — the reference-parity "SPI shim" of
+SURVEY.md §7 M4) plus the cached-tick clock. Built on demand with ``make``
+(g++); everything degrades gracefully when the toolchain or library is
+unavailable — ``load_shim()`` returns None and callers fall back to the
+pure-Python client.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_NAME = "libsentinel_shim.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> Optional[str]:
+    path = os.path.abspath(os.path.join(_NATIVE_DIR, _LIB_NAME))
+    src = os.path.abspath(os.path.join(_NATIVE_DIR, "sentinel_shim.cpp"))
+    try:
+        if os.path.getmtime(path) >= os.path.getmtime(src):
+            return path
+    except OSError:
+        if os.path.exists(path):  # prebuilt .so shipped without the source
+            return path
+    try:
+        subprocess.run(["make", "-s", _LIB_NAME],
+                       cwd=os.path.abspath(_NATIVE_DIR),
+                       check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return path if os.path.exists(path) else None
+
+
+def load_shim() -> Optional[ctypes.CDLL]:
+    """The shim library, built+loaded lazily; None when unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.st_client_connect.restype = ctypes.c_void_p
+        lib.st_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.st_request_token.restype = ctypes.c_int
+        lib.st_request_token.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.st_client_close.argtypes = [ctypes.c_void_p]
+        lib.st_now_ms.restype = ctypes.c_longlong
+        _lib = lib
+        return _lib
+
+
+class NativeTokenClient:
+    """Blocking token client backed by the C++ shim (wire-compatible with
+    the Python ``ClusterTokenClient``; one in-flight request at a time)."""
+
+    def __init__(self, host: str, port: int, namespace: str = "default",
+                 timeout_ms: int = 3000):
+        lib = load_shim()
+        if lib is None:
+            raise RuntimeError("native shim unavailable (no g++/make?)")
+        self._lib = lib
+        self._handle = lib.st_client_connect(
+            host.encode(), port, namespace.encode(), timeout_ms)
+        if not self._handle:
+            raise ConnectionError(f"shim could not connect to {host}:{port}")
+
+    def request_token(self, flow_id: int, count: int = 1,
+                      prioritized: bool = False):
+        from sentinel_tpu.cluster.token_service import TokenResult
+
+        extra = ctypes.c_int(0)
+        status = self._lib.st_request_token(
+            self._handle, flow_id, count, 1 if prioritized else 0,
+            ctypes.byref(extra))
+        if status == 2:  # SHOULD_WAIT
+            return TokenResult(status, wait_ms=extra.value)
+        return TokenResult(status, remaining=extra.value)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.st_client_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def native_now_ms() -> Optional[int]:
+    """Cached-tick clock read; None when the shim is unavailable."""
+    lib = load_shim()
+    if lib is None:
+        return None
+    return int(lib.st_now_ms())

@@ -1,0 +1,61 @@
+"""Native shim tests: build the C++ library, then prove wire compatibility
+by acquiring tokens from the Python token server through the C client.
+"""
+
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster.constants import THRESHOLD_GLOBAL, TokenResultStatus
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.native import NativeTokenClient, load_shim, native_now_ms
+
+pytestmark = pytest.mark.skipif(load_shim() is None,
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture()
+def token_server(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [st.FlowRule(
+        resource="native-res", count=3, cluster_mode=True,
+        cluster_config={"flowId": 4242, "thresholdType": THRESHOLD_GLOBAL})])
+    server = ClusterTokenServer(
+        DefaultTokenService(rules), host="127.0.0.1", port=0).start()
+    yield server
+    server.stop()
+
+
+def test_native_client_acquires_tokens(token_server):
+    with NativeTokenClient("127.0.0.1", token_server.bound_port) as client:
+        got = [client.request_token(4242).status for _ in range(5)]
+    assert got.count(TokenResultStatus.OK) == 3
+    assert got.count(TokenResultStatus.BLOCKED) == 2
+
+
+def test_native_client_unknown_flow(token_server):
+    with NativeTokenClient("127.0.0.1", token_server.bound_port) as client:
+        assert client.request_token(999).status == TokenResultStatus.NO_RULE_EXISTS
+
+
+def test_native_client_registers_namespace(token_server):
+    with NativeTokenClient("127.0.0.1", token_server.bound_port, "nsZ"):
+        deadline = time.time() + 2
+        while (token_server.service.connections.connected_count("nsZ") == 0
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert token_server.service.connections.connected_count("nsZ") == 1
+
+
+def test_native_connect_failure_raises():
+    with pytest.raises((ConnectionError, RuntimeError)):
+        NativeTokenClient("127.0.0.1", 1, timeout_ms=300)
+
+
+def test_native_clock_reasonable():
+    now = native_now_ms()
+    assert now is not None
+    assert abs(now - time.time() * 1000) < 5000
