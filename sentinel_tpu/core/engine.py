@@ -518,7 +518,8 @@ class SentinelEngine:
             self._sealed_sec = seconds[-1]
             w60 = W_rotate_host(self._state.w60, now, S.SPEC_60S)
             idx = np.asarray([s % C.MINUTE_BUCKETS for s in seconds])
-            slices = np.asarray(w60.counts[:, idx, :])       # [R, k, E]
+            # Window layout is [B, E, R]; transpose to [R, k, E] host-side.
+            slices = np.asarray(w60.counts[idx]).transpose(2, 0, 1)
             threads = np.asarray(self._state.cur_threads)    # [R]
             metas = [m for m in self.registry.meta if m.kind == KIND_CLUSTER]
         out = []
@@ -551,7 +552,7 @@ class SentinelEngine:
             self._ensure_compiled()
             now = time_util.current_time_millis()
             w1 = W_rotate_host(self._state.w1, now, S.SPEC_1S)
-            return (np.asarray(w1.counts.sum(axis=1)),
+            return (np.asarray(W_all_totals(w1)),
                     np.asarray(self._state.cur_threads))
 
     def tree_dict(self) -> Dict:
@@ -588,7 +589,7 @@ class SentinelEngine:
             self._ensure_compiled()
             now = time_util.current_time_millis()
             w1 = W_rotate_host(self._state.w1, now, S.SPEC_1S)
-            totals = np.asarray(w1.counts.sum(axis=1))
+            totals = np.asarray(W_all_totals(w1))
             threads = np.asarray(self._state.cur_threads)
         out = {}
         for res, row in self.registry.resources().items():
@@ -609,5 +610,11 @@ def W_rotate_host(win, now_ms, spec):
     from sentinel_tpu.ops import window as W
 
     return W.rotate(win, jnp.asarray(now_ms, jnp.int64), spec)
+
+
+def W_all_totals(win):
+    from sentinel_tpu.ops import window as W
+
+    return W.all_totals(win)
 
 

@@ -337,7 +337,7 @@ def check_flow(
     # Warm-up token sync (per rule, once per second) against the node the
     # rule admits on (sync_row), not blindly the resource ClusterNode.
     prev_idx = jnp.mod(W.current_index(now_ms, spec) - 1, spec.buckets)
-    prev_pass_all = jnp.take(w1.counts[:, :, C.MetricEvent.PASS], prev_idx, axis=1)
+    prev_pass_all = jnp.take(w1.counts[:, C.MetricEvent.PASS, :], prev_idx, axis=0)
     rule_prev_pass = _gather(prev_pass_all, rt.sync_row, 0).astype(jnp.float32)
     fs = _sync_warmup(rt, fs, rule_prev_pass, now_ms)
 

@@ -106,18 +106,19 @@ def check_system(
     cur_threads: jax.Array,  # int32[R]
     batch: EntryBatch,
     candidate: jax.Array,    # bool[N]
+    now_ms: jax.Array,
 ) -> jax.Array:
     """Vectorized ``SystemRuleManager.checkSystem``: bool[N] blocked.
 
-    Two evaluation passes reproduce the serial "blocked requests never
-    count" rule (same convention as check_flow): pass 1 verdicts with every
-    candidate in the ENTRY_NODE prefixes, pass 2 with prefixes restricted
-    to pass-1 survivors.
+    ``w60`` arrives write-rotated only (current bucket fresh); the BBR read
+    masks stale buckets itself. Two evaluation passes reproduce the serial
+    "blocked requests never count" rule (same convention as check_flow).
     """
     pass1 = _eval_system(rt, signals, w1, w60, cur_threads, batch,
-                         candidate, survivors=candidate)
+                         candidate, survivors=candidate, now_ms=now_ms)
     return _eval_system(rt, signals, w1, w60, cur_threads, batch,
-                        candidate, survivors=candidate & (~pass1))
+                        candidate, survivors=candidate & (~pass1),
+                        now_ms=now_ms)
 
 
 def _eval_system(
@@ -129,6 +130,7 @@ def _eval_system(
     batch: EntryBatch,
     candidate: jax.Array,
     survivors: jax.Array,
+    now_ms: jax.Array,
 ) -> jax.Array:
     n = batch.size
     applicable = candidate & batch.entry_in & rt.enabled
@@ -151,11 +153,15 @@ def _eval_system(
     rt_ok = (rt.avg_rt < 0) | (cur_rt <= rt.avg_rt)
 
     # BBR gate on load: estimated capacity = maxSuccessQps · minRt / 1000.
-    # maxSuccessQps: the minute window's busiest 1s bucket (fresh buckets
-    # only — w60 was rotated by the caller); minRt from the 1s window.
-    bucket_succ = w60.counts[ENTRY_ROW, :, C.MetricEvent.SUCCESS].astype(jnp.float32)
+    # maxSuccessQps: the minute window's busiest 1s bucket — fresh buckets
+    # only, masked here (w60 is only write-rotated by the step).
+    spec_60s = W.WindowSpec(C.MINUTE_WINDOW_MS, C.MINUTE_BUCKETS)
+    fresh = W.staleness_mask(w60, now_ms, spec_60s)
+    bucket_succ = jnp.where(
+        fresh, w60.counts[:, C.MetricEvent.SUCCESS, ENTRY_ROW], 0
+    ).astype(jnp.float32)
     max_succ_qps = jnp.max(bucket_succ)
-    min_rt = jnp.min(w1.min_rt[ENTRY_ROW]).astype(jnp.float32)
+    min_rt = jnp.min(w1.min_rt[:, ENTRY_ROW]).astype(jnp.float32)
     min_rt = jnp.where(min_rt >= W.MIN_RT_EMPTY, 0.0, min_rt)
     bbr_ok = (threads <= 1.0) | (threads <= max_succ_qps * min_rt / 1000.0)
     load_ok = (rt.load < 0) | (signals[SIG_LOAD] <= rt.load) | bbr_ok

@@ -105,7 +105,10 @@ def entry_step(
     the pod-parallel wrapper (``parallel/cluster.py``) from a ``psum``."""
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, SPEC_1S)
-    w60 = W.rotate(state.w60, now_ms, SPEC_60S)
+    # The minute window only needs its CURRENT bucket fresh for commits;
+    # readers (BBR check below, host metric sealing) mask staleness
+    # themselves. Full rotation would sweep 60x the bytes per step.
+    w60 = W.rotate_current(state.w60, now_ms, SPEC_60S)
 
     valid = batch.cluster_row >= 0
     reason = jnp.where(valid, C.BlockReason.PASS, -1).astype(jnp.int32)
@@ -123,7 +126,7 @@ def entry_step(
 
     cand = valid & (~blocked)
     sys_blocked = Y.check_system(rules.system, state.sys_signals, w1, w60,
-                                 state.cur_threads, batch, cand)
+                                 state.cur_threads, batch, cand, now_ms)
     reason = jnp.where(cand & sys_blocked, C.BlockReason.SYSTEM, reason)
     blocked = blocked | sys_blocked
 
@@ -180,7 +183,7 @@ def exit_step(
     """
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, SPEC_1S)
-    w60 = W.rotate(state.w60, now_ms, SPEC_60S)
+    w60 = W.rotate_current(state.w60, now_ms, SPEC_60S)
 
     valid = batch.cluster_row >= 0
     rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
