@@ -134,6 +134,7 @@ class SentinelEngine:
                        "system": True, "param": True}
         self._entry_jit = jax.jit(S.entry_step, donate_argnums=(0,))
         self._exit_jit = jax.jit(S.exit_step, donate_argnums=(0,))
+        self._flush_jit = jax.jit(S.flush_seconds, donate_argnums=(0,))
 
     # -- rule compilation --------------------------------------------------
 
@@ -272,6 +273,14 @@ class SentinelEngine:
         prioritized: bool = False,
     ) -> EntryHandle:
         """``SphU.entry``: admit or raise a ``BlockException`` subclass."""
+        if count > C.MAX_ACQUIRE_COUNT:
+            # The device kernels carry per-request counts through bf16
+            # matmul operands, exact only up to 256 (ops/segment.py). The
+            # reference's acquireCount is 1 in every shipped call site;
+            # reject out-of-range counts loudly instead of silently
+            # mis-admitting.
+            raise ValueError(
+                f"count={count} exceeds MAX_ACQUIRE_COUNT={C.MAX_ACQUIRE_COUNT}")
         ctx = ctx_mod.get_context()
         if ctx is None:
             ctx = ctx_mod.enter(C.CONTEXT_DEFAULT_NAME)
@@ -516,6 +525,9 @@ class SentinelEngine:
             if not seconds:
                 return []
             self._sealed_sec = seconds[-1]
+            # Fold any completed staged second into w60 before reading it
+            # (the step stages the live second in state.sec — see ops/step).
+            self._state = self._flush_jit(self._state, now)
             w60 = W_rotate_host(self._state.w60, now, S.SPEC_60S)
             idx = np.asarray([s % C.MINUTE_BUCKETS for s in seconds])
             # Window layout is [B, E, R]; transpose to [R, k, E] host-side.

@@ -43,7 +43,7 @@ from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch, ExitBatch
 from sentinel_tpu.core.registry import NodeRegistry
 from sentinel_tpu.ops import window as W
-from sentinel_tpu.ops.segment import segmented_prefix
+from sentinel_tpu.ops.segment import first_in_segment
 from sentinel_tpu.utils.shapes import round_up as _round_up
 
 # RowWindow channels
@@ -202,9 +202,9 @@ def check_degrade(
         retry_due = is_open & (now_ms >= nr)
 
         # One probe per rule per batch: first arrival with a due retry.
+        # (Scatter-min of positions — O(N), no prefix machinery needed.)
         probe_ids = jnp.where(has_rule & retry_due, rule_id, -1)
-        _, is_first = segmented_prefix(probe_ids, jnp.zeros((n,), jnp.int32))
-        probe = has_rule & retry_due & is_first & (probe_ids >= 0)
+        probe = has_rule & retry_due & first_in_segment(probe_ids, rt.num_rules)
 
         blocked_k = has_rule & (is_half | (is_open & ~probe))
         blocked = blocked | blocked_k

@@ -42,7 +42,7 @@ from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
 from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.ops import window as W
-from sentinel_tpu.ops.segment import segmented_prefix
+from sentinel_tpu.ops.segment import segmented_prefix_dense
 from sentinel_tpu.utils.shapes import round_up as _round_up
 
 
@@ -382,13 +382,16 @@ def _eval_flow_slots(
     entry_count = jnp.where(survivors, 1, 0)  # thread gauge moves 1/entry
 
     # Within-batch arrival-order prefixes over the rows each request commits
-    # PASS to: [cluster, dn, origin] interleaved request-major. Token-prefix
-    # feeds QPS checks; entry-prefix feeds THREAD (concurrency) checks.
-    rows3 = jnp.stack([batch.cluster_row, batch.dn_row, batch.origin_row], axis=1).reshape(-1)
-    tok3, _ = segmented_prefix(rows3, jnp.repeat(token_count, 3))
-    ent3, _ = segmented_prefix(rows3, jnp.repeat(entry_count, 3))
-    tok3 = tok3.reshape(n, 3)
-    ent3 = ent3.reshape(n, 3)
+    # PASS to. Node rows of different kinds never collide (the registry
+    # allocates every node from one shared row space), so cluster/dn/origin
+    # are three independent segment spaces — three dense prefixes, each
+    # sharing one mask matmul for the token (QPS) and entry (THREAD) value
+    # columns (``ops/segment.py`` — the MXU path; sorts blew scoped VMEM).
+    vals2 = jnp.stack([token_count, entry_count], axis=1).astype(jnp.float32)
+    cols = [segmented_prefix_dense(rows, vals2)[0]
+            for rows in (batch.cluster_row, batch.dn_row, batch.origin_row)]
+    tok3 = jnp.stack([c[:, 0] for c in cols], axis=1)  # [:, (cluster, dn, origin)]
+    ent3 = jnp.stack([c[:, 1] for c in cols], axis=1)
 
     blocked = jnp.zeros((n,), bool)
     wait_us = jnp.zeros((n,), jnp.int64)
@@ -432,7 +435,7 @@ def _eval_flow_slots(
         # cluster=[:,0], dn=[:,1], origin=[:,2]; RELATE rows get no
         # within-batch credit (cross-resource, bounded by one micro-batch).
         def _sel(prefixes):
-            p = jnp.where(sel_default, prefixes[:, 0], jnp.int64(0))
+            p = jnp.where(sel_default, prefixes[:, 0], jnp.float32(0))
             p = jnp.where(sel_specific | sel_other, prefixes[:, 2], p)
             return jnp.where(chain, prefixes[:, 1], p)
 
@@ -475,9 +478,9 @@ def _eval_flow_slots(
         is_rl = (behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER) | (
             behavior == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
         )
-        rl_prefix, _ = segmented_prefix(
+        rl_prefix, _ = segmented_prefix_dense(
             jnp.where(applicable & is_rl, rule_id, -1),
-            jnp.where(applicable & survivors, batch.count, 0),
+            jnp.where(applicable & survivors, batch.count, 0).astype(jnp.float32),
         )
         now_us = now_ms.astype(jnp.int64) * 1000
         # Clamp the bucket head the same way the state advance does: the

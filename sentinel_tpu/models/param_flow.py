@@ -39,7 +39,7 @@ from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch, ExitBatch, MAX_PARAMS
 from sentinel_tpu.core.registry import NodeRegistry
 from sentinel_tpu.ops import window as W
-from sentinel_tpu.ops.segment import segmented_prefix
+from sentinel_tpu.ops.segment import segmented_prefix_dense
 from sentinel_tpu.utils.shapes import round_up as _round_up
 
 DEFAULT_SLOTS = 2048  # per-rule bucket table width (reference LRU cap: 4000)
@@ -311,8 +311,11 @@ def _eval_param(
         # Group identity for within-batch sequencing: same (rule, slot).
         gid = jnp.where(applicable, rule_id * table_slots + slot, -1)
         acq = jnp.where(survivors & applicable, batch.count, 0)
-        tok_prefix, _ = segmented_prefix(gid, acq)
-        ent_prefix, _ = segmented_prefix(gid, jnp.where(survivors & applicable, 1, 0))
+        pre2, _ = segmented_prefix_dense(
+            gid,
+            jnp.stack([acq, jnp.where(survivors & applicable, 1, 0)], axis=1).astype(jnp.float32),
+        )
+        tok_prefix, ent_prefix = pre2[:, 0], pre2[:, 1]
 
         # --- QPS / DEFAULT: windowed token bucket (passDefaultLocalCheck)
         stored_tokens = _gather2(ps.tokens, srule, slot, 0.0)
@@ -387,7 +390,9 @@ def _eval_param(
             )
             passed = ps.passed_us.at[fresh_rl, slot].set(0, mode="drop")
             rlidx = W.oob(jnp.where(admitted & is_rl, srule, -1), ps.key.shape[0])
-            consumed_after, _ = segmented_prefix(gid, jnp.where(admitted & is_rl, batch.count, 0))
+            consumed_after, _ = segmented_prefix_dense(
+                gid, jnp.where(admitted & is_rl, batch.count, 0).astype(jnp.float32)
+            )
             last_total = consumed_after + jnp.where(admitted & is_rl, batch.count, 0)
             new_head = latest + last_total.astype(jnp.int64) * cost_us
             ps = ps._replace(

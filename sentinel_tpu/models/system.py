@@ -103,6 +103,7 @@ def check_system(
     signals: jax.Array,      # f32[NUM_SIGNALS] host-sampled [load1, cpu]
     w1: W.Window,
     w60: W.Window,
+    sec_counts: jax.Array,   # int32[E, R] live current-second accumulator
     cur_threads: jax.Array,  # int32[R]
     batch: EntryBatch,
     candidate: jax.Array,    # bool[N]
@@ -110,13 +111,14 @@ def check_system(
 ) -> jax.Array:
     """Vectorized ``SystemRuleManager.checkSystem``: bool[N] blocked.
 
-    ``w60`` arrives write-rotated only (current bucket fresh); the BBR read
-    masks stale buckets itself. Two evaluation passes reproduce the serial
+    ``w60`` holds only folded (completed) seconds; the live second lives in
+    ``sec_counts`` (the step's staging accumulator). The BBR read masks
+    stale buckets itself. Two evaluation passes reproduce the serial
     "blocked requests never count" rule (same convention as check_flow).
     """
-    pass1 = _eval_system(rt, signals, w1, w60, cur_threads, batch,
+    pass1 = _eval_system(rt, signals, w1, w60, sec_counts, cur_threads, batch,
                          candidate, survivors=candidate, now_ms=now_ms)
-    return _eval_system(rt, signals, w1, w60, cur_threads, batch,
+    return _eval_system(rt, signals, w1, w60, sec_counts, cur_threads, batch,
                         candidate, survivors=candidate & (~pass1),
                         now_ms=now_ms)
 
@@ -126,6 +128,7 @@ def _eval_system(
     signals: jax.Array,
     w1: W.Window,
     w60: W.Window,
+    sec_counts: jax.Array,
     cur_threads: jax.Array,
     batch: EntryBatch,
     candidate: jax.Array,
@@ -153,14 +156,18 @@ def _eval_system(
     rt_ok = (rt.avg_rt < 0) | (cur_rt <= rt.avg_rt)
 
     # BBR gate on load: estimated capacity = maxSuccessQps · minRt / 1000.
-    # maxSuccessQps: the minute window's busiest 1s bucket — fresh buckets
-    # only, masked here (w60 is only write-rotated by the step).
+    # maxSuccessQps: the minute window's busiest 1s bucket — fresh folded
+    # buckets (masked) plus the live staged second, exactly the reference's
+    # "partial current bucket counts too" behavior.
     spec_60s = W.WindowSpec(C.MINUTE_WINDOW_MS, C.MINUTE_BUCKETS)
     fresh = W.staleness_mask(w60, now_ms, spec_60s)
     bucket_succ = jnp.where(
         fresh, w60.counts[:, C.MetricEvent.SUCCESS, ENTRY_ROW], 0
     ).astype(jnp.float32)
-    max_succ_qps = jnp.max(bucket_succ)
+    max_succ_qps = jnp.maximum(
+        jnp.max(bucket_succ),
+        sec_counts[C.MetricEvent.SUCCESS, ENTRY_ROW].astype(jnp.float32),
+    )
     min_rt = jnp.min(w1.min_rt[:, ENTRY_ROW]).astype(jnp.float32)
     min_rt = jnp.where(min_rt >= W.MIN_RT_EMPTY, 0.0, min_rt)
     bbr_ok = (threads <= 1.0) | (threads <= max_succ_qps * min_rt / 1000.0)

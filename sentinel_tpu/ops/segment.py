@@ -7,17 +7,46 @@ once, so to reproduce arrival-order semantics we compute, for every request,
 the sum of candidate counts of earlier requests that target the same node
 row / rule — a segmented exclusive prefix sum in arrival order.
 
-Implementation: stable sort by segment id, cumsum, subtract each segment's
-base, scatter back. O(N log N) on tiny N (micro-batch ≤ 4096), fully on
-device, no data-dependent shapes.
+Two implementations:
+
+``segmented_prefix``       — stable sort + cumsum + cummax. O(N log N) and
+                             exact for any integer magnitudes; the right
+                             shape for host-side (CPU) callers such as the
+                             cluster token server's micro-batcher.
+
+``segmented_prefix_dense`` — the TPU-native path. On TPU, sorts lower to
+                             bitonic networks and cumulative ops lower to
+                             ``reduce-window``, which is both slow and blew
+                             scoped VMEM inside the fused ``lax.scan`` step
+                             (BENCH_r01: "scoped allocation 19.09M > 16.00M
+                             limit"). Instead we compute the prefix as a
+                             *blocked triangular masked matmul*: for a row
+                             block I, ``prefix[i] = Σ_j  eq(id_i, id_j) ·
+                             earlier(j, i) · v[j]`` — an [B, N] @ [N, M]
+                             product that runs on the MXU with the mask
+                             generated on the VPU. Total work is O(N²·M)
+                             FLOPs, which for micro-batches (N ≤ 8192) is
+                             microseconds of MXU time and, critically, has
+                             a static, fusion-friendly memory footprint of
+                             O(B·N) per scan block. Multiple value columns
+                             (M) share one mask evaluation — flow needs
+                             token + entry prefixes over the same rows.
+
+Exactness: the mask is {0,1} and values are cast to bfloat16 with float32
+accumulation, so results are exact for per-request counts ≤ 256 (bf16
+integer range) — counts are 1 in every reference code path (`SphU.entry`
+acquires batch=1; larger acquireCount stays far below 256).
 """
 
 from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.lax
 import jax.numpy as jnp
+
+_ID_SENTINEL = jnp.int32(-(2**31))
 
 
 def segmented_prefix(ids: jnp.ndarray, values: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -26,6 +55,9 @@ def segmented_prefix(ids: jnp.ndarray, values: jnp.ndarray) -> Tuple[jnp.ndarray
     Returns (prefix_excl, is_first) both aligned with the input order.
     ``is_first`` marks the first occurrence of each id (used e.g. to admit a
     single HALF_OPEN probe per breaker per batch).
+
+    Sort-based host/CPU path; see ``segmented_prefix_dense`` for the device
+    hot path.
     """
     n = ids.shape[0]
     order = jnp.argsort(ids, stable=True)
@@ -40,3 +72,110 @@ def segmented_prefix(ids: jnp.ndarray, values: jnp.ndarray) -> Tuple[jnp.ndarray
     prefix_sorted = csum - sval - base
     inv = jnp.zeros((n,), order.dtype).at[order].set(jnp.arange(n, dtype=order.dtype))
     return prefix_sorted[inv], first[inv]
+
+
+def segmented_prefix_dense(
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    block: int = 512,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked-matmul segmented exclusive prefix (the MXU path).
+
+    ``ids``: int32[N] segment ids (< 0 entries form their own shared segment
+    but their values are expected to be 0 by callers, so they contribute
+    nothing). ``values``: [N] or [N, M] — M value columns computed against
+    one shared mask. Returns ``(prefix, is_first)`` with ``prefix`` shaped
+    like ``values`` (float32) and ``is_first`` bool[N].
+    """
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[:, None]
+    n, m = values.shape
+    nb = -(-n // block)
+    npad = nb * block
+    ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n), constant_values=_ID_SENTINEL)
+    # One extra ones-column yields the count of earlier same-id requests,
+    # from which is_first falls out for free.
+    vals_p = jnp.pad(
+        jnp.concatenate([values.astype(jnp.float32), jnp.ones((n, 1), jnp.float32)], axis=1),
+        ((0, npad - n), (0, 0)),
+    )
+    v16 = vals_p.astype(jnp.bfloat16)  # exact for integer counts ≤ 256
+    idsb = ids_p.reshape(nb, block)
+    pos = jnp.arange(npad, dtype=jnp.int32)
+    off = jnp.arange(block, dtype=jnp.int32)
+
+    def body(_, b):
+        my_ids = idsb[b]                                   # [B]
+        my_pos = b * block + off                           # [B]
+        mask = (my_ids[:, None] == ids_p[None, :]) & (pos[None, :] < my_pos[:, None])
+        out = jax.lax.dot_general(
+            mask.astype(jnp.bfloat16), v16,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # [B, M+1]
+        return _, out
+
+    _, outs = jax.lax.scan(body, None, jnp.arange(nb, dtype=jnp.int32))
+    outs = outs.reshape(npad, m + 1)[:n]
+    prefix, earlier_count = outs[:, :m], outs[:, m]
+    is_first = earlier_count == 0
+    if squeeze:
+        prefix = prefix[:, 0]
+    return prefix, is_first
+
+
+def bincount_matmul(
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    num_bins: int,
+    lo: int = 128,
+) -> jnp.ndarray:
+    """Weighted bincount as a two-level one-hot outer product (MXU path).
+
+    ``Σ_n values[n] into bin ids[n]`` without a scatter: decompose
+    ``id = hi·lo + lo_part`` and compute ``out[hi, lo] = Aᵀ @ B`` with
+    ``A[n, hi] = onehot_hi[n, hi]·v[n]`` and ``B[n, lo] = onehot_lo``. TPU
+    scatters serialize (~7ns/update — measured 0.4ms for a 64k-update
+    commit); this form is two [N, 128]-ish bf16 matmul operands and a tiny
+    MXU contraction instead.
+
+    ``ids``: int32[N], negative or >= num_bins dropped. ``values``: [N] or
+    [N, M] — M columns share the one-hot operands. Returns float32
+    [num_bins] or [M, num_bins]. Exact for integer |values| ≤ 256 (bf16);
+    callers with wider integers split them into byte limbs.
+    """
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[:, None]
+    n, m = values.shape
+    nb_hi = -(-num_bins // lo)
+    valid = (ids >= 0) & (ids < num_bins)
+    idc = jnp.where(valid, ids, 0)
+    v = jnp.where(valid[:, None], values, 0).astype(jnp.bfloat16)  # [N, M]
+    hi_id = idc // lo
+    lo_id = idc % lo
+    onehot_hi = (hi_id[:, None] == jnp.arange(nb_hi, dtype=jnp.int32)[None, :])
+    onehot_lo = (lo_id[:, None] == jnp.arange(lo, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
+    # A: [N, M·nb_hi] — per-column weighted hi one-hots, stacked.
+    a = (onehot_hi[:, None, :] & valid[:, None, None]).astype(jnp.bfloat16) * v[:, :, None]
+    a = a.reshape(n, m * nb_hi)
+    out = jax.lax.dot_general(
+        a, onehot_lo, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [M·nb_hi, lo]
+    out = out.reshape(m, nb_hi * lo)[:, :num_bins]
+    return out[0] if squeeze else out
+
+
+def first_in_segment(ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """bool[N]: is this the first occurrence of its (non-negative) id?
+
+    Negative ids always return False. O(N) via a scatter-min of positions —
+    far cheaper than a full prefix when only first-arrival matters (e.g. one
+    HALF_OPEN probe per breaker per batch).
+    """
+    n = ids.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    oob = jnp.where((ids >= 0) & (ids < num_segments), ids, num_segments)
+    first_pos = jnp.full((num_segments,), n, jnp.int32).at[oob].min(pos, mode="drop")
+    return first_pos.at[oob].get(mode="fill", fill_value=-1) == pos
