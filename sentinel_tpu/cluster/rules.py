@@ -56,6 +56,11 @@ class ClusterFlowRuleManager:
         self._lock = threading.RLock()
         self._by_namespace: Dict[str, List[FlowRule]] = {}
         self._namespace_ids: Dict[str, int] = {}
+        # flowId-keyed lookup maps, rebuilt on every load with the SAME
+        # int-coercion as compile() — a rule loaded with flowId "123" must
+        # serve request_token(123) (string/int mismatch was a lookup miss).
+        self._rule_of_flow_id: Dict[int, FlowRule] = {}
+        self._ns_of_flow_id: Dict[int, str] = {}
         self.version = 0
         self._listeners = []
 
@@ -89,6 +94,13 @@ class ClusterFlowRuleManager:
         with self._lock:
             self._by_namespace[namespace] = valid
             self.namespace_id(namespace)
+            rule_of, ns_of = {}, {}
+            for ns, rs in self._by_namespace.items():
+                for r in rs:
+                    fid = int((r.cluster_config or {})["flowId"])
+                    rule_of[fid] = r
+                    ns_of[fid] = ns
+            self._rule_of_flow_id, self._ns_of_flow_id = rule_of, ns_of
             self.version += 1
             listeners = list(self._listeners)
         for fn in listeners:
@@ -101,20 +113,20 @@ class ClusterFlowRuleManager:
             return [r for rs in self._by_namespace.values() for r in rs]
 
     def rule_by_flow_id(self, flow_id: int) -> Optional[FlowRule]:
+        try:
+            flow_id = int(flow_id)
+        except (TypeError, ValueError):
+            return None
         with self._lock:
-            for rs in self._by_namespace.values():
-                for r in rs:
-                    if (r.cluster_config or {}).get("flowId") == flow_id:
-                        return r
-        return None
+            return self._rule_of_flow_id.get(flow_id)
 
     def namespace_of_flow_id(self, flow_id: int) -> Optional[str]:
+        try:
+            flow_id = int(flow_id)
+        except (TypeError, ValueError):
+            return None
         with self._lock:
-            for ns, rs in self._by_namespace.items():
-                for r in rs:
-                    if (r.cluster_config or {}).get("flowId") == flow_id:
-                        return ns
-        return None
+            return self._ns_of_flow_id.get(flow_id)
 
     def add_listener(self, fn) -> None:
         with self._lock:
@@ -151,13 +163,15 @@ class ClusterFlowRuleManager:
             slot_of[int(cc["flowId"])] = i
             ns_of[int(cc["flowId"])] = ns
         # The RowWindow bucket COUNT is shared (= the finest sampleCount);
-        # every rule's span must still equal its own interval, so each row's
-        # bucket length is interval / shared-count. Rules asking for coarser
-        # sampling just get finer buckets — same totals, no over-span.
+        # every rule's span must still cover its own interval, so each row's
+        # bucket length is ceil(interval / shared-count) — rounding UP so an
+        # indivisible interval (e.g. 1000ms / 7 samples) yields a span ≥ the
+        # configured interval instead of refreshing quota early. Rules asking
+        # for coarser sampling just get finer buckets — same totals.
         for i, (ns, r) in enumerate(items):
             cc = r.cluster_config or {}
             interval = int(cc.get("windowIntervalMs", CC.DEFAULT_WINDOW_INTERVAL_MS))
-            bucket_ms[i] = max(1, interval // max_samples)
+            bucket_ms[i] = max(1, -(-interval // max_samples))
         rt = ClusterRuleTensors(
             flow_id=jnp.asarray(flow_id),
             threshold=jnp.asarray(threshold),

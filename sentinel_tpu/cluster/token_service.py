@@ -40,7 +40,6 @@ from sentinel_tpu.cluster.rules import (
     ClusterRuleTensors,
 )
 from sentinel_tpu.ops import window as W
-from sentinel_tpu.ops.segment import segmented_prefix
 from sentinel_tpu.utils import time_util
 from sentinel_tpu.utils.param_hash import hash_param
 
@@ -139,24 +138,46 @@ def acquire_step(
         g(rt.threshold, 0.0) * jnp.maximum(conns, 1.0),
     )
 
-    def verdict(survivors):
-        """Serial semantics: only admitted requests consume the prefix."""
-        tok_prefix, _ = segmented_prefix(
-            jnp.where(known, slots, -1), jnp.where(survivors, counts, 0))
-        passed = (base + tok_prefix.astype(jnp.float32)) * (1000.0 / interval)
-        return passed, passed + counts.astype(jnp.float32) <= thr
-
-    _, ok1 = verdict(known)
-    passed, ok = verdict(known & ok1)
-
-    # Occupy branch for prioritized over-quota requests: bounded backlog.
+    # Greedy serial admission in arrival order — exactly the reference's
+    # per-request CAS semantics: each request sees the usage that every
+    # EARLIER ADMITTED request contributed, and admitted requests consume.
+    # (A two-pass survivor approximation over-admits: after an oversized
+    # request is rejected, later requests would each be judged alone.)
+    # The SHOULD_WAIT occupy backlog is serialized the same way: granted
+    # waits consume occupy budget for later requests in the batch.
+    num_slots = rt.threshold.shape[0]
+    qps_scale = 1000.0 / interval
     waiting = totals[:, CC.ClusterFlowEvent.WAITING].astype(jnp.float32)
-    can_wait = prioritized & (waiting + counts <= max_occupy_ratio * thr)
+
+    def body(carry, x):
+        used_tbl, wait_tbl = carry
+        slot_i, cnt_i, base_i, thr_i, scale_i, known_i, prio_i, waiting_i = x
+        slot_safe = W.oob(slot_i, num_slots)
+        passed_i = (base_i + used_tbl.at[slot_safe].get(
+            mode="fill", fill_value=0.0)) * scale_i
+        ok_i = known_i & (passed_i + cnt_i <= thr_i)
+        backlog_i = waiting_i + wait_tbl.at[slot_safe].get(
+            mode="fill", fill_value=0.0)
+        can_wait_i = (known_i & prio_i & (~ok_i)
+                      & (backlog_i + cnt_i <= max_occupy_ratio * thr_i))
+        used_tbl = used_tbl.at[slot_safe].add(
+            jnp.where(ok_i, cnt_i, 0.0), mode="drop")
+        wait_tbl = wait_tbl.at[slot_safe].add(
+            jnp.where(can_wait_i, cnt_i, 0.0), mode="drop")
+        return (used_tbl, wait_tbl), (ok_i, can_wait_i, passed_i)
+
+    zeros = jnp.zeros((num_slots,), jnp.float32)
+    _, (ok, can_wait, passed) = jax.lax.scan(
+        body, (zeros, zeros),
+        (slots, counts.astype(jnp.float32), base, thr, qps_scale, known,
+         prioritized, waiting),
+    )
+
     bucket_ms = jnp.maximum(g(win.bucket_ms, 1000), 1)
     wait_ms = (bucket_ms - jnp.mod(now_ms, bucket_ms)).astype(jnp.int32)
 
     status = jnp.where(ok, CC.TokenResultStatus.OK, CC.TokenResultStatus.BLOCKED)
-    status = jnp.where(~ok & can_wait, CC.TokenResultStatus.SHOULD_WAIT, status)
+    status = jnp.where(can_wait, CC.TokenResultStatus.SHOULD_WAIT, status)
     status = jnp.where(~known, CC.TokenResultStatus.NO_RULE_EXISTS, status)
     status = status.astype(jnp.int32)
     wait_ms = jnp.where(status == CC.TokenResultStatus.SHOULD_WAIT, wait_ms, 0)
@@ -258,6 +279,10 @@ class DefaultTokenService:
             counts = np.zeros(len(requests), np.int32)
             prio = np.zeros(len(requests), bool)
             for i, (flow_id, count, prioritized) in enumerate(requests):
+                try:
+                    flow_id = int(flow_id)
+                except (TypeError, ValueError):
+                    continue  # slot stays -1 -> NO_RULE_EXISTS
                 ns = self._ns_of.get(flow_id)
                 if ns is not None and not self.limiter.try_pass(ns, now):
                     out[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
@@ -292,24 +317,38 @@ class DefaultTokenService:
         ns = self.rules.namespace_of_flow_id(flow_id)
         if ns is not None and not self.limiter.try_pass(ns, now):
             return TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
+        # AVG_LOCAL scales the per-value threshold by the namespace's live
+        # client count, mirroring the flow-token path (reference:
+        # ClusterParamFlowChecker.calcGlobalThreshold).
         thr = rule.count
+        cc = rule.cluster_config or {}
+        if int(cc.get("thresholdType", CC.THRESHOLD_AVG_LOCAL)) == CC.THRESHOLD_AVG_LOCAL:
+            thr *= max(self.connections.connected_count(ns), 1) if ns else 1
         window_start = now - now % 1000
         with self._lock:
+            # Check all values first (any over-quota value blocks the whole
+            # request, reference ParamFlowChecker semantics), accumulating
+            # within-call usage so duplicate params cannot each be judged
+            # against the untouched bucket.
+            pending: Dict[Tuple[int, int], float] = {}
             blocked = False
             for p in params:
                 key = (flow_id, hash_param(p))
                 start, used = self._param_buckets.get(key, (window_start, 0.0))
                 if start != window_start:
-                    start, used = window_start, 0.0
-                if used + count > thr:
+                    used = 0.0
+                within = pending.get(key, 0.0)
+                if used + within + count > thr:
                     blocked = True
-                self._param_buckets[key] = (start, used)
+                    break
+                pending[key] = within + count
             if blocked:
                 return TokenResult(CC.TokenResultStatus.BLOCKED)
-            for p in params:
-                key = (flow_id, hash_param(p))
-                start, used = self._param_buckets[key]
-                self._param_buckets[key] = (start, used + count)
+            for key, add in pending.items():
+                start, used = self._param_buckets.get(key, (window_start, 0.0))
+                if start != window_start:
+                    used = 0.0
+                self._param_buckets[key] = (window_start, used + add)
             if len(self._param_buckets) > 100_000:  # bounded key space
                 self._param_buckets.clear()
         return TokenResult(CC.TokenResultStatus.OK)

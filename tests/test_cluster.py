@@ -259,3 +259,89 @@ def test_malformed_flow_id_rule_is_dropped(frozen_time):
         resource="x", count=1, cluster_mode=True,
         cluster_config={"flowId": "abc"})])
     assert rules.get_rules("ns") == []
+
+
+def test_serial_admission_no_over_admit_after_oversized_reject(frozen_time):
+    """ADVICE r1 (high): quota 10, batch (15, 10, 10) — admitted requests
+    must contribute to later requests' usage, so exactly ONE of the two
+    10-token requests passes (total admitted <= quota)."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(800, 10)])
+    svc = DefaultTokenService(rules)
+    results = svc.request_tokens(
+        [(800, 15, False), (800, 10, False), (800, 10, False)])
+    assert results[0].status == TokenResultStatus.BLOCKED
+    statuses = [r.status for r in results[1:]]
+    assert statuses.count(TokenResultStatus.OK) == 1
+    assert statuses.count(TokenResultStatus.BLOCKED) == 1
+
+
+def test_string_flow_id_serves_tokens_and_param_tokens(frozen_time):
+    """ADVICE r1 (low): flowId loaded as a numeric string must behave
+    exactly like an int flowId in every lookup path."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule("123", 2)])
+    svc = DefaultTokenService(rules)
+    assert svc.request_token(123).status == TokenResultStatus.OK
+    assert svc.request_token("123").status == TokenResultStatus.OK
+    assert svc.request_param_token(123, 1, ["v"]).status == TokenResultStatus.OK
+    assert svc.request_param_token("123", 1, ["w"]).status == TokenResultStatus.OK
+    assert rules.namespace_of_flow_id(123) == "default"
+    assert rules.namespace_of_flow_id("123") == "default"
+
+
+def test_param_token_avg_local_scales_with_connections(frozen_time):
+    """ADVICE r1 (low): AVG_LOCAL cluster param rules scale the per-value
+    threshold by the namespace's connected-client count."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("nsP", [_rule(310, 1, THRESHOLD_AVG_LOCAL)])
+    svc = DefaultTokenService(rules)
+    svc.connections.connect("nsP")
+    svc.connections.connect("nsP")
+    svc.connections.connect("nsP")  # 3 clients -> threshold 3 per value
+    got = [svc.request_param_token(310, 1, ["k"]).status for _ in range(4)]
+    assert got.count(TokenResultStatus.OK) == 3
+    assert got[-1] == TokenResultStatus.BLOCKED
+
+
+def test_indivisible_interval_does_not_refresh_early(frozen_time):
+    """ADVICE r1 (low): 1000ms window with 7 samples must span >= 1000ms
+    (ceil-div bucket length), not 994ms."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(320, 1, sampleCount=7,
+                                       windowIntervalMs=1000)])
+    svc = DefaultTokenService(rules)
+    # Align the clock to a bucket boundary (bucket_ms = ceil(1000/7) = 143)
+    # so the first token lands at its bucket's start and the full span is
+    # measured from here.
+    frozen_time.freeze_time(1_699_999_999_984)  # multiple of 143
+    assert svc.request_token(320).status == TokenResultStatus.OK
+    frozen_time.advance_time(995)  # inside the configured interval
+    assert svc.request_token(320).status == TokenResultStatus.BLOCKED
+    frozen_time.advance_time(200)  # past the (ceil-rounded) window span
+    assert svc.request_token(320).status == TokenResultStatus.OK
+
+
+def test_prioritized_occupy_backlog_serialized_within_batch(frozen_time):
+    """The SHOULD_WAIT occupy budget is consumed serially within a batch:
+    two prioritized 10-token requests against an exhausted quota 10 with
+    maxOccupyRatio 1.0 cannot BOTH be granted a wait."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(810, 10)])
+    svc = DefaultTokenService(rules, max_occupy_ratio=1.0)
+    assert svc.request_token(810, 10).status == TokenResultStatus.OK  # exhaust
+    results = svc.request_tokens([(810, 10, True), (810, 10, True)])
+    statuses = [r.status for r in results]
+    assert statuses.count(TokenResultStatus.SHOULD_WAIT) == 1
+    assert statuses.count(TokenResultStatus.BLOCKED) == 1
+
+
+def test_param_token_duplicate_values_accumulate_within_call(frozen_time):
+    """Duplicate params in ONE call must be judged cumulatively."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(820, 1)])
+    svc = DefaultTokenService(rules)
+    assert svc.request_param_token(820, 1, ["k", "k"]).status == \
+        TokenResultStatus.BLOCKED
+    # the blocked call must not have consumed the bucket
+    assert svc.request_param_token(820, 1, ["k"]).status == TokenResultStatus.OK
