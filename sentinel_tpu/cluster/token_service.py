@@ -160,8 +160,11 @@ def acquire_step(
             mode="fill", fill_value=0.0)
         can_wait_i = (known_i & prio_i & (~ok_i)
                       & (backlog_i + cnt_i <= max_occupy_ratio * thr_i))
+        # Granted waits consume USAGE too (base charges WAITING from prior
+        # batches; within-batch must match, or a later request would be
+        # judged blind to an earlier SHOULD_WAIT grant).
         used_tbl = used_tbl.at[slot_safe].add(
-            jnp.where(ok_i, cnt_i, 0.0), mode="drop")
+            jnp.where(ok_i | can_wait_i, cnt_i, 0.0), mode="drop")
         wait_tbl = wait_tbl.at[slot_safe].add(
             jnp.where(can_wait_i, cnt_i, 0.0), mode="drop")
         return (used_tbl, wait_tbl), (ok_i, can_wait_i, passed_i)
@@ -311,6 +314,10 @@ class DefaultTokenService:
                             params: Sequence, now_ms: Optional[int] = None) -> TokenResult:
         """Per-(flowId, param) global QPS buckets (``ClusterParamFlowChecker``)."""
         now = now_ms if now_ms is not None else time_util.current_time_millis()
+        try:
+            flow_id = int(flow_id)  # one bucket key space for "123" and 123
+        except (TypeError, ValueError):
+            return TokenResult(CC.TokenResultStatus.NO_RULE_EXISTS)
         rule = self.rules.rule_by_flow_id(flow_id)
         if rule is None:
             return TokenResult(CC.TokenResultStatus.NO_RULE_EXISTS)

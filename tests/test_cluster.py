@@ -345,3 +345,24 @@ def test_param_token_duplicate_values_accumulate_within_call(frozen_time):
         TokenResultStatus.BLOCKED
     # the blocked call must not have consumed the bucket
     assert svc.request_param_token(820, 1, ["k"]).status == TokenResultStatus.OK
+
+
+def test_should_wait_grant_charges_usage_for_batch_peers(frozen_time):
+    """A granted SHOULD_WAIT consumes quota for LATER requests in the same
+    batch (WAITING counts as usage, exactly as it does across batches)."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(830, 10)])
+    svc = DefaultTokenService(rules, max_occupy_ratio=1.0)
+    assert svc.request_token(830, 5).status == TokenResultStatus.OK
+    results = svc.request_tokens([(830, 8, True), (830, 5, False)])
+    assert results[0].status == TokenResultStatus.SHOULD_WAIT
+    assert results[1].status == TokenResultStatus.BLOCKED  # 5+8+5 > 10
+
+
+def test_param_token_bucket_shared_across_flow_id_spellings(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule("123", 1)])
+    svc = DefaultTokenService(rules)
+    assert svc.request_param_token(123, 1, ["k"]).status == TokenResultStatus.OK
+    assert svc.request_param_token("123", 1, ["k"]).status == \
+        TokenResultStatus.BLOCKED  # same bucket, not a fresh one

@@ -1,0 +1,212 @@
+"""Pod-as-token-server tests on the virtual 8-device CPU mesh.
+
+The single most load-bearing claim of the TPU-native design
+(``parallel/cluster.py``): a mesh of devices jointly enforces ONE global
+quota for cluster-mode rules via a ``psum`` over the pod axis, with
+overshoot bounded by one micro-step of cross-device staleness — each device
+admits against the other devices' pass counts as of the step start, so
+
+    total admitted <= threshold + (D - 1) x (max per-device admission/step)
+
+and once counts propagate (the next step), admission stops pod-wide.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import EntryBatch, ExitBatch, make_entry_batch_np, make_exit_batch_np
+from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.models import authority as A
+from sentinel_tpu.models import degrade as D_
+from sentinel_tpu.models import flow as F
+from sentinel_tpu.models import param_flow as PF
+from sentinel_tpu.models import system as Y
+from sentinel_tpu.ops import step as S
+from sentinel_tpu.parallel import cluster as PC
+
+NOW0 = 1_700_000_000_000
+CAPACITY = 128
+NDEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) >= NDEV, "conftest must force 8 CPU devices"
+    return Mesh(np.asarray(devices[:NDEV]), (PC.AXIS,))
+
+
+def _build(rules):
+    reg = NodeRegistry(CAPACITY)
+    row = reg.cluster_row("shared")
+    ft, _ = F.compile_flow_rules(rules, reg, CAPACITY)
+    dt, di = D_.compile_degrade_rules([], reg, CAPACITY)
+    pt = PF.compile_param_rules([], reg, CAPACITY)
+    pack = S.RulePack(
+        flow=ft, degrade=dt,
+        authority=A.compile_authority_rules([], reg, CAPACITY),
+        system=Y.compile_system_rules([]),
+        param=pt,
+    )
+    one = S.make_state(CAPACITY, ft.num_rules, NOW0,
+                       degrade=D_.make_degrade_state(dt, di),
+                       param=PF.make_param_state(pt.num_rules))
+    return reg, row, pack, one
+
+
+def _entry_batch(row, per_dev, count=1):
+    """EntryBatch sharded over NDEV devices: [NDEV*per_dev] rows."""
+    buf = make_entry_batch_np(NDEV * per_dev)
+    buf["cluster_row"][:] = row
+    buf["dn_row"][:] = -1  # keep the ruled row single-committed
+    buf["count"][:] = count
+    return EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+
+def _exit_batch(row, per_dev):
+    buf = make_exit_batch_np(NDEV * per_dev)
+    buf["cluster_row"][:] = row
+    buf["dn_row"][:] = -1
+    buf["count"][:] = 1
+    buf["success"][:] = True
+    return ExitBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+
+_STEPS = {}
+
+
+def _steps(mesh):
+    """Jitted pod steps, built once per mesh (shard_map without jit would
+    dispatch the whole step op-by-op)."""
+    key = id(mesh)
+    if key not in _STEPS:
+        entry, exit_ = PC.make_pod_steps(mesh)
+        _STEPS[key] = (jax.jit(entry), jax.jit(exit_))
+    return _STEPS[key]
+
+
+def _run(mesh, pack, pod_state, batch, now):
+    entry, _ = _steps(mesh)
+    return entry(pod_state, pack, batch, jnp.asarray(now, jnp.int64))
+
+
+def _admitted(dec):
+    return int((np.asarray(dec.reason) == C.BlockReason.PASS).sum())
+
+
+def test_pod_respects_global_threshold_with_bounded_overshoot(mesh):
+    """Step 1: every device admits locally (stale psum) within the bound;
+    step 2: propagated counts stop admission pod-wide."""
+    thr, per_dev = 10, 4
+    _, row, pack, one = _build([F.FlowRule(resource="shared", count=thr,
+                                           cluster_mode=True)])
+    pod = PC.make_pod_state(NDEV, one)
+    batch = _entry_batch(row, per_dev)
+
+    pod, dec1 = _run(mesh, pack, pod, batch, NOW0)
+    admitted1 = _admitted(dec1)
+    # Each device alone could admit at most min(per_dev, thr).
+    assert admitted1 <= thr + (NDEV - 1) * min(per_dev, thr)
+    assert admitted1 >= thr  # the pod is not under-admitting either
+
+    pod, dec2 = _run(mesh, pack, pod, batch, NOW0 + 1)
+    # Global usage (>= thr) is now visible everywhere: nothing passes.
+    assert _admitted(dec2) == 0
+
+
+def test_pod_stops_when_one_device_exhausts_quota(mesh):
+    """Quota consumed on device 0 only must block devices 1..7 next step."""
+    thr = 6
+    _, row, pack, one = _build([F.FlowRule(resource="shared", count=thr,
+                                           cluster_mode=True)])
+    pod = PC.make_pod_state(NDEV, one)
+
+    # Device 0 sends `thr` requests, other devices idle (row -1 = no-op).
+    buf = make_entry_batch_np(NDEV * thr)
+    buf["cluster_row"][:] = -1
+    buf["cluster_row"][:thr] = row  # shard 0 only
+    buf["dn_row"][:] = buf["cluster_row"]
+    buf["count"][:] = 1
+    pod, dec1 = _run(mesh, pack, pod,
+                     EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()}),
+                     NOW0)
+    assert _admitted(dec1) == thr
+
+    # Now every device tries: all must see the global window as full.
+    pod, dec2 = _run(mesh, pack, pod, _entry_batch(row, 2), NOW0 + 1)
+    assert _admitted(dec2) == 0
+
+
+def test_pod_quota_refreshes_across_window_rotation(mesh):
+    thr = 8
+    _, row, pack, one = _build([F.FlowRule(resource="shared", count=thr,
+                                           cluster_mode=True)])
+    pod = PC.make_pod_state(NDEV, one)
+    pod, dec1 = _run(mesh, pack, pod, _entry_batch(row, 1), NOW0)
+    assert _admitted(dec1) == NDEV  # 8 <= thr: all pass
+    pod, dec2 = _run(mesh, pack, pod, _entry_batch(row, 1), NOW0 + 10)
+    assert _admitted(dec2) == 0  # window holds 8 >= thr globally
+    # A full window later the quota is back for the whole pod.
+    pod, dec3 = _run(mesh, pack, pod, _entry_batch(row, 1), NOW0 + 1100)
+    assert _admitted(dec3) == NDEV
+
+
+def test_local_rules_stay_per_device(mesh):
+    """A non-cluster rule is enforced per device replica, not pod-wide."""
+    thr, per_dev = 3, 5
+    _, row, pack, one = _build([F.FlowRule(resource="shared", count=thr,
+                                           cluster_mode=False)])
+    pod = PC.make_pod_state(NDEV, one)
+    pod, dec = _run(mesh, pack, pod, _entry_batch(row, per_dev), NOW0)
+    # Every device admits its own `thr` — D x thr total, proving no psum
+    # coupling for local rules.
+    assert _admitted(dec) == NDEV * thr
+    reasons = np.asarray(dec.reason).reshape(NDEV, per_dev)
+    for d in range(NDEV):
+        assert (reasons[d] == C.BlockReason.PASS).sum() == thr
+
+
+def test_exit_path_balances_thread_gauges_across_devices(mesh):
+    """Entries then exits on every device: each replica's concurrency gauge
+    returns to zero (the pod analog of StatisticSlot.exit)."""
+    _, row, pack, one = _build([F.FlowRule(resource="shared", count=1e9,
+                                           cluster_mode=True)])
+    pod = PC.make_pod_state(NDEV, one)
+    entry, exit_ = _steps(mesh)
+    per_dev = 3
+    pod, dec = entry(pod, pack, _entry_batch(row, per_dev),
+                     jnp.asarray(NOW0, jnp.int64))
+    assert _admitted(dec) == NDEV * per_dev
+    gauges = np.asarray(pod.cur_threads)[:, row]
+    assert (gauges == per_dev).all()  # [D] replicas each carry their own
+
+    pod = exit_(pod, pack, _exit_batch(row, per_dev),
+                jnp.asarray(NOW0 + 5, jnp.int64))
+    gauges = np.asarray(pod.cur_threads)[:, row]
+    assert (gauges == 0).all()
+
+
+def test_pod_admission_matches_single_server_totals_over_steps(mesh):
+    """Multi-step conservation: the pod never admits more per window than a
+    single token server with the same threshold would, beyond the documented
+    one-step staleness bound."""
+    thr, per_dev, steps = 12, 2, 6
+    _, row, pack, one = _build([F.FlowRule(resource="shared", count=thr,
+                                           cluster_mode=True)])
+    pod = PC.make_pod_state(NDEV, one)
+    batch = _entry_batch(row, per_dev)
+    total = 0
+    for k in range(steps):
+        pod, dec = _run(mesh, pack, pod, batch, NOW0 + k)
+        total += _admitted(dec)
+    bound = thr + (NDEV - 1) * min(per_dev, thr)
+    assert total <= bound
+    # and the pod-global window agrees with what was admitted
+    w1_total = int(np.asarray(pod.w1.counts)[:, :, C.MetricEvent.PASS, row].sum())
+    assert w1_total == total
