@@ -270,7 +270,8 @@ class FlowRuleManager(RuleManager):
 
 class FlowVerdict(NamedTuple):
     blocked: jax.Array  # bool[N]
-    wait_us: jax.Array  # int64[N] sleep-then-pass (rate limiter)
+    wait_us: jax.Array  # int64[N] sleep-then-pass (rate limiter / occupy)
+    occupied: jax.Array  # bool[N] prioritized grant borrowing the next bucket
     state: FlowState
 
 
@@ -315,6 +316,7 @@ def check_flow(
     now_ms: jax.Array,
     already_blocked: jax.Array,  # bool[N] blocked by an earlier slot
     extra_pass: Optional[jax.Array] = None,  # int32[R] other-device pass counts
+    occupied_next: Optional[jax.Array] = None,  # int32[R] borrows on next bucket
 ) -> FlowVerdict:
     """Vectorized ``FlowRuleChecker.checkFlow`` over the micro-batch.
 
@@ -341,12 +343,14 @@ def check_flow(
     rule_prev_pass = _gather(prev_pass_all, rt.sync_row, 0).astype(jnp.float32)
     fs = _sync_warmup(rt, fs, rule_prev_pass, now_ms)
 
-    blocked1, _, _ = _eval_flow_slots(
-        rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass
+    blocked1, _, _, _ = _eval_flow_slots(
+        rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass,
+        occupied_next=occupied_next,
     )
-    blocked, wait_us, consumed = _eval_flow_slots(
+    blocked, wait_us, consumed, occupied = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
         survivors=candidate & (~blocked1), extra_pass=extra_pass,
+        occupied_next=occupied_next,
     )
 
     # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
@@ -355,7 +359,7 @@ def check_flow(
     fs = fs._replace(
         latest_passed_us=jnp.where(consumed > 0, new_latest, fs.latest_passed_us)
     )
-    return FlowVerdict(blocked=blocked, wait_us=wait_us, state=fs)
+    return FlowVerdict(blocked=blocked, wait_us=wait_us, occupied=occupied, state=fs)
 
 
 def _eval_flow_slots(
@@ -368,6 +372,7 @@ def _eval_flow_slots(
     candidate: jax.Array,
     survivors: Optional[jax.Array] = None,
     extra_pass: Optional[jax.Array] = None,
+    occupied_next: Optional[jax.Array] = None,
 ):
     """One vectorized sweep over all rule slots.
 
@@ -395,7 +400,17 @@ def _eval_flow_slots(
 
     blocked = jnp.zeros((n,), bool)
     wait_us = jnp.zeros((n,), jnp.int64)
+    occupied = jnp.zeros((n,), bool)
     consumed = jnp.zeros((rt.num_rules,), jnp.int64)  # rate-limiter tokens
+
+    # Occupy-next-window geometry (DefaultController.tryOccupyNext): at the
+    # next bucket boundary the OLDEST bucket's counts leave the window, so
+    # next-window usage = window pass − oldest-bucket pass + already-borrowed.
+    spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
+    cur_idx = W.current_index(now_ms, spec)
+    oldest_idx = jnp.mod(cur_idx + 1, spec.buckets)
+    oldest_pass_all = jnp.take(w1.counts[:, C.MetricEvent.PASS, :], oldest_idx, axis=0)  # [R]
+    occ_wait_us = (spec.bucket_ms - jnp.mod(now_ms.astype(jnp.int64), spec.bucket_ms)) * 1000
 
     for k in range(rt.slots):
         rule_id = rt.rules_by_row.at[
@@ -499,6 +514,34 @@ def _eval_flow_slots(
         ok = jnp.where(behavior == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER, warm_ok & rl_ok, ok)
 
         slot_blocked = applicable & (~ok)
+
+        # --- prioritized occupy-next-window (DefaultController.tryOccupyNext
+        # + OccupiableBucketLeapArray): a prioritized QPS request rejected by
+        # the DEFAULT controller may borrow from the next bucket if the
+        # next window (current − expiring bucket + borrows) has room and the
+        # wait fits the occupy timeout. Granted requests pass with a wait;
+        # their PASS lands in the bucket they borrowed (ops/step.py fold).
+        occ_cand = (slot_blocked & batch.prioritized
+                    & (grade == C.FLOW_GRADE_QPS)
+                    & (behavior == C.CONTROL_BEHAVIOR_DEFAULT))
+        if occupied_next is not None:
+            occ_prefix, _ = segmented_prefix_dense(
+                jnp.where(occ_cand, sel_row, -1),
+                jnp.where(occ_cand & survivors, batch.count, 0).astype(jnp.float32),
+            )
+            next_used = (
+                pass_1s
+                - _gather(oldest_pass_all, sel_row, 0).astype(jnp.float32)
+                + _gather(occupied_next, sel_row, 0).astype(jnp.float32)
+                + occ_prefix
+            )
+            grant = occ_cand & (next_used + acq <= thr) & (
+                occ_wait_us <= C.DEFAULT_OCCUPY_TIMEOUT_MS * 1000
+            )
+            occupied = occupied | grant
+            wait_us = jnp.maximum(wait_us, jnp.where(grant, occ_wait_us, 0))
+            slot_blocked = slot_blocked & (~grant)
+
         blocked = blocked | slot_blocked
 
         # Bucket tokens are consumed only by requests that survive every
