@@ -127,6 +127,10 @@ MAX_SLOT_CHAIN_SIZE = 6000  # reference CtSph cap; we cap registry rows instead
 
 DEFAULT_MAX_RT_MS = 4900  # csp.sentinel.statistic.max.rt default
 
+# Prioritized entries may wait at most this long for the next window bucket
+# (reference: OccupyTimeoutProperty default, capped at one sample bucket).
+DEFAULT_OCCUPY_TIMEOUT_MS = 500
+
 # Per-request acquire counts ride bf16 matmul operands on device
 # (ops/segment.py), exact only up to 256; the API rejects larger counts.
 MAX_ACQUIRE_COUNT = 256

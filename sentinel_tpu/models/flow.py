@@ -272,6 +272,7 @@ class FlowVerdict(NamedTuple):
     blocked: jax.Array  # bool[N]
     wait_us: jax.Array  # int64[N] sleep-then-pass (rate limiter / occupy)
     occupied: jax.Array  # bool[N] prioritized grant borrowing the next bucket
+    occ_add: jax.Array  # int32[R] borrow counts granted this step, per node row
     state: FlowState
 
 
@@ -317,6 +318,7 @@ def check_flow(
     already_blocked: jax.Array,  # bool[N] blocked by an earlier slot
     extra_pass: Optional[jax.Array] = None,  # int32[R] other-device pass counts
     occupied_next: Optional[jax.Array] = None,  # int32[R] borrows on next bucket
+    extra_next: Optional[jax.Array] = None,  # int32[R] other-device next-window use
 ) -> FlowVerdict:
     """Vectorized ``FlowRuleChecker.checkFlow`` over the micro-batch.
 
@@ -343,14 +345,14 @@ def check_flow(
     rule_prev_pass = _gather(prev_pass_all, rt.sync_row, 0).astype(jnp.float32)
     fs = _sync_warmup(rt, fs, rule_prev_pass, now_ms)
 
-    blocked1, _, _, _ = _eval_flow_slots(
+    blocked1, _, _, _, _ = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass,
-        occupied_next=occupied_next,
+        occupied_next=occupied_next, extra_next=extra_next,
     )
-    blocked, wait_us, consumed, occupied = _eval_flow_slots(
+    blocked, wait_us, consumed, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
         survivors=candidate & (~blocked1), extra_pass=extra_pass,
-        occupied_next=occupied_next,
+        occupied_next=occupied_next, extra_next=extra_next,
     )
 
     # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
@@ -359,7 +361,8 @@ def check_flow(
     fs = fs._replace(
         latest_passed_us=jnp.where(consumed > 0, new_latest, fs.latest_passed_us)
     )
-    return FlowVerdict(blocked=blocked, wait_us=wait_us, occupied=occupied, state=fs)
+    return FlowVerdict(blocked=blocked, wait_us=wait_us, occupied=occupied,
+                       occ_add=occ_add, state=fs)
 
 
 def _eval_flow_slots(
@@ -373,6 +376,7 @@ def _eval_flow_slots(
     survivors: Optional[jax.Array] = None,
     extra_pass: Optional[jax.Array] = None,
     occupied_next: Optional[jax.Array] = None,
+    extra_next: Optional[jax.Array] = None,
 ):
     """One vectorized sweep over all rule slots.
 
@@ -401,6 +405,7 @@ def _eval_flow_slots(
     blocked = jnp.zeros((n,), bool)
     wait_us = jnp.zeros((n,), jnp.int64)
     occupied = jnp.zeros((n,), bool)
+    occ_add = jnp.zeros((w1.num_rows,), jnp.int32)  # granted borrows per row
     consumed = jnp.zeros((rt.num_rules,), jnp.int64)  # rate-limiter tokens
 
     # Occupy-next-window geometry (DefaultController.tryOccupyNext): at the
@@ -434,7 +439,10 @@ def _eval_flow_slots(
         relate = strat == C.FLOW_STRATEGY_RELATE
         chain = (strat == C.FLOW_STRATEGY_CHAIN) & (batch.context_id == g(rt.ref_context, -1))
 
-        applicable = has_rule & candidate & (sel_specific | sel_default | sel_other | relate | chain)
+        # A request already granted an occupy borrow by an earlier slot has
+        # left the chain (reference: PriorityWaitException short-circuits the
+        # remaining rules), so later slots never see it.
+        applicable = has_rule & candidate & (~occupied) & (sel_specific | sel_default | sel_other | relate | chain)
         # Requests whose remote-enforced rules (cluster mode + flowId) were
         # already checked by a token server skip those rules locally
         # (reference: passClusterCheck replaces the local check; fallback
@@ -521,7 +529,9 @@ def _eval_flow_slots(
         # next window (current − expiring bucket + borrows) has room and the
         # wait fits the occupy timeout. Granted requests pass with a wait;
         # their PASS lands in the bucket they borrowed (ops/step.py fold).
-        occ_cand = (slot_blocked & batch.prioritized
+        # ``~blocked``: a request an EARLIER slot rejected already threw in
+        # the serial reference — later slots must not hand it a borrow.
+        occ_cand = (slot_blocked & (~blocked) & batch.prioritized
                     & (grade == C.FLOW_GRADE_QPS)
                     & (behavior == C.CONTROL_BEHAVIOR_DEFAULT))
         if occupied_next is not None:
@@ -535,12 +545,24 @@ def _eval_flow_slots(
                 + _gather(occupied_next, sel_row, 0).astype(jnp.float32)
                 + occ_prefix
             )
+            if extra_next is not None:
+                # Cluster-mode rules borrow against the POD-global next
+                # window: fold in the other devices' psum'd next-window
+                # usage, or every device would grant up to the full global
+                # threshold independently.
+                next_used = next_used + jnp.where(
+                    g(rt.cluster_mode, False),
+                    _gather(extra_next, sel_row, 0).astype(jnp.float32), 0.0
+                )
             grant = occ_cand & (next_used + acq <= thr) & (
                 occ_wait_us <= C.DEFAULT_OCCUPY_TIMEOUT_MS * 1000
             )
             occupied = occupied | grant
             wait_us = jnp.maximum(wait_us, jnp.where(grant, occ_wait_us, 0))
             slot_blocked = slot_blocked & (~grant)
+            occ_add = occ_add.at[W.oob(sel_row, w1.num_rows)].add(
+                jnp.where(grant, batch.count, 0).astype(jnp.int32), mode="drop"
+            )
 
         blocked = blocked | slot_blocked
 
@@ -553,4 +575,4 @@ def _eval_flow_slots(
             jnp.where(admitted_rl, batch.count, 0).astype(jnp.int64), mode="drop"
         )
 
-    return blocked, wait_us, consumed
+    return blocked, wait_us, consumed, occupied, occ_add

@@ -68,6 +68,14 @@ class SentinelState(NamedTuple):
     param: P.ParamFlowState
     sys_signals: jax.Array  # f32[2] host-sampled [load1, cpu_usage]
     sec: SecondAccum      # current-second staging for the minute window
+    # Prioritized occupy-next-window borrows (reference:
+    # OccupiableBucketLeapArray's borrowArray): counts granted against the
+    # NEXT w1 bucket, folded into it as PASS when that bucket becomes
+    # current. ``occupied_stamp`` is the w1 bucket-start the borrows were
+    # granted in (-1 = none); a jump of more than one bucket deprecates them,
+    # exactly like a borrow bucket the ring never rotates into.
+    occupied_next: jax.Array   # int32[R] pending borrow counts per node row
+    occupied_stamp: jax.Array  # int64[] bucket-start of the granting bucket
 
 
 class RulePack(NamedTuple):
@@ -101,6 +109,8 @@ def make_state(num_rows: int, flow_rules: int, now_ms: int,
             min_rt=jnp.full((num_rows,), W.MIN_RT_EMPTY, jnp.int32),
             stamp=jnp.int64(-1),
         ),
+        occupied_next=jnp.zeros((num_rows,), jnp.int32),
+        occupied_stamp=jnp.int64(-1),
     )
 
 
@@ -196,16 +206,31 @@ def entry_step(
     batch: EntryBatch,
     now_ms: jax.Array,
     extra_pass=None,
+    extra_next=None,
 ) -> Tuple[SentinelState, Decisions]:
-    """One admission step. ``extra_pass`` (int32[R], optional) is the other
-    devices' pass-count contribution for cluster-mode rules — supplied by
-    the pod-parallel wrapper (``parallel/cluster.py``) from a ``psum``."""
+    """One admission step. ``extra_pass`` / ``extra_next`` (int32[R],
+    optional) are the other devices' pass-count / next-window-usage
+    contributions for cluster-mode rules — supplied by the pod-parallel
+    wrapper (``parallel/cluster.py``) from a ``psum``."""
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, SPEC_1S)
     # Minute-window commits are staged in the [E, R] second accumulator and
     # folded at most once per second; readers (BBR check below, host metric
     # sealing) combine w60 + the live accumulator themselves.
     w60, sec = _roll_second(state.w60, state.sec, now_ms)
+
+    # Land pending occupy borrows: once the bucket after the granting one is
+    # current, its borrowed counts become real PASS there (reference:
+    # OccupiableBucketLeapArray.resetWindowTo transfers the borrow bucket).
+    # A jump of 2+ buckets means the target bucket already expired — the
+    # borrows are dropped, like a borrow bucket the ring rotated past.
+    idx1 = W.current_index(now_ms, SPEC_1S)
+    cur_start = now_ms - now_ms % SPEC_1S.bucket_ms
+    moved = (state.occupied_stamp >= 0) & (cur_start != state.occupied_stamp)
+    land = moved & (cur_start == state.occupied_stamp + SPEC_1S.bucket_ms)
+    w1 = w1._replace(counts=w1.counts.at[idx1, C.MetricEvent.PASS].add(
+        jnp.where(land, state.occupied_next, 0)))
+    occupied_next = jnp.where(moved, 0, state.occupied_next)
 
     valid = batch.cluster_row >= 0
     reason = jnp.where(valid, C.BlockReason.PASS, -1).astype(jnp.int32)
@@ -233,18 +258,27 @@ def entry_step(
     blocked = blocked | pv.blocked
 
     fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked,
-                      extra_pass=extra_pass)
+                      extra_pass=extra_pass, occupied_next=occupied_next,
+                      extra_next=extra_next)
     reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
     blocked = blocked | fv.blocked
 
-    dv = D.check_degrade(rules.degrade, state.degrade, batch, now_ms, valid & (~blocked))
+    # Occupy grants leave the chain before the degrade slot (reference:
+    # PriorityWaitException propagates out of FlowSlot).
+    granted = valid & (~blocked) & fv.occupied
+    dv = D.check_degrade(rules.degrade, state.degrade, batch, now_ms,
+                         valid & (~blocked) & (~granted))
     reason = jnp.where(valid & (~blocked) & dv.blocked, C.BlockReason.DEGRADE, reason)
     blocked = blocked | dv.blocked
 
     # --- StatisticSlot commit --------------------------------------------
     rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
     admit = valid & (~blocked)
-    pass_counts = jnp.where(admit, batch.count, 0)
+    # Granted occupies don't commit PASS now: their pass lands in the bucket
+    # they borrowed (the fold above, next step). The minute staging gets
+    # PASS + OCCUPIED_PASS immediately on the rule-selected row (reference:
+    # StatisticNode.addOccupiedPass hits the minute counter at grant time).
+    pass_counts = jnp.where(admit & (~granted), batch.count, 0)
     block_counts = jnp.where(valid & blocked, batch.count, 0)
     pass4 = jnp.broadcast_to(pass_counts[:, None], rows4.shape)
     block4 = jnp.broadcast_to(block_counts[:, None], rows4.shape)
@@ -252,6 +286,11 @@ def entry_step(
     delta = _event_delta(rows4, [(C.MetricEvent.PASS, pass4, False),
                                  (C.MetricEvent.BLOCK, block4, False)], w1.num_rows)
     w1, sec = _apply_delta(w1, sec, delta, now_ms)
+    occupied_next = occupied_next + fv.occ_add
+    occupied_stamp = cur_start
+    sec = sec._replace(counts=sec.counts
+                       .at[C.MetricEvent.PASS].add(fv.occ_add)
+                       .at[C.MetricEvent.OCCUPIED_PASS].add(fv.occ_add))
 
     thread_inc = jnp.broadcast_to(jnp.where(admit, 1, 0)[:, None], rows4.shape)
     cur_threads = state.cur_threads + seg.bincount_matmul(
@@ -262,7 +301,9 @@ def entry_step(
 
     new_state = SentinelState(w1=w1, w60=w60, cur_threads=cur_threads,
                               flow=fv.state, degrade=dv.state, param=pv.state,
-                              sys_signals=state.sys_signals, sec=sec)
+                              sys_signals=state.sys_signals, sec=sec,
+                              occupied_next=occupied_next,
+                              occupied_stamp=occupied_stamp)
     return new_state, Decisions(reason=reason, wait_us=wait_us)
 
 

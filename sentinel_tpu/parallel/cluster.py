@@ -75,16 +75,34 @@ def global_pass_counts(w1: W.Window, axis: str) -> Tuple[jax.Array, jax.Array]:
     return total - local, local
 
 
+def global_next_window(w1: W.Window, occupied_next: jax.Array, now_ms: jax.Array,
+                       axis: str) -> jax.Array:
+    """extra_next[R]: other devices' NEXT-window usage (occupy borrows).
+
+    A device's next-window usage is its window pass minus the bucket about
+    to expire, plus its pending borrows. psum'd so prioritized occupy
+    grants admit against the pod-global next window, not just the local
+    slice (otherwise every device would lend up to the global threshold).
+    """
+    spec = S.SPEC_1S
+    oldest_idx = jnp.mod(W.current_index(now_ms, spec) + 1, spec.buckets)
+    oldest = w1.counts[oldest_idx, C.MetricEvent.PASS, :]
+    local = (W.all_totals(w1)[:, C.MetricEvent.PASS] - oldest
+             + occupied_next)
+    return jax.lax.psum(local, axis) - local
+
+
 def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
                now_ms: jax.Array, *, axis: str) -> Tuple[S.SentinelState, Decisions]:
     local = _squeeze0(state)
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(local.w1, now_ms, S.SPEC_1S)
     extra_pass, _ = global_pass_counts(w1, axis)
+    extra_next = global_next_window(w1, local.occupied_next, now_ms, axis)
     # Hand the rotated window through so entry_step's own rotate hits the
     # cheap restamp branch instead of re-sweeping the counts tensor.
     new_local, dec = S.entry_step(local._replace(w1=w1), rules, batch, now_ms,
-                                  extra_pass=extra_pass)
+                                  extra_pass=extra_pass, extra_next=extra_next)
     return _expand0(new_local), dec
 
 

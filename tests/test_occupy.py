@@ -1,0 +1,149 @@
+"""Prioritized occupy-next-window tests.
+
+Reference behavior being reproduced (SURVEY.md §2.1 "FlowSlot"):
+``DefaultController.tryOccupyNext`` + ``OccupiableBucketLeapArray`` — a
+prioritized QPS entry rejected by the default controller may *borrow* quota
+from the next window bucket if that future window has room, waiting out the
+remainder of the current bucket instead of failing. The borrowed pass lands
+in the bucket it borrowed (the reference's ``resetWindowTo`` transfer), so
+it counts against subsequent admissions there.
+
+Clock geometry: the frozen epoch 1_700_000_000_000 is a whole-second
+boundary; the 1s window has two 500ms buckets.
+"""
+
+import pytest
+
+import sentinel_tpu as st
+
+
+def _fill(resource, n):
+    for _ in range(n):
+        with st.entry(resource):
+            pass
+
+
+def _row(engine, resource):
+    return engine.registry.cluster_row(resource)
+
+
+def test_non_prioritized_never_borrows(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
+    _fill("occ", 10)
+    frozen_time.advance_time(900)  # quota now sits in the expiring bucket
+    with pytest.raises(st.FlowException):
+        st.entry("occ")
+
+
+def test_borrow_denied_while_next_window_is_full(engine, frozen_time):
+    """Passes in the CURRENT bucket still occupy the next window."""
+    st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
+    _fill("occ", 10)
+    # Still inside the granting bucket: the next window keeps all 10 passes
+    # (only the empty oldest bucket expires), so there is nothing to borrow.
+    with pytest.raises(st.FlowException):
+        st.entry("occ", prioritized=True)
+    assert int(engine._state.occupied_next[_row(engine, "occ")]) == 0
+
+
+def test_prioritized_borrows_once_bucket_expires(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
+    _fill("occ", 10)
+    frozen_time.advance_time(900)  # 10 passes now in the expiring bucket
+    e = st.entry("occ", prioritized=True)  # sleeps ~100ms, then passes
+    e.exit()
+    row = _row(engine, "occ")
+    assert int(engine._state.occupied_next[row]) == 1
+    # The granted pass is deferred to the borrowed bucket: the live window
+    # still reads 10 passes, and no block was recorded.
+    snap = engine.node_snapshot()["occ"]
+    assert snap["passQps"] == 10
+    assert snap["blockQps"] == 0
+
+
+def test_borrow_capacity_is_the_rule_count(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="occ", count=2)])
+    _fill("occ", 2)
+    frozen_time.advance_time(900)
+    st.entry("occ", prioritized=True).exit()
+    st.entry("occ", prioritized=True).exit()
+    with pytest.raises(st.FlowException):  # next window now full of borrows
+        st.entry("occ", prioritized=True)
+    assert int(engine._state.occupied_next[_row(engine, "occ")]) == 2
+
+
+def test_borrow_lands_as_pass_in_next_bucket(engine, frozen_time):
+    """Folded borrows count against the window (the borrow is repaid)."""
+    st.load_flow_rules([st.FlowRule(resource="occ", count=2)])
+    _fill("occ", 2)
+    frozen_time.advance_time(900)
+    st.entry("occ", prioritized=True).exit()
+    st.entry("occ", prioritized=True).exit()
+    frozen_time.advance_time(100)  # enter the borrowed bucket
+    # Window quota is consumed by the 2 landed borrows.
+    with pytest.raises(st.FlowException):
+        st.entry("occ")
+    row = _row(engine, "occ")
+    assert int(engine._state.occupied_next[row]) == 0
+    snap = engine.node_snapshot()["occ"]
+    # 2 original passes expired with their bucket; the 2 borrows landed.
+    assert snap["passQps"] == 2
+
+
+def test_stale_borrows_deprecate_when_buckets_skip(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
+    _fill("occ", 10)
+    frozen_time.advance_time(900)
+    st.entry("occ", prioritized=True).exit()
+    # Jump PAST the borrowed bucket: the borrow's target window expired
+    # before anything rotated into it, so it is dropped, not landed.
+    frozen_time.advance_time(1600)
+    with st.entry("occ"):
+        pass
+    row = _row(engine, "occ")
+    assert int(engine._state.occupied_next[row]) == 0
+    assert engine.node_snapshot()["occ"]["passQps"] == 1
+
+
+def test_earlier_slot_block_denies_later_slot_borrow(engine, frozen_time):
+    """A request rejected by an earlier rule slot must not collect a borrow
+    from a later slot (the serial reference threw before reaching it)."""
+    import numpy as np
+
+    st.load_flow_rules([
+        # Slot 0: origin-scoped, will block with a FULL next window.
+        st.FlowRule(resource="r", count=2, limit_app="svcA"),
+        # Slot 1: default, whose next window HAS room to lend.
+        st.FlowRule(resource="r", count=10),
+    ])
+    st.context_enter("c1", origin="bulk")
+    for _ in range(8):  # 8 passes on the cluster node, this bucket
+        with st.entry("r"):
+            pass
+    st.exit_context()
+    frozen_time.advance_time(900)  # those 8 now sit in the expiring bucket
+    st.context_enter("c2", origin="svcA")
+    for _ in range(2):  # svcA's origin quota, in the CURRENT bucket
+        with st.entry("r"):
+            pass
+    # Slot 0 blocks (origin next-window full: its 2 passes don't expire);
+    # slot 1 would lend (8 of its 10 expire) — but the request is dead.
+    with pytest.raises(st.FlowException):
+        st.entry("r", prioritized=True)
+    st.exit_context()
+    assert int(np.asarray(engine._state.occupied_next).sum()) == 0
+
+
+def test_occupied_pass_reaches_minute_metrics(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
+    _fill("occ", 10)
+    frozen_time.advance_time(900)
+    st.entry("occ", prioritized=True).exit()
+    from sentinel_tpu.core import constants as C
+
+    row = _row(engine, "occ")
+    state = engine._state
+    assert int(state.sec.counts[C.MetricEvent.OCCUPIED_PASS, row]) == 1
+    # Minute staging records the grant's pass immediately (reference:
+    # StatisticNode.addOccupiedPass hits the minute counter at grant time).
+    assert int(state.sec.counts[C.MetricEvent.PASS, row]) == 11

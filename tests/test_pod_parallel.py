@@ -210,3 +210,46 @@ def test_pod_admission_matches_single_server_totals_over_steps(mesh):
     # and the pod-global window agrees with what was admitted
     w1_total = int(np.asarray(pod.w1.counts)[:, :, C.MetricEvent.PASS, row].sum())
     assert w1_total == total
+
+
+def test_pod_occupy_borrows_respect_global_next_window(mesh):
+    """Prioritized occupy grants admit against the POD-global next window:
+    wave 1 lends within the one-step staleness bound, and once the borrows
+    propagate (next step) the whole pod stops lending."""
+    thr, per_dev = 10, 4
+    _, row, pack, one = _build([F.FlowRule(resource="shared", count=thr,
+                                           cluster_mode=True)])
+    pod = PC.make_pod_state(NDEV, one)
+
+    # Saturate the window from device 0 in the first bucket.
+    buf = make_entry_batch_np(NDEV * thr)
+    buf["cluster_row"][:] = -1
+    buf["cluster_row"][:thr] = row  # shard 0 only
+    buf["dn_row"][:] = buf["cluster_row"]
+    buf["count"][:] = 1
+    pod, dec0 = _run(mesh, pack, pod,
+                     EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()}),
+                     NOW0)
+    assert _admitted(dec0) == thr
+
+    # Next bucket: the quota sits in the expiring bucket, so the global
+    # next window has `thr` of room. Every device sends prioritized traffic.
+    buf = make_entry_batch_np(NDEV * per_dev)
+    buf["cluster_row"][:] = row
+    buf["dn_row"][:] = -1
+    buf["count"][:] = 1
+    buf["prioritized"][:] = True
+    pbatch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+    pod, dec1 = _run(mesh, pack, pod, pbatch, NOW0 + 600)
+    r1, w1_ = np.asarray(dec1.reason), np.asarray(dec1.wait_us)
+    granted1 = int(((r1 == C.BlockReason.PASS) & (w1_ > 0)).sum())
+    borrows = int(np.asarray(pod.occupied_next).sum())
+    assert granted1 == borrows
+    assert 1 <= granted1 <= thr + (NDEV - 1) * per_dev
+
+    # Same bucket, one step later: pending borrows are psum-visible, the
+    # global next window is full — zero further grants anywhere.
+    pod, dec2 = _run(mesh, pack, pod, pbatch, NOW0 + 610)
+    assert _admitted(dec2) == 0
+    assert int(np.asarray(pod.occupied_next).sum()) == borrows
