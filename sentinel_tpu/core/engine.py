@@ -126,6 +126,13 @@ class SentinelEngine:
         self._cluster_flow_info: Dict[str, list] = {}
         self._cluster_param_info: Dict[str, list] = {}
         self._pipeline = None
+        # Entries that passed UNGUARDED because the pipeline could not
+        # produce a verdict (collector death / cycle error). A silent
+        # fail-open is an invisible protection outage — count it and log
+        # at most once per second (reference's fallback is at least
+        # observable through block logs).
+        self.fail_open_count = 0
+        self._fail_open_logged_ms = 0
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
@@ -333,6 +340,18 @@ class SentinelEngine:
         ctx.entry_stack.append(handle)
         return handle
 
+    def _note_fail_open(self, why: str) -> None:
+        """Count + rate-limited log of an unguarded pass-through."""
+        self.fail_open_count += 1
+        now = time_util.current_time_millis()
+        if now - self._fail_open_logged_ms >= 1000:
+            self._fail_open_logged_ms = now
+            import logging
+
+            logging.getLogger("sentinel_tpu").warning(
+                "entry passed UNGUARDED (%s); fail_open_count=%d",
+                why, self.fail_open_count)
+
     def _cluster_token_check(self, resource, count, prioritized, args) -> Tuple[bool, bool]:
         """Remote token acquire for cluster-mode rules (``passClusterCheck``).
 
@@ -403,8 +422,10 @@ class SentinelEngine:
                         # Stop() drained everything it could and the ticket
                         # never surfaced (collector died mid-cycle): pass
                         # unguarded rather than risk a double commit.
+                        self._note_fail_open("collector died mid-cycle")
                         return 0, 0
                 if ticket.reason == -2:  # cycle error: pass-through
+                    self._note_fail_open("pipeline cycle error")
                     return 0, 0
                 return ticket.reason, ticket.wait_us
         with self._lock:

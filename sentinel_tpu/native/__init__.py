@@ -27,12 +27,11 @@ _load_failed = False
 def _build() -> Optional[str]:
     path = os.path.abspath(os.path.join(_NATIVE_DIR, _LIB_NAME))
     src = os.path.abspath(os.path.join(_NATIVE_DIR, "sentinel_shim.cpp"))
-    try:
-        if os.path.getmtime(path) >= os.path.getmtime(src):
-            return path
-    except OSError:
-        if os.path.exists(path):  # prebuilt .so shipped without the source
-            return path
+    if not os.path.exists(src):
+        # No source (e.g. trimmed install): a prebuilt .so is all we have.
+        return path if os.path.exists(path) else None
+    # Source present: ALWAYS go through make, whose own mtime check rebuilds
+    # strictly-stale outputs. An equal-mtime prebuilt never shadows source.
     try:
         subprocess.run(["make", "-s", _LIB_NAME],
                        cwd=os.path.abspath(_NATIVE_DIR),

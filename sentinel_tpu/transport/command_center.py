@@ -131,10 +131,12 @@ class _HttpHandler(BaseHTTPRequestHandler):
 class CommandCenter:
     """The embedded command server (``SimpleHttpCommandCenter`` analog).
 
-    Binds ``csp.sentinel.api.host`` (default 0.0.0.0 for reference parity —
-    the reference command port is likewise unauthenticated; bind loopback on
-    shared hosts). Without an explicit ``engine`` the center follows the
-    process-default engine, surviving ``sentinel_tpu.reset()``.
+    Binds ``csp.sentinel.api.host``, defaulting to 127.0.0.1: the command
+    plane is unauthenticated (``setRules``/``setSwitch`` can disable all
+    protection), so exposing it beyond loopback is an explicit operator
+    decision via config, not a default. Without an explicit ``engine`` the
+    center follows the process-default engine, surviving
+    ``sentinel_tpu.reset()``.
     """
 
     def __init__(self, engine=None, port: Optional[int] = None,
@@ -143,7 +145,7 @@ class CommandCenter:
         from sentinel_tpu.transport import handlers as _h  # noqa: F401
 
         self._engine = engine
-        self.host = host or config.get("csp.sentinel.api.host") or "0.0.0.0"
+        self.host = host or config.get("csp.sentinel.api.host") or "127.0.0.1"
         self.port = port if port is not None else config.api_port()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None

@@ -183,6 +183,7 @@ def cmd_system_status(req: CommandRequest) -> CommandResponse:
         "qps": int(t[C.MetricEvent.PASS]),
         "avgRt": float(t[C.MetricEvent.RT]) / succ,
         "maxThread": int(threads[ENTRY_ROW]),
+        "failOpenCount": int(getattr(eng, "fail_open_count", 0)),
     })
 
 

@@ -91,3 +91,21 @@ def test_stop_pipeline_restores_sync_path(engine, frozen_time):
     engine.stop_pipeline()
     assert st.entry_ok("s") is not None
     assert st.entry_ok("s") is None  # quota shared across modes
+
+
+def test_fail_open_is_counted_and_logged(piped, frozen_time, caplog):
+    """A pipeline cycle error passes entries UNGUARDED — that outage must be
+    observable: fail_open_count increments and a warning is logged."""
+    import logging
+
+    st.load_flow_rules([st.FlowRule(resource="fo", count=0)])  # blocks all
+    orig = piped._run_entry_batch
+    piped._run_entry_batch = lambda batch: (_ for _ in ()).throw(RuntimeError("boom"))
+    try:
+        with caplog.at_level(logging.WARNING, logger="sentinel_tpu"):
+            with st.entry("fo"):  # passes unguarded despite the count=0 rule
+                pass
+    finally:
+        piped._run_entry_batch = orig
+    assert piped.fail_open_count == 1
+    assert any("UNGUARDED" in r.message for r in caplog.records)
