@@ -19,6 +19,44 @@ class ClusterStateManager:
         self.mode = CLUSTER_NOT_STARTED
         self.token_client = None
         self.token_server = None
+        self.last_modified = 0
+        # Ops-plane staged configs (reference: ClusterClientConfigManager /
+        # ClusterServerConfigManager — dynamic properties the dashboard
+        # writes BEFORE flipping the mode via setClusterMode).
+        self.client_config = {"serverHost": None, "serverPort": None,
+                              "requestTimeout": 200, "namespace": "default"}
+        self.server_config = {"port": 0, "maxAllowedQps": 30000.0}
+
+    def apply_mode(self, mode: int) -> None:
+        """Flip role from the staged configs (``setClusterMode`` handler).
+
+        Reference: ``ModifyClusterModeCommandHandler`` →
+        ``ClusterStateManager.applyState``.
+        """
+        import time as _time
+
+        with self._lock:
+            if mode == CLUSTER_CLIENT:
+                host = self.client_config.get("serverHost")
+                port = self.client_config.get("serverPort")
+                if not host or not port:
+                    raise ValueError(
+                        "client config not set: POST cluster/client/modifyConfig first")
+                self.set_to_client(str(host), int(port),
+                                   str(self.client_config.get("namespace")
+                                       or "default"))
+            elif mode == CLUSTER_SERVER:
+                from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+                service = DefaultTokenService(
+                    max_allowed_qps=float(self.server_config["maxAllowedQps"]))
+                self.set_to_server(port=int(self.server_config["port"]),
+                                   service=service)
+            elif mode == CLUSTER_NOT_STARTED:
+                self.stop()
+            else:
+                raise ValueError(f"invalid mode {mode}")
+            self.last_modified = int(_time.time() * 1000)
 
     def set_to_client(self, host: str, port: int,
                       namespace: str = "default") -> None:

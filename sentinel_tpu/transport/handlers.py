@@ -202,6 +202,111 @@ def cmd_set_switch(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success("success")
 
 
+@command_mapping("getClusterMode", "cluster role of this instance")
+def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
+    """Reference: ``FetchClusterModeCommandHandler``."""
+    cs = req.engine.cluster
+    return CommandResponse.of_success({
+        "mode": cs.mode,
+        "lastModified": cs.last_modified,
+        "clientAvailable": cs.client_if_active() is not None,
+        "serverRunning": cs.token_server is not None,
+    })
+
+
+@command_mapping("setClusterMode", "flip cluster role (0=client, 1=server)")
+def cmd_set_cluster_mode(req: CommandRequest) -> CommandResponse:
+    """Reference: ``ModifyClusterModeCommandHandler``."""
+    try:
+        mode = int(req.get_param("mode", ""))
+    except ValueError:
+        return CommandResponse.of_failure("invalid parameter: mode")
+    try:
+        req.engine.cluster.apply_mode(mode)
+    except (ValueError, OSError) as ex:
+        return CommandResponse.of_failure(f"failed to apply mode: {ex}")
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("cluster/client/fetchConfig", "token client config")
+def cmd_cluster_client_fetch(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_success(dict(req.engine.cluster.client_config))
+
+
+@command_mapping("cluster/client/modifyConfig", "stage token client config")
+def cmd_cluster_client_modify(req: CommandRequest) -> CommandResponse:
+    """Reference: ``ModifyClusterClientConfigHandler`` (data= JSON body)."""
+    data = req.get_param("data") or req.body
+    try:
+        cfg = json.loads(data or "{}")
+        if not isinstance(cfg, dict):
+            raise ValueError("expected an object")
+    except ValueError as ex:
+        return CommandResponse.of_failure(f"parse error: {ex}")
+    cs = req.engine.cluster
+    cs.client_config.update(
+        {k: cfg[k] for k in ("serverHost", "serverPort", "requestTimeout",
+                             "namespace") if k in cfg})
+    # A live client re-connects to the new target (reference listener
+    # behavior on ClusterClientConfigManager updates).
+    if cs.mode == 0:
+        cs.apply_mode(0)
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("cluster/server/fetchConfig", "token server config + namespaces")
+def cmd_cluster_server_fetch(req: CommandRequest) -> CommandResponse:
+    cs = req.engine.cluster
+    out = dict(cs.server_config)
+    srv = cs.token_server
+    if srv is not None:
+        out["boundPort"] = srv.bound_port
+        out["namespaces"] = srv.service.rules.namespaces()
+    return CommandResponse.of_success(out)
+
+
+@command_mapping("cluster/server/modifyTransportConfig", "stage token server config")
+def cmd_cluster_server_modify(req: CommandRequest) -> CommandResponse:
+    cs = req.engine.cluster
+    port = req.get_param("port")
+    qps = req.get_param("maxAllowedQps")
+    try:
+        if port is not None:
+            cs.server_config["port"] = int(port)
+        if qps is not None:
+            cs.server_config["maxAllowedQps"] = float(qps)
+    except ValueError:
+        return CommandResponse.of_failure("invalid parameter")
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("cluster/server/modifyFlowRules", "load cluster flow rules")
+def cmd_cluster_server_rules(req: CommandRequest) -> CommandResponse:
+    """Reference: ``ModifyClusterFlowRulesCommandHandler`` — wholesale per
+    namespace, into the RUNNING embedded server's rule manager."""
+    srv = req.engine.cluster.token_server
+    if srv is None:
+        return CommandResponse.of_failure("token server not running")
+    namespace = req.get_param("namespace", "default")
+    data = req.get_param("data") or req.body
+    try:
+        rules = CV.flow_rules_from_json(data or "[]")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(f"parse error: {ex}")
+    srv.service.rules.load_rules(namespace, rules)
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("cluster/server/metrics", "token server per-flowId metrics")
+def cmd_cluster_server_metrics(req: CommandRequest) -> CommandResponse:
+    srv = req.engine.cluster.token_server
+    if srv is None:
+        return CommandResponse.of_failure("token server not running")
+    snap = srv.service.metrics_snapshot()
+    return CommandResponse.of_success(
+        [{"flowId": fid, **vals} for fid, vals in sorted(snap.items())])
+
+
 @command_mapping("api", "list registered commands")
 def cmd_api(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success([

@@ -182,3 +182,93 @@ def test_heartbeat_rotates_on_failure():
     hb = HeartbeatSender(dashboards=["127.0.0.1:1", "127.0.0.1:2"], api_port=1)
     assert not hb.send_once()
     assert hb._idx == 1  # rotated to the second dashboard
+
+
+# --- cluster-mode ops commands (reference: setClusterMode/getClusterMode +
+# cluster config handlers, SURVEY.md §2.3) ----------------------------------
+
+
+def test_cluster_mode_flip_via_http(center, engine):
+    """Stage server config, flip to SERVER, load cluster rules, read
+    metrics; then flip a client engine at it and acquire a real token."""
+    status, body = _get(center, "getClusterMode")
+    assert json.loads(body)["mode"] == -1  # NOT_STARTED
+
+    # stage + flip to server (ephemeral port)
+    status, body = _post(center, "cluster/server/modifyTransportConfig?port=0", "")
+    assert body == "success"
+    status, body = _post(center, "setClusterMode?mode=1", "")
+    assert status == 200 and body == "success"
+    mode = json.loads(_get(center, "getClusterMode")[1])
+    assert mode["mode"] == 1 and mode["serverRunning"]
+
+    cfg = json.loads(_get(center, "cluster/server/fetchConfig")[1])
+    port = cfg["boundPort"]
+    assert port > 0
+
+    # push cluster rules into the running server via the ops plane
+    rules = [{"resource": "cr", "count": 2.0, "clusterMode": True,
+              "clusterConfig": {"flowId": 77, "thresholdType": 1}}]
+    status, body = _post(
+        center, "cluster/server/modifyFlowRules?namespace=default",
+        f"data={urllib.parse.quote(json.dumps(rules))}")
+    assert body == "success"
+
+    # flip THIS engine to client mode pointing at its own embedded server
+    # (reference: embedded mode does exactly this loop-back)
+    status, body = _post(
+        center, "cluster/client/modifyConfig",
+        json.dumps({"serverHost": "127.0.0.1", "serverPort": port}))
+    assert body == "success"
+    # modifyConfig staged it; the engine is in SERVER mode, so flipping to
+    # client tears down the server — instead talk to the server directly.
+    from sentinel_tpu.cluster.client import ClusterTokenClient
+    from sentinel_tpu.cluster.constants import TokenResultStatus
+
+    client = ClusterTokenClient("127.0.0.1", port, "default").start()
+    try:
+        r1 = client.request_token(77, 1)
+        r2 = client.request_token(77, 1)
+        r3 = client.request_token(77, 1)
+        assert r1.status == TokenResultStatus.OK
+        assert r2.status == TokenResultStatus.OK
+        assert r3.status == TokenResultStatus.BLOCKED
+    finally:
+        client.stop()
+
+    metrics = json.loads(_get(center, "cluster/server/metrics")[1])
+    row = {m["flowId"]: m for m in metrics}[77]
+    assert row["pass"] == 2.0 and row["blockRequest"] == 1.0
+
+    # flip back down
+    status, body = _post(center, "setClusterMode?mode=-1", "")
+    assert body == "success"
+    assert json.loads(_get(center, "getClusterMode")[1])["mode"] == -1
+
+
+def test_cluster_client_mode_via_http_against_external_server(center, engine):
+    """setClusterMode=0 connects the engine's token client to the staged
+    server address (fetchConfig shows it; getClusterMode clientAvailable)."""
+    from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+    ext = ClusterTokenServer(DefaultTokenService(ClusterFlowRuleManager()),
+                             host="127.0.0.1", port=0).start()
+    try:
+        _post(center, "cluster/client/modifyConfig",
+              json.dumps({"serverHost": "127.0.0.1",
+                          "serverPort": ext.bound_port}))
+        status, body = _post(center, "setClusterMode?mode=0", "")
+        assert body == "success"
+        cfg = json.loads(_get(center, "cluster/client/fetchConfig")[1])
+        assert cfg["serverPort"] == ext.bound_port
+        import time
+        for _ in range(50):  # PING handshake is async
+            if json.loads(_get(center, "getClusterMode")[1])["clientAvailable"]:
+                break
+            time.sleep(0.05)
+        assert json.loads(_get(center, "getClusterMode")[1])["clientAvailable"]
+        _post(center, "setClusterMode?mode=-1", "")
+    finally:
+        ext.stop()
