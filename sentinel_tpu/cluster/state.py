@@ -23,9 +23,22 @@ class ClusterStateManager:
         # Ops-plane staged configs (reference: ClusterClientConfigManager /
         # ClusterServerConfigManager — dynamic properties the dashboard
         # writes BEFORE flipping the mode via setClusterMode).
+        # requestTimeout is in MILLISECONDS (reference units).
         self.client_config = {"serverHost": None, "serverPort": None,
                               "requestTimeout": 200, "namespace": "default"}
         self.server_config = {"port": 0, "maxAllowedQps": 30000.0}
+        # Cluster rules survive server re-applies (config changes rebuild
+        # the service, not the rule set — reference rule managers are
+        # namespace-keyed properties independent of the transport).
+        self._server_rules = None
+
+    def server_rules(self):
+        from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+
+        with self._lock:
+            if self._server_rules is None:
+                self._server_rules = ClusterFlowRuleManager()
+            return self._server_rules
 
     def apply_mode(self, mode: int) -> None:
         """Flip role from the staged configs (``setClusterMode`` handler).
@@ -42,13 +55,17 @@ class ClusterStateManager:
                 if not host or not port:
                     raise ValueError(
                         "client config not set: POST cluster/client/modifyConfig first")
+                timeout_s = float(self.client_config.get("requestTimeout")
+                                  or 200) / 1000.0
                 self.set_to_client(str(host), int(port),
                                    str(self.client_config.get("namespace")
-                                       or "default"))
+                                       or "default"),
+                                   request_timeout_s=timeout_s)
             elif mode == CLUSTER_SERVER:
                 from sentinel_tpu.cluster.token_service import DefaultTokenService
 
                 service = DefaultTokenService(
+                    rules=self.server_rules(),
                     max_allowed_qps=float(self.server_config["maxAllowedQps"]))
                 self.set_to_server(port=int(self.server_config["port"]),
                                    service=service)
@@ -59,22 +76,36 @@ class ClusterStateManager:
             self.last_modified = int(_time.time() * 1000)
 
     def set_to_client(self, host: str, port: int,
-                      namespace: str = "default") -> None:
-        """Flip to CLIENT: connect to a remote token server."""
+                      namespace: str = "default",
+                      request_timeout_s: float = 2.0) -> None:
+        """Flip to CLIENT: connect to a remote token server.
+
+        The old role is torn down first (a staticly-configured port must be
+        free for re-binds); if starting the new role fails the manager drops
+        to NOT_STARTED rather than reporting a role that isn't running.
+        """
         from sentinel_tpu.cluster.client import ClusterTokenClient
 
         with self._lock:
             self._teardown()
-            self.token_client = ClusterTokenClient(host, port, namespace).start()
+            self.mode = CLUSTER_NOT_STARTED
+            self.token_client = ClusterTokenClient(
+                host, port, namespace,
+                request_timeout_s=request_timeout_s).start()
             self.mode = CLUSTER_CLIENT
 
     def set_to_server(self, host: str = "0.0.0.0", port: int = 0,
                       service=None) -> "object":
-        """Flip to SERVER: run the embedded token server; returns it."""
+        """Flip to SERVER: run the embedded token server; returns it.
+
+        Failure semantics mirror :meth:`set_to_client`: a failed bind leaves
+        the manager honestly NOT_STARTED, never claiming a dead role.
+        """
         from sentinel_tpu.cluster.server import ClusterTokenServer
 
         with self._lock:
             self._teardown()
+            self.mode = CLUSTER_NOT_STARTED
             self.token_server = ClusterTokenServer(
                 service=service, host=host, port=port).start()
             self.mode = CLUSTER_SERVER

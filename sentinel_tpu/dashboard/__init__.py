@@ -1,0 +1,26 @@
+"""The sentinel-tpu dashboard (reference: ``sentinel-dashboard``, SURVEY.md
+§2.6): machine discovery from heartbeats, a metrics poller + 5-minute
+in-memory repository, rule CRUD pushed through each engine's command port,
+cluster token-server assignment, and a single-page live UI.
+
+Run standalone::
+
+    python -m sentinel_tpu.dashboard --port 8080
+
+then point engines at it with ``csp.sentinel.dashboard.server=host:8080``.
+"""
+
+from sentinel_tpu.dashboard.client import ApiError, SentinelApiClient
+from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
+from sentinel_tpu.dashboard.metrics import InMemoryMetricsRepository, MetricFetcher
+from sentinel_tpu.dashboard.server import DashboardServer
+
+__all__ = [
+    "ApiError",
+    "AppManagement",
+    "DashboardServer",
+    "InMemoryMetricsRepository",
+    "MachineInfo",
+    "MetricFetcher",
+    "SentinelApiClient",
+]

@@ -1,0 +1,98 @@
+"""HTTP client to each engine's command port.
+
+Reference: ``dashboard:client/SentinelApiClient.java`` — the dashboard
+talks to every registered instance's command center (default :8719) to
+fetch/push rules, scrape metrics, and drive cluster mode. Thin, synchronous
+``urllib`` here (callers poll from worker threads).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+DEFAULT_TIMEOUT_S = 3.0
+
+
+class ApiError(RuntimeError):
+    pass
+
+
+class SentinelApiClient:
+    def __init__(self, timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.timeout_s = timeout_s
+
+    # -- raw command transport --------------------------------------------
+
+    def _url(self, ip: str, port: int, cmd: str, params: Optional[Dict] = None) -> str:
+        qs = f"?{urllib.parse.urlencode(params)}" if params else ""
+        return f"http://{ip}:{port}/{cmd}{qs}"
+
+    def get(self, ip: str, port: int, cmd: str,
+            params: Optional[Dict] = None) -> str:
+        try:
+            with urllib.request.urlopen(
+                    self._url(ip, port, cmd, params), timeout=self.timeout_s) as r:
+                return r.read().decode("utf-8")
+        except (urllib.error.URLError, OSError) as ex:
+            raise ApiError(f"GET {cmd} on {ip}:{port} failed: {ex}") from ex
+
+    def post(self, ip: str, port: int, cmd: str,
+             params: Optional[Dict] = None, body: str = "") -> str:
+        req = urllib.request.Request(
+            self._url(ip, port, cmd, params), data=body.encode("utf-8"),
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.read().decode("utf-8")
+        except urllib.error.HTTPError as ex:
+            raise ApiError(
+                f"POST {cmd} on {ip}:{port}: {ex.read().decode(errors='replace')}"
+            ) from ex
+        except (urllib.error.URLError, OSError) as ex:
+            raise ApiError(f"POST {cmd} on {ip}:{port} failed: {ex}") from ex
+
+    # -- typed surface (mirrors SentinelApiClient methods) ----------------
+
+    def fetch_rules(self, ip: str, port: int, rule_type: str) -> List[Dict]:
+        return json.loads(self.get(ip, port, "getRules", {"type": rule_type}))
+
+    def set_rules(self, ip: str, port: int, rule_type: str,
+                  rules: List[Dict]) -> None:
+        out = self.post(ip, port, "setRules", {"type": rule_type},
+                        body=f"data={urllib.parse.quote(json.dumps(rules))}")
+        if out != "success":
+            raise ApiError(f"setRules rejected: {out}")
+
+    def fetch_metric(self, ip: str, port: int, start_ms: int, end_ms: int,
+                     max_lines: int = 6000) -> str:
+        return self.get(ip, port, "metric", {
+            "startTime": start_ms, "endTime": end_ms, "maxLines": max_lines})
+
+    def fetch_cluster_node(self, ip: str, port: int) -> List[Dict]:
+        return json.loads(self.get(ip, port, "clusterNode"))
+
+    def fetch_cluster_mode(self, ip: str, port: int) -> Dict:
+        return json.loads(self.get(ip, port, "getClusterMode"))
+
+    def set_cluster_mode(self, ip: str, port: int, mode: int) -> None:
+        out = self.post(ip, port, "setClusterMode", {"mode": mode})
+        if out != "success":
+            raise ApiError(f"setClusterMode rejected: {out}")
+
+    def modify_cluster_client_config(self, ip: str, port: int,
+                                     server_host: str, server_port: int) -> None:
+        self.post(ip, port, "cluster/client/modifyConfig",
+                  body=json.dumps({"serverHost": server_host,
+                                   "serverPort": server_port}))
+
+    def modify_cluster_server_config(self, ip: str, port: int,
+                                     token_port: int) -> None:
+        self.post(ip, port, "cluster/server/modifyTransportConfig",
+                  {"port": token_port})
+
+    def fetch_cluster_server_config(self, ip: str, port: int) -> Dict:
+        return json.loads(self.get(ip, port, "cluster/server/fetchConfig"))

@@ -1,0 +1,267 @@
+"""The dashboard web server: discovery + metrics + rule CRUD + cluster ops.
+
+Reference: ``sentinel-dashboard`` (SURVEY.md §2.6) — Spring Boot +
+AngularJS there; here a stdlib HTTP server exposing the same capability
+set as a small JSON API plus one static page:
+
+  * ``POST /registry/machine``                heartbeat receiver
+    (``MachineRegistryController``)
+  * ``GET  /app/names.json``                  app list (``AppController``)
+  * ``GET  /app/machines.json?app=``          machine list + health
+  * ``GET  /v1/rules?app=&type=``             rule CRUD, V1 style: read from
+  * ``POST /v1/rules?app=&type=``             the machines, push to ALL
+    (``FlowControllerV1`` et al. via ``SentinelApiClient``)
+  * ``GET  /metric/queryTopResourceMetric.json?app=``    live QPS series
+  * ``GET  /metric/queryByAppAndResource.json?app=&identity=``
+    (``MetricController`` over ``InMemoryMetricsRepository``)
+  * ``GET  /resource/machineResource.json?ip=&port=``    clusterNode proxy
+  * ``POST /cluster/assign?app=&ip=&port=``   token-server assignment
+    (``ClusterConfigController.assign``: chosen machine -> SERVER, every
+    other healthy machine -> CLIENT of it)
+  * ``GET  /``                                the UI (static/index.html)
+
+Rules are owned by the engines (and their writable datasources); the
+dashboard holds no rule store — matching the reference's V1 controllers,
+whose in-memory repository is a display cache, not a source of truth.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Dict, Optional
+
+from sentinel_tpu.dashboard.client import ApiError, SentinelApiClient
+from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
+from sentinel_tpu.dashboard.metrics import InMemoryMetricsRepository, MetricFetcher
+
+RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow")
+_STATIC_DIR = Path(__file__).parent / "static"
+
+
+class DashboardServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8080,
+                 fetch_interval_s: float = 1.0):
+        self.host = host
+        self.port = port
+        self.apps = AppManagement()
+        self.api = SentinelApiClient()
+        self.repository = InMemoryMetricsRepository()
+        self.fetcher = MetricFetcher(self.apps, self.repository,
+                                     interval_s=fetch_interval_s)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self._server.server_address[1] if self._server else self.port
+
+    def start(self, fetch: bool = True) -> "DashboardServer":
+        """``fetch=False`` skips the metric poll thread (tests drive
+        ``fetcher.fetch_once`` deterministically)."""
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._server.dashboard = self
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sentinel-dashboard",
+            daemon=True)
+        self._thread.start()
+        if fetch:
+            self.fetcher.start()
+        return self
+
+    def stop(self) -> None:
+        self.fetcher.stop()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- operations (handlers delegate here; also usable programmatically) --
+
+    def register_machine(self, params: Dict[str, str]) -> None:
+        self.apps.register(MachineInfo(
+            app=params.get("app", "unknown"),
+            ip=params.get("ip", "127.0.0.1"),
+            port=int(params.get("port", "8719")),
+            hostname=params.get("hostname", ""),
+            app_type=int(params.get("app_type", "0") or 0),
+            version=params.get("v", ""),
+            pid=int(params.get("pid", "0") or 0),
+        ))
+
+    def _first_healthy(self, app: str) -> MachineInfo:
+        ms = self.apps.healthy_machines(app)
+        if not ms:
+            raise ApiError(f"no healthy machine for app {app!r}")
+        return ms[0]
+
+    def get_rules(self, app: str, rule_type: str):
+        m = self._first_healthy(app)
+        return self.api.fetch_rules(m.ip, m.port, rule_type)
+
+    def set_rules(self, app: str, rule_type: str, rules) -> Dict[str, bool]:
+        """Push wholesale to every healthy machine (V1 publish semantics)."""
+        out = {}
+        for m in self.apps.healthy_machines(app):
+            try:
+                self.api.set_rules(m.ip, m.port, rule_type, rules)
+                out[m.key] = True
+            except ApiError:
+                out[m.key] = False
+        if not out:
+            raise ApiError(f"no healthy machine for app {app!r}")
+        return out
+
+    def assign_token_server(self, app: str, ip: str, port: int,
+                            token_port: int = 0) -> Dict:
+        """Reference ``ClusterConfigController`` assign flow: flip the chosen
+        machine to SERVER, then point every other healthy machine at it."""
+        self.api.modify_cluster_server_config(ip, port, token_port)
+        self.api.set_cluster_mode(ip, port, 1)
+        bound = self.api.fetch_cluster_server_config(ip, port).get("boundPort")
+        if bound is None:
+            raise ApiError(
+                f"{ip}:{port} flipped to server but reports no bound token port")
+        clients = {}
+        for m in self.apps.healthy_machines(app):
+            if m.ip == ip and m.port == port:
+                continue
+            try:
+                self.api.modify_cluster_client_config(m.ip, m.port, ip, int(bound))
+                self.api.set_cluster_mode(m.ip, m.port, 0)
+                clients[m.key] = True
+            except ApiError:
+                clients[m.key] = False
+        return {"server": f"{ip}:{port}", "tokenPort": bound, "clients": clients}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "sentinel-tpu-dashboard"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _json(self, obj, code: int = 200):
+        data = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _ok(self, result):
+        # reference dashboard Result<T> envelope: {success, code, msg, data}
+        self._json({"success": True, "code": 0, "msg": None, "data": result})
+
+    def _fail(self, msg: str, code: int = 400):
+        self._json({"success": False, "code": code, "msg": msg, "data": None},
+                   code=code)
+
+    def _static(self, name: str):
+        path = _STATIC_DIR / name
+        if not path.is_file():
+            self._fail("not found", 404)
+            return
+        data = path.read_bytes()
+        ctype = "text/html; charset=utf-8" if name.endswith(".html") else \
+            "application/javascript" if name.endswith(".js") else "text/css"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # -- routing -----------------------------------------------------------
+
+    def do_GET(self):
+        self._route("")
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length).decode("utf-8") if length else ""
+        self._route(body)
+
+    def _route(self, body: str):
+        d: DashboardServer = self.server.dashboard
+        parsed = urllib.parse.urlparse(self.path)
+        path = parsed.path
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        try:
+            if path in ("/", "/index.html"):
+                return self._static("index.html")
+            if path == "/registry/machine":
+                form = {k: v[0] for k, v in urllib.parse.parse_qs(body).items()}
+                form.update(q)
+                d.register_machine(form)
+                return self._ok("registered")
+            if path == "/app/names.json":
+                return self._ok(d.apps.app_names())
+            if path == "/app/machines.json":
+                return self._ok([m.to_dict()
+                                 for m in d.apps.machines(q.get("app", ""))])
+            if path == "/v1/rules":
+                app, rtype = q.get("app", ""), q.get("type", "flow")
+                if rtype not in RULE_TYPES:
+                    return self._fail(f"invalid type {rtype!r}")
+                if self.command == "GET":
+                    return self._ok(d.get_rules(app, rtype))
+                rules = json.loads(body or "[]")
+                if not isinstance(rules, list):
+                    return self._fail("expected a JSON list")
+                return self._ok(d.set_rules(app, rtype, rules))
+            if path == "/metric/queryTopResourceMetric.json":
+                return self._metric_top(d, q)
+            if path == "/metric/queryByAppAndResource.json":
+                app = q.get("app", "")
+                res = q.get("identity", "")
+                start, end = self._range(q)
+                return self._ok(d.repository.query(app, res, start, end))
+            if path == "/resource/machineResource.json":
+                return self._ok(d.api.fetch_cluster_node(
+                    q.get("ip", ""), int(q.get("port", "8719"))))
+            if path == "/cluster/assign":
+                return self._ok(d.assign_token_server(
+                    q.get("app", ""), q.get("ip", ""),
+                    int(q.get("port", "8719")),
+                    int(q.get("tokenPort", "0"))))
+            if path == "/cluster/state.json":
+                out = []
+                for m in d.apps.healthy_machines(q.get("app", "")):
+                    try:
+                        out.append({**m.to_dict(),
+                                    **d.api.fetch_cluster_mode(m.ip, m.port)})
+                    except ApiError:
+                        pass
+                return self._ok(out)
+            return self._fail(f"unknown path {path}", 404)
+        except ApiError as ex:
+            return self._fail(str(ex), 502)
+        except (ValueError, KeyError) as ex:
+            return self._fail(f"bad request: {ex}")
+        except BrokenPipeError:
+            pass
+
+    def _range(self, q):
+        now = int(time.time() * 1000)
+        start = int(q.get("startTime", now - 5 * 60_000))
+        end = int(q.get("endTime", now))
+        return start, end
+
+    def _metric_top(self, d: DashboardServer, q):
+        app = q.get("app", "")
+        start, end = self._range(q)
+        top = d.repository.top_resources(app, start, end,
+                                         int(q.get("pageSize", "30")))
+        return self._ok({
+            "resource": {r: d.repository.query(app, r, start, end)
+                         for r in top},
+        })

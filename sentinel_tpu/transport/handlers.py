@@ -236,21 +236,32 @@ def cmd_cluster_client_fetch(req: CommandRequest) -> CommandResponse:
 @command_mapping("cluster/client/modifyConfig", "stage token client config")
 def cmd_cluster_client_modify(req: CommandRequest) -> CommandResponse:
     """Reference: ``ModifyClusterClientConfigHandler`` (data= JSON body)."""
+    from sentinel_tpu.cluster.state import CLUSTER_CLIENT
+
     data = req.get_param("data") or req.body
     try:
         cfg = json.loads(data or "{}")
         if not isinstance(cfg, dict):
             raise ValueError("expected an object")
-    except ValueError as ex:
+        staged = {k: cfg[k] for k in ("serverHost", "serverPort",
+                                      "requestTimeout", "namespace") if k in cfg}
+        # Validate before mutating so a bad payload can't poison the
+        # staged config for later setClusterMode calls.
+        if "serverPort" in staged:
+            staged["serverPort"] = int(staged["serverPort"])
+        if "requestTimeout" in staged:
+            staged["requestTimeout"] = float(staged["requestTimeout"])
+    except (ValueError, TypeError) as ex:
         return CommandResponse.of_failure(f"parse error: {ex}")
     cs = req.engine.cluster
-    cs.client_config.update(
-        {k: cfg[k] for k in ("serverHost", "serverPort", "requestTimeout",
-                             "namespace") if k in cfg})
+    cs.client_config.update(staged)
     # A live client re-connects to the new target (reference listener
     # behavior on ClusterClientConfigManager updates).
-    if cs.mode == 0:
-        cs.apply_mode(0)
+    if cs.mode == CLUSTER_CLIENT:
+        try:
+            cs.apply_mode(CLUSTER_CLIENT)
+        except (ValueError, OSError) as ex:
+            return CommandResponse.of_failure(f"failed to re-apply: {ex}")
     return CommandResponse.of_success("success")
 
 
@@ -283,17 +294,19 @@ def cmd_cluster_server_modify(req: CommandRequest) -> CommandResponse:
 @command_mapping("cluster/server/modifyFlowRules", "load cluster flow rules")
 def cmd_cluster_server_rules(req: CommandRequest) -> CommandResponse:
     """Reference: ``ModifyClusterFlowRulesCommandHandler`` — wholesale per
-    namespace, into the RUNNING embedded server's rule manager."""
-    srv = req.engine.cluster.token_server
-    if srv is None:
-        return CommandResponse.of_failure("token server not running")
+    namespace. Targets the running server's manager, or the persistent
+    staged manager (shared with future ``setClusterMode=1`` flips) so rules
+    can be pre-loaded and survive server re-applies."""
+    cs = req.engine.cluster
+    srv = cs.token_server
+    manager = srv.service.rules if srv is not None else cs.server_rules()
     namespace = req.get_param("namespace", "default")
     data = req.get_param("data") or req.body
     try:
         rules = CV.flow_rules_from_json(data or "[]")
     except (ValueError, KeyError, TypeError) as ex:
         return CommandResponse.of_failure(f"parse error: {ex}")
-    srv.service.rules.load_rules(namespace, rules)
+    manager.load_rules(namespace, rules)
     return CommandResponse.of_success("success")
 
 

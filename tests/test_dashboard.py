@@ -1,0 +1,192 @@
+"""Dashboard tests (reference: ``sentinel-dashboard``, SURVEY.md §2.6).
+
+End-to-end over real HTTP: engines register via heartbeat, the dashboard
+lists them, proxies rule CRUD to every machine, scrapes /metric into the
+in-memory repository, serves the UI page, and assigns a cluster token
+server.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.dashboard import (
+    DashboardServer,
+    InMemoryMetricsRepository,
+    MetricFetcher,
+)
+from sentinel_tpu.metrics.metric_node import MetricNode
+from sentinel_tpu.metrics.timer import MetricTimerListener
+from sentinel_tpu.metrics.writer import MetricWriter
+from sentinel_tpu.transport.command_center import CommandCenter
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+
+@pytest.fixture(autouse=True)
+def _loopback_heartbeat(monkeypatch):
+    # Command centers bind loopback by default; register the matching
+    # address (deployments exposing the ops plane set both keys together).
+    monkeypatch.setenv("CSP_SENTINEL_HEARTBEAT_CLIENT_IP", "127.0.0.1")
+
+
+@pytest.fixture()
+def dash():
+    d = DashboardServer(port=0).start(fetch=False)
+    yield d
+    d.stop()
+
+
+def _get(dash, path):
+    url = f"http://127.0.0.1:{dash.bound_port}{path}"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        body = json.loads(r.read().decode())
+    assert body["success"], body
+    return body["data"]
+
+
+def _post(dash, path, body=""):
+    url = f"http://127.0.0.1:{dash.bound_port}{path}"
+    req = urllib.request.Request(url, data=body.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        out = json.loads(r.read().decode())
+    assert out["success"], out
+    return out["data"]
+
+
+def test_discovery_from_heartbeats(dash, engine):
+    """Two command centers register through the real HeartbeatSender and
+    both show as healthy machines of the app."""
+    c1 = CommandCenter(engine, port=0).start()
+    c2 = CommandCenter(engine, port=0).start()
+    try:
+        target = [f"127.0.0.1:{dash.bound_port}"]
+        assert HeartbeatSender(dashboards=target,
+                               api_port=c1.bound_port).send_once()
+        assert HeartbeatSender(dashboards=target,
+                               api_port=c2.bound_port).send_once()
+        apps = _get(dash, "/app/names.json")
+        assert len(apps) == 1
+        machines = _get(dash, f"/app/machines.json?app={apps[0]}")
+        assert {m["port"] for m in machines} == {c1.bound_port, c2.bound_port}
+        assert all(m["healthy"] for m in machines)
+    finally:
+        c1.stop()
+        c2.stop()
+
+
+def test_rule_crud_pushes_to_all_machines(dash, engine):
+    """Edit a rule through the dashboard: GET shows the machines' rules,
+    POST pushes wholesale to every healthy machine and the engine enforces
+    it immediately."""
+    c1 = CommandCenter(engine, port=0).start()
+    c2 = CommandCenter(engine, port=0).start()
+    try:
+        target = [f"127.0.0.1:{dash.bound_port}"]
+        HeartbeatSender(dashboards=target, api_port=c1.bound_port).send_once()
+        HeartbeatSender(dashboards=target, api_port=c2.bound_port).send_once()
+        app = _get(dash, "/app/names.json")[0]
+
+        assert _get(dash, f"/v1/rules?app={app}&type=flow") == []
+        pushed = _post(dash, f"/v1/rules?app={app}&type=flow",
+                       json.dumps([{"resource": "dashRes", "count": 2.0}]))
+        assert set(pushed.values()) == {True} and len(pushed) == 2
+
+        shown = _get(dash, f"/v1/rules?app={app}&type=flow")
+        assert shown[0]["resource"] == "dashRes" and shown[0]["count"] == 2.0
+        passed = sum(1 for _ in range(5) if st.entry_ok("dashRes"))
+        assert passed == 2
+    finally:
+        c1.stop()
+        c2.stop()
+
+
+def test_metric_fetch_into_repository(dash, engine, frozen_time, tmp_path,
+                                      monkeypatch):
+    """Live QPS path: engine traffic -> metric log -> /metric command ->
+    MetricFetcher -> repository -> dashboard query endpoints."""
+    monkeypatch.setenv("CSP_SENTINEL_LOG_DIR", str(tmp_path))
+    monkeypatch.setenv("PROJECT_NAME", "dashApp")
+    st.load_flow_rules([st.FlowRule(resource="hot", count=3)])
+    for _ in range(5):
+        h = st.entry_ok("hot")
+        if h:
+            h.exit()
+    frozen_time.advance_time(2_000)  # seal the second
+    writer = MetricWriter(app="dashApp", base_dir=str(tmp_path))
+    MetricTimerListener(engine, writer).tick(frozen_time.current_time_millis())
+    writer.close()
+
+    center = CommandCenter(engine, port=0).start()
+    try:
+        HeartbeatSender(dashboards=[f"127.0.0.1:{dash.bound_port}"],
+                        api_port=center.bound_port).send_once()
+        app = _get(dash, "/app/names.json")[0]
+        now = frozen_time.current_time_millis()
+        ingested = dash.fetcher.fetch_once(now_ms=now)
+        assert ingested >= 1
+
+        top = _get(dash, f"/metric/queryTopResourceMetric.json?app={app}"
+                         f"&startTime={now - 60_000}&endTime={now}")
+        assert "hot" in top["resource"]
+        pts = top["resource"]["hot"]
+        assert pts[0]["passQps"] == 3 and pts[0]["blockQps"] == 2
+
+        series = _get(dash, f"/metric/queryByAppAndResource.json?app={app}"
+                            f"&identity=hot&startTime={now - 60_000}"
+                            f"&endTime={now}")
+        assert series and series[0]["passQps"] == 3
+    finally:
+        center.stop()
+
+
+def test_repository_aggregates_and_evicts():
+    repo = InMemoryMetricsRepository(retention_ms=10_000)
+    for machine in range(2):  # same second from two machines aggregates
+        repo.save("a", MetricNode(timestamp=1000, resource="r",
+                                  pass_qps=5, block_qps=1, rt=10.0))
+    assert repo.query("a", "r", 0, 5000)[0]["passQps"] == 10
+    assert repo.query("a", "r", 0, 5000)[0]["rt"] == 10.0  # averaged, not summed
+    repo._evict(now_ms=20_000)  # 1000 < 20000 - 10000 -> gone
+    assert repo.query("a", "r", 0, 5000) == []
+
+
+def test_top_resources_ranked_by_volume():
+    repo = InMemoryMetricsRepository()
+    repo.save("a", MetricNode(timestamp=1000, resource="low", pass_qps=1))
+    repo.save("a", MetricNode(timestamp=1000, resource="high", pass_qps=50))
+    assert repo.top_resources("a", 0, 5000) == ["high", "low"]
+
+
+def test_ui_page_served(dash):
+    url = f"http://127.0.0.1:{dash.bound_port}/"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        page = r.read().decode()
+    assert "sentinel-tpu" in page and "queryTopResourceMetric" in page
+
+
+def test_cluster_assign_flow(dash, engine):
+    """Assign: chosen machine flips to SERVER; the other healthy machine
+    becomes a CLIENT pointed at the bound token port."""
+    c1 = CommandCenter(engine, port=0).start()
+    c2 = CommandCenter(engine, port=0).start()
+    try:
+        target = [f"127.0.0.1:{dash.bound_port}"]
+        HeartbeatSender(dashboards=target, api_port=c1.bound_port).send_once()
+        HeartbeatSender(dashboards=target, api_port=c2.bound_port).send_once()
+        app = _get(dash, "/app/names.json")[0]
+        out = _post(dash, f"/cluster/assign?app={app}&ip=127.0.0.1"
+                          f"&port={c1.bound_port}&tokenPort=0")
+        assert out["server"] == f"127.0.0.1:{c1.bound_port}"
+        assert out["tokenPort"] > 0
+        # both centers share one engine in-process, so the final role is
+        # CLIENT (the assign flipped server first, then client re-targeted
+        # the same engine) — the state endpoint must reflect a live role.
+        states = _get(dash, f"/cluster/state.json?app={app}")
+        assert states and all(s["mode"] in (0, 1) for s in states)
+        engine.cluster.stop()
+    finally:
+        c1.stop()
+        c2.stop()

@@ -272,3 +272,40 @@ def test_cluster_client_mode_via_http_against_external_server(center, engine):
         _post(center, "setClusterMode?mode=-1", "")
     finally:
         ext.stop()
+
+
+def test_cluster_rules_survive_server_reapply(center, engine):
+    """Rules staged before the flip are served after it, and a config
+    re-apply (setClusterMode=1 again) must NOT discard loaded rules."""
+    rules = [{"resource": "keep", "count": 9.0, "clusterMode": True,
+              "clusterConfig": {"flowId": 5150, "thresholdType": 1}}]
+    # stage rules BEFORE any server exists
+    status, body = _post(
+        center, "cluster/server/modifyFlowRules?namespace=default",
+        f"data={urllib.parse.quote(json.dumps(rules))}")
+    assert body == "success"
+    _post(center, "cluster/server/modifyTransportConfig?port=0", "")
+    _post(center, "setClusterMode?mode=1", "")
+    cfg = json.loads(_get(center, "cluster/server/fetchConfig")[1])
+    assert cfg["namespaces"] == ["default"]
+    # re-apply (e.g. after a maxAllowedQps change): rules must survive
+    _post(center, "cluster/server/modifyTransportConfig?maxAllowedQps=123", "")
+    _post(center, "setClusterMode?mode=1", "")
+    cfg = json.loads(_get(center, "cluster/server/fetchConfig")[1])
+    assert cfg["namespaces"] == ["default"]
+    metrics = json.loads(_get(center, "cluster/server/metrics")[1])
+    assert {m["flowId"] for m in metrics} == {5150}
+    _post(center, "setClusterMode?mode=-1", "")
+
+
+def test_cluster_client_modify_rejects_bad_port(center, engine):
+    """A malformed serverPort must fail cleanly WITHOUT poisoning the
+    staged config."""
+    _post(center, "cluster/client/modifyConfig",
+          json.dumps({"serverHost": "127.0.0.1", "serverPort": 12345}))
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(center, "cluster/client/modifyConfig",
+              json.dumps({"serverPort": "abc"}))
+    assert e.value.code == 400
+    cfg = json.loads(_get(center, "cluster/client/fetchConfig")[1])
+    assert cfg["serverPort"] == 12345  # earlier staged value intact
