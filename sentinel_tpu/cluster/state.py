@@ -55,8 +55,8 @@ class ClusterStateManager:
                 if not host or not port:
                     raise ValueError(
                         "client config not set: POST cluster/client/modifyConfig first")
-                timeout_s = float(self.client_config.get("requestTimeout")
-                                  or 200) / 1000.0
+                tv = self.client_config.get("requestTimeout")
+                timeout_s = (200.0 if tv is None else float(tv)) / 1000.0
                 self.set_to_client(str(host), int(port),
                                    str(self.client_config.get("namespace")
                                        or "default"),

@@ -128,8 +128,10 @@ class MetricFetcher:
         now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
         end = now_ms - FETCH_LAG_MS
         ingested = 0
+        live_keys = set()
         for app in self.apps.app_names():
             for m in self.apps.healthy_machines(app):
+                live_keys.add(m.key)
                 start = self._last_fetched.get(m.key, end - FETCH_SPAN_MS) + 1
                 start = max(start, end - FETCH_SPAN_MS)
                 if start > end:
@@ -152,6 +154,10 @@ class MetricFetcher:
                     ingested += 1
                 if newest:
                     self._last_fetched[m.key] = newest
+        # Machines that churned away (restarts on ephemeral ports) would
+        # otherwise accumulate resume keys forever.
+        for key in [k for k in self._last_fetched if k not in live_keys]:
+            del self._last_fetched[key]
         self.repository._evict(now_ms)
         return ingested
 

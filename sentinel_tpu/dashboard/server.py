@@ -198,6 +198,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path in ("/", "/index.html"):
                 return self._static("index.html")
+            # Mutating routes are POST-only: a crawler or <img> prefetch must
+            # not reassign a cluster or register phantom machines via GET.
+            if path in ("/registry/machine", "/cluster/assign") \
+                    and self.command != "POST":
+                return self._fail("POST required", 405)
             if path == "/registry/machine":
                 form = {k: v[0] for k, v in urllib.parse.parse_qs(body).items()}
                 form.update(q)

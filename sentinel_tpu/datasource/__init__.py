@@ -17,6 +17,13 @@ from sentinel_tpu.datasource.base import (
     WritableDataSource,
     bind,
 )
+from sentinel_tpu.datasource.push import (
+    BrokerDataSource,
+    BrokerWritableDataSource,
+    InProcessBroker,
+    PollingKVDataSource,
+    PushDataSource,
+)
 from sentinel_tpu.datasource.converters import (
     authority_rules_from_json,
     authority_rules_to_json,
@@ -32,6 +39,8 @@ from sentinel_tpu.datasource.converters import (
 
 __all__ = [
     "AbstractDataSource", "AutoRefreshDataSource", "Converter",
+    "BrokerDataSource", "BrokerWritableDataSource", "InProcessBroker",
+    "PollingKVDataSource", "PushDataSource",
     "FileRefreshableDataSource", "FileWritableDataSource",
     "ReadableDataSource", "WritableDataSource", "bind",
     "authority_rules_from_json", "authority_rules_to_json",

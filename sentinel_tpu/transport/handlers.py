@@ -298,15 +298,21 @@ def cmd_cluster_server_rules(req: CommandRequest) -> CommandResponse:
     staged manager (shared with future ``setClusterMode=1`` flips) so rules
     can be pre-loaded and survive server re-applies."""
     cs = req.engine.cluster
-    srv = cs.token_server
-    manager = srv.service.rules if srv is not None else cs.server_rules()
     namespace = req.get_param("namespace", "default")
     data = req.get_param("data") or req.body
     try:
         rules = CV.flow_rules_from_json(data or "[]")
     except (ValueError, KeyError, TypeError) as ex:
         return CommandResponse.of_failure(f"parse error: {ex}")
-    manager.load_rules(namespace, rules)
+    # Always land in the persistent staged manager (future apply_mode flips
+    # serve from it); a running server with its OWN manager — started via
+    # set_to_server(service=...) rather than apply_mode — gets the same
+    # load so the live and staged rule sets can't split-brain.
+    staged = cs.server_rules()
+    staged.load_rules(namespace, rules)
+    srv = cs.token_server
+    if srv is not None and srv.service.rules is not staged:
+        srv.service.rules.load_rules(namespace, rules)
     return CommandResponse.of_success("success")
 
 

@@ -1,0 +1,126 @@
+"""Datasource tests (reference: ``sentinel-datasource-extension`` + the
+per-config-system modules, SURVEY.md §2.2/§3.2): both datasource shapes
+(push, versioned poll) swap the rule managers' property without touching
+files, and the writable half round-trips ``setRules`` persistence.
+"""
+
+import json
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import (
+    BrokerDataSource,
+    BrokerWritableDataSource,
+    InProcessBroker,
+    PollingKVDataSource,
+    PushDataSource,
+    bind,
+    flow_rules_from_json,
+    flow_rules_to_json,
+)
+
+
+def test_push_source_drives_engine_rules(engine):
+    """Rule push propagates engine-side with no file involved."""
+    broker = InProcessBroker()
+    src = BrokerDataSource(broker, "rules/flow", flow_rules_from_json)
+    bind(src, st.load_flow_rules)
+    try:
+        assert engine.flow_rules.get_rules() == []
+        broker.set("rules/flow",
+                   json.dumps([{"resource": "pushed", "count": 1.0}]))
+        rules = engine.flow_rules.get_rules()
+        assert len(rules) == 1 and rules[0].resource == "pushed"
+        # enforced immediately
+        assert st.entry_ok("pushed") and not st.entry_ok("pushed")
+    finally:
+        src.close()
+
+
+def test_push_source_initial_load(engine):
+    """A key already present at subscribe time loads like Redis's initial
+    GET."""
+    broker = InProcessBroker()
+    broker.set("k", json.dumps([{"resource": "pre", "count": 5.0}]))
+    src = BrokerDataSource(broker, "k", flow_rules_from_json)
+    bind(src, st.load_flow_rules)
+    # bind() fires the listener with the property's current value
+    assert [r.resource for r in engine.flow_rules.get_rules()] == ["pre"]
+    src.close()
+
+
+def test_push_bad_payload_keeps_last_good(engine):
+    broker = InProcessBroker()
+    src = BrokerDataSource(broker, "k", flow_rules_from_json)
+    bind(src, st.load_flow_rules)
+    try:
+        broker.set("k", json.dumps([{"resource": "good", "count": 2.0}]))
+        broker.set("k", "{not json!")
+        assert [r.resource for r in engine.flow_rules.get_rules()] == ["good"]
+    finally:
+        src.close()
+
+
+def test_polling_kv_source_version_gated(engine):
+    broker = InProcessBroker()
+    src = PollingKVDataSource(broker, "cfg", flow_rules_from_json,
+                              recommend_refresh_ms=100000)
+    bind(src, st.load_flow_rules)
+    try:
+        src.first_load()
+        assert engine.flow_rules.get_rules() == []
+        src.refresh()  # no version change -> no-op
+        broker.set("cfg", json.dumps([{"resource": "polled", "count": 3.0}]))
+        src.refresh()
+        assert [r.resource for r in engine.flow_rules.get_rules()] == ["polled"]
+        # unchanged version: refresh is a cheap no-op (is_modified False)
+        assert not src.is_modified()
+    finally:
+        src.close()
+
+
+def test_writable_round_trip_via_set_rules():
+    """setRules -> BrokerWritableDataSource -> broker -> push source ->
+    a SECOND engine's manager: the reference's datasource persistence loop."""
+    import urllib.parse
+    import urllib.request
+
+    from sentinel_tpu.transport.command_center import CommandCenter
+    from sentinel_tpu.transport.handlers import register_writable_datasource
+
+    eng = st.reset(capacity=512)
+    broker = InProcessBroker()
+    register_writable_datasource(
+        "flow", BrokerWritableDataSource(broker, "rules/flow",
+                                         flow_rules_to_json))
+    observed = []
+    reader = PushDataSource(flow_rules_from_json)
+    broker.subscribe("rules/flow", reader.on_update)
+    reader.property.add_listener(
+        type("L", (), {"config_update": lambda self, v: observed.append(v),
+                       "config_load": lambda self, v: observed.append(v)})())
+
+    center = CommandCenter(eng, port=0).start()
+    try:
+        rules = [{"resource": "rt", "count": 4.0}]
+        body = f"data={urllib.parse.quote(json.dumps(rules))}"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{center.bound_port}/setRules?type=flow",
+            data=body.encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.read().decode() == "success"
+        assert observed and observed[-1][0].resource == "rt"
+        assert broker.version("rules/flow") == 1
+    finally:
+        center.stop()
+        from sentinel_tpu.transport import handlers as H
+
+        H._writable_datasources.pop("flow", None)  # don't leak across tests
+        st.reset(capacity=512)
+
+
+def test_push_source_has_no_pull_path():
+    src = PushDataSource(flow_rules_from_json)
+    with pytest.raises(NotImplementedError):
+        src.read_source()
