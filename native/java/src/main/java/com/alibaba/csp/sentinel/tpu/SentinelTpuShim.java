@@ -1,0 +1,63 @@
+package com.alibaba.csp.sentinel.tpu;
+
+import com.sun.jna.Library;
+import com.sun.jna.Native;
+import com.sun.jna.Pointer;
+import com.sun.jna.Structure;
+import com.sun.jna.ptr.IntByReference;
+
+import java.util.Arrays;
+import java.util.List;
+
+/**
+ * JNA binding to {@code libsentinel_shim.so} (C ABI declared in
+ * {@code native/sentinel_shim.h}) — the bridge by which a JVM running the
+ * reference slot chain acquires cluster tokens from the sentinel-tpu
+ * backend (SURVEY.md §7 M4).
+ *
+ * <p>The shim speaks the same length-framed TLV protocol as the Python
+ * {@code cluster/codec.py}: PING namespace registration on connect, FLOW
+ * and PARAM_FLOW acquires with xid correlation. One in-flight request per
+ * handle (the shim serializes internally); pool handles for concurrency.
+ *
+ * <p>Build: see {@code native/java/BUILD.md}. No JNI glue is required —
+ * JNA maps these declarations straight onto the C ABI, so the same
+ * header also serves hand-written JNI if a zero-dependency build is
+ * preferred.
+ */
+public interface SentinelTpuShim extends Library {
+
+    SentinelTpuShim INSTANCE = Native.load("sentinel_shim", SentinelTpuShim.class);
+
+    /** Mirror of {@code st_param} in sentinel_shim.h (tag selects field:
+     * 0=int {@code i}, 1=string {@code s}, 2=bool {@code i},
+     * 3=double {@code d}). */
+    @Structure.FieldOrder({"tag", "i", "d", "s"})
+    class StParam extends Structure {
+        public byte tag;
+        public long i;
+        public double d;
+        public String s;
+
+        @Override
+        protected List<String> getFieldOrder() {
+            return Arrays.asList("tag", "i", "d", "s");
+        }
+    }
+
+    Pointer st_client_connect(String host, int port, String ns, int timeoutMs);
+
+    int st_request_token(Pointer handle, long flowId, int count,
+                         int prioritized, IntByReference outExtra);
+
+    int st_request_param_token(Pointer handle, long flowId, int count,
+                               StParam[] params, int nparams);
+
+    void st_client_close(Pointer handle);
+
+    void st_time_start();
+
+    void st_time_stop();
+
+    long st_now_ms();
+}

@@ -1,0 +1,163 @@
+package com.alibaba.csp.sentinel.tpu;
+
+import com.alibaba.csp.sentinel.cluster.ClusterConstants;
+import com.alibaba.csp.sentinel.cluster.TokenResult;
+import com.alibaba.csp.sentinel.cluster.TokenResultStatus;
+import com.alibaba.csp.sentinel.cluster.TokenServerDescriptor;
+import com.alibaba.csp.sentinel.cluster.client.ClusterTokenClient;
+import com.alibaba.csp.sentinel.cluster.client.config.ClusterClientConfigManager;
+import com.alibaba.csp.sentinel.log.RecordLog;
+import com.alibaba.csp.sentinel.spi.Spi;
+import com.sun.jna.Pointer;
+import com.sun.jna.ptr.IntByReference;
+
+import java.util.Collection;
+import java.util.concurrent.atomic.AtomicReference;
+
+/**
+ * {@link ClusterTokenClient} SPI implementation that forwards token
+ * acquires to the sentinel-tpu backend through the native shim — the
+ * "Java SPI slot" of SURVEY.md §7 M4: drop this jar (plus JNA and
+ * {@code libsentinel_shim.so}) on the classpath of ANY app already using
+ * the reference, register it in
+ * {@code META-INF/services/com.alibaba.csp.sentinel.cluster.client.ClusterTokenClient},
+ * and the stock {@code FlowSlot}/{@code ParamFlowSlot} cluster branches
+ * ({@code FlowRuleChecker.passClusterCheck},
+ * {@code ParamFlowChecker.passClusterCheck}) route to the TPU token
+ * server with no further code changes. Failure semantics are preserved:
+ * a transport failure returns {@code FAIL}, which the checkers translate
+ * into {@code fallbackToLocalOrPass}.
+ *
+ * <p>Server address/namespace come from the standard
+ * {@code ClusterClientConfigManager} (the dashboard's cluster-assign flow
+ * feeds it), so operationally this client is indistinguishable from the
+ * default Netty one.
+ *
+ * <p>NOTE (sandbox provenance): written against the documented 1.8-era
+ * SPI surface; no JVM exists in this build environment, so method
+ * signatures should be re-checked against the fork's sentinel-core before
+ * the first compile (see BUILD.md).
+ */
+@Spi(order = -1000)  // win over the default Netty client when present
+public class TpuClusterTokenClient implements ClusterTokenClient {
+
+    private final AtomicReference<Pointer> handle = new AtomicReference<>();
+    private volatile TokenServerDescriptor descriptor;
+
+    private Pointer connectedHandle() {
+        Pointer h = handle.get();
+        if (h != null) {
+            return h;
+        }
+        String host = ClusterClientConfigManager.getServerHost();
+        int port = ClusterClientConfigManager.getServerPort();
+        if (host == null || port <= 0) {
+            return null;
+        }
+        Pointer fresh = SentinelTpuShim.INSTANCE.st_client_connect(
+            host, port, ClusterConstants.DEFAULT_CLUSTER_NAMESPACE /* or app name */,
+            ClusterClientConfigManager.getRequestTimeout());
+        if (fresh != null && handle.compareAndSet(null, fresh)) {
+            descriptor = new TokenServerDescriptor(host, port);
+            RecordLog.info("[TpuClusterTokenClient] connected to {}:{}", host, port);
+            return fresh;
+        }
+        if (fresh != null) {
+            SentinelTpuShim.INSTANCE.st_client_close(fresh); // lost the race
+        }
+        return handle.get();
+    }
+
+    private void dropConnection() {
+        Pointer h = handle.getAndSet(null);
+        if (h != null) {
+            SentinelTpuShim.INSTANCE.st_client_close(h);
+        }
+    }
+
+    @Override
+    public void start() {
+        connectedHandle();
+    }
+
+    @Override
+    public void stop() {
+        dropConnection();
+    }
+
+    @Override
+    public int getState() {
+        return handle.get() != null ? ClientState.CLIENT_STATUS_STARTED
+                                    : ClientState.CLIENT_STATUS_OFF;
+    }
+
+    @Override
+    public TokenServerDescriptor currentServer() {
+        return descriptor;
+    }
+
+    @Override
+    public TokenResult requestToken(Long flowId, int acquireCount, boolean prioritized) {
+        Pointer h = connectedHandle();
+        if (h == null || flowId == null) {
+            return new TokenResult(TokenResultStatus.FAIL);
+        }
+        IntByReference extra = new IntByReference();
+        int status = SentinelTpuShim.INSTANCE.st_request_token(
+            h, flowId, acquireCount, prioritized ? 1 : 0, extra);
+        if (status == -1) {
+            // ST_FAIL only: transport failure, reconnect next call. Other
+            // negative statuses (TOO_MANY_REQUEST=-2, BAD_REQUEST=-4) are
+            // real server replies — dropping the connection on them would
+            // turn server load-shedding into a reconnect storm.
+            dropConnection();
+            return new TokenResult(TokenResultStatus.FAIL);
+        }
+        TokenResult result = new TokenResult(status);
+        if (status == TokenResultStatus.SHOULD_WAIT) {
+            result.setWaitInMs(extra.getValue());
+        } else {
+            result.setRemaining(extra.getValue());
+        }
+        return result;
+    }
+
+    @Override
+    public TokenResult requestParamToken(Long flowId, int acquireCount,
+                                         Collection<Object> params) {
+        Pointer h = connectedHandle();
+        if (h == null || flowId == null) {
+            return new TokenResult(TokenResultStatus.FAIL);
+        }
+        SentinelTpuShim.StParam[] arr =
+            (SentinelTpuShim.StParam[]) new SentinelTpuShim.StParam().toArray(
+                Math.max(params.size(), 1));
+        int n = 0;
+        for (Object p : params) {
+            SentinelTpuShim.StParam sp = arr[n++];
+            if (p instanceof Boolean) {
+                sp.tag = 2; sp.i = ((Boolean) p) ? 1 : 0;
+            } else if (p instanceof Integer || p instanceof Long
+                       || p instanceof Short || p instanceof Byte) {
+                sp.tag = 0; sp.i = ((Number) p).longValue();
+            } else if (p instanceof Double || p instanceof Float) {
+                sp.tag = 3; sp.d = ((Number) p).doubleValue();
+            } else {
+                sp.tag = 1; sp.s = String.valueOf(p);
+            }
+        }
+        int status = SentinelTpuShim.INSTANCE.st_request_param_token(
+            h, flowId, acquireCount, arr, n);
+        if (status == -1) {  // ST_FAIL only; see requestToken
+            dropConnection();
+            return new TokenResult(TokenResultStatus.FAIL);
+        }
+        return new TokenResult(status);
+    }
+
+    /** Client lifecycle states (reference ClusterConstants values). */
+    static final class ClientState {
+        static final int CLIENT_STATUS_OFF = 0;
+        static final int CLIENT_STATUS_STARTED = 2;
+    }
+}

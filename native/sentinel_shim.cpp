@@ -33,6 +33,7 @@ namespace {
 
 constexpr uint8_t MSG_PING = 0;
 constexpr uint8_t MSG_FLOW = 1;
+constexpr uint8_t MSG_PARAM_FLOW = 2;
 
 constexpr int ST_FAIL = -1;
 
@@ -47,6 +48,13 @@ void put_i32(std::vector<uint8_t>& b, int32_t v) {
 }
 void put_i64(std::vector<uint8_t>& b, int64_t v) {
   for (int s = 56; s >= 0; s -= 8) b.push_back((uint64_t(v) >> s) & 0xff);
+}
+void put_f64(std::vector<uint8_t>& b, double v) {
+  // IEEE-754 bits, big-endian (struct ">d" in cluster/codec.py).
+  uint64_t bits;
+  static_assert(sizeof(bits) == sizeof(v), "double is 64-bit");
+  std::memcpy(&bits, &v, sizeof(bits));
+  put_i64(b, int64_t(bits));
 }
 int32_t get_i32(const uint8_t* p) {
   return (int32_t(p[0]) << 24) | (int32_t(p[1]) << 16) | (int32_t(p[2]) << 8) |
@@ -176,6 +184,61 @@ int st_request_token(void* handle, long long flow_id, int count,
       *out_extra = (status == 2) ? wait_ms : remaining;
     }
   }
+  return status;
+}
+
+// One hot-parameter value (mirror of sentinel_shim.h's st_param).
+struct st_param {
+  unsigned char tag;  // 0=int, 1=str, 2=bool, 3=float
+  long long i;
+  double d;
+  const char* s;
+};
+
+// Acquire param-flow tokens. Entity (cluster/codec.py
+// encode_param_flow_request): flowId:i64 | count:i32 | nparams:u16 |
+// per-param u8 tag + typed payload. Returns the TokenResultStatus or -1.
+int st_request_param_token(void* handle, long long flow_id, int count,
+                           const st_param* params, int nparams) {
+  if (!handle || nparams < 0 || (nparams > 0 && !params)) return ST_FAIL;
+  auto* c = static_cast<Client*>(handle);
+  std::vector<uint8_t> entity;
+  put_i64(entity, flow_id);
+  put_i32(entity, count);
+  entity.push_back(uint8_t(nparams >> 8));
+  entity.push_back(uint8_t(nparams & 0xff));
+  for (int k = 0; k < nparams; ++k) {
+    const st_param& p = params[k];
+    entity.push_back(p.tag);
+    switch (p.tag) {
+      case 0:  // int: i64
+        put_i64(entity, p.i);
+        break;
+      case 1: {  // str: u16 len | utf-8
+        size_t n = p.s ? std::strlen(p.s) : 0;
+        // Oversized values can't fit the u16 frame anyway (the entity-size
+        // check below would reject them) — fail fast rather than truncate,
+        // which could split a multibyte UTF-8 char on the wire.
+        if (n > 0xFFF0) return ST_FAIL;
+        entity.push_back(uint8_t(n >> 8));
+        entity.push_back(uint8_t(n & 0xff));
+        if (n > 0) entity.insert(entity.end(), p.s, p.s + n);
+        break;
+      }
+      case 2:  // bool: u8
+        entity.push_back(p.i ? 1 : 0);
+        break;
+      case 3:  // float: f64 bits
+        put_f64(entity, p.d);
+        break;
+      default:
+        return ST_FAIL;
+    }
+  }
+  if (entity.size() > 0xFFF0) return ST_FAIL;  // must fit one u16 frame
+  int8_t status = ST_FAIL;
+  std::vector<uint8_t> resp;
+  if (!c->call(MSG_PARAM_FLOW, entity, &status, &resp)) return ST_FAIL;
   return status;
 }
 

@@ -1,0 +1,66 @@
+/* sentinel_shim.h — C ABI of the sentinel-tpu native client shim.
+ *
+ * The language-neutral client path to the sentinel-tpu token server
+ * (SURVEY.md §7 M4): JNI, JNA, ctypes, and plain C/C++ all bind these
+ * symbols from libsentinel_shim.so. Wire protocol: the length-framed TLV
+ * of cluster/codec.py (the reference's cluster-common Netty protocol
+ * re-specified; message types PING=0, FLOW=1, PARAM_FLOW=2).
+ *
+ * Thread-safety: one in-flight request per client handle (an internal
+ * mutex serializes callers, matching the blocking-client design); create
+ * one handle per worker for parallelism.
+ */
+
+#ifndef SENTINEL_SHIM_H_
+#define SENTINEL_SHIM_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* TokenResultStatus values returned by the request calls (wire-visible,
+ * reference core:cluster/TokenResultStatus.java):
+ *   OK=0, BLOCKED=1, SHOULD_WAIT=2, NO_RULE_EXISTS=3, NO_REF_RULE_EXISTS=4,
+ *   NOT_AVAILABLE=5, FAIL=-1, TOO_MANY_REQUEST=-2, BAD_REQUEST=-4.
+ * -1 additionally signals local/transport failure. */
+
+/* Connect to a token server and register `ns` via PING.
+ * Returns an opaque handle, or NULL on failure. */
+void* st_client_connect(const char* host, int port, const char* ns,
+                        int timeout_ms);
+
+/* Acquire `count` flow tokens for `flow_id`. Returns the status; when
+ * out_extra is non-NULL it receives remaining (OK) or wait-ms
+ * (SHOULD_WAIT). */
+int st_request_token(void* handle, long long flow_id, int count,
+                     int prioritized, int* out_extra);
+
+/* One hot-parameter value for st_request_param_token. `tag` selects the
+ * wire encoding AND which field carries the value (the server hashes
+ * params typed, so an int param must be sent as an int to share buckets
+ * with other clients' ints): */
+typedef struct st_param {
+  unsigned char tag; /* 0=int (i), 1=utf-8 string (s), 2=bool (i), 3=float (d) */
+  long long i;
+  double d;
+  const char* s;     /* NUL-terminated; used when tag==1 */
+} st_param;
+
+/* Acquire `count` param-flow tokens for (`flow_id`, params). Returns the
+ * status (PARAM_FLOW responses carry no entity). */
+int st_request_param_token(void* handle, long long flow_id, int count,
+                           const st_param* params, int nparams);
+
+void st_client_close(void* handle);
+
+/* Cached-tick millisecond clock (reference core:util/TimeUtil.java): a
+ * 1ms tick thread caches the wall clock so hot paths avoid syscalls. */
+void st_time_start(void);
+void st_time_stop(void);
+long long st_now_ms(void);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* SENTINEL_SHIM_H_ */

@@ -80,3 +80,17 @@ class AppManagement:
     def remove(self, app: str, ip: str, port: int) -> bool:
         with self._lock:
             return self._apps.get(app, {}).pop(f"{ip}:{port}", None) is not None
+
+    def purge_dead(self, now_ms: Optional[int] = None) -> int:
+        """Drop machines silent past DEAD_MS from the registry entirely
+        (callers prune their per-machine state against the survivors)."""
+        removed = 0
+        with self._lock:
+            for app in list(self._apps):
+                machines = self._apps[app]
+                for key in [k for k, m in machines.items() if m.dead(now_ms)]:
+                    del machines[key]
+                    removed += 1
+                if not machines:
+                    del self._apps[app]
+        return removed

@@ -128,10 +128,15 @@ class MetricFetcher:
         now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
         end = now_ms - FETCH_LAG_MS
         ingested = 0
-        live_keys = set()
+        # Resume keys are kept for every REGISTERED machine (incl. ones on a
+        # transient heartbeat blip — pruning those would re-fetch and
+        # double-count seconds when they come back); only machines dead long
+        # enough to be purged from the registry are dropped.
+        self.apps.purge_dead()
+        registered = {m.key for app in self.apps.app_names()
+                      for m in self.apps.machines(app, include_dead=True)}
         for app in self.apps.app_names():
             for m in self.apps.healthy_machines(app):
-                live_keys.add(m.key)
                 start = self._last_fetched.get(m.key, end - FETCH_SPAN_MS) + 1
                 start = max(start, end - FETCH_SPAN_MS)
                 if start > end:
@@ -156,7 +161,7 @@ class MetricFetcher:
                     self._last_fetched[m.key] = newest
         # Machines that churned away (restarts on ephemeral ports) would
         # otherwise accumulate resume keys forever.
-        for key in [k for k in self._last_fetched if k not in live_keys]:
+        for key in [k for k in self._last_fetched if k not in registered]:
             del self._last_fetched[key]
         self.repository._evict(now_ms)
         return ingested

@@ -42,6 +42,11 @@ class InProcessBroker:
         self._lock = threading.Lock()
         self._kv: Dict[str, Tuple[str, int]] = {}  # key -> (value, version)
         self._subs: Dict[str, List[Callable[[str], None]]] = defaultdict(list)
+        # Per-key delivery serialization: concurrent set()s must not deliver
+        # an older value after a newer one (subscribers would keep the stale
+        # rules until the next unrelated write).
+        self._delivery: Dict[str, threading.Lock] = defaultdict(threading.Lock)
+        self._delivered: Dict[str, int] = defaultdict(int)
 
     # -- KV ----------------------------------------------------------------
 
@@ -51,12 +56,22 @@ class InProcessBroker:
         with self._lock:
             version = self._kv.get(key, ("", 0))[1] + 1
             self._kv[key] = (value, version)
-            subs = list(self._subs.get(key, ()))
-        for cb in subs:
-            try:
-                cb(value)
-            except Exception as ex:
-                _log_warn("broker subscriber failed: %r", ex)
+            delivery = self._delivery[key]
+        with delivery:
+            # Deliver the LATEST committed value exactly once per version:
+            # a racing older set() finds its version already superseded and
+            # skips, so subscribers always converge on the newest value.
+            with self._lock:
+                current, cur_version = self._kv[key]
+                subs = list(self._subs.get(key, ()))
+            if self._delivered[key] >= cur_version:
+                return version
+            self._delivered[key] = cur_version
+            for cb in subs:
+                try:
+                    cb(current)
+                except Exception as ex:
+                    _log_warn("broker subscriber failed: %r", ex)
         return version
 
     def get(self, key: str) -> Optional[str]:
@@ -119,18 +134,25 @@ class BrokerDataSource(PushDataSource[T]):
         super().__init__(converter)
         self.broker = broker
         self.key = key
+        self._pushed = False
         # Subscribe FIRST, then initial GET: a set() racing the constructor
-        # then costs at worst a duplicate delivery instead of a lost update.
-        broker.subscribe(key, self.on_update)
+        # is at worst a duplicate delivery. The _pushed guard closes the
+        # reverse race (push lands between the GET and applying it — the
+        # initial value must not clobber the newer pushed one).
+        broker.subscribe(key, self._on_push)
         initial = broker.get(key)
-        if initial is not None:
+        if initial is not None and not self._pushed:
             self.on_update(initial)
+
+    def _on_push(self, raw: str) -> None:
+        self._pushed = True
+        self.on_update(raw)
 
     def read_source(self) -> str:
         return self.broker.get(self.key) or ""
 
     def close(self) -> None:
-        self.broker.unsubscribe(self.key, self.on_update)
+        self.broker.unsubscribe(self.key, self._on_push)
 
 
 class PollingKVDataSource(AutoRefreshDataSource[str, T]):

@@ -53,20 +53,54 @@ def load_shim() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(path)
-        except OSError:
+            _declare(lib)
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so missing newer symbols
+            # (no source to rebuild from) — fall back like any other miss.
             _load_failed = True
             return None
-        lib.st_client_connect.restype = ctypes.c_void_p
-        lib.st_client_connect.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
-        lib.st_request_token.restype = ctypes.c_int
-        lib.st_request_token.argtypes = [
-            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int)]
-        lib.st_client_close.argtypes = [ctypes.c_void_p]
-        lib.st_now_ms.restype = ctypes.c_longlong
         _lib = lib
         return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.st_client_connect.restype = ctypes.c_void_p
+    lib.st_client_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.st_request_token.restype = ctypes.c_int
+    lib.st_request_token.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.st_request_param_token.restype = ctypes.c_int
+    lib.st_request_param_token.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+        ctypes.POINTER(StParam), ctypes.c_int]
+    lib.st_client_close.argtypes = [ctypes.c_void_p]
+    lib.st_now_ms.restype = ctypes.c_longlong
+
+
+class StParam(ctypes.Structure):
+    """Mirror of ``st_param`` in native/sentinel_shim.h."""
+
+    _fields_ = [("tag", ctypes.c_ubyte), ("i", ctypes.c_longlong),
+                ("d", ctypes.c_double), ("s", ctypes.c_char_p)]
+
+
+def _pack_params(params):
+    arr = (StParam * len(params))()
+    keepalive = []
+    for k, p in enumerate(params):
+        if isinstance(p, bool):
+            arr[k].tag, arr[k].i = 2, int(p)
+        elif isinstance(p, int):
+            arr[k].tag, arr[k].i = 0, p
+        elif isinstance(p, float):
+            arr[k].tag, arr[k].d = 3, p
+        else:
+            raw = str(p).encode("utf-8")
+            keepalive.append(raw)
+            arr[k].tag, arr[k].s = 1, raw
+    return arr, keepalive
 
 
 class NativeTokenClient:
@@ -95,6 +129,17 @@ class NativeTokenClient:
         if status == 2:  # SHOULD_WAIT
             return TokenResult(status, wait_ms=extra.value)
         return TokenResult(status, remaining=extra.value)
+
+    def request_param_token(self, flow_id: int, count: int, params):
+        """Hot-param acquire through the shim (typed params hash-compatible
+        with the Python client's)."""
+        from sentinel_tpu.cluster.token_service import TokenResult
+
+        arr, keepalive = _pack_params(list(params))
+        status = self._lib.st_request_param_token(
+            self._handle, flow_id, count, arr, len(arr))
+        del keepalive
+        return TokenResult(status)
 
     def close(self) -> None:
         if self._handle:

@@ -251,6 +251,8 @@ def cmd_cluster_client_modify(req: CommandRequest) -> CommandResponse:
             staged["serverPort"] = int(staged["serverPort"])
         if "requestTimeout" in staged:
             staged["requestTimeout"] = float(staged["requestTimeout"])
+            if staged["requestTimeout"] <= 0:
+                raise ValueError("requestTimeout must be positive (ms)")
     except (ValueError, TypeError) as ex:
         return CommandResponse.of_failure(f"parse error: {ex}")
     cs = req.engine.cluster

@@ -59,3 +59,60 @@ def test_native_clock_reasonable():
     now = native_now_ms()
     assert now is not None
     assert abs(now - time.time() * 1000) < 5000
+
+
+@pytest.fixture()
+def param_server(frozen_time):
+    """Token server with a THRESHOLD_GLOBAL param rule: 2 tokens/s/value."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [st.FlowRule(
+        resource="native-param", count=2, cluster_mode=True,
+        cluster_config={"flowId": 7100, "thresholdType": THRESHOLD_GLOBAL})])
+    server = ClusterTokenServer(
+        DefaultTokenService(rules), host="127.0.0.1", port=0).start()
+    yield server
+    server.stop()
+
+
+def test_native_param_token_acquire(param_server):
+    """PARAM_FLOW through the C shim: per-value buckets enforced."""
+    with NativeTokenClient("127.0.0.1", param_server.bound_port) as client:
+        got = [client.request_param_token(7100, 1, ["hotKey"]).status
+               for _ in range(4)]
+        assert got.count(TokenResultStatus.OK) == 2
+        assert got.count(TokenResultStatus.BLOCKED) == 2
+        # a different value has its own bucket
+        assert client.request_param_token(7100, 1, ["coldKey"]).status \
+            == TokenResultStatus.OK
+        # unknown flowId -> NO_RULE_EXISTS (client falls back to local)
+        assert client.request_param_token(999, 1, ["x"]).status \
+            == TokenResultStatus.NO_RULE_EXISTS
+
+
+def test_native_param_buckets_shared_with_python_client(param_server):
+    """Typed wire params hash identically from C and Python, so both
+    clients drain the SAME (flowId, value) bucket — incl. int vs str
+    distinction (42 and "42" are different buckets in both languages)."""
+    from sentinel_tpu.cluster.client import ClusterTokenClient
+
+    py = ClusterTokenClient("127.0.0.1", param_server.bound_port).start()
+    try:
+        with NativeTokenClient("127.0.0.1", param_server.bound_port) as c:
+            assert c.request_param_token(7100, 1, [42]).status \
+                == TokenResultStatus.OK
+            assert py.request_param_token(7100, 1, [42]).status \
+                == TokenResultStatus.OK
+            # bucket for int 42 is now full (2/2) from both sides
+            assert c.request_param_token(7100, 1, [42]).status \
+                == TokenResultStatus.BLOCKED
+            assert py.request_param_token(7100, 1, [42]).status \
+                == TokenResultStatus.BLOCKED
+            # "42" (string) is a distinct typed bucket, still open
+            assert c.request_param_token(7100, 1, ["42"]).status \
+                == TokenResultStatus.OK
+        # mixed types in one request: bool + float + str
+        with NativeTokenClient("127.0.0.1", param_server.bound_port) as c:
+            assert c.request_param_token(7100, 1, [True, 1.5, "u"]).status \
+                == TokenResultStatus.OK
+    finally:
+        py.stop()
