@@ -12,7 +12,6 @@ import com.sun.jna.Pointer;
 import com.sun.jna.ptr.IntByReference;
 
 import java.util.Collection;
-import java.util.concurrent.atomic.AtomicReference;
 
 /**
  * {@link ClusterTokenClient} SPI implementation that forwards token
@@ -41,13 +40,26 @@ import java.util.concurrent.atomic.AtomicReference;
 @Spi(order = -1000)  // win over the default Netty client when present
 public class TpuClusterTokenClient implements ClusterTokenClient {
 
-    private final AtomicReference<Pointer> handle = new AtomicReference<>();
-    private volatile TokenServerDescriptor descriptor;
+    /** Failed connects are not retried for this long (the default Netty
+     * client reconnects asynchronously; a synchronous connect storm on
+     * request threads would turn a limiter outage into app latency). */
+    private static final long RECONNECT_BACKOFF_MS = 2000;
 
-    private Pointer connectedHandle() {
-        Pointer h = handle.get();
-        if (h != null) {
-            return h;
+    // All state below is guarded by the instance monitor: every request
+    // runs synchronized, so a close can never free the native handle
+    // while another thread is mid-call on it (the shim serializes
+    // per-handle anyway, so the monitor adds no throughput cost — pool
+    // TpuClusterTokenClient instances for parallelism).
+    private Pointer handle;
+    private TokenServerDescriptor descriptor;
+    private long lastConnectFailMs;
+
+    private synchronized Pointer connectedHandle() {
+        if (handle != null) {
+            return handle;
+        }
+        if (System.currentTimeMillis() - lastConnectFailMs < RECONNECT_BACKOFF_MS) {
+            return null;  // fast-fail to fallbackToLocalOrPass during outage
         }
         String host = ClusterClientConfigManager.getServerHost();
         int port = ClusterClientConfigManager.getServerPort();
@@ -57,21 +69,20 @@ public class TpuClusterTokenClient implements ClusterTokenClient {
         Pointer fresh = SentinelTpuShim.INSTANCE.st_client_connect(
             host, port, ClusterConstants.DEFAULT_CLUSTER_NAMESPACE /* or app name */,
             ClusterClientConfigManager.getRequestTimeout());
-        if (fresh != null && handle.compareAndSet(null, fresh)) {
-            descriptor = new TokenServerDescriptor(host, port);
-            RecordLog.info("[TpuClusterTokenClient] connected to {}:{}", host, port);
-            return fresh;
+        if (fresh == null) {
+            lastConnectFailMs = System.currentTimeMillis();
+            return null;
         }
-        if (fresh != null) {
-            SentinelTpuShim.INSTANCE.st_client_close(fresh); // lost the race
-        }
-        return handle.get();
+        handle = fresh;
+        descriptor = new TokenServerDescriptor(host, port);
+        RecordLog.info("[TpuClusterTokenClient] connected to {}:{}", host, port);
+        return handle;
     }
 
-    private void dropConnection() {
-        Pointer h = handle.getAndSet(null);
-        if (h != null) {
-            SentinelTpuShim.INSTANCE.st_client_close(h);
+    private synchronized void dropConnection() {
+        if (handle != null) {
+            SentinelTpuShim.INSTANCE.st_client_close(handle);
+            handle = null;
         }
     }
 
@@ -86,18 +97,18 @@ public class TpuClusterTokenClient implements ClusterTokenClient {
     }
 
     @Override
-    public int getState() {
-        return handle.get() != null ? ClientState.CLIENT_STATUS_STARTED
+    public synchronized int getState() {
+        return handle != null ? ClientState.CLIENT_STATUS_STARTED
                                     : ClientState.CLIENT_STATUS_OFF;
     }
 
     @Override
-    public TokenServerDescriptor currentServer() {
+    public synchronized TokenServerDescriptor currentServer() {
         return descriptor;
     }
 
     @Override
-    public TokenResult requestToken(Long flowId, int acquireCount, boolean prioritized) {
+    public synchronized TokenResult requestToken(Long flowId, int acquireCount, boolean prioritized) {
         Pointer h = connectedHandle();
         if (h == null || flowId == null) {
             return new TokenResult(TokenResultStatus.FAIL);
@@ -123,7 +134,7 @@ public class TpuClusterTokenClient implements ClusterTokenClient {
     }
 
     @Override
-    public TokenResult requestParamToken(Long flowId, int acquireCount,
+    public synchronized TokenResult requestParamToken(Long flowId, int acquireCount,
                                          Collection<Object> params) {
         Pointer h = connectedHandle();
         if (h == null || flowId == null) {

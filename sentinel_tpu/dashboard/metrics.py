@@ -132,7 +132,7 @@ class MetricFetcher:
         # transient heartbeat blip — pruning those would re-fetch and
         # double-count seconds when they come back); only machines dead long
         # enough to be purged from the registry are dropped.
-        self.apps.purge_dead()
+        self.apps.purge_dead(now_ms)
         registered = {m.key for app in self.apps.app_names()
                       for m in self.apps.machines(app, include_dead=True)}
         for app in self.apps.app_names():

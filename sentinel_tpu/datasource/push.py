@@ -44,8 +44,9 @@ class InProcessBroker:
         self._subs: Dict[str, List[Callable[[str], None]]] = defaultdict(list)
         # Per-key delivery serialization: concurrent set()s must not deliver
         # an older value after a newer one (subscribers would keep the stale
-        # rules until the next unrelated write).
-        self._delivery: Dict[str, threading.Lock] = defaultdict(threading.Lock)
+        # rules until the next unrelated write). RLock: a subscriber may
+        # write the key back from inside its callback.
+        self._delivery: Dict[str, threading.RLock] = defaultdict(threading.RLock)
         self._delivered: Dict[str, int] = defaultdict(int)
 
     # -- KV ----------------------------------------------------------------
@@ -58,20 +59,26 @@ class InProcessBroker:
             self._kv[key] = (value, version)
             delivery = self._delivery[key]
         with delivery:
-            # Deliver the LATEST committed value exactly once per version:
-            # a racing older set() finds its version already superseded and
-            # skips, so subscribers always converge on the newest value.
-            with self._lock:
-                current, cur_version = self._kv[key]
-                subs = list(self._subs.get(key, ()))
-            if self._delivered[key] >= cur_version:
-                return version
-            self._delivered[key] = cur_version
-            for cb in subs:
-                try:
-                    cb(current)
-                except Exception as ex:
-                    _log_warn("broker subscriber failed: %r", ex)
+            # Deliver toward the LATEST committed value until converged.
+            # Mid-loop supersession (a racing or re-entrant newer set)
+            # aborts the stale round; the while re-delivers the newest to
+            # everyone, so no subscriber is left on an older value.
+            while True:
+                with self._lock:
+                    current, cur_version = self._kv[key]
+                    subs = list(self._subs.get(key, ()))
+                if self._delivered[key] >= cur_version:
+                    break
+                self._delivered[key] = cur_version
+                for cb in subs:
+                    with self._lock:
+                        superseded = self._kv[key][1] > cur_version
+                    if superseded:
+                        break
+                    try:
+                        cb(current)
+                    except Exception as ex:
+                        _log_warn("broker subscriber failed: %r", ex)
         return version
 
     def get(self, key: str) -> Optional[str]:
@@ -83,6 +90,18 @@ class InProcessBroker:
         with self._lock:
             item = self._kv.get(key)
         return item[1] if item else 0
+
+    def sync(self, key: str, cb: Callable[[str], None]) -> None:
+        """Deliver the key's current value to ``cb`` under the delivery
+        lock — the race-free "initial GET" for a fresh subscriber: no set()
+        can interleave, and any later set() delivers strictly newer."""
+        with self._lock:
+            delivery = self._delivery[key]
+        with delivery:
+            with self._lock:
+                item = self._kv.get(key)
+            if item is not None:
+                cb(item[0])
 
     # -- pub/sub -----------------------------------------------------------
 
@@ -134,25 +153,19 @@ class BrokerDataSource(PushDataSource[T]):
         super().__init__(converter)
         self.broker = broker
         self.key = key
-        self._pushed = False
-        # Subscribe FIRST, then initial GET: a set() racing the constructor
-        # is at worst a duplicate delivery. The _pushed guard closes the
-        # reverse race (push lands between the GET and applying it — the
-        # initial value must not clobber the newer pushed one).
-        broker.subscribe(key, self._on_push)
-        initial = broker.get(key)
-        if initial is not None and not self._pushed:
-            self.on_update(initial)
-
-    def _on_push(self, raw: str) -> None:
-        self._pushed = True
-        self.on_update(raw)
+        # Subscribe, then take the initial value through broker.sync(),
+        # which holds the per-key delivery lock: a concurrent set() either
+        # fully delivers before the sync (sync re-reads the newer value) or
+        # fully after (strictly newer) — the stale-initial-clobbers-push
+        # race cannot happen.
+        broker.subscribe(key, self.on_update)
+        broker.sync(key, self.on_update)
 
     def read_source(self) -> str:
         return self.broker.get(self.key) or ""
 
     def close(self) -> None:
-        self.broker.unsubscribe(self.key, self._on_push)
+        self.broker.unsubscribe(self.key, self.on_update)
 
 
 class PollingKVDataSource(AutoRefreshDataSource[str, T]):

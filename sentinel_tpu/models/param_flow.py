@@ -9,19 +9,36 @@ exceptions, clusterMode), ``ParamFlowRuleManager``, ``ParamFlowChecker``
 LRU-bounded key space via ``CacheMap``). Upstream paths: ``param:…``
 (reference mount was empty; citations are upstream-layout paths).
 
-TPU-native design: instead of per-value concurrent hash maps, each rule owns
-a fixed direct-mapped slot table on device — ``slot = hash(value) % S`` —
-holding the bucket state (owner key, tokens, refill time, thread gauge).
-A new key landing on an occupied slot *evicts* it and starts a fresh bucket,
-which is the tensor analog of the reference's LRU cache bounding the key
-space (an evicted key restarts fresh there too). Distinct hot keys colliding
-in one slot conflate until one wins; with S ≫ hot-key count this is rare and
-bounded (documented semantics delta). Within a micro-batch, arrival-order
-exactness uses the same segmented-prefix machinery as flow rules.
+TPU-native design (the BASELINE "CMS + top_k" north star, two tiers):
 
-Per-value exception items compile to an exact-match (hash → threshold)
-side table, checked before the rule-wide threshold — matching
-``ParamFlowItem`` semantics for the value types our host hash covers.
+  * **Hot tier — exact.** Each rule owns a fixed direct-mapped slot table
+    on device — ``slot = hash(value) % S`` — holding exact bucket state
+    (owner key, tokens, refill time, thread gauge). The table IS the
+    top-k hot set: ownership is *promotion-gated* (below), so sustained
+    hot keys hold their slots and get exact token-bucket semantics, the
+    analog of the reference's LRU ``CacheMap`` hot entries.
+  * **Cold tier — count-min sketch.** A per-rule CMS
+    (``[D, W]`` with D independent multiplicative hashes of the 32-bit
+    value hash) counts every admitted acquire in the current duration
+    window. A key that does NOT own its slot admits against
+    ``max_count − min_d CMS[d, h_d(key)]`` instead of a free fresh
+    bucket — so a 100k-key space is still rate-limited per value, with
+    one-sided error: CMS only over-estimates, so cold keys can only be
+    under-admitted, never over-admitted (fail-closed; property-tested in
+    tests/test_param_cms.py).
+  * **Promotion (space-saving top-k).** An admitted non-owner key takes
+    the slot only when its CMS count has reached the owner's — a
+    cold-key storm can no longer evict a genuinely hot key's exact
+    bucket, while a newly-hot key wins the slot within one window.
+    QPS/DEFAULT grade uses this two-tier path; THREAD and RATE_LIMITER
+    grades keep direct eviction (their per-value state has no windowed
+    CMS analog).
+
+Within a micro-batch, arrival-order exactness uses the same
+segmented-prefix machinery as flow rules. Per-value exception items
+compile to an exact-match (hash → threshold) side table, checked before
+the rule-wide threshold — matching ``ParamFlowItem`` semantics for the
+value types our host hash covers.
 """
 
 from __future__ import annotations
@@ -44,6 +61,23 @@ from sentinel_tpu.utils.shapes import round_up as _round_up
 
 DEFAULT_SLOTS = 2048  # per-rule bucket table width (reference LRU cap: 4000)
 MAX_ITEMS = 8         # per-rule exact-value exception slots
+
+# Count-min sketch geometry (cold tier). With W=2048 and D=4, the classic
+# bound gives over-estimate ≤ ~e·N/W per row (N = window acquires) with
+# probability 1 − e^−D; one-sided error only.
+CMS_DEPTH = 4
+CMS_WIDTH = 2048
+# Odd multiplicative-hash constants (Knuth/xxhash-style); row d's position
+# for a 32-bit value hash v is ((v · A_d) >> 16) mod W, computable on
+# device from the stored owner key too.
+_CMS_MULT = np.array([0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F],
+                     np.uint32)
+
+
+def _cms_positions(pv_hash: jax.Array) -> jax.Array:
+    """[N] uint32 value hashes -> [N, D] int32 sketch columns."""
+    h = pv_hash[:, None] * jnp.asarray(_CMS_MULT)[None, :]  # uint32 wrap
+    return ((h >> jnp.uint32(16)) % jnp.uint32(CMS_WIDTH)).astype(jnp.int32)
 
 
 @dataclass
@@ -110,13 +144,16 @@ class ParamRuleTensors(NamedTuple):
 
 
 class ParamFlowState(NamedTuple):
-    """Per-(rule, hash-slot) bucket table (re-created on rule load)."""
+    """Per-(rule, hash-slot) bucket table + cold-tier CMS (re-created on
+    rule load)."""
 
     key: jax.Array        # uint32[PR, S] owner param hash, 0 = empty
     tokens: jax.Array     # float32[PR, S] remaining tokens (QPS/default)
     filled_ms: jax.Array  # int64[PR, S] last refill time
     passed_us: jax.Array  # int64[PR, S] throttle-mode leaky-bucket head
     threads: jax.Array    # int32[PR, S] concurrency gauge (THREAD grade)
+    cms: jax.Array        # float32[PR, D, W] window acquire sketch
+    cms_start: jax.Array  # int64[PR] sketch window start (per-rule duration)
 
 
 def make_param_state(num_rules: int, table_slots: int = DEFAULT_SLOTS) -> ParamFlowState:
@@ -127,6 +164,8 @@ def make_param_state(num_rules: int, table_slots: int = DEFAULT_SLOTS) -> ParamF
         filled_ms=jnp.zeros((pr, s), jnp.int64),
         passed_us=jnp.zeros((pr, s), jnp.int64),
         threads=jnp.zeros((pr, s), jnp.int32),
+        cms=jnp.zeros((pr, CMS_DEPTH, CMS_WIDTH), jnp.float32),
+        cms_start=jnp.zeros((pr,), jnp.int64),
     )
 
 
@@ -236,6 +275,17 @@ def _gather2(arr, r, s, fill):
     return jnp.where(ok, arr[jnp.where(ok, r, 0), s], jnp.asarray(fill, arr.dtype))
 
 
+def _cms_min(cms: jax.Array, srule: jax.Array, pos: jax.Array) -> jax.Array:
+    """min over depth of ``cms[rule, d, pos[:, d]]`` — the CMS estimate.
+
+    ``srule`` < 0 (no applicable rule) reads row 0 and is masked to 0.
+    """
+    ok = (srule >= 0) & (srule < cms.shape[0])
+    r = jnp.where(ok, srule, 0)
+    vals = cms[r[:, None], jnp.arange(CMS_DEPTH)[None, :], pos]  # [N, D]
+    return jnp.where(ok, vals.min(axis=1), 0.0)
+
+
 def check_param_flow(
     rt: ParamRuleTensors,
     ps: ParamFlowState,
@@ -249,6 +299,24 @@ def check_param_flow(
     verdicts with every candidate consuming bucket prefixes; pass 2
     restricts prefixes to pass-1 survivors and commits bucket state.
     """
+    # Roll the cold-tier sketch windows first so both passes see one view.
+    # DECAY (halve per elapsed window) instead of zeroing: a hard reset
+    # would zero both est and owner_est at every boundary, letting the
+    # first cold request of a window steal a hot key's slot (promotion
+    # gate no-op). Decay keeps hot keys' counts dominant across rolls;
+    # since est only grows vs. the true in-window count, the one-sided
+    # (never-over-admit) guarantee is preserved — cold keys right after a
+    # roll are judged against ≤½ of last window's estimate on top of
+    # their own usage.
+    now64 = now_ms.astype(jnp.int64)
+    dur = jnp.maximum(rt.duration_ms, 1)
+    win_start = now64 - now64 % dur
+    elapsed = jnp.clip((win_start - ps.cms_start) // dur, 0, 30)
+    factor = jnp.exp2(-elapsed.astype(jnp.float32))
+    ps = ps._replace(
+        cms=ps.cms * factor[:, None, None],
+        cms_start=jnp.where(elapsed > 0, win_start, ps.cms_start),
+    )
     pass1 = _eval_param(rt, ps, batch, now_ms, candidate,
                         survivors=candidate, commit=False)
     return _eval_param(rt, ps, batch, now_ms, candidate,
@@ -324,7 +392,13 @@ def _eval_param(
         refilled = jnp.minimum(
             stored_tokens + windows.astype(jnp.float32) * thr, max_count
         )
-        avail = jnp.where(fresh, max_count, refilled)
+        # Cold tier: a key that does not own its slot admits against the
+        # CMS estimate of its own window usage (one-sided: est >= truth,
+        # so cold keys never over-admit). The hot owner keeps its exact
+        # bucket.
+        pos = _cms_positions(pv_hash)                    # [N, D]
+        est = _cms_min(ps.cms, srule, pos)               # [N]
+        avail = jnp.where(fresh, jnp.maximum(max_count - est, 0.0), refilled)
         acqf = batch.count.astype(jnp.float32)
         qps_ok = (thr > 0) & (tok_prefix.astype(jnp.float32) + acqf <= avail)
 
@@ -359,30 +433,57 @@ def _eval_param(
         wait_us = jnp.maximum(wait_us, jnp.where(admitted & is_rl, rl_wait, 0))
 
         if commit:
-            ridx = W.oob(jnp.where(admitted | (applicable & fresh), srule, -1), ps.key.shape[0])
-            # Claim slot ownership (last writer wins on rare collisions) and
-            # stamp refill time for fresh/refilled buckets.
+            dflt = applicable & (~is_thread) & (~is_rl)
+            # Promotion gate (space-saving top-k): an admitted cold key
+            # takes the slot only when its window count has caught up with
+            # the owner's — a cold-key storm can't evict a hot key's exact
+            # bucket. Empty slots (key 0) are claimed directly.
+            owner_est = _cms_min(ps.cms, srule, _cms_positions(stored_key))
+            promoted = (admitted & dflt & fresh
+                        & ((stored_key == 0) | (est + acqf >= owner_est)))
+            # THREAD / RATE_LIMITER keep direct eviction (no windowed CMS
+            # analog for gauges / leaky-bucket heads).
+            claim_other = (admitted | (applicable & fresh)) & (is_thread | is_rl)
+            claim = promoted | claim_other | (admitted & dflt & (~fresh))
+            ridx = W.oob(jnp.where(claim, srule, -1), ps.key.shape[0])
             ps = ps._replace(
                 key=ps.key.at[ridx, slot].set(pv_hash, mode="drop"),
             )
-            need_stamp = applicable & (windows >= 1) & (~is_thread) & (~is_rl)
-            tidx = W.oob(jnp.where(need_stamp | (applicable & fresh), srule, -1), ps.key.shape[0])
+            need_stamp = dflt & (~fresh) & (windows >= 1)
+            tidx = W.oob(jnp.where(
+                need_stamp | promoted | (claim_other & fresh), srule, -1
+            ), ps.key.shape[0])
             ps = ps._replace(
                 filled_ms=ps.filled_ms.at[tidx, slot].set(
                     now_ms.astype(jnp.int64), mode="drop"
                 )
             )
-            # Default-mode token accounting: set bucket to its refilled level
-            # once, then subtract every admitted acquire (scatter-add handles
-            # duplicates within the batch).
-            dflt = applicable & (~is_thread) & (~is_rl)
-            didx = W.oob(jnp.where(dflt, srule, -1), ps.key.shape[0])
+            # Default-mode token accounting: owners (and freshly promoted
+            # keys, seeded from the CMS-discounted level) get their bucket
+            # set, then every admitted acquire is subtracted. Non-promoted
+            # cold admits consume CMS only — they must not clobber the
+            # owner's bucket.
+            touch = dflt & ((~fresh) | promoted)
+            didx = W.oob(jnp.where(touch, srule, -1), ps.key.shape[0])
             tokens = ps.tokens.at[didx, slot].set(avail, mode="drop")
             tokens = tokens.at[
-                W.oob(jnp.where(admitted & (~is_thread) & (~is_rl), srule, -1), ps.key.shape[0]),
+                W.oob(jnp.where(admitted & touch, srule, -1), ps.key.shape[0]),
                 slot,
             ].add(-acqf, mode="drop")
             ps = ps._replace(tokens=jnp.maximum(tokens, 0.0))
+            # Every admitted default-grade acquire lands in the sketch (the
+            # owner's too, keeping owner_est honest for promotion races).
+            # Conservative update: only cells at the current minimum grow,
+            # which tightens the one-sided over-estimate for colliding keys
+            # (still never under-estimates).
+            cidx = W.oob(jnp.where(admitted & dflt, srule, -1), ps.key.shape[0])
+            r0 = jnp.where(srule >= 0, srule, 0)
+            depth_vals = ps.cms[r0[:, None], jnp.arange(CMS_DEPTH)[None, :], pos]
+            at_min = depth_vals <= depth_vals.min(axis=1, keepdims=True)
+            inc = jnp.where((admitted & dflt)[:, None] & at_min, acqf[:, None], 0.0)
+            ps = ps._replace(cms=ps.cms.at[
+                cidx[:, None], jnp.arange(CMS_DEPTH)[None, :], pos
+            ].add(inc, mode="drop"))
             # Throttle-mode head advance: head' = latest + consumed · cost.
             # Evicted slots first drop their stale head so .max starts fresh.
             fresh_rl = W.oob(
