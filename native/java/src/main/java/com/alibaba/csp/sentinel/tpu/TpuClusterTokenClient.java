@@ -50,8 +50,11 @@ public class TpuClusterTokenClient implements ClusterTokenClient {
     // while another thread is mid-call on it (the shim serializes
     // per-handle anyway, so the monitor adds no throughput cost — pool
     // TpuClusterTokenClient instances for parallelism).
-    private Pointer handle;
-    private TokenServerDescriptor descriptor;
+    // volatile: getState()/currentServer() read these WITHOUT the
+    // monitor so a hung native request can't stall observability threads;
+    // mutation and every native call still run synchronized.
+    private volatile Pointer handle;
+    private volatile TokenServerDescriptor descriptor;
     private long lastConnectFailMs;
 
     private synchronized Pointer connectedHandle() {
@@ -97,13 +100,13 @@ public class TpuClusterTokenClient implements ClusterTokenClient {
     }
 
     @Override
-    public synchronized int getState() {
+    public int getState() {
         return handle != null ? ClientState.CLIENT_STATUS_STARTED
-                                    : ClientState.CLIENT_STATUS_OFF;
+                              : ClientState.CLIENT_STATUS_OFF;
     }
 
     @Override
-    public synchronized TokenServerDescriptor currentServer() {
+    public TokenServerDescriptor currentServer() {
         return descriptor;
     }
 

@@ -59,16 +59,15 @@ class InProcessBroker:
             self._kv[key] = (value, version)
             delivery = self._delivery[key]
         with delivery:
-            # Deliver toward the LATEST committed value until converged.
-            # Mid-loop supersession (a racing or re-entrant newer set)
-            # aborts the stale round; the while re-delivers the newest to
-            # everyone, so no subscriber is left on an older value.
-            while True:
+            # Deliver until OUR version is covered (bounded: at most one
+            # round past supersession — the superseding writer is parked on
+            # this lock and owns delivering its own newer value, so no
+            # subscriber is left stale and no writer loops on behalf of a
+            # sustained write stream).
+            while self._delivered[key] < version:
                 with self._lock:
                     current, cur_version = self._kv[key]
                     subs = list(self._subs.get(key, ()))
-                if self._delivered[key] >= cur_version:
-                    break
                 self._delivered[key] = cur_version
                 for cb in subs:
                     with self._lock:

@@ -152,7 +152,11 @@ class ParamFlowState(NamedTuple):
     filled_ms: jax.Array  # int64[PR, S] last refill time
     passed_us: jax.Array  # int64[PR, S] throttle-mode leaky-bucket head
     threads: jax.Array    # int32[PR, S] concurrency gauge (THREAD grade)
-    cms: jax.Array        # float32[PR, D, W] window acquire sketch
+    cms: jax.Array        # float32[PR, D, W] THIS-window acquire sketch
+                          # (admission tier; hard-reset each window)
+    cms_hot: jax.Array    # float32[PR, D, W] decayed hotness sketch
+                          # (promotion gate only; halves each window so a
+                          # hot owner's history survives the boundary)
     cms_start: jax.Array  # int64[PR] sketch window start (per-rule duration)
 
 
@@ -165,6 +169,7 @@ def make_param_state(num_rules: int, table_slots: int = DEFAULT_SLOTS) -> ParamF
         passed_us=jnp.zeros((pr, s), jnp.int64),
         threads=jnp.zeros((pr, s), jnp.int32),
         cms=jnp.zeros((pr, CMS_DEPTH, CMS_WIDTH), jnp.float32),
+        cms_hot=jnp.zeros((pr, CMS_DEPTH, CMS_WIDTH), jnp.float32),
         cms_start=jnp.zeros((pr,), jnp.int64),
     )
 
@@ -292,35 +297,52 @@ def check_param_flow(
     batch: EntryBatch,
     now_ms: jax.Array,
     candidate: jax.Array,     # bool[N]
+    extra_cms: Optional[jax.Array] = None,  # f32[PR, D, W] other devices' sketch
 ) -> ParamVerdict:
     """Vectorized ``ParamFlowChecker.passLocalCheck`` over the micro-batch.
 
     Two evaluation passes (same convention as check_flow): pass 1 computes
     verdicts with every candidate consuming bucket prefixes; pass 2
     restricts prefixes to pass-1 survivors and commits bucket state.
+
+    ``extra_cms`` (pod path): the psum of the OTHER devices' sketches.
+    Sketch addition is the sketch of the union stream, so cluster-mode
+    param rules admit every value against the POD-global window estimate —
+    one-sided like the local sketch, with the same one-step staleness
+    bound as cluster flow rules. Local-mode rules ignore it.
     """
-    # Roll the cold-tier sketch windows first so both passes see one view.
-    # DECAY (halve per elapsed window) instead of zeroing: a hard reset
-    # would zero both est and owner_est at every boundary, letting the
-    # first cold request of a window steal a hot key's slot (promotion
-    # gate no-op). Decay keeps hot keys' counts dominant across rolls;
-    # since est only grows vs. the true in-window count, the one-sided
-    # (never-over-admit) guarantee is preserved — cold keys right after a
-    # roll are judged against ≤½ of last window's estimate on top of
-    # their own usage.
+    # Roll the sketch windows first so both passes see one view (see
+    # roll_sketch_windows; the pod wrapper also calls it BEFORE its psum so
+    # the cross-device extra never carries a stale window).
+    ps = roll_sketch_windows(rt, ps, now_ms)
+    pass1 = _eval_param(rt, ps, batch, now_ms, candidate,
+                        survivors=candidate, commit=False,
+                        extra_cms=extra_cms)
+    return _eval_param(rt, ps, batch, now_ms, candidate,
+                       survivors=candidate & (~pass1.blocked), commit=True,
+                       extra_cms=extra_cms)
+
+
+def roll_sketch_windows(rt: ParamRuleTensors, ps: ParamFlowState,
+                        now_ms: jax.Array) -> ParamFlowState:
+    """Lazy per-rule sketch window roll. The ADMISSION sketch hard-resets
+    each window (it estimates this-window usage only, so quotas refresh
+    fully — one-sided); the PROMOTION sketch decays (halves per elapsed
+    window) so a hot owner's history survives the boundary and the first
+    cold request of a fresh window cannot steal its slot (a zeroed gate
+    would be a no-op there). Idempotent within a window.
+    """
     now64 = now_ms.astype(jnp.int64)
     dur = jnp.maximum(rt.duration_ms, 1)
     win_start = now64 - now64 % dur
     elapsed = jnp.clip((win_start - ps.cms_start) // dur, 0, 30)
     factor = jnp.exp2(-elapsed.astype(jnp.float32))
-    ps = ps._replace(
-        cms=ps.cms * factor[:, None, None],
-        cms_start=jnp.where(elapsed > 0, win_start, ps.cms_start),
+    rolled = elapsed > 0
+    return ps._replace(
+        cms=jnp.where(rolled[:, None, None], 0.0, ps.cms),
+        cms_hot=ps.cms_hot * factor[:, None, None],
+        cms_start=jnp.where(rolled, win_start, ps.cms_start),
     )
-    pass1 = _eval_param(rt, ps, batch, now_ms, candidate,
-                        survivors=candidate, commit=False)
-    return _eval_param(rt, ps, batch, now_ms, candidate,
-                       survivors=candidate & (~pass1.blocked), commit=True)
 
 
 def _eval_param(
@@ -331,6 +353,7 @@ def _eval_param(
     candidate: jax.Array,
     survivors: jax.Array,
     commit: bool,
+    extra_cms: Optional[jax.Array] = None,
 ) -> ParamVerdict:
     n = batch.size
     table_slots = ps.key.shape[1]
@@ -399,6 +422,13 @@ def _eval_param(
         pos = _cms_positions(pv_hash)                    # [N, D]
         est = _cms_min(ps.cms, srule, pos)               # [N]
         avail = jnp.where(fresh, jnp.maximum(max_count - est, 0.0), refilled)
+        if extra_cms is not None:
+            # Pod path: cluster-mode param rules admit EVERY value (owner
+            # included) against the pod-global sketch — local + others'.
+            est_global = _cms_min(ps.cms + extra_cms, srule, pos)
+            cm = g(rt.cluster_mode, False)
+            avail = jnp.where(cm, jnp.maximum(max_count - est_global, 0.0),
+                              avail)
         acqf = batch.count.astype(jnp.float32)
         qps_ok = (thr > 0) & (tok_prefix.astype(jnp.float32) + acqf <= avail)
 
@@ -438,9 +468,10 @@ def _eval_param(
             # takes the slot only when its window count has caught up with
             # the owner's — a cold-key storm can't evict a hot key's exact
             # bucket. Empty slots (key 0) are claimed directly.
-            owner_est = _cms_min(ps.cms, srule, _cms_positions(stored_key))
+            hot_est = _cms_min(ps.cms_hot, srule, pos)
+            owner_est = _cms_min(ps.cms_hot, srule, _cms_positions(stored_key))
             promoted = (admitted & dflt & fresh
-                        & ((stored_key == 0) | (est + acqf >= owner_est)))
+                        & ((stored_key == 0) | (hot_est + acqf >= owner_est)))
             # THREAD / RATE_LIMITER keep direct eviction (no windowed CMS
             # analog for gauges / leaky-bucket heads).
             claim_other = (admitted | (applicable & fresh)) & (is_thread | is_rl)
@@ -478,12 +509,18 @@ def _eval_param(
             # (still never under-estimates).
             cidx = W.oob(jnp.where(admitted & dflt, srule, -1), ps.key.shape[0])
             r0 = jnp.where(srule >= 0, srule, 0)
-            depth_vals = ps.cms[r0[:, None], jnp.arange(CMS_DEPTH)[None, :], pos]
+            darange = jnp.arange(CMS_DEPTH)[None, :]
+            depth_vals = ps.cms[r0[:, None], darange, pos]
             at_min = depth_vals <= depth_vals.min(axis=1, keepdims=True)
             inc = jnp.where((admitted & dflt)[:, None] & at_min, acqf[:, None], 0.0)
             ps = ps._replace(cms=ps.cms.at[
-                cidx[:, None], jnp.arange(CMS_DEPTH)[None, :], pos
-            ].add(inc, mode="drop"))
+                cidx[:, None], darange, pos].add(inc, mode="drop"))
+            hot_vals = ps.cms_hot[r0[:, None], darange, pos]
+            hot_min = hot_vals <= hot_vals.min(axis=1, keepdims=True)
+            hot_inc = jnp.where((admitted & dflt)[:, None] & hot_min,
+                                acqf[:, None], 0.0)
+            ps = ps._replace(cms_hot=ps.cms_hot.at[
+                cidx[:, None], darange, pos].add(hot_inc, mode="drop"))
             # Throttle-mode head advance: head' = latest + consumed · cost.
             # Evicted slots first drop their stale head so .max starts fresh.
             fresh_rl = W.oob(

@@ -207,11 +207,12 @@ def entry_step(
     now_ms: jax.Array,
     extra_pass=None,
     extra_next=None,
+    extra_cms=None,
 ) -> Tuple[SentinelState, Decisions]:
-    """One admission step. ``extra_pass`` / ``extra_next`` (int32[R],
-    optional) are the other devices' pass-count / next-window-usage
-    contributions for cluster-mode rules — supplied by the pod-parallel
-    wrapper (``parallel/cluster.py``) from a ``psum``."""
+    """One admission step. ``extra_pass`` / ``extra_next`` (int32[R]) /
+    ``extra_cms`` (f32[PR, D, W] param sketch), all optional, are the
+    other devices' contributions for cluster-mode rules — supplied by the
+    pod-parallel wrapper (``parallel/cluster.py``) from a ``psum``."""
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, SPEC_1S)
     # Minute-window commits are staged in the [E, R] second accumulator and
@@ -253,7 +254,8 @@ def entry_step(
     blocked = blocked | sys_blocked
 
     cand = valid & (~blocked)
-    pv = P.check_param_flow(rules.param, state.param, batch, now_ms, cand)
+    pv = P.check_param_flow(rules.param, state.param, batch, now_ms, cand,
+                            extra_cms=extra_cms)
     reason = jnp.where(cand & pv.blocked, C.BlockReason.PARAM_FLOW, reason)
     blocked = blocked | pv.blocked
 

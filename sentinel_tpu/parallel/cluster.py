@@ -99,10 +99,20 @@ def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
     w1 = W.rotate(local.w1, now_ms, S.SPEC_1S)
     extra_pass, _ = global_pass_counts(w1, axis)
     extra_next = global_next_window(w1, local.occupied_next, now_ms, axis)
+    # Cluster-mode param rules admit against the pod-global sketch. Roll
+    # the local sketch windows BEFORE the psum: every device rolls at the
+    # same per-rule boundary, so the cross-device extra never carries a
+    # stale window (which would zero the first step of each fresh window).
+    from sentinel_tpu.models import param_flow as PF
+
+    local = local._replace(param=PF.roll_sketch_windows(
+        rules.param, local.param, now_ms))
+    extra_cms = jax.lax.psum(local.param.cms, axis) - local.param.cms
     # Hand the rotated window through so entry_step's own rotate hits the
     # cheap restamp branch instead of re-sweeping the counts tensor.
     new_local, dec = S.entry_step(local._replace(w1=w1), rules, batch, now_ms,
-                                  extra_pass=extra_pass, extra_next=extra_next)
+                                  extra_pass=extra_pass, extra_next=extra_next,
+                                  extra_cms=extra_cms)
     return _expand0(new_local), dec
 
 

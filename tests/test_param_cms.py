@@ -163,3 +163,26 @@ def test_hot_owner_survives_cold_steal_after_window_roll(kit):
     # The hot key still gets its exact fresh-window quota afterwards.
     v = check(ps, _batch(row, np.full(6, hot)), NOW0 + 1001)
     assert int((~np.asarray(v.blocked)).sum()) == 5
+
+
+def test_cold_nonowner_full_quota_every_window(kit):
+    """Regression: a value that never wins its slot (hot owner holds it)
+    still gets its full quota each window — the admission sketch resets
+    while only the promotion sketch decays."""
+    reg, row, rt, check = kit
+    ps = P.make_param_state(rt.num_rules)
+    table = ps.key.shape[1]
+    hot = np.uint32(555_001)
+    cold = np.uint32(int(hot) + table)  # same slot, never promoted
+    for w in range(3):
+        t = NOW0 + w * 1000
+        # hot key re-asserts ownership each window
+        v = check(ps, _batch(row, np.full(6, hot)), t)
+        ps = v.state
+        assert int((~np.asarray(v.blocked)).sum()) == 5, w
+        # the cold value then gets its own full per-value quota too
+        v = check(ps, _batch(row, np.full(6, cold)), t + 1)
+        ps = v.state
+        assert int((~np.asarray(v.blocked)).sum()) == 5, w
+        slot = int(hot) % table
+        assert int(np.asarray(ps.key)[0, slot]) == int(hot), w
