@@ -64,6 +64,12 @@ def get_engine() -> SentinelEngine:
     global _default_engine
     if _default_engine is None:
         _default_engine = SentinelEngine()
+        # doInit AFTER the singleton is installed so @init_func hooks that
+        # use this module API configure THIS engine (reference ordering:
+        # first SphU.entry triggers InitExecutor once Env is ready).
+        from sentinel_tpu.core.spi import run_init_funcs
+
+        run_init_funcs()
     return _default_engine
 
 
@@ -73,6 +79,9 @@ def reset(capacity: int = 4096) -> SentinelEngine:
     if _default_engine is not None:
         _default_engine.close()
     _default_engine = SentinelEngine(capacity)
+    from sentinel_tpu.core.spi import run_init_funcs
+
+    run_init_funcs()
     return _default_engine
 
 
@@ -158,14 +167,26 @@ def load_param_flow_rules(rules) -> None:
     get_engine().param_rules.load_rules(list(rules))
 
 
+from sentinel_tpu.core.spi import (
+    EntryInfo,
+    ProcessorSlot,
+    init_func,
+    register_device_checker,
+    register_slot,
+    unregister_device_checker,
+    unregister_slot,
+)
+
 __all__ = [
     "AuthorityException", "AuthorityRule", "BlockException", "BlockReason",
-    "DegradeException", "DegradeRule", "EntryHandle", "EntryType",
+    "DegradeException", "DegradeRule", "EntryHandle", "EntryInfo", "EntryType",
     "FlowException", "FlowRule", "MetricEvent", "ParamFlowException",
-    "ParamFlowItem", "ParamFlowRule", "ResourceType", "SentinelEngine",
-    "SystemBlockException", "SystemRule", "constants", "context_enter",
-    "entry", "entry_ok", "exit_context", "get_context", "get_engine",
-    "init_ops_plane", "load_authority_rules", "load_degrade_rules",
-    "load_flow_rules", "load_param_flow_rules", "load_system_rules", "reset",
-    "shutdown_ops_plane", "trace",
+    "ParamFlowItem", "ParamFlowRule", "ProcessorSlot", "ResourceType",
+    "SentinelEngine", "SystemBlockException", "SystemRule", "constants",
+    "context_enter", "entry", "entry_ok", "exit_context", "get_context",
+    "get_engine", "init_func", "init_ops_plane", "load_authority_rules",
+    "load_degrade_rules", "load_flow_rules", "load_param_flow_rules",
+    "load_system_rules", "register_device_checker", "register_slot", "reset",
+    "shutdown_ops_plane", "trace", "unregister_device_checker",
+    "unregister_slot",
 ]

@@ -66,6 +66,7 @@ class BlockReason(enum.IntEnum):
     AUTHORITY = 4
     PARAM_FLOW = 5
     WAIT = 6
+    CUSTOM = 7  # SPI-registered device checker (core/spi.py)
 
 
 # ---------------------------------------------------------------------------

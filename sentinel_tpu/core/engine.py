@@ -16,6 +16,7 @@ the same step functions from a background cadence loop.
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -139,9 +140,30 @@ class SentinelEngine:
         self._named_origins: Dict[str, set] = {}
         self._dirty = {"flow": True, "degrade": True, "authority": True,
                        "system": True, "param": True}
-        self._entry_jit = jax.jit(S.entry_step, donate_argnums=(0,))
         self._exit_jit = jax.jit(S.exit_step, donate_argnums=(0,))
         self._flush_jit = jax.jit(S.flush_seconds, donate_argnums=(0,))
+        # SPI boot (reference: Env static init -> InitExecutor.doInit) +
+        # device-checker splice: the step re-jits when registrations change.
+        from sentinel_tpu.core import spi as spi_mod
+
+        self._spi = spi_mod
+        self._spi_version = -1
+        self._entry_jit = None
+        self._rebuild_entry_jit()
+        # Init funcs do NOT run here: an @init_func calling the module API
+        # mid-construction would hit a half-assigned singleton. get_engine()
+        # fires them once the default engine is installed (the reference's
+        # "first SphU.entry triggers doInit" ordering).
+
+    def _rebuild_entry_jit(self):
+        # Version BEFORE checkers: a registration racing between the two
+        # reads then leaves version != snapshot and the next
+        # _ensure_compiled re-runs this (the reverse order would pin a
+        # stale checker set forever).
+        self._spi_version = self._spi.device_version()
+        checkers = self._spi.device_checkers()
+        step = functools.partial(S.entry_step, extra_checkers=checkers)
+        self._entry_jit = jax.jit(step, donate_argnums=(0,))
 
     # -- rule compilation --------------------------------------------------
 
@@ -174,6 +196,8 @@ class SentinelEngine:
         leaves circuit-breaker state intact, and vice versa. Node stats
         always survive.
         """
+        if self._spi_version != self._spi.device_version():
+            self._rebuild_entry_jit()  # SPI device checker set changed
         if self._state is None:
             now = time_util.current_time_millis()
             ft, named = F.compile_flow_rules(
@@ -315,6 +339,40 @@ class SentinelEngine:
             return EntryHandle(self, resource, ctx, -1, -1, -1, entry_in, count, ())
 
         params = tuple(_hash_param(a) for a in args[:MAX_PARAMS])
+
+        # SPI host slots (core/spi.py): a slot raising a BlockException
+        # rejects the entry; the block is committed to statistics first
+        # (the reference's StatisticSlot records custom-slot rejections).
+        custom_ex = None
+        slots = self._spi.host_slots()
+        if slots:
+            info = self._spi.EntryInfo(resource=resource, origin=ctx.origin,
+                             count=count, entry_type=int(entry_type),
+                             prioritized=prioritized, args=tuple(args),
+                             context_name=ctx.name)
+            for slot in slots:
+                try:
+                    slot.on_entry(info)
+                except BlockException as ex:
+                    custom_ex = ex
+                    break
+                except Exception:
+                    # A buggy slot must not leak the auto-created context
+                    # (it would shadow the thread's next ContextUtil.enter).
+                    ctx_mod.auto_exit_context()
+                    raise
+        if custom_ex is not None:
+            self._submit_entry(
+                resource, cluster_row, dn_row, origin_row, origin_id,
+                reg.context_id(ctx.name), count, prioritized, entry_in,
+                params, skip_cluster=True, pre_blocked=True)
+            ctx_mod.auto_exit_context()
+            from sentinel_tpu.log.record_log import log_block
+
+            log_block(resource, type(custom_ex).__name__, ctx.origin, count,
+                      time_util.current_time_millis())
+            raise custom_ex
+
         skip_cluster, pre_blocked = self._cluster_token_check(
             resource, count, prioritized, args)
         reason, wait_us = self._submit_entry(
@@ -487,6 +545,23 @@ class SentinelEngine:
             return
         now = time_util.current_time_millis()
         rt = max(0, now - handle.created_ms)
+        slots = self._spi.host_slots()
+        if slots:
+            info = self._spi.EntryInfo(
+                resource=handle.resource, origin=ctx.origin, count=count,
+                entry_type=(C.EntryType.IN if handle.entry_in
+                            else C.EntryType.OUT),
+                prioritized=False, args=(), context_name=ctx.name)
+            for slot in slots:
+                try:
+                    slot.on_exit(info, rt, handle.error)
+                except Exception as ex:
+                    # Exit hooks never break the real exit, but a broken
+                    # slot must be observable, not silent.
+                    from sentinel_tpu.log.record_log import record_log
+
+                    record_log.warn("SPI slot %r on_exit failed: %r",
+                                    type(slot).__name__, ex)
         fields = dict(
             cluster_row=handle.cluster_row, dn_row=handle.dn_row,
             origin_row=handle.origin_row, entry_in=handle.entry_in,

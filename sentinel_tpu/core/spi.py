@@ -1,0 +1,253 @@
+"""SPI / extension mechanism (reference: ``core:init/InitFunc`` +
+``@InitOrder`` + ``spi/SpiLoader`` + the ``SlotChainBuilder`` seam that
+lets the param-flow module splice ``ParamFlowSlot`` into the chain —
+SURVEY.md §2.1 "Init & SPI", §1 L3).
+
+Three extension seams, Python-native:
+
+  * **Init funcs** — ``@init_func(order=...)`` callables (plus anything on
+    the ``sentinel_tpu.init_funcs`` entry-point group) run exactly once at
+    first engine construction, mirroring ``InitExecutor.doInit`` firing on
+    the first ``SphU.entry``.
+  * **Host slots** — :class:`ProcessorSlot` objects with ``on_entry`` /
+    ``on_exit`` hooks wrapped around every ``engine.entry()`` call.
+    ``on_entry`` may raise a ``BlockException`` subclass to reject the
+    request; the engine commits the block to statistics (the reference's
+    StatisticSlot records custom-slot rejections the same way) before the
+    exception reaches the caller. Discovered from the
+    ``sentinel_tpu.slots`` entry-point group or registered directly.
+  * **Device checkers** — pure JAX functions spliced INTO the fused
+    admission step between the param-flow and flow slots (the reference's
+    SPI splice point): ``fn(state, rules, batch, now_ms, candidate) ->
+    blocked bool[N]``. Registration bumps a version; the engine re-jits
+    its step on the next entry, the same recompile semantics as a rule
+    push. Verdicts surface as ``BlockReason.CUSTOM`` /
+    :class:`~sentinel_tpu.core.exceptions.BlockException`.
+
+The jitted chain can't host arbitrary Python mid-kernel, so the reference's
+single linked-slot abstraction splits into the host pair (arbitrary code,
+per-entry) and the device seam (pure array code, fused); together they
+cover what custom ``ProcessorSlot``s do upstream.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+_lock = threading.RLock()
+
+
+def _entry_points(group: str):
+    try:
+        from importlib.metadata import entry_points
+
+        return list(entry_points(group=group))
+    except Exception:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Init funcs
+# ---------------------------------------------------------------------------
+
+_init_funcs: List[Tuple[int, Callable[[], None]]] = []
+_init_done = False
+_init_complete = threading.Event()
+_init_thread: Optional[threading.Thread] = None
+
+
+def init_func(order: int = 0):
+    """Register a one-shot boot hook (reference: ``@InitOrder`` +
+    ``InitFunc``). Runs at first engine construction; registering after
+    boot runs the hook immediately (late-loaded extension modules)."""
+
+    def deco(fn: Callable[[], None]):
+        with _lock:
+            if _init_done:
+                fn()
+            else:
+                _init_funcs.append((order, fn))
+        return fn
+
+    return deco
+
+
+def run_init_funcs() -> None:
+    """Idempotent ``InitExecutor.doInit``: entry-point group first, then
+    registered funcs, ordered.
+
+    Losers of the boot race WAIT until the winner's hooks finish, so no
+    thread can use a half-initialized engine (hooks calling back into this
+    module from the boot thread return immediately instead of
+    deadlocking).
+    """
+    global _init_done, _init_thread
+    with _lock:
+        if _init_done:
+            runner = False
+        else:
+            _init_done = True
+            _init_thread = threading.current_thread()
+            runner = True
+            for ep in _entry_points("sentinel_tpu.init_funcs"):
+                try:
+                    fn = ep.load()
+                    _init_funcs.append((getattr(fn, "__init_order__", 0), fn))
+                except Exception:
+                    from sentinel_tpu.log.record_log import record_log
+
+                    record_log.warn("init entry point %s failed to load", ep)
+            funcs = sorted(_init_funcs, key=lambda t: t[0])
+    if not runner:
+        if threading.current_thread() is _init_thread:
+            return  # re-entrant call from inside an init func
+        _init_complete.wait(timeout=60)
+        return
+    try:
+        for _, fn in funcs:
+            try:
+                fn()
+            except Exception as ex:
+                from sentinel_tpu.log.record_log import record_log
+
+                record_log.warn("init func %r failed: %r", fn, ex)
+    finally:
+        _init_complete.set()
+
+
+def reset_spi_for_tests() -> None:
+    global _init_done, _slots_loaded
+    with _lock:
+        _init_done = False
+        _init_complete.clear()
+        _init_funcs.clear()
+        _slots.clear()
+        _slots_loaded = False  # entry-point slots reload like init funcs do
+        _rebuild_slot_cache()
+        _device_checkers.clear()
+        bump_device_version()
+
+
+# ---------------------------------------------------------------------------
+# Host slots
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EntryInfo:
+    """What a host slot sees (reference: the slot-chain arguments)."""
+
+    resource: str
+    origin: str
+    count: int
+    entry_type: int
+    prioritized: bool
+    args: Sequence
+    context_name: str
+
+
+class ProcessorSlot:
+    """Host-side custom slot. Subclass and override either hook."""
+
+    def on_entry(self, info: EntryInfo) -> None:
+        """Raise a BlockException subclass to reject the entry."""
+
+    def on_exit(self, info: EntryInfo, rt_ms: int, error: bool) -> None:
+        pass
+
+
+_slots: List[Tuple[int, ProcessorSlot]] = []
+_slots_loaded = False
+# Immutable snapshot read lock-free on the hot path (GIL-atomic attribute
+# read; rebuilt under the lock on every mutation). The common zero-slot
+# deployment costs one tuple read per entry/exit, no lock.
+_slots_cache: Tuple[ProcessorSlot, ...] = ()
+
+
+def _rebuild_slot_cache() -> None:
+    global _slots_cache
+    _slots.sort(key=lambda t: t[0])
+    _slots_cache = tuple(s for _, s in _slots)
+
+
+def register_slot(slot: ProcessorSlot, order: int = 0) -> None:
+    with _lock:
+        _slots.append((order, slot))
+        _rebuild_slot_cache()
+
+
+def unregister_slot(slot: ProcessorSlot) -> None:
+    with _lock:
+        _slots[:] = [(o, s) for o, s in _slots if s is not slot]
+        _rebuild_slot_cache()
+
+
+def _load_slot_entry_points() -> None:
+    global _slots_loaded
+    with _lock:
+        if _slots_loaded:
+            return
+        _slots_loaded = True
+        for ep in _entry_points("sentinel_tpu.slots"):
+            try:
+                slot = ep.load()()
+                # order comes from the LOADED slot (EntryPoint objects
+                # carry no such attribute), like __init_order__ for inits.
+                _slots.append((getattr(slot, "__slot_order__", 0), slot))
+            except Exception:
+                from sentinel_tpu.log.record_log import record_log
+
+                record_log.warn("slot entry point %s failed to load", ep)
+        _rebuild_slot_cache()
+
+
+def host_slots() -> Tuple[ProcessorSlot, ...]:
+    if not _slots_loaded:
+        _load_slot_entry_points()
+    return _slots_cache
+
+
+# ---------------------------------------------------------------------------
+# Device checkers
+# ---------------------------------------------------------------------------
+
+# fn(state, rules, batch, now_ms, candidate) -> blocked bool[N]; must be a
+# pure traceable JAX function (it runs inside the fused jitted step).
+DeviceChecker = Callable
+
+_device_checkers: List[Tuple[int, str, DeviceChecker]] = []
+_device_version = 0
+
+
+def bump_device_version() -> None:
+    global _device_version
+    _device_version += 1
+
+
+def register_device_checker(fn: DeviceChecker, order: int = 0,
+                            name: Optional[str] = None) -> None:
+    """Splice a pure-JAX verdict into the fused step (before the flow
+    slot — the reference's ParamFlowSlot splice point). Engines re-jit on
+    their next entry."""
+    with _lock:
+        _device_checkers.append((order, name or getattr(fn, "__name__", "custom"), fn))
+        _device_checkers.sort(key=lambda t: t[0])
+        bump_device_version()
+
+
+def unregister_device_checker(fn: DeviceChecker) -> None:
+    with _lock:
+        _device_checkers[:] = [t for t in _device_checkers if t[2] is not fn]
+        bump_device_version()
+
+
+def device_checkers() -> Tuple[DeviceChecker, ...]:
+    with _lock:
+        return tuple(fn for _, _, fn in _device_checkers)
+
+
+def device_version() -> int:
+    with _lock:
+        return _device_version

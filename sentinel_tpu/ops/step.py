@@ -208,11 +208,16 @@ def entry_step(
     extra_pass=None,
     extra_next=None,
     extra_cms=None,
+    extra_checkers: tuple = (),
 ) -> Tuple[SentinelState, Decisions]:
     """One admission step. ``extra_pass`` / ``extra_next`` (int32[R]) /
     ``extra_cms`` (f32[PR, D, W] param sketch), all optional, are the
     other devices' contributions for cluster-mode rules — supplied by the
-    pod-parallel wrapper (``parallel/cluster.py``) from a ``psum``."""
+    pod-parallel wrapper (``parallel/cluster.py``) from a ``psum``.
+
+    ``extra_checkers``: SPI-registered pure device checkers (core/spi.py),
+    spliced between the param-flow and flow slots — the reference's
+    SlotChainBuilder splice point. Static (closed over at jit time)."""
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, SPEC_1S)
     # Minute-window commits are staged in the [E, R] second accumulator and
@@ -258,6 +263,13 @@ def entry_step(
                             extra_cms=extra_cms)
     reason = jnp.where(cand & pv.blocked, C.BlockReason.PARAM_FLOW, reason)
     blocked = blocked | pv.blocked
+
+    for chk in extra_checkers:
+        cand = valid & (~blocked)
+        custom_blocked = cand & chk(state._replace(w1=w1), rules, batch,
+                                    now_ms, cand)
+        reason = jnp.where(custom_blocked, C.BlockReason.CUSTOM, reason)
+        blocked = blocked | custom_blocked
 
     fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked,
                       extra_pass=extra_pass, occupied_next=occupied_next,

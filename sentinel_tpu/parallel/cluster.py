@@ -93,26 +93,31 @@ def global_next_window(w1: W.Window, occupied_next: jax.Array, now_ms: jax.Array
 
 
 def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
-               now_ms: jax.Array, *, axis: str) -> Tuple[S.SentinelState, Decisions]:
+               now_ms: jax.Array, *, axis: str, cluster_param: bool,
+               extra_checkers: tuple = ()) -> Tuple[S.SentinelState, Decisions]:
     local = _squeeze0(state)
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(local.w1, now_ms, S.SPEC_1S)
     extra_pass, _ = global_pass_counts(w1, axis)
     extra_next = global_next_window(w1, local.occupied_next, now_ms, axis)
-    # Cluster-mode param rules admit against the pod-global sketch. Roll
-    # the local sketch windows BEFORE the psum: every device rolls at the
-    # same per-rule boundary, so the cross-device extra never carries a
-    # stale window (which would zero the first step of each fresh window).
-    from sentinel_tpu.models import param_flow as PF
+    extra_cms = None
+    if cluster_param:
+        # Cluster-mode param rules admit against the pod-global sketch.
+        # Roll the local sketch windows BEFORE the psum: every device
+        # rolls at the same per-rule boundary, so the cross-device extra
+        # never carries a stale window (which would zero the first step
+        # of each fresh window).
+        from sentinel_tpu.models import param_flow as PF
 
-    local = local._replace(param=PF.roll_sketch_windows(
-        rules.param, local.param, now_ms))
-    extra_cms = jax.lax.psum(local.param.cms, axis) - local.param.cms
+        local = local._replace(param=PF.roll_sketch_windows(
+            rules.param, local.param, now_ms))
+        extra_cms = jax.lax.psum(local.param.cms, axis) - local.param.cms
     # Hand the rotated window through so entry_step's own rotate hits the
     # cheap restamp branch instead of re-sweeping the counts tensor.
     new_local, dec = S.entry_step(local._replace(w1=w1), rules, batch, now_ms,
                                   extra_pass=extra_pass, extra_next=extra_next,
-                                  extra_cms=extra_cms)
+                                  extra_cms=extra_cms,
+                                  extra_checkers=extra_checkers)
     return _expand0(new_local), dec
 
 
@@ -122,16 +127,28 @@ def _pod_exit(state: S.SentinelState, rules: S.RulePack, batch: ExitBatch,
     return _expand0(S.exit_step(_squeeze0(state), rules, batch, now_ms))
 
 
-def make_pod_steps(mesh: Mesh, axis: str = AXIS):
+def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True):
     """Build (entry_step, exit_step) shard_mapped over ``mesh[axis]``.
 
     State leaves carry a leading device axis (sharded); batches are sharded
     over the request axis; rules and ``now_ms`` are replicated. The returned
     functions are jittable; callers wrap them in ``jax.jit`` with state
     donation.
+
+    ``cluster_param=False`` drops the param-sketch all-reduce (a
+    [PR, 4, 2048] f32 psum per step) for deployments with no cluster-mode
+    param rules — a static choice, like rule compilation itself.
+
+    SPI device checkers (core/spi.py) registered at BUILD time are spliced
+    into the pod step like the single-device engine's; later registrations
+    need a fresh ``make_pod_steps`` (pod callers own their jit lifecycle —
+    watch ``spi.device_version()`` the way the engine does).
     """
+    from sentinel_tpu.core import spi as _spi
+
     entry = _shard_map(
-        functools.partial(_pod_entry, axis=axis),
+        functools.partial(_pod_entry, axis=axis, cluster_param=cluster_param,
+                          extra_checkers=_spi.device_checkers()),
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P()),
         out_specs=(P(axis), P(axis)),

@@ -1,0 +1,130 @@
+"""SPI / extension mechanism tests (reference: ``core:init/InitFunc`` +
+``SpiLoader`` + the slot-chain splice seam — SURVEY.md §2.1, §1 L3)."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core import spi
+
+
+@pytest.fixture(autouse=True)
+def _clean_spi():
+    spi.reset_spi_for_tests()
+    yield
+    spi.reset_spi_for_tests()
+
+
+def test_init_funcs_run_once_at_engine_boot(engine):
+    calls = []
+    spi.reset_spi_for_tests()
+
+    @st.init_func(order=2)
+    def later():
+        calls.append("later")
+
+    @st.init_func(order=1)
+    def earlier():
+        calls.append("earlier")
+
+    st.reset(capacity=512)  # new engine boots -> doInit
+    assert calls == ["earlier", "later"]
+    st.reset(capacity=512)  # second boot: already done, no re-run
+    assert calls == ["earlier", "later"]
+
+
+def test_init_func_registered_after_boot_runs_immediately(engine):
+    engine._ensure_compiled()  # engine booted; _init_done is True
+    calls = []
+
+    @st.init_func()
+    def late():
+        calls.append(1)
+
+    assert calls == [1]
+
+
+def test_host_slot_blocks_and_records(engine, frozen_time):
+    """A custom host slot rejecting a resource: typed exception reaches the
+    caller AND the block lands in statistics (StatisticSlot semantics)."""
+
+    class DenySlot(st.ProcessorSlot):
+        def on_entry(self, info):
+            if info.resource == "forbidden":
+                raise st.FlowException(info.resource)
+
+    slot = DenySlot()
+    st.register_slot(slot, order=-10)
+    try:
+        with pytest.raises(st.FlowException):
+            st.entry("forbidden")
+        assert st.entry_ok("allowed")  # other resources untouched
+        snap = engine.node_snapshot()
+        assert snap["forbidden"]["blockQps"] == 1
+        assert snap["forbidden"]["passQps"] == 0
+    finally:
+        st.unregister_slot(slot)
+    # unregistered: passes again
+    assert st.entry_ok("forbidden")
+
+
+def test_host_slot_exit_hook_sees_rt_and_error(engine, frozen_time):
+    seen = []
+
+    class Watch(st.ProcessorSlot):
+        def on_exit(self, info, rt_ms, error):
+            seen.append((info.resource, rt_ms, error))
+
+    slot = Watch()
+    st.register_slot(slot)
+    try:
+        h = st.entry("watched")
+        frozen_time.advance_time(25)
+        h.trace(ValueError("boom"))
+        h.exit()
+    finally:
+        st.unregister_slot(slot)
+    assert seen == [("watched", 25, True)]
+
+
+def test_device_checker_spliced_into_fused_step(engine, frozen_time):
+    """A pure-JAX checker registered via SPI blocks inside the jitted
+    chain (reason CUSTOM), and deregistration re-jits it away."""
+    import jax.numpy as jnp
+
+    def cap_big_acquires(state, rules, batch, now_ms, candidate):
+        return candidate & (batch.count > 3)
+
+    st.register_device_checker(cap_big_acquires)
+    try:
+        assert st.entry_ok("r", count=3)  # under the custom cap
+        with pytest.raises(st.BlockException) as e:
+            st.entry("r", count=4)
+        assert not isinstance(e.value, st.FlowException)  # base custom type
+        snap = engine.node_snapshot()
+        assert snap["r"]["blockQps"] == 4  # token-weighted, count=4
+    finally:
+        st.unregister_device_checker(cap_big_acquires)
+    assert st.entry_ok("r", count=4)  # re-jitted without the checker
+
+
+def test_device_checker_can_read_window_state(engine, frozen_time):
+    """Checkers get the live rotated window: a custom 'max 2 per second
+    pod-row' rule built from w1 totals alone."""
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core import constants as CC
+    from sentinel_tpu.ops import window as W
+
+    def two_per_second(state, rules, batch, now_ms, candidate):
+        totals = W.row_totals(state.w1, batch.cluster_row)
+        used = totals[:, CC.MetricEvent.PASS]
+        return candidate & (used >= 2)
+
+    st.register_device_checker(two_per_second)
+    try:
+        got = sum(1 for _ in range(5) if st.entry_ok("w2"))
+        assert got == 2
+    finally:
+        st.unregister_device_checker(two_per_second)
