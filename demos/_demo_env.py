@@ -1,0 +1,21 @@
+"""Shared demo bootstrap: pin JAX to CPU before anything imports it.
+
+(Remove the pin on a TPU host — everything else is identical.)
+"""
+
+import os
+import sys
+
+# Repo root on sys.path so the demos run from a checkout without an
+# install (sys.path, not PYTHONPATH — the env var breaks TPU-plugin
+# discovery on some hosts).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Force CPU (the host image may pre-set JAX_PLATFORMS to its accelerator);
+# export SENTINEL_DEMO_PLATFORM to drive a real device instead.
+platform = os.environ.get("SENTINEL_DEMO_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = platform
+
+import jax
+
+jax.config.update("jax_platforms", platform)

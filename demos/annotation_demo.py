@@ -1,0 +1,36 @@
+"""@SentinelResource demo (reference: ``sentinel-demo-annotation-spring-aop``):
+decorate a function, route blocks to a blockHandler and business errors to
+a fallback."""
+
+import _demo_env  # noqa: F401
+
+import sentinel_tpu as st
+from sentinel_tpu.adapters.annotation import sentinel_resource
+
+
+def on_block(name, ex):
+    return f"degraded({name})"
+
+
+def on_error(name, ex):
+    return f"fallback({ex})"
+
+
+@sentinel_resource("greet", block_handler=on_block, fallback=on_error)
+def greet(who: str) -> str:
+    if who == "oops":
+        raise ValueError("bad input")
+    return f"hello {who}"
+
+
+st.load_flow_rules([st.FlowRule(resource="greet", count=3)])
+
+# Absorb the XLA compile so the calls below share one 1s window.
+h = st.entry_ok("warmup")
+if h:
+    h.exit()
+
+# 'oops' passes admission, raises inside -> fallback; ada + grace pass;
+# linus is the 4th acquire in the window -> blockHandler.
+for who in ["oops", "ada", "grace", "linus"]:
+    print(f"greet({who!r}) -> {greet(who)!r}")

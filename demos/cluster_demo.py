@@ -1,0 +1,32 @@
+"""Cluster demo (reference: ``sentinel-demo-cluster``): an embedded token
+server serves a GLOBAL quota over TCP; this process flips to SERVER mode,
+loads a cluster rule, and a token client (the same path every other
+instance would use) acquires against the shared window."""
+
+import _demo_env  # noqa: F401
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.constants import THRESHOLD_GLOBAL, TokenResultStatus
+
+eng = st.get_engine()
+
+# Stage cluster rules, then flip this instance to SERVER (the ops plane
+# does the same via cluster/server/modifyFlowRules + setClusterMode=1).
+eng.cluster.server_rules().load_rules("default", [st.FlowRule(
+    resource="sharedApi", count=5, cluster_mode=True,
+    cluster_config={"flowId": 101, "thresholdType": THRESHOLD_GLOBAL})])
+eng.cluster.apply_mode(1)
+port = eng.cluster.token_server.bound_port
+print(f"embedded token server on :{port}")
+
+client = ClusterTokenClient("127.0.0.1", port, "default").start()
+names = {TokenResultStatus.OK: "OK", TokenResultStatus.BLOCKED: "BLOCKED"}
+try:
+    for i in range(8):
+        r = client.request_token(101, 1)
+        print(f"acquire #{i + 1}: {names.get(r.status, r.status)}"
+              + (f" (remaining={r.remaining})" if r.status == 0 else ""))
+finally:
+    client.stop()
+    eng.cluster.stop()

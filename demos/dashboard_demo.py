@@ -1,0 +1,47 @@
+"""Dashboard demo: engine + command center + heartbeat + metric log +
+dashboard with live UI at http://127.0.0.1:8080/ — open it and watch the
+pass/block chart while the traffic loop runs (Ctrl-C to stop)."""
+
+import _demo_env  # noqa: F401
+
+import os
+import random
+import tempfile
+import time
+
+os.environ.setdefault("CSP_SENTINEL_HEARTBEAT_CLIENT_IP", "127.0.0.1")
+log_dir = tempfile.mkdtemp(prefix="sentinel-demo-logs-")
+os.environ.setdefault("CSP_SENTINEL_LOG_DIR", log_dir)
+os.environ.setdefault("PROJECT_NAME", "demo-app")
+
+import sentinel_tpu as st
+from sentinel_tpu.dashboard import DashboardServer
+from sentinel_tpu.metrics.timer import MetricTimerListener
+from sentinel_tpu.metrics.writer import MetricWriter
+from sentinel_tpu.transport.command_center import CommandCenter
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+dash = DashboardServer(port=8080).start()
+eng = st.get_engine()
+center = CommandCenter(eng, port=0).start()
+timer = MetricTimerListener(eng, MetricWriter(app="demo-app",
+                                              base_dir=log_dir)).start()
+hb = HeartbeatSender(dashboards=["127.0.0.1:8080"],
+                     api_port=center.bound_port, interval_ms=5000).start()
+hb.send_once()
+
+st.load_flow_rules([st.FlowRule(resource="getUser", count=25),
+                    st.FlowRule(resource="listOrders", count=8)])
+print("dashboard: http://127.0.0.1:8080/  (Ctrl-C stops)")
+
+try:
+    while True:
+        for res, n in (("getUser", random.randint(10, 35)),
+                       ("listOrders", random.randint(3, 14))):
+            for _ in range(n):
+                h = st.entry_ok(res)
+                if h:
+                    h.exit()
+        time.sleep(1.0)
+except KeyboardInterrupt:
+    pass

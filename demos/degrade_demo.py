@@ -1,0 +1,39 @@
+"""Circuit-breaker demo (reference: ``sentinel-demo-basic`` degrade demos):
+an exception-ratio breaker OPENs under failures, rejects while open, then
+HALF_OPENs a probe and CLOSEs when the service recovers."""
+
+import _demo_env  # noqa: F401
+
+import time
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+
+st.load_degrade_rules([st.DegradeRule(
+    resource="svc", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO, count=0.5,
+    time_window=2, min_request_amount=5, stat_interval_ms=1000)])
+
+broken = True
+
+
+def call_service():
+    with st.entry("svc") as h:
+        if broken:
+            h.trace(RuntimeError("backend down"))
+            return "error"
+        return "ok"
+
+
+st.entry_ok("_warmup")  # absorb the XLA compile before the timed loop
+
+phase = "failing"
+for i in range(40):
+    if i == 15:
+        broken = False
+        phase = "recovered"
+    try:
+        result = call_service()
+        print(f"{i:2d} [{phase}] call -> {result}")
+    except st.DegradeException:
+        print(f"{i:2d} [{phase}] SHORT-CIRCUITED (breaker open)")
+    time.sleep(0.2)

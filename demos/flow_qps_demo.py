@@ -1,0 +1,36 @@
+"""FlowQpsDemo (reference: ``sentinel-demo-basic``'s ``FlowQpsDemo`` —
+BASELINE config #1): one resource under a 20 QPS rule, hammered for three
+seconds; watch pass/block counts per second."""
+
+import _demo_env  # noqa: F401  (pins JAX platform; import first)
+
+import time
+from collections import Counter
+
+import sentinel_tpu as st
+
+QPS_LIMIT = 20
+
+st.load_flow_rules([st.FlowRule(resource="methodA", count=QPS_LIMIT)])
+
+h = st.entry_ok("_warmup")  # absorb the XLA compile before timing
+if h:
+    h.exit()
+
+per_second = Counter()
+t_end = time.time() + 3
+while time.time() < t_end:
+    sec = int(time.time())
+    try:
+        with st.entry("methodA"):
+            per_second[(sec, "pass")] += 1
+    except st.FlowException:
+        per_second[(sec, "block")] += 1
+
+for sec in sorted({s for s, _ in per_second}):
+    p, b = per_second[(sec, "pass")], per_second[(sec, "block")]
+    print(f"{time.strftime('%H:%M:%S', time.localtime(sec))}  "
+          f"pass={p:4d}  block={b:5d}  (limit {QPS_LIMIT}/s)")
+
+snap = st.get_engine().node_snapshot()["methodA"]
+print("live node:", {k: snap[k] for k in ("passQps", "blockQps")})

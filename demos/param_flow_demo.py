@@ -1,0 +1,23 @@
+"""Hot-parameter demo (reference: ``sentinel-demo-parameter-flow-control``):
+per-value quotas — a hot user is limited while everyone else passes, and a
+ParamFlowItem grants one VIP a higher quota."""
+
+import _demo_env  # noqa: F401
+
+import sentinel_tpu as st
+
+st.load_param_flow_rules([st.ParamFlowRule(
+    "getUser", param_idx=0, count=2,
+    items=[st.ParamFlowItem(object="vip", count=100)])])
+
+# One throwaway call absorbs the XLA compile (~30s on CPU) so the loop
+# below runs inside a single one-second window.
+h = st.entry_ok("getUser", args=["_warmup"])
+if h:
+    h.exit()
+
+for user in ["alice", "alice", "alice", "bob", "vip", "vip", "vip", "vip"]:
+    ok = st.entry_ok("getUser", args=[user])
+    print(f"getUser({user!r}) -> {'pass' if ok else 'BLOCKED'}")
+    if ok:
+        ok.exit()

@@ -1,0 +1,29 @@
+"""WarmUpFlowDemo (reference: ``sentinel-demo-basic``): a cold system is
+throttled to count/coldFactor and ramps to the full threshold over the
+warm-up period."""
+
+import _demo_env  # noqa: F401
+
+import time
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+
+st.load_flow_rules([st.FlowRule(
+    resource="warm", count=30, control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+    warm_up_period_sec=6)])
+
+h = st.entry_ok("_warmup")  # absorb the XLA compile before timing
+if h:
+    h.exit()
+
+print("cold start: expect ~10/s (count/coldFactor), ramping to 30/s")
+for second in range(8):
+    passed = blocked = 0
+    t_end = time.time() + 1
+    while time.time() < t_end:
+        if st.entry_ok("warm"):
+            passed += 1
+        else:
+            blocked += 1
+    print(f"t={second}s  pass={passed:3d}  block={blocked:5d}")
