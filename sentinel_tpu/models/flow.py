@@ -97,6 +97,10 @@ class FlowRuleTensors(NamedTuple):
     cluster_mode: jax.Array   # bool[FR]
     remote_mode: jax.Array    # bool[FR] cluster rule WITH a flowId: enforced
                               # by a remote token server when one is active
+    dcn_mode: jax.Array       # bool[FR] cluster rule with scope="global":
+                              # admits against the CROSS-POD window (psum
+                              # over the dcn axis too — SURVEY §2.10
+                              # namespace sharding); default pod scope
     rules_by_row: jax.Array   # int32[R, K] rule ids per ClusterNode row, -1 pad
 
     @property
@@ -170,6 +174,7 @@ def compile_flow_rules(
     slope = np.zeros(fr, np.float32)
     cluster_mode = np.zeros(fr, bool)
     remote_mode = np.zeros(fr, bool)
+    dcn_mode = np.zeros(fr, bool)
 
     named_origins = named_origin_map(valid, registry)
     by_row: Dict[int, List[int]] = {}
@@ -184,6 +189,8 @@ def compile_flow_rules(
         cluster_mode[i] = r.cluster_mode
         remote_mode[i] = (r.cluster_mode
                           and (r.cluster_config or {}).get("flowId") is not None)
+        dcn_mode[i] = (r.cluster_mode
+                       and (r.cluster_config or {}).get("scope") == "global")
         if r.limit_app == C.LIMIT_APP_DEFAULT:
             limit_origin[i] = C.ORIGIN_ID_DEFAULT
         elif r.limit_app == C.LIMIT_APP_OTHER:
@@ -250,6 +257,7 @@ def compile_flow_rules(
         slope=jnp.asarray(slope),
         cluster_mode=jnp.asarray(cluster_mode),
         remote_mode=jnp.asarray(remote_mode),
+        dcn_mode=jnp.asarray(dcn_mode),
         rules_by_row=jnp.asarray(rules_by_row),
     )
     return t, named_origins
@@ -319,6 +327,8 @@ def check_flow(
     extra_pass: Optional[jax.Array] = None,  # int32[R] other-device pass counts
     occupied_next: Optional[jax.Array] = None,  # int32[R] borrows on next bucket
     extra_next: Optional[jax.Array] = None,  # int32[R] other-device next-window use
+    extra_pass_global: Optional[jax.Array] = None,  # int32[R] cross-POD passes
+    extra_next_global: Optional[jax.Array] = None,  # int32[R] cross-POD next use
 ) -> FlowVerdict:
     """Vectorized ``FlowRuleChecker.checkFlow`` over the micro-batch.
 
@@ -348,11 +358,13 @@ def check_flow(
     blocked1, _, _, _, _ = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
+        extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
     )
     blocked, wait_us, consumed, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
         survivors=candidate & (~blocked1), extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
+        extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
     )
 
     # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
@@ -377,6 +389,8 @@ def _eval_flow_slots(
     extra_pass: Optional[jax.Array] = None,
     occupied_next: Optional[jax.Array] = None,
     extra_next: Optional[jax.Array] = None,
+    extra_pass_global: Optional[jax.Array] = None,
+    extra_next_global: Optional[jax.Array] = None,
 ):
     """One vectorized sweep over all rule slots.
 
@@ -472,11 +486,18 @@ def _eval_flow_slots(
         if extra_pass is not None:
             # Cluster-mode rules admit against the POD-global window: add
             # the psum'd pass counts of the other devices (the TPU-native
-            # token server — SURVEY.md §2.11). Local-mode rules stay local.
+            # token server — SURVEY.md §2.11). scope="global" rules admit
+            # against the CROSS-POD window instead (psum over the dcn axis
+            # too — namespace sharding, SURVEY §2.10). Local rules stay
+            # local.
             cm = g(rt.cluster_mode, False)
-            used_qps = used_qps + jnp.where(
-                cm, _gather(extra_pass, sel_row, 0).astype(jnp.float32), 0.0
-            )
+            extra = _gather(extra_pass, sel_row, 0).astype(jnp.float32)
+            if extra_pass_global is not None:
+                extra = jnp.where(
+                    g(rt.dcn_mode, False),
+                    _gather(extra_pass_global, sel_row, 0).astype(jnp.float32),
+                    extra)
+            used_qps = used_qps + jnp.where(cm, extra, 0.0)
         used_thr = (
             _gather(cur_threads, sel_row, 0).astype(jnp.float32)
             + ent_prefix.astype(jnp.float32)
@@ -547,13 +568,17 @@ def _eval_flow_slots(
             )
             if extra_next is not None:
                 # Cluster-mode rules borrow against the POD-global next
-                # window: fold in the other devices' psum'd next-window
-                # usage, or every device would grant up to the full global
-                # threshold independently.
+                # window (global-scope rules: cross-pod): fold in the other
+                # devices' psum'd next-window usage, or every device would
+                # grant up to the full global threshold independently.
+                en = _gather(extra_next, sel_row, 0).astype(jnp.float32)
+                if extra_next_global is not None:
+                    en = jnp.where(
+                        g(rt.dcn_mode, False),
+                        _gather(extra_next_global, sel_row, 0).astype(jnp.float32),
+                        en)
                 next_used = next_used + jnp.where(
-                    g(rt.cluster_mode, False),
-                    _gather(extra_next, sel_row, 0).astype(jnp.float32), 0.0
-                )
+                    g(rt.cluster_mode, False), en, 0.0)
             grant = occ_cand & (next_used + acq <= thr) & (
                 occ_wait_us <= C.DEFAULT_OCCUPY_TIMEOUT_MS * 1000
             )

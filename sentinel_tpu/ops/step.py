@@ -209,6 +209,8 @@ def entry_step(
     extra_next=None,
     extra_cms=None,
     extra_checkers: tuple = (),
+    extra_pass_global=None,
+    extra_next_global=None,
 ) -> Tuple[SentinelState, Decisions]:
     """One admission step. ``extra_pass`` / ``extra_next`` (int32[R]) /
     ``extra_cms`` (f32[PR, D, W] param sketch), all optional, are the
@@ -273,7 +275,9 @@ def entry_step(
 
     fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked,
                       extra_pass=extra_pass, occupied_next=occupied_next,
-                      extra_next=extra_next)
+                      extra_next=extra_next,
+                      extra_pass_global=extra_pass_global,
+                      extra_next_global=extra_next_global)
     reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
     blocked = blocked | fv.blocked
 

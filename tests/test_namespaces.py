@@ -1,0 +1,187 @@
+"""Cross-pod namespace sharding tests (SURVEY §2.10) on the virtual
+8-device topology arranged as a 2x4 (dcn, ici) mesh: two "pods" of four
+devices. Pod-scope cluster rules enforce per-slice quotas; global-scope
+rules enforce ONE quota across both pods (the psum's outer reduction is
+the DCN hop on real hardware). Host side: the namespace router."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.models import authority as A
+from sentinel_tpu.models import degrade as D_
+from sentinel_tpu.models import flow as F
+from sentinel_tpu.models import param_flow as PF
+from sentinel_tpu.models import system as Y
+from sentinel_tpu.ops import step as S
+from sentinel_tpu.parallel import namespaces as NS
+
+NOW0 = 1_700_000_000_000
+CAPACITY = 128
+SLICES, PER_SLICE = 2, 4
+NDEV = SLICES * PER_SLICE
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= NDEV
+    return NS.make_dcn_mesh(SLICES, PER_SLICE)
+
+
+_ENTRY = {}
+
+
+def _entry_fn(mesh):
+    if id(mesh) not in _ENTRY:
+        entry, exit_ = NS.make_dcn_pod_steps(mesh)
+        _ENTRY[id(mesh)] = (jax.jit(entry), jax.jit(exit_))
+    return _ENTRY[id(mesh)][0]
+
+
+def _exit_fn(mesh):
+    _entry_fn(mesh)
+    return _ENTRY[id(mesh)][1]
+
+
+def _build(rules):
+    reg = NodeRegistry(CAPACITY)
+    row = reg.cluster_row("shared")
+    ft, _ = F.compile_flow_rules(rules, reg, CAPACITY)
+    dt, di = D_.compile_degrade_rules([], reg, CAPACITY)
+    pt = PF.compile_param_rules([], reg, CAPACITY)
+    pack = S.RulePack(
+        flow=ft, degrade=dt,
+        authority=A.compile_authority_rules([], reg, CAPACITY),
+        system=Y.compile_system_rules([]),
+        param=pt)
+    one = S.make_state(CAPACITY, ft.num_rules, NOW0,
+                       degrade=D_.make_degrade_state(dt, di),
+                       param=PF.make_param_state(pt.num_rules))
+    return row, pack, NS.make_dcn_pod_state(SLICES, PER_SLICE, one)
+
+
+def _batch(row, per_dev):
+    buf = make_entry_batch_np(NDEV * per_dev)
+    buf["cluster_row"][:] = row
+    buf["dn_row"][:] = -1
+    buf["count"][:] = 1
+    return EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+
+def _admitted_per_slice(dec, per_dev):
+    r = np.asarray(dec.reason).reshape(SLICES, PER_SLICE * per_dev)
+    return [(row == C.BlockReason.PASS).sum() for row in r]
+
+
+def test_pod_scope_rule_is_per_slice(mesh):
+    """Default cluster scope: EACH pod enforces the quota independently —
+    the sharded-namespace case (a namespace lives on one slice)."""
+    thr, per_dev = 6, 3
+    row, pack, pod = _build([F.FlowRule(resource="shared", count=thr,
+                                        cluster_mode=True)])
+    entry = _entry_fn(mesh)
+    pod, dec1 = entry(pod, pack, _batch(row, per_dev), jnp.asarray(NOW0, jnp.int64))
+    a1 = _admitted_per_slice(dec1, per_dev)
+    for a in a1:  # each slice within its own bound, no cross-pod coupling
+        assert thr <= a <= thr + (PER_SLICE - 1) * per_dev
+    pod, dec2 = entry(pod, pack, _batch(row, per_dev), jnp.asarray(NOW0 + 1, jnp.int64))
+    assert _admitted_per_slice(dec2, per_dev) == [0, 0]
+
+
+def test_global_scope_rule_spans_pods(mesh):
+    """scope='global': ONE quota across both pods. Saturate it entirely
+    from pod 0; pod 1 must see the usage through the DCN-axis psum."""
+    thr = 8
+    row, pack, pod = _build([F.FlowRule(
+        resource="shared", count=thr, cluster_mode=True,
+        cluster_config={"scope": "global"})])
+    entry = _entry_fn(mesh)
+
+    per_dev = thr
+    buf = make_entry_batch_np(NDEV * per_dev)
+    buf["cluster_row"][:] = -1
+    buf["cluster_row"][:thr] = row  # device 0 of pod 0 only
+    buf["dn_row"][:] = buf["cluster_row"]
+    buf["count"][:] = 1
+    pod, dec1 = entry(pod, pack,
+                      EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()}),
+                      jnp.asarray(NOW0, jnp.int64))
+    assert sum(_admitted_per_slice(dec1, per_dev)) == thr
+
+    # Pod 1 (and pod 0) now see the world window as full.
+    pod, dec2 = entry(pod, pack, _batch(row, 2), jnp.asarray(NOW0 + 1, jnp.int64))
+    assert _admitted_per_slice(dec2, 2) == [0, 0]
+
+
+def test_global_scope_bounded_overshoot_then_stop(mesh):
+    thr, per_dev = 10, 2
+    row, pack, pod = _build([F.FlowRule(
+        resource="shared", count=thr, cluster_mode=True,
+        cluster_config={"scope": "global"})])
+    entry = _entry_fn(mesh)
+    pod, dec1 = entry(pod, pack, _batch(row, per_dev), jnp.asarray(NOW0, jnp.int64))
+    total1 = sum(_admitted_per_slice(dec1, per_dev))
+    assert thr <= total1 <= thr + (NDEV - 1) * per_dev
+    pod, dec2 = entry(pod, pack, _batch(row, per_dev), jnp.asarray(NOW0 + 1, jnp.int64))
+    assert sum(_admitted_per_slice(dec2, per_dev)) == 0
+
+
+# -- host layer --------------------------------------------------------------
+
+
+def test_namespace_router_stable_and_pinnable():
+    m = NS.NamespaceShardMap(4)
+    a = m.slice_of("payments")
+    assert a == m.slice_of("payments")  # stable
+    assert 0 <= a < 4
+    m.pin("payments", 3)
+    assert m.slice_of("payments") == 3
+    spread = {m.slice_of(f"ns{i}") for i in range(64)}
+    assert len(spread) > 1  # hashing actually spreads
+
+
+def test_namespace_router_fails_over_and_recovers():
+    m = NS.NamespaceShardMap(3)
+    m.pin("orders", 1)
+    m.mark_down(1)
+    fallback = m.slice_of("orders")
+    assert fallback != 1 and 0 <= fallback < 3
+    assert m.slice_of("orders") == fallback  # deterministic failover
+    m.mark_up(1)
+    assert m.slice_of("orders") == 1  # pinned home restored
+    m.mark_down(0)
+    m.mark_down(1)
+    m.mark_down(2)
+    with pytest.raises(RuntimeError):
+        m.slice_of("orders")
+
+
+def test_dcn_exit_step_balances_gauges(mesh):
+    """Entries then exits over the 2x4 mesh: every replica's concurrency
+    gauge returns to zero (no exit path = permanently blocked THREAD
+    rules)."""
+    from sentinel_tpu.core.batch import ExitBatch, make_exit_batch_np
+
+    row, pack, pod = _build([F.FlowRule(resource="shared", count=1e9,
+                                        cluster_mode=True)])
+    entry, exit_ = _entry_fn(mesh), _exit_fn(mesh)
+    per_dev = 2
+    pod, dec = entry(pod, pack, _batch(row, per_dev),
+                     jnp.asarray(NOW0, jnp.int64))
+    assert sum(_admitted_per_slice(dec, per_dev)) == NDEV * per_dev
+    gauges = np.asarray(pod.cur_threads)[..., row]
+    assert (gauges == per_dev).all()
+
+    buf = make_exit_batch_np(NDEV * per_dev)
+    buf["cluster_row"][:] = row
+    buf["dn_row"][:] = -1
+    buf["count"][:] = 1
+    buf["success"][:] = True
+    pod = exit_(pod, pack,
+                ExitBatch(**{k: jnp.asarray(v) for k, v in buf.items()}),
+                jnp.asarray(NOW0 + 5, jnp.int64))
+    assert (np.asarray(pod.cur_threads)[..., row] == 0).all()
