@@ -68,10 +68,17 @@ def main() -> None:
             except OSError:
                 continue
             if m != mtime:
-                mtime = m
-                with open(args.rules, "r", encoding="utf-8") as f:
-                    manager.load_rules(rules_from_json(f.read()))
-                print("RLS rules reloaded", flush=True)
+                try:
+                    with open(args.rules, "r", encoding="utf-8") as f:
+                        manager.load_rules(rules_from_json(f.read()))
+                    mtime = m  # recorded only on SUCCESS: a mid-write or
+                    # malformed read retries next poll even when the final
+                    # write lands in the same coarse mtime tick
+                    print("RLS rules reloaded", flush=True)
+                except (OSError, ValueError, KeyError, TypeError) as ex:
+                    # Malformed/mid-write update: keep serving last-good.
+                    print(f"RLS rules reload FAILED (kept last good): {ex!r}",
+                          flush=True)
     except KeyboardInterrupt:
         server.stop(grace=1.0)
 
