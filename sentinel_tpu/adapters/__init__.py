@@ -3,8 +3,12 @@ each adapter translates a host-framework request into
 ``context_enter(origin) + entry(resource, IN)`` with a block-handler hook.
 
 Python-native adapter set: a decorator (the ``@SentinelResource`` aspect
-analog), WSGI and ASGI middlewares (Servlet / WebFlux analogs), and the API
-gateway common layer (route/API-group rules + param parsing).
+analog), WSGI and ASGI middlewares (Servlet / WebFlux analogs), the API
+gateway common layer (route/API-group rules + param parsing), gRPC
+server/client interceptors (``sentinel-grpc-adapter`` — import
+``sentinel_tpu.adapters.grpc_adapter``, requires grpcio), and an outbound
+HTTP client guard (``sentinel-okhttp-adapter`` analog,
+``sentinel_tpu.adapters.http_client``).
 """
 
 from sentinel_tpu.adapters.annotation import sentinel_resource
@@ -19,11 +23,12 @@ from sentinel_tpu.adapters.gateway import (
     GatewayRequest,
     gateway_entry,
 )
+from sentinel_tpu.adapters.http_client import SentinelHttpClient, guarded
 from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
 
 __all__ = [
     "ApiDefinition", "ApiPredicateItem", "GatewayApiDefinitionManager",
     "GatewayFlowRule", "GatewayParamFlowItem", "GatewayRequest",
-    "GatewayRuleManager", "SentinelASGIMiddleware", "SentinelWSGIMiddleware",
-    "gateway_entry", "sentinel_resource",
+    "GatewayRuleManager", "SentinelASGIMiddleware", "SentinelHttpClient",
+    "SentinelWSGIMiddleware", "gateway_entry", "guarded", "sentinel_resource",
 ]

@@ -381,3 +381,239 @@ class TestReviewRegressions:
             raise ValueError("x")
 
         assert asyncio.run(work()) == "async-fb"
+
+
+class TestGrpcAdapters:
+    """gRPC server + client interceptors over a REAL in-process channel
+    (reference: sentinel-grpc-adapter's interceptor pair)."""
+
+    @pytest.fixture()
+    def echo_server(self, engine):
+        import concurrent.futures
+
+        import grpc
+
+        from sentinel_tpu.adapters.grpc_adapter import (
+            SentinelGrpcServerInterceptor,
+        )
+
+        def echo(request, context):
+            return request  # bytes in, bytes out
+
+        handler = grpc.method_handlers_generic_handler(
+            "test.Echo", {"Call": grpc.unary_unary_rpc_method_handler(
+                echo,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)})
+        server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4),
+            interceptors=[SentinelGrpcServerInterceptor()])
+        server.add_generic_rpc_handlers((handler,))
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        yield f"127.0.0.1:{port}"
+        server.stop(grace=None)
+
+    def test_server_interceptor_blocks_over_quota(self, engine, echo_server):
+        import grpc
+
+        st.load_flow_rules([st.FlowRule(resource="/test.Echo/Call", count=2)])
+        with grpc.insecure_channel(echo_server) as channel:
+            call = channel.unary_unary("/test.Echo/Call",
+                                       request_serializer=lambda b: b,
+                                       response_deserializer=lambda b: b)
+            assert call(b"hi") == b"hi"
+            assert call(b"hi") == b"hi"
+            with pytest.raises(grpc.RpcError) as e:
+                call(b"hi")
+            assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        snap = engine.node_snapshot()["/test.Echo/Call"]
+        assert snap["passQps"] == 2 and snap["blockQps"] == 1
+
+    def test_client_interceptor_guards_outbound(self, engine):
+        import concurrent.futures
+
+        import grpc
+
+        from sentinel_tpu.adapters.grpc_adapter import (
+            SentinelGrpcClientInterceptor,
+        )
+
+        # Plain server (no server-side interceptor — in-process it would
+        # share this engine's quota and block first).
+        handler = grpc.method_handlers_generic_handler(
+            "test.Echo", {"Call": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: req,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)})
+        server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=2))
+        server.add_generic_rpc_handlers((handler,))
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        try:
+            st.load_flow_rules([st.FlowRule(resource="/test.Echo/Call",
+                                            count=1)])
+            with grpc.insecure_channel(f"127.0.0.1:{port}") as raw:
+                channel = grpc.intercept_channel(
+                    raw, SentinelGrpcClientInterceptor())
+                call = channel.unary_unary("/test.Echo/Call",
+                                           request_serializer=lambda b: b,
+                                           response_deserializer=lambda b: b)
+                assert call(b"x") == b"x"
+                with pytest.raises(BlockException):
+                    call(b"x")  # client-side OUT entry over quota
+        finally:
+            server.stop(grace=None)
+
+
+class TestHttpClientAdapter:
+    def _local_server(self, status=200):
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(status)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, *a):
+                pass
+
+        server = HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server
+
+    def test_blocks_and_names_resources(self, engine):
+        from sentinel_tpu.adapters.http_client import SentinelHttpClient
+
+        server = self._local_server()
+        port = server.server_address[1]
+        try:
+            client = SentinelHttpClient()
+            resource = f"GET:127.0.0.1:{port}/api/users"
+            st.load_flow_rules([st.FlowRule(resource=resource, count=1)])
+            assert client.get(
+                f"http://127.0.0.1:{port}/api/users?id=1").read() == b"ok"
+            with pytest.raises(BlockException):
+                client.get(f"http://127.0.0.1:{port}/api/users?id=2")
+            snap = engine.node_snapshot()[resource]
+            assert snap["passQps"] == 1 and snap["blockQps"] == 1
+        finally:
+            server.shutdown()
+
+    def test_5xx_feeds_exception_metrics(self, engine):
+        import urllib.error
+
+        from sentinel_tpu.adapters.http_client import SentinelHttpClient
+
+        server = self._local_server(status=503)
+        port = server.server_address[1]
+        try:
+            client = SentinelHttpClient()
+            with pytest.raises(urllib.error.HTTPError):
+                client.get(f"http://127.0.0.1:{port}/down")
+            snap = engine.node_snapshot()[f"GET:127.0.0.1:{port}/down"]
+            assert snap["exceptionQps"] == 1
+        finally:
+            server.shutdown()
+
+    def test_guarded_wraps_any_callable(self, engine):
+        from sentinel_tpu.adapters.http_client import guarded
+
+        st.load_flow_rules([st.FlowRule(resource="dep", count=1)])
+        calls = []
+        fn = guarded(lambda x: calls.append(x) or "r", "dep")
+        assert fn(1) == "r"
+        with pytest.raises(BlockException):
+            fn(2)
+        assert calls == [1]
+
+
+class TestGrpcStreaming:
+    def test_stream_entry_spans_iteration_and_traces_midstream(self, engine):
+        """The entry must stay live across response streaming (concurrency
+        visible mid-stream) and a mid-stream failure must feed exception
+        metrics."""
+        import concurrent.futures
+        import threading
+
+        import grpc
+
+        from sentinel_tpu.adapters.grpc_adapter import (
+            SentinelGrpcServerInterceptor,
+        )
+
+        midstream_threads = []
+        release = threading.Event()
+
+        def counter(request, context):
+            yield b"1"
+            midstream_threads.append(
+                engine.node_snapshot()["/test.S/Stream"]["curThreadNum"])
+            release.wait(timeout=5)
+            if request == b"boom":
+                raise RuntimeError("mid-stream failure")
+            yield b"2"
+
+        handler = grpc.method_handlers_generic_handler(
+            "test.S", {"Stream": grpc.unary_stream_rpc_method_handler(
+                counter,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)})
+        server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4),
+            interceptors=[SentinelGrpcServerInterceptor()])
+        server.add_generic_rpc_handlers((handler,))
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+                call = channel.unary_stream(
+                    "/test.S/Stream",
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b)
+                release.set()
+                assert list(call(b"ok")) == [b"1", b"2"]
+                with pytest.raises(grpc.RpcError):
+                    list(call(b"boom"))
+            # concurrency was visible WHILE the stream was in flight
+            assert midstream_threads and midstream_threads[0] >= 1
+            snap = engine.node_snapshot()["/test.S/Stream"]
+            assert snap["exceptionQps"] == 1  # only the boom stream
+        finally:
+            server.stop(grace=None)
+
+
+def test_http_client_4xx_not_counted_as_dependency_exception(engine):
+    """A 404 is a caller error: it re-raises but must NOT feed exception
+    metrics (a degrade rule would break a healthy dependency)."""
+    import threading
+    import urllib.error
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from sentinel_tpu.adapters.http_client import SentinelHttpClient
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        client = SentinelHttpClient()
+        with pytest.raises(urllib.error.HTTPError):
+            client.get(f"http://127.0.0.1:{port}/missing")
+        snap = engine.node_snapshot()[f"GET:127.0.0.1:{port}/missing"]
+        assert snap["exceptionQps"] == 0
+        assert snap["passQps"] == 1
+    finally:
+        server.shutdown()
