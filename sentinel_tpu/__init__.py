@@ -167,6 +167,11 @@ def load_param_flow_rules(rules) -> None:
     get_engine().param_rules.load_rules(list(rules))
 
 
+from sentinel_tpu.core.checkpoint import (
+    CheckpointTimer,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from sentinel_tpu.core.spi import (
     EntryInfo,
     ProcessorSlot,
@@ -179,6 +184,7 @@ from sentinel_tpu.core.spi import (
 
 __all__ = [
     "AuthorityException", "AuthorityRule", "BlockException", "BlockReason",
+    "CheckpointTimer", "restore_checkpoint", "save_checkpoint",
     "DegradeException", "DegradeRule", "EntryHandle", "EntryInfo", "EntryType",
     "FlowException", "FlowRule", "MetricEvent", "ParamFlowException",
     "ParamFlowItem", "ParamFlowRule", "ProcessorSlot", "ResourceType",

@@ -151,6 +151,37 @@ class NodeRegistry:
 
     # -- lookups for the ops plane ----------------------------------------
 
+    def to_dict(self) -> Dict:
+        """Serializable snapshot (checkpoint/warm-restart support)."""
+        from dataclasses import asdict
+
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "meta": [asdict(m) for m in self.meta],
+                "cluster": dict(self._cluster),
+                # Tuple keys as JSON-native triples — names are arbitrary
+                # user strings, so no in-band delimiter is safe.
+                "default": [[c, r, v] for (c, r), v in self._default.items()],
+                "origin": [[r, o, v] for (r, o), v in self._origin.items()],
+                "entrance": dict(self._entrance),
+                "origin_ids": dict(self._origin_ids),
+                "context_ids": dict(self._context_ids),
+            }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "NodeRegistry":
+        reg = cls(int(d["capacity"]))
+        with reg._lock:
+            reg.meta = [NodeMeta(**m) for m in d["meta"]]
+            reg._cluster = dict(d["cluster"])
+            reg._default = {(c, r): v for c, r, v in d["default"]}
+            reg._origin = {(r, o): v for r, o, v in d["origin"]}
+            reg._entrance = dict(d["entrance"])
+            reg._origin_ids = dict(d["origin_ids"])
+            reg._context_ids = dict(d["context_ids"])
+        return reg
+
     def resources(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._cluster)

@@ -143,3 +143,32 @@ def test_param_hash_deterministic_and_typed():
     hashes = [_hash_param(v) for v in vals]
     assert len(set(hashes)) == len(vals)
     assert all(0 < h <= 0xFFFFFFFF for h in hashes)
+
+
+def test_admission_totals_invariant_under_permutation(engine, frozen_time):
+    """Race-detection analog (SURVEY §5): the device result must equal a
+    serial oracle under permuted batches — for unit counts, per-resource
+    admitted TOTALS are arrival-order invariant (which requests pass
+    depends on order; how many never does)."""
+    rng = np.random.default_rng(42)
+    st.load_flow_rules([st.FlowRule(resource="pa", count=4),
+                        st.FlowRule(resource="pb", count=7)])
+    reg = engine.registry
+    rows = {r: reg.cluster_row(r) for r in ("pa", "pb")}
+    engine._ensure_compiled()
+    base = (["pa"] * 9) + (["pb"] * 9)
+    totals = []
+    for trial in range(4):
+        order = list(base)
+        rng.shuffle(order)
+        batch_rows = [dict(cluster_row=rows[r], dn_row=-1, origin_row=-1,
+                           count=1) for r in order]
+        dec = engine.check_batch(_batch(engine, batch_rows))
+        admitted = np.asarray(dec.reason) == C.BlockReason.PASS
+        per_res = {r: int(sum(a for a, o in zip(admitted, order) if o == r))
+                   for r in rows}
+        totals.append(per_res)
+        st.load_flow_rules([st.FlowRule(resource="pa", count=4),
+                            st.FlowRule(resource="pb", count=7)])
+        frozen_time.advance_time(2_000)  # fresh window per trial
+    assert all(t == {"pa": 4, "pb": 7} for t in totals), totals
