@@ -22,6 +22,36 @@ from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.exceptions import BlockException
 
 
+def make_routers(block_handler, fallback, default_fallback,
+                 exceptions_to_ignore):
+    """(on_blocked, on_error) with the reference aspect's resolution order —
+    shared by this decorator and the asyncio variant (adapters/aio.py) so
+    their semantics cannot drift."""
+
+    def on_blocked(ex, args, kwargs):
+        # Reference resolution order: blockHandler, else the fallback
+        # chain may handle BlockException too.
+        for handler in (block_handler, fallback, default_fallback):
+            if handler is not None:
+                return handler(*args, ex=ex, **kwargs)
+        raise ex
+
+    def on_error(entry, ex, args, kwargs):
+        if isinstance(ex, BlockException):
+            # A nested guarded call blocked: route to the block handler,
+            # not the business fallback (reference aspect catches
+            # BlockException around proceed() too).
+            return on_blocked(ex, args, kwargs)
+        if not isinstance(ex, exceptions_to_ignore):
+            entry.trace(ex)
+            handler = fallback or default_fallback
+            if handler is not None:
+                return handler(*args, ex=ex, **kwargs)
+        raise ex
+
+    return on_blocked, on_error
+
+
 def sentinel_resource(
     value: Optional[str] = None,
     entry_type: int = C.EntryType.OUT,
@@ -40,27 +70,8 @@ def sentinel_resource(
 
     def deco(fn: Callable) -> Callable:
         resource = value or f"{fn.__module__}:{fn.__qualname__}"
-
-        def on_blocked(ex, args, kwargs):
-            # Reference resolution order: blockHandler, else the fallback
-            # chain may handle BlockException too.
-            for handler in (block_handler, fallback, default_fallback):
-                if handler is not None:
-                    return handler(*args, ex=ex, **kwargs)
-            raise ex
-
-        def on_error(entry, ex, args, kwargs):
-            if isinstance(ex, BlockException):
-                # A nested guarded call blocked: route to the block handler,
-                # not the business fallback (reference aspect catches
-                # BlockException around proceed() too).
-                return on_blocked(ex, args, kwargs)
-            if not isinstance(ex, exceptions_to_ignore):
-                entry.trace(ex)
-                handler = fallback or default_fallback
-                if handler is not None:
-                    return handler(*args, ex=ex, **kwargs)
-            raise ex
+        on_blocked, on_error = make_routers(
+            block_handler, fallback, default_fallback, exceptions_to_ignore)
 
         async def _maybe_await(value):
             if inspect.isawaitable(value):  # async handlers are awaited

@@ -121,6 +121,34 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
         engine._state = None
         engine._dirty = {k: True for k in engine._dirty}
         engine._ensure_compiled()
+        # Shape/dtype validation against the freshly compiled tensors: a
+        # checkpoint from a build with different window geometry (or a
+        # truncated file) must fail HERE with a clear error, not deep
+        # inside the first jitted step.
+        expect = {
+            "w1_counts": engine._state.w1.counts,
+            "w1_min_rt": engine._state.w1.min_rt,
+            "w1_starts": engine._state.w1.starts,
+            "w60_counts": engine._state.w60.counts,
+            "w60_min_rt": engine._state.w60.min_rt,
+            "w60_starts": engine._state.w60.starts,
+            "cur_threads": engine._state.cur_threads,
+            "sec_counts": engine._state.sec.counts,
+            "sec_min_rt": engine._state.sec.min_rt,
+            "sec_stamp": engine._state.sec.stamp,
+            "occupied_next": engine._state.occupied_next,
+            "occupied_stamp": engine._state.occupied_stamp,
+        }
+        for name, tmpl in expect.items():
+            got = arrays.get(name)
+            if got is None:
+                raise ValueError(f"incompatible checkpoint: missing {name}")
+            if tuple(got.shape) != tuple(tmpl.shape) \
+                    or np.dtype(got.dtype) != np.dtype(tmpl.dtype):
+                raise ValueError(
+                    f"incompatible checkpoint: {name} is "
+                    f"{got.dtype}{list(got.shape)}, engine expects "
+                    f"{np.dtype(tmpl.dtype)}{list(tmpl.shape)}")
         engine._state = engine._state._replace(
             w1=Window(jnp.asarray(arrays["w1_counts"]),
                       jnp.asarray(arrays["w1_min_rt"]),
@@ -154,6 +182,7 @@ class CheckpointTimer:
         import threading
 
         if self._thread is None:
+            self._stop.clear()  # allow start() after a stop()
             self._thread = threading.Thread(
                 target=self._run, name="sentinel-checkpoint", daemon=True)
             self._thread.start()

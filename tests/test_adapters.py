@@ -617,3 +617,121 @@ def test_http_client_4xx_not_counted_as_dependency_exception(engine):
         assert snap["passQps"] == 1
     finally:
         server.shutdown()
+
+
+class TestAsyncioAdapter:
+    """asyncio adapter (reactor-adapter analog): entry on await, exit on
+    completion, cancellation-safe, concurrency visible across tasks."""
+
+    def test_entry_scope_blocks_over_quota(self, engine):
+        from sentinel_tpu.adapters import aio
+
+        st.load_flow_rules([st.FlowRule(resource="aio", count=2)])
+
+        async def run():
+            outcomes = []
+            for _ in range(4):
+                try:
+                    async with aio.entry_scope("aio"):
+                        outcomes.append("ok")
+                except BlockException:
+                    outcomes.append("blocked")
+            return outcomes
+
+        assert asyncio.run(run()) == ["ok", "ok", "blocked", "blocked"]
+
+    def test_coroutine_decorator_routes_block_and_fallback(self, engine):
+        from sentinel_tpu.adapters import aio
+
+        @aio.sentinel_coroutine("aiod",
+                                block_handler=lambda x, ex: f"blocked:{x}",
+                                fallback=lambda x, ex: f"fb:{x}")
+        async def work(x):
+            if x == "boom":
+                raise ValueError("x")
+            return f"done:{x}"
+
+        st.load_flow_rules([st.FlowRule(resource="aiod", count=2)])
+
+        async def run():
+            return [await work("a"), await work("boom"), await work("c")]
+
+        assert asyncio.run(run()) == ["done:a", "fb:boom", "blocked:c"]
+        assert engine.node_snapshot()["aiod"]["exceptionQps"] == 1
+
+    def test_concurrent_tasks_share_thread_quota(self, engine):
+        """THREAD-grade concurrency across asyncio tasks: gauge counts
+        in-flight awaits, releasing on exit."""
+        from sentinel_tpu.adapters import aio
+        from sentinel_tpu.core import constants as CC
+
+        st.load_flow_rules([st.FlowRule(resource="aioc", count=2,
+                                        grade=CC.FLOW_GRADE_THREAD)])
+
+        async def held(gate):
+            async with aio.entry_scope("aioc"):
+                await gate.wait()
+                return "ok"
+
+        async def run():
+            gate = asyncio.Event()
+            t1 = asyncio.create_task(held(gate))
+            t2 = asyncio.create_task(held(gate))
+            await asyncio.sleep(0.4)  # both entries in flight
+            try:
+                async with aio.entry_scope("aioc"):
+                    third = "ok"
+            except BlockException:
+                third = "blocked"
+            gate.set()
+            assert await asyncio.gather(t1, t2) == ["ok", "ok"]
+            # concurrency released: a new entry passes
+            async with aio.entry_scope("aioc"):
+                fourth = "ok"
+            return third, fourth
+
+        third, fourth = asyncio.run(run())
+        assert third == "blocked" and fourth == "ok"
+
+    def test_cancellation_exits_entry(self, engine):
+        """A cancelled task must release its concurrency slot — whether
+        cancellation lands mid-body or mid-admission (the entry may commit
+        in the worker thread AFTER the cancel; the undo callback exits
+        it)."""
+        import time as _time
+
+        from sentinel_tpu.adapters import aio
+        from sentinel_tpu.core import constants as CC
+
+        st.load_flow_rules([st.FlowRule(resource="aiox", count=1,
+                                        grade=CC.FLOW_GRADE_THREAD)])
+        # warm the step compile so admission timing is not dominated by it
+        h = st.entry_ok("warmup-aiox")
+        if h:
+            h.exit()
+        row = engine.registry.cluster_row("aiox")
+
+        @aio.sentinel_coroutine("aiox")
+        async def hang():
+            await asyncio.sleep(30)
+
+        async def run():
+            t = asyncio.create_task(hang())
+            await asyncio.sleep(0.5)
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+            # the slot must come free (undo may land a beat later when
+            # cancellation hit mid-admission)
+            deadline = _time.time() + 10
+            while _time.time() < deadline:
+                if int(engine.row_stats()[1][row]) == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert int(engine.row_stats()[1][row]) == 0
+            async with aio.entry_scope("aiox"):
+                return "ok"
+
+        assert asyncio.run(run()) == "ok"
