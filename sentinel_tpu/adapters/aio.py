@@ -80,7 +80,12 @@ class entry_scope:
 
     async def __aexit__(self, exc_type, exc, tb):
         if self._handle is not None:
-            if exc is not None and not BlockException.is_block_exception(exc):
+            if (exc is not None
+                    and not BlockException.is_block_exception(exc)
+                    and not isinstance(exc, asyncio.CancelledError)):
+                # cancellation is not a service error (a wait_for timeout
+                # must not feed an exception-ratio breaker) — same stance
+                # as sentinel_coroutine's ignore list
                 self._handle.trace(exc)
             self._handle.exit()  # sync: survives task cancellation
         return False
@@ -109,7 +114,9 @@ def sentinel_coroutine(value: Optional[str] = None,
             tuple(exceptions_to_ignore) + (asyncio.CancelledError,))
 
         async def _maybe(out):
-            if asyncio.iscoroutine(out):
+            import inspect
+
+            if inspect.isawaitable(out):  # same test as sentinel_resource's
                 out = await out
             return out
 

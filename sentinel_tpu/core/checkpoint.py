@@ -31,6 +31,44 @@ import numpy as np
 CHECKPOINT_VERSION = 1
 
 
+def _tensor_schema(capacity: int):
+    """name -> (shape, dtype) of every persisted tensor — the single list
+    driving save and restore-validation, derivable WITHOUT compiling (so
+    restore can reject an incompatible file before mutating anything)."""
+    from sentinel_tpu.core import constants as C
+
+    E, R = C.NUM_EVENTS, capacity
+    return {
+        "w1_counts": ((C.SECOND_BUCKETS, E, R), np.int32),
+        "w1_min_rt": ((C.SECOND_BUCKETS, R), np.int32),
+        "w1_starts": ((C.SECOND_BUCKETS,), np.int64),
+        "w60_counts": ((C.MINUTE_BUCKETS, E, R), np.int32),
+        "w60_min_rt": ((C.MINUTE_BUCKETS, R), np.int32),
+        "w60_starts": ((C.MINUTE_BUCKETS,), np.int64),
+        "cur_threads": ((R,), np.int32),
+        "sec_counts": ((E, R), np.int32),
+        "sec_min_rt": ((R,), np.int32),
+        "sec_stamp": ((), np.int64),
+        "occupied_next": ((R,), np.int32),
+        "occupied_stamp": ((), np.int64),
+    }
+
+
+def _state_arrays(state):
+    """The persisted tensors, in schema order."""
+    return {
+        "w1_counts": state.w1.counts, "w1_min_rt": state.w1.min_rt,
+        "w1_starts": state.w1.starts,
+        "w60_counts": state.w60.counts, "w60_min_rt": state.w60.min_rt,
+        "w60_starts": state.w60.starts,
+        "cur_threads": state.cur_threads,
+        "sec_counts": state.sec.counts, "sec_min_rt": state.sec.min_rt,
+        "sec_stamp": state.sec.stamp,
+        "occupied_next": state.occupied_next,
+        "occupied_stamp": state.occupied_stamp,
+    }
+
+
 def save_checkpoint(engine, path: str) -> None:
     """Atomically snapshot the engine's node statistics to ``path``."""
     import jax
@@ -44,20 +82,7 @@ def save_checkpoint(engine, path: str) -> None:
             "sealed_sec": engine._sealed_sec,
             "registry": engine.registry.to_dict(),
         }
-        arrays = {
-            "w1_counts": np.asarray(state.w1.counts),
-            "w1_min_rt": np.asarray(state.w1.min_rt),
-            "w1_starts": np.asarray(state.w1.starts),
-            "w60_counts": np.asarray(state.w60.counts),
-            "w60_min_rt": np.asarray(state.w60.min_rt),
-            "w60_starts": np.asarray(state.w60.starts),
-            "cur_threads": np.asarray(state.cur_threads),
-            "sec_counts": np.asarray(state.sec.counts),
-            "sec_min_rt": np.asarray(state.sec.min_rt),
-            "sec_stamp": np.asarray(state.sec.stamp),
-            "occupied_next": np.asarray(state.occupied_next),
-            "occupied_stamp": np.asarray(state.occupied_stamp),
-        }
+        arrays = {k: np.asarray(v) for k, v in _state_arrays(state).items()}
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
                                or ".", suffix=".ckpt.tmp")
     try:
@@ -113,6 +138,19 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
                 f"capacity {engine.capacity}")
         arrays = {k: z[k] for k in z.files if k != "__header__"}
 
+    # Validate BEFORE any mutation (shapes are derivable from capacity +
+    # window constants, no compile needed): an incompatible or truncated
+    # file must leave the engine exactly as it was.
+    for name, (shape, dtype) in _tensor_schema(engine.capacity).items():
+        got = arrays.get(name)
+        if got is None:
+            raise ValueError(f"incompatible checkpoint: missing {name}")
+        if tuple(got.shape) != shape or np.dtype(got.dtype) != np.dtype(dtype):
+            raise ValueError(
+                f"incompatible checkpoint: {name} is "
+                f"{got.dtype}{list(got.shape)}, engine expects "
+                f"{np.dtype(dtype)}{list(shape)}")
+
     with engine._lock:
         engine.registry = NodeRegistry.from_dict(header["registry"])
         engine._sealed_sec = int(header["sealed_sec"])
@@ -121,34 +159,6 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
         engine._state = None
         engine._dirty = {k: True for k in engine._dirty}
         engine._ensure_compiled()
-        # Shape/dtype validation against the freshly compiled tensors: a
-        # checkpoint from a build with different window geometry (or a
-        # truncated file) must fail HERE with a clear error, not deep
-        # inside the first jitted step.
-        expect = {
-            "w1_counts": engine._state.w1.counts,
-            "w1_min_rt": engine._state.w1.min_rt,
-            "w1_starts": engine._state.w1.starts,
-            "w60_counts": engine._state.w60.counts,
-            "w60_min_rt": engine._state.w60.min_rt,
-            "w60_starts": engine._state.w60.starts,
-            "cur_threads": engine._state.cur_threads,
-            "sec_counts": engine._state.sec.counts,
-            "sec_min_rt": engine._state.sec.min_rt,
-            "sec_stamp": engine._state.sec.stamp,
-            "occupied_next": engine._state.occupied_next,
-            "occupied_stamp": engine._state.occupied_stamp,
-        }
-        for name, tmpl in expect.items():
-            got = arrays.get(name)
-            if got is None:
-                raise ValueError(f"incompatible checkpoint: missing {name}")
-            if tuple(got.shape) != tuple(tmpl.shape) \
-                    or np.dtype(got.dtype) != np.dtype(tmpl.dtype):
-                raise ValueError(
-                    f"incompatible checkpoint: {name} is "
-                    f"{got.dtype}{list(got.shape)}, engine expects "
-                    f"{np.dtype(tmpl.dtype)}{list(tmpl.shape)}")
         engine._state = engine._state._replace(
             w1=Window(jnp.asarray(arrays["w1_counts"]),
                       jnp.asarray(arrays["w1_min_rt"]),
@@ -181,11 +191,15 @@ class CheckpointTimer:
     def start(self) -> "CheckpointTimer":
         import threading
 
-        if self._thread is None:
-            self._stop.clear()  # allow start() after a stop()
-            self._thread = threading.Thread(
-                target=self._run, name="sentinel-checkpoint", daemon=True)
-            self._thread.start()
+        if self._thread is not None and self._thread.is_alive():
+            # Includes a thread whose stop() join timed out: clearing the
+            # event now would resurrect it alongside a new one.
+            return self
+        self._thread = None
+        self._stop.clear()  # allow start() after a stop()
+        self._thread = threading.Thread(
+            target=self._run, name="sentinel-checkpoint", daemon=True)
+        self._thread.start()
         return self
 
     def _run(self):
@@ -201,4 +215,7 @@ class CheckpointTimer:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-            self._thread = None
+            if not self._thread.is_alive():
+                self._thread = None
+            # else: keep the handle so start() can see the straggler and
+            # refuse to race a second writer against it
