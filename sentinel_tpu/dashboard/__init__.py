@@ -10,6 +10,7 @@ Run standalone::
 then point engines at it with ``csp.sentinel.dashboard.server=host:8080``.
 """
 
+from sentinel_tpu.dashboard.auth import AuthService, AuthUser
 from sentinel_tpu.dashboard.client import ApiError, SentinelApiClient
 from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
 from sentinel_tpu.dashboard.metrics import InMemoryMetricsRepository, MetricFetcher
@@ -18,6 +19,8 @@ from sentinel_tpu.dashboard.server import DashboardServer
 __all__ = [
     "ApiError",
     "AppManagement",
+    "AuthService",
+    "AuthUser",
     "DashboardServer",
     "InMemoryMetricsRepository",
     "MachineInfo",

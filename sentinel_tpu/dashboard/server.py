@@ -19,6 +19,9 @@ set as a small JSON API plus one static page:
     (``ClusterConfigController.assign``: chosen machine -> SERVER, every
     other healthy machine -> CLIENT of it)
   * ``GET  /``                                the UI (static/index.html)
+  * ``POST /auth/login`` / ``/auth/logout``, ``GET /auth/check``
+    (``auth.AuthService``; enabled only when
+    ``sentinel.dashboard.auth.username`` is configured)
 
 Rules are owned by the engines (and their writable datasources); the
 dashboard holds no rule store — matching the reference's V1 controllers,
@@ -35,19 +38,26 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Dict, Optional
 
+from sentinel_tpu.dashboard.auth import COOKIE_NAME, AuthService
 from sentinel_tpu.dashboard.client import ApiError, SentinelApiClient
 from sentinel_tpu.dashboard.discovery import AppManagement, MachineInfo
 from sentinel_tpu.dashboard.metrics import InMemoryMetricsRepository, MetricFetcher
 
 RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow")
 _STATIC_DIR = Path(__file__).parent / "static"
+# LoginAuthenticationFilter exemptions: login itself, the UI shell, and
+# the heartbeat receiver (engines are not logged-in browsers).
+_PUBLIC_PATHS = ("/", "/index.html", "/auth/login", "/auth/check",
+                 "/registry/machine")
 
 
 class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 8080,
-                 fetch_interval_s: float = 1.0):
+                 fetch_interval_s: float = 1.0,
+                 auth: Optional[AuthService] = None):
         self.host = host
         self.port = port
+        self.auth = auth if auth is not None else AuthService()
         self.apps = AppManagement()
         self.api = SentinelApiClient()
         self.repository = InMemoryMetricsRepository()
@@ -150,17 +160,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ----------------------------------------------------------
 
-    def _json(self, obj, code: int = 200):
+    def _json(self, obj, code: int = 200, headers=()):
         data = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json; charset=utf-8")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in headers:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
-    def _ok(self, result):
+    def _ok(self, result, headers=()):
         # reference dashboard Result<T> envelope: {success, code, msg, data}
-        self._json({"success": True, "code": 0, "msg": None, "data": result})
+        self._json({"success": True, "code": 0, "msg": None, "data": result},
+                   headers=headers)
 
     def _fail(self, msg: str, code: int = 400):
         self._json({"success": False, "code": code, "msg": msg, "data": None},
@@ -180,6 +193,52 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    # -- auth --------------------------------------------------------------
+
+    def _session_token(self) -> Optional[str]:
+        authz = self.headers.get("Authorization", "")
+        if authz.startswith("Bearer "):
+            return authz[len("Bearer "):].strip()
+        for part in self.headers.get("Cookie", "").split(";"):
+            k, _, v = part.strip().partition("=")
+            if k == COOKIE_NAME and v:
+                return v
+        return None
+
+    def _auth_routes(self, d: DashboardServer, path: str, body: str) -> bool:
+        """Handle /auth/*; returns True when the request was consumed."""
+        if path == "/auth/login":
+            if self.command != "POST":
+                self._fail("POST required", 405)
+                return True
+            form = {k: v[0] for k, v in urllib.parse.parse_qs(body).items()}
+            token = d.auth.login(form.get("username", ""),
+                                 form.get("password", ""))
+            if token is None:
+                self._fail("invalid username or password", 401)
+            else:
+                self._ok({"username": form.get("username", "")},
+                         headers=[("Set-Cookie",
+                                   f"{COOKIE_NAME}={token}; HttpOnly; "
+                                   f"Path=/; SameSite=Strict")])
+            return True
+        if path == "/auth/logout":
+            if self.command != "POST":
+                self._fail("POST required", 405)
+                return True
+            d.auth.logout(self._session_token())
+            self._ok("logged out")
+            return True
+        if path == "/auth/check":
+            user = d.auth.validate(self._session_token())
+            if user is None and d.auth.enabled:
+                self._fail("not logged in", 401)
+            else:
+                self._ok({"username": user.username if user else "",
+                          "authRequired": d.auth.enabled})
+            return True
+        return False
+
     # -- routing -----------------------------------------------------------
 
     def do_GET(self):
@@ -196,6 +255,11 @@ class _Handler(BaseHTTPRequestHandler):
         path = parsed.path
         q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         try:
+            if self._auth_routes(d, path, body):
+                return
+            if d.auth.enabled and path not in _PUBLIC_PATHS \
+                    and d.auth.validate(self._session_token()) is None:
+                return self._fail("not logged in", 401)
             if path in ("/", "/index.html"):
                 return self._static("index.html")
             # Mutating routes are POST-only: a crawler or <img> prefetch must

@@ -7,6 +7,7 @@ server.
 """
 
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -14,6 +15,7 @@ import pytest
 
 import sentinel_tpu as st
 from sentinel_tpu.dashboard import (
+    AuthService,
     DashboardServer,
     InMemoryMetricsRepository,
     MetricFetcher,
@@ -165,6 +167,94 @@ def test_ui_page_served(dash):
     with urllib.request.urlopen(url, timeout=5) as r:
         page = r.read().decode()
     assert "sentinel-tpu" in page and "queryTopResourceMetric" in page
+
+
+def _raw(dash, path, method="GET", body=b"", headers=None):
+    url = f"http://127.0.0.1:{dash.bound_port}{path}"
+    req = urllib.request.Request(url, data=body if method == "POST" else None,
+                                 method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, dict(r.headers), json.loads(r.read().decode())
+    except urllib.error.HTTPError as ex:
+        return ex.code, dict(ex.headers), json.loads(ex.read().decode())
+
+
+def test_auth_disabled_by_default(dash):
+    """Empty username -> FakeAuthServiceImpl semantics: everything open."""
+    assert not dash.auth.enabled
+    code, _, out = _raw(dash, "/app/names.json")
+    assert code == 200 and out["success"]
+    code, _, out = _raw(dash, "/auth/check")
+    assert code == 200 and out["data"]["authRequired"] is False
+
+
+def test_auth_gates_api_but_not_heartbeat():
+    """LoginAuthenticationFilter: API 401s without a session; the UI shell
+    and the machine-registry heartbeat endpoint stay open."""
+    d = DashboardServer(port=0, auth=AuthService("admin", "s3cret")).start(
+        fetch=False)
+    try:
+        code, _, _ = _raw(d, "/app/names.json")
+        assert code == 401
+        # heartbeats from engines must not need a login
+        code, _, out = _raw(d, "/registry/machine?app=a&ip=127.0.0.1&port=1",
+                            method="POST")
+        assert code == 200 and out["success"]
+        # UI shell serves (it shows the login overlay client-side)
+        url = f"http://127.0.0.1:{d.bound_port}/"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert "loginform" in r.read().decode()
+    finally:
+        d.stop()
+
+
+def test_auth_login_session_logout():
+    d = DashboardServer(port=0, auth=AuthService("admin", "s3cret")).start(
+        fetch=False)
+    try:
+        code, _, _ = _raw(d, "/auth/login", method="POST",
+                          body=b"username=admin&password=wrong")
+        assert code == 401
+        code, hdrs, out = _raw(d, "/auth/login", method="POST",
+                               body=b"username=admin&password=s3cret")
+        assert code == 200 and out["data"]["username"] == "admin"
+        cookie = hdrs["Set-Cookie"].split(";")[0]
+        token = cookie.split("=", 1)[1]
+
+        code, _, out = _raw(d, "/app/names.json", headers={"Cookie": cookie})
+        assert code == 200 and out["success"]
+        # Bearer form works for programmatic clients
+        code, _, _ = _raw(d, "/app/names.json",
+                          headers={"Authorization": f"Bearer {token}"})
+        assert code == 200
+
+        code, _, _ = _raw(d, "/auth/logout", method="POST",
+                          headers={"Cookie": cookie})
+        assert code == 200
+        code, _, _ = _raw(d, "/app/names.json", headers={"Cookie": cookie})
+        assert code == 401
+    finally:
+        d.stop()
+
+
+def test_auth_blank_password_stays_disabled():
+    """A username without a password must not enable auth that would
+    accept an empty password."""
+    svc = AuthService("admin", "")
+    assert not svc.enabled
+    assert svc.login("admin", "") is None
+
+
+def test_auth_session_expiry():
+    clock = [0.0]
+    svc = AuthService("u", "p", ttl_s=100, clock=lambda: clock[0])
+    token = svc.login("u", "p")
+    assert svc.validate(token) is not None
+    clock[0] = 99.0
+    assert svc.validate(token) is not None
+    clock[0] = 100.0
+    assert svc.validate(token) is None  # expired exactly at ttl
 
 
 def test_cluster_assign_flow(dash, engine):
