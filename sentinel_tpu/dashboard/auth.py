@@ -81,8 +81,11 @@ class AuthService:
         """Return a session token, or None on bad credentials."""
         if not self.enabled:
             return None
-        ok_user = hmac.compare_digest(username or "", self._username)
-        ok_pass = hmac.compare_digest(password or "", self._password)
+        # bytes operands: compare_digest refuses non-ASCII str
+        ok_user = hmac.compare_digest(
+            (username or "").encode("utf-8"), self._username.encode("utf-8"))
+        ok_pass = hmac.compare_digest(
+            (password or "").encode("utf-8"), self._password.encode("utf-8"))
         if not (ok_user and ok_pass):
             return None
         token = secrets.token_urlsafe(24)

@@ -51,6 +51,11 @@ _PUBLIC_PATHS = ("/", "/index.html", "/auth/login", "/auth/check",
                  "/registry/machine")
 
 
+def _flat_qs(qs: str) -> Dict[str, str]:
+    """query-string / form body → first-value-wins flat dict."""
+    return {k: v[0] for k, v in urllib.parse.parse_qs(qs).items()}
+
+
 class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 8080,
                  fetch_interval_s: float = 1.0,
@@ -211,7 +216,7 @@ class _Handler(BaseHTTPRequestHandler):
             if self.command != "POST":
                 self._fail("POST required", 405)
                 return True
-            form = {k: v[0] for k, v in urllib.parse.parse_qs(body).items()}
+            form = _flat_qs(body)
             token = d.auth.login(form.get("username", ""),
                                  form.get("password", ""))
             if token is None:
@@ -253,7 +258,7 @@ class _Handler(BaseHTTPRequestHandler):
         d: DashboardServer = self.server.dashboard
         parsed = urllib.parse.urlparse(self.path)
         path = parsed.path
-        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        q = _flat_qs(parsed.query)
         try:
             if self._auth_routes(d, path, body):
                 return
@@ -268,7 +273,7 @@ class _Handler(BaseHTTPRequestHandler):
                     and self.command != "POST":
                 return self._fail("POST required", 405)
             if path == "/registry/machine":
-                form = {k: v[0] for k, v in urllib.parse.parse_qs(body).items()}
+                form = _flat_qs(body)
                 form.update(q)
                 d.register_machine(form)
                 return self._ok("registered")

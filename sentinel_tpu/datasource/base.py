@@ -106,9 +106,11 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
     def is_modified(self) -> bool:
         return True
 
-    def refresh(self) -> None:
-        """One poll iteration (exposed for deterministic tests)."""
-        if not self.is_modified():
+    def refresh(self, force: bool = False) -> None:
+        """One poll iteration (exposed for deterministic tests); ``force``
+        skips the is_modified gate (coarse-mtime filesystems can miss a
+        same-tick rewrite)."""
+        if not force and not self.is_modified():
             return
         value = self.load_config()
         if value is not None:

@@ -366,3 +366,63 @@ def test_param_token_bucket_shared_across_flow_id_spellings(frozen_time):
     assert svc.request_param_token(123, 1, ["k"]).status == TokenResultStatus.OK
     assert svc.request_param_token("123", 1, ["k"]).status == \
         TokenResultStatus.BLOCKED  # same bucket, not a fresh one
+
+
+# -- alone-mode standalone server (python -m sentinel_tpu.cluster) ----------
+
+def test_standalone_server_rules_file_lifecycle(tmp_path, frozen_time):
+    """Alone-mode server: rules come from a JSON file per namespace, file
+    edits land via the poll path, and a removed namespace unloads (clients
+    see NO_RULE_EXISTS and fall back local, the designed failure mode)."""
+    import json as _json
+
+    from sentinel_tpu.cluster.__main__ import StandaloneTokenServer
+
+    path = tmp_path / "cluster_rules.json"
+    path.write_text(_json.dumps({
+        "ns-a": [{"resource": "getUser", "count": 3, "clusterMode": True,
+                  "clusterConfig": {"flowId": 900, "thresholdType": 1}}],
+        "ns-b": [{"resource": "getItem", "count": 1, "clusterMode": True,
+                  "clusterConfig": {"flowId": 901, "thresholdType": 1}}],
+    }))
+    # refresh_ms huge so the background poll never races the test's own
+    # deterministic srv.refresh() calls
+    srv = StandaloneTokenServer(port=0, host="127.0.0.1",
+                                rules_path=str(path),
+                                refresh_ms=3_600_000)
+    srv.start()
+    client = ClusterTokenClient("127.0.0.1", srv.bound_port, "ns-a").start()
+    try:
+        deadline = time.time() + 3
+        while not client.is_connected() and time.time() < deadline:
+            time.sleep(0.02)
+        got = [client.request_token(900).status for _ in range(4)]
+        assert got.count(TokenResultStatus.OK) == 3
+        assert got.count(TokenResultStatus.BLOCKED) == 1
+        assert client.request_token(901).status == TokenResultStatus.OK
+
+        # raise ns-a's quota + drop ns-b entirely; poll must apply both
+        path.write_text(_json.dumps({
+            "ns-a": [{"resource": "getUser", "count": 5, "clusterMode": True,
+                      "clusterConfig": {"flowId": 900, "thresholdType": 1}}],
+        }))
+        srv.refresh()
+        assert client.request_token(901).status == \
+            TokenResultStatus.NO_RULE_EXISTS
+        frozen_time.advance_time(1100)  # fresh window for the new quota
+        got = [client.request_token(900).status for _ in range(6)]
+        assert got.count(TokenResultStatus.OK) == 5
+    finally:
+        client.stop()
+        srv.stop()
+
+
+def test_standalone_server_rejects_bad_rules_file(tmp_path):
+    from sentinel_tpu.cluster.__main__ import parse_namespace_rules
+
+    with pytest.raises(ValueError):
+        parse_namespace_rules("[1, 2]")
+    with pytest.raises(ValueError):
+        parse_namespace_rules('{"ns": 5}')
+    out = parse_namespace_rules('{"ns": []}')
+    assert out == {"ns": []}

@@ -238,6 +238,14 @@ def test_auth_login_session_logout():
         d.stop()
 
 
+def test_auth_non_ascii_credentials():
+    """compare_digest needs bytes operands for non-ASCII credentials."""
+    svc = AuthService("admin", "café")
+    assert svc.login("admin", "wrong·guess") is None
+    token = svc.login("admin", "café")
+    assert token and svc.validate(token).username == "admin"
+
+
 def test_auth_blank_password_stays_disabled():
     """A username without a password must not enable auth that would
     accept an empty password."""
