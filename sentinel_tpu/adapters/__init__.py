@@ -6,9 +6,11 @@ Python-native adapter set: a decorator (the ``@SentinelResource`` aspect
 analog), WSGI and ASGI middlewares (Servlet / WebFlux analogs), the API
 gateway common layer (route/API-group rules + param parsing), gRPC
 server/client interceptors (``sentinel-grpc-adapter`` — import
-``sentinel_tpu.adapters.grpc_adapter``, requires grpcio), and an outbound
+``sentinel_tpu.adapters.grpc_adapter``, requires grpcio), an outbound
 HTTP client guard (``sentinel-okhttp-adapter`` analog,
-``sentinel_tpu.adapters.http_client``).
+``sentinel_tpu.adapters.http_client``), asyncio coroutine guards
+(``sentinel_tpu.adapters.aio``), and async-stream guards — the
+``sentinel-reactor-adapter`` analog (``sentinel_tpu.adapters.streams``).
 """
 
 from sentinel_tpu.adapters.annotation import sentinel_resource
@@ -24,11 +26,13 @@ from sentinel_tpu.adapters.gateway import (
     gateway_entry,
 )
 from sentinel_tpu.adapters.http_client import SentinelHttpClient, guarded
+from sentinel_tpu.adapters.streams import guard_aiter, sentinel_stream
 from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
 
 __all__ = [
     "ApiDefinition", "ApiPredicateItem", "GatewayApiDefinitionManager",
     "GatewayFlowRule", "GatewayParamFlowItem", "GatewayRequest",
     "GatewayRuleManager", "SentinelASGIMiddleware", "SentinelHttpClient",
-    "SentinelWSGIMiddleware", "gateway_entry", "guarded", "sentinel_resource",
+    "SentinelWSGIMiddleware", "gateway_entry", "guard_aiter", "guarded",
+    "sentinel_resource", "sentinel_stream",
 ]

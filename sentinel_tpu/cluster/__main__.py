@@ -80,12 +80,19 @@ class StandaloneTokenServer:
     def _apply(self, ns_rules: Dict[str, List[FlowRule]]) -> None:
         mgr = self.service.rules
         for gone in set(mgr.namespaces()) - set(ns_rules):
-            mgr.load_rules(gone, [])
+            if mgr.get_rules(gone):  # skip already-empty: no listener churn
+                mgr.load_rules(gone, [])
         for ns, rules in ns_rules.items():
             mgr.load_rules(ns, rules)
 
     def start(self) -> "StandaloneTokenServer":
         if self._source is not None:
+            # Fail FAST on a missing/malformed rules file at startup: a
+            # server that silently binds with zero rules disables cluster
+            # limiting fleet-wide (every acquire -> NO_RULE_EXISTS ->
+            # local fallback) with no error anywhere. Later edits stay
+            # lenient — the poll loop logs and keeps the last good rules.
+            self._source.load_config()  # raises on unreadable/bad JSON
             self._source.start()  # first_load applies rules before bind
         self.server.start()
         return self

@@ -735,3 +735,98 @@ class TestAsyncioAdapter:
                 return "ok"
 
         assert asyncio.run(run()) == "ok"
+
+
+class TestStreams:
+    """Reactor-transformer analog: one entry per stream subscription."""
+
+    def test_stream_entry_spans_whole_stream(self, engine):
+        from sentinel_tpu.adapters.streams import guard_aiter
+
+        async def gen():
+            for i in range(3):
+                yield i
+
+        async def run():
+            return [x async for x in guard_aiter("streamRes", gen())]
+
+        assert asyncio.run(run()) == [0, 1, 2]
+        snap = engine.node_snapshot()["streamRes"]
+        assert snap["passQps"] == 1  # one entry for the stream, not 3
+        assert snap["curThreadNum"] == 0  # exited on completion
+
+    def test_stream_block_raises_at_first_pull(self, engine):
+        from sentinel_tpu.adapters.streams import guard_aiter
+
+        st.load_flow_rules([st.FlowRule(resource="deniedStream", count=0)])
+
+        async def gen():
+            yield 1
+
+        async def run():
+            it = guard_aiter("deniedStream", gen())
+            try:
+                async for _ in it:
+                    pass
+            except st.FlowException:
+                return "blocked"
+            return "ran"
+
+        assert asyncio.run(run()) == "blocked"
+        assert engine.node_snapshot()["deniedStream"]["blockQps"] == 1
+
+    def test_stream_error_traced_and_exited(self, engine):
+        from sentinel_tpu.adapters.streams import guard_aiter
+
+        async def gen():
+            yield 1
+            raise RuntimeError("mid-stream failure")
+
+        async def run():
+            got = []
+            try:
+                async for x in guard_aiter("errStream", gen()):
+                    got.append(x)
+            except RuntimeError:
+                return got
+            return None
+
+        assert asyncio.run(run()) == [1]
+        snap = engine.node_snapshot()["errStream"]
+        assert snap["exceptionQps"] == 1
+        assert snap["curThreadNum"] == 0
+
+    def test_stream_abandonment_exits_without_error(self, engine):
+        """Consumer breaks out early (reactor cancel): the entry exits,
+        nothing is traced."""
+        from sentinel_tpu.adapters.streams import guard_aiter
+
+        async def gen():
+            for i in range(100):
+                yield i
+
+        async def run():
+            it = guard_aiter("cancelStream", gen())
+            async for x in it:
+                break  # abandon after one element
+            await it.aclose()
+
+        asyncio.run(run())
+        snap = engine.node_snapshot()["cancelStream"]
+        assert snap["curThreadNum"] == 0
+        assert snap["exceptionQps"] == 0
+
+    def test_sentinel_stream_decorator(self, engine):
+        from sentinel_tpu.adapters.streams import sentinel_stream
+
+        @sentinel_stream("decoStream")
+        async def numbers(n):
+            for i in range(n):
+                yield i
+
+        async def run():
+            return [x async for x in numbers(2)]
+
+        assert asyncio.run(run()) == [0, 1]
+        assert engine.node_snapshot()["decoStream"]["passQps"] == 1
+        assert numbers.__sentinel_resource__ == "decoStream"

@@ -426,3 +426,19 @@ def test_standalone_server_rejects_bad_rules_file(tmp_path):
         parse_namespace_rules('{"ns": 5}')
     out = parse_namespace_rules('{"ns": []}')
     assert out == {"ns": []}
+
+
+def test_standalone_server_fails_fast_on_bad_rules_file(tmp_path):
+    from sentinel_tpu.cluster.__main__ import StandaloneTokenServer
+
+    missing = StandaloneTokenServer(port=0, host="127.0.0.1",
+                                    rules_path=str(tmp_path / "nope.json"))
+    with pytest.raises(OSError):
+        missing.start()
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    srv = StandaloneTokenServer(port=0, host="127.0.0.1",
+                                rules_path=str(bad))
+    with pytest.raises(ValueError):
+        srv.start()
