@@ -9,8 +9,12 @@ server/client interceptors (``sentinel-grpc-adapter`` — import
 ``sentinel_tpu.adapters.grpc_adapter``, requires grpcio), an outbound
 HTTP client guard (``sentinel-okhttp-adapter`` analog,
 ``sentinel_tpu.adapters.http_client``), asyncio coroutine guards
-(``sentinel_tpu.adapters.aio``), and async-stream guards — the
-``sentinel-reactor-adapter`` analog (``sentinel_tpu.adapters.streams``).
+(``sentinel_tpu.adapters.aio``), async-stream guards — the
+``sentinel-reactor-adapter`` analog (``sentinel_tpu.adapters.streams``) —
+and per-framework sugar: a Flask extension
+(``sentinel_tpu.adapters.flask_ext``) and a Django-style middleware
+(``sentinel_tpu.adapters.django_mw``), both duck-typed so neither
+framework is a dependency.
 """
 
 from sentinel_tpu.adapters.annotation import sentinel_resource

@@ -16,6 +16,34 @@ WEB_CONTEXT_NAME = "sentinel_web_context"
 DEFAULT_BLOCK_BODY = b"Blocked by Sentinel (flow limiting)"
 
 
+def enter_web_entries(resource: str, origin: str,
+                      total_resource: Optional[str]):
+    """Shared web-adapter choreography (WSGI + Django middlewares):
+    enter the web context, make the CommonTotalFilter-style aggregate
+    entry then the resource entry, and return ``(entries, cleanup)``.
+    On a BlockException any partial entries AND the context are rolled
+    back before the exception propagates to the adapter's block handler.
+    ``cleanup`` must be called exactly once, after the response body is
+    fully produced (streaming bodies defer it to exhaustion/close)."""
+    st.context_enter(WEB_CONTEXT_NAME, origin)
+    entries = []
+
+    def cleanup():
+        for e in reversed(entries):
+            e.exit()
+        st.exit_context()
+
+    try:
+        if total_resource:
+            entries.append(st.entry(total_resource, entry_type=C.EntryType.IN))
+        if resource:
+            entries.append(st.entry(resource, entry_type=C.EntryType.IN))
+    except BlockException:
+        cleanup()
+        raise
+    return entries, cleanup
+
+
 class SentinelWSGIMiddleware:
     def __init__(
         self,
@@ -40,42 +68,26 @@ class SentinelWSGIMiddleware:
         path = environ.get("PATH_INFO", "/")
         resource = self.url_cleaner(path)
         origin = self.origin_parser(environ)
-        st.context_enter(WEB_CONTEXT_NAME, origin)
-        entries = []
-
-        def cleanup():
-            for e in reversed(entries):
-                e.exit()
-            st.exit_context()
-
         try:
-            try:
-                if self.total_resource:
-                    entries.append(st.entry(self.total_resource,
-                                            entry_type=C.EntryType.IN))
-                if resource:
-                    entries.append(st.entry(resource, entry_type=C.EntryType.IN))
-            except BlockException as ex:
-                cleanup()  # an earlier entry (total resource) may be live
-                if self.block_handler is not None:
-                    return self.block_handler(environ, start_response, ex)
-                start_response("429 Too Many Requests",
-                               [("Content-Type", "text/plain")])
-                return [DEFAULT_BLOCK_BODY]
+            entries, cleanup = enter_web_entries(resource, origin,
+                                                 self.total_resource)
+        except BlockException as ex:
+            if self.block_handler is not None:
+                return self.block_handler(environ, start_response, ex)
+            start_response("429 Too Many Requests",
+                           [("Content-Type", "text/plain")])
+            return [DEFAULT_BLOCK_BODY]
+        try:
             result = self.app(environ, start_response)
         except BaseException as ex:
             for e in entries:
                 e.trace(ex)
             cleanup()
             raise
-        else:
-            # Entries stay live while the (possibly streaming) body is
-            # consumed — RT covers body generation and mid-stream errors
-            # are traced (reference CommonFilter completes after the chain).
-            return _GuardedIterable(result, entries, cleanup)
-        finally:
-            if not entries:
-                st.exit_context()
+        # Entries stay live while the (possibly streaming) body is
+        # consumed — RT covers body generation and mid-stream errors
+        # are traced (reference CommonFilter completes after the chain).
+        return _GuardedIterable(result, entries, cleanup)
 
 
 class _GuardedIterable:

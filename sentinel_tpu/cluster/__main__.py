@@ -90,10 +90,13 @@ class StandaloneTokenServer:
             # Fail FAST on a missing/malformed rules file at startup: a
             # server that silently binds with zero rules disables cluster
             # limiting fleet-wide (every acquire -> NO_RULE_EXISTS ->
-            # local fallback) with no error anywhere. Later edits stay
-            # lenient — the poll loop logs and keeps the last good rules.
-            self._source.load_config()  # raises on unreadable/bad JSON
-            self._source.start()  # first_load applies rules before bind
+            # local fallback) with no error anywhere. The validated value
+            # itself is pushed (no second, error-swallowing read to race);
+            # later edits stay lenient — the poll loop logs and keeps the
+            # last good rules.
+            value = self._source.load_config()  # raises on bad file
+            self._source.property.update_value(value)
+            self._source.start(initial_load=False)
         self.server.start()
         return self
 

@@ -57,6 +57,10 @@ class ExitBatch(NamedTuple):
     param_hash: jax.Array   # uint32[N, MAX_PARAMS]
     param_present: jax.Array  # bool[N, MAX_PARAMS]
 
+    @property
+    def size(self) -> int:
+        return self.cluster_row.shape[0]
+
 
 class Decisions(NamedTuple):
     """Per-entry verdicts coming back from the device step."""

@@ -134,6 +134,11 @@ class SentinelEngine:
         # observable through block logs).
         self.fail_open_count = 0
         self._fail_open_logged_ms = 0
+        # Per-step timing (SURVEY §5): enqueue wall per dispatch + sampled
+        # synchronous step wall; surfaced via the `profile` ops command.
+        from sentinel_tpu.metrics.profiling import StepTimer
+
+        self.step_timer = StepTimer()
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
@@ -509,10 +514,14 @@ class SentinelEngine:
             return int(dec.reason[0]), int(dec.wait_us[0])
 
     def _run_entry_batch_locked(self, batch: EntryBatch) -> Decisions:
+        from sentinel_tpu.metrics.profiling import timed_call
+
         self._ensure_compiled()
         now = time_util.current_time_millis()
         self._refresh_signals(now)
-        self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
+        self._state, dec = timed_call(
+            self.step_timer, "entry", batch.size, self._entry_jit,
+            self._state, self._rules, batch, now)
         return dec
 
     def _run_entry_batch(self, batch: EntryBatch) -> Decisions:
@@ -520,10 +529,14 @@ class SentinelEngine:
             return self._run_entry_batch_locked(batch)
 
     def _run_exit_batch(self, batch: ExitBatch) -> None:
+        from sentinel_tpu.metrics.profiling import timed_call
+
         with self._lock:
             self._ensure_compiled()
             now = time_util.current_time_millis()
-            self._state = self._exit_jit(self._state, self._rules, batch, now)
+            self._state = timed_call(
+                self.step_timer, "exit", batch.size, self._exit_jit,
+                self._state, self._rules, batch, now)
 
     # -- pipelined mode ----------------------------------------------------
 

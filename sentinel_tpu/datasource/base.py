@@ -87,8 +87,12 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def start(self) -> "AutoRefreshDataSource":
-        self.first_load()
+    def start(self, initial_load: bool = True) -> "AutoRefreshDataSource":
+        """``initial_load=False`` skips the (error-swallowing) first read —
+        for callers that already loaded, validated, and pushed the initial
+        value themselves and must not race a second read."""
+        if initial_load:
+            self.first_load()
         self._thread = threading.Thread(
             target=self._run, name="sentinel-datasource-auto-refresh", daemon=True
         )

@@ -1,0 +1,127 @@
+"""Step timing + kernel tracing (SURVEY.md §5 "Tracing / profiling").
+
+The reference has no in-process profiler (introspection stops at the
+command API's live-stat dumps); the survey's TPU plan adds two things the
+tensor design makes natural:
+
+  * **per-step timing** — every device dispatch (entry/exit batch) is
+    recorded: an enqueue wall time always (JAX dispatch is async, so this
+    measures host-side submit cost), and a *sampled* synchronous wall
+    time every ``sync_every``-th dispatch (block on the decisions) that
+    estimates true end-to-end step latency without serializing the
+    steady-state stream. Snapshots feed the ``profile`` ops command.
+  * **kernel traces** — :func:`trace` wraps ``jax.profiler`` so a window
+    of real traffic can be captured for TensorBoard/Perfetto kernel-level
+    inspection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class StepTimer:
+    """Lock-guarded rolling timing stats for device step dispatches."""
+
+    def __init__(self, ring: int = 512, sync_every: int = 64):
+        self._lock = threading.Lock()
+        self._ring = ring
+        self.sync_every = sync_every
+        self._counts: Dict[str, int] = {}
+        self._entries: Dict[str, int] = {}
+        self._enqueue: Dict[str, list] = {}
+        self._sync: Dict[str, list] = {}
+
+    def record(self, kind: str, batch_n: int, enqueue_ms: float,
+               sync_ms: Optional[float] = None) -> None:
+        with self._lock:
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            self._entries[kind] = self._entries.get(kind, 0) + batch_n
+            buf = self._enqueue.setdefault(kind, [])
+            buf.append(enqueue_ms)
+            del buf[:-self._ring]
+            if sync_ms is not None:
+                sbuf = self._sync.setdefault(kind, [])
+                sbuf.append(sync_ms)
+                del sbuf[:-self._ring]
+
+    def should_sync(self, kind: str) -> bool:
+        """True on the sampled dispatches that should block and measure."""
+        with self._lock:
+            return self._counts.get(kind, 0) % self.sync_every == 0
+
+    def snapshot(self, reset: bool = False) -> Dict[str, Dict[str, float]]:
+        """Read (and with ``reset=True`` atomically clear) the stats —
+        one lock acquisition, so a poller doing read-and-clear never
+        drops dispatches recorded between the two operations."""
+        with self._lock:
+            out = {}
+            for kind, n in self._counts.items():
+                enq = np.asarray(self._enqueue.get(kind, []) or [0.0])
+                sync = self._sync.get(kind)
+                row = {
+                    "dispatches": n,
+                    "entries": self._entries.get(kind, 0),
+                    "enqueueP50Ms": round(float(np.percentile(enq, 50)), 3),
+                    "enqueueP99Ms": round(float(np.percentile(enq, 99)), 3),
+                }
+                if sync:
+                    s = np.asarray(sync)
+                    row["stepP50Ms"] = round(float(np.percentile(s, 50)), 3)
+                    row["stepP99Ms"] = round(float(np.percentile(s, 99)), 3)
+                    row["stepSamples"] = len(sync)
+                out[kind] = row
+            if reset:
+                self._counts.clear()
+                self._entries.clear()
+                self._enqueue.clear()
+                self._sync.clear()
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._entries.clear()
+            self._enqueue.clear()
+            self._sync.clear()
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a kernel-level device trace of everything inside the block.
+
+    ``with profiling.trace("/tmp/sentinel-trace"): ...`` then open the
+    directory in TensorBoard (or xprof) to see per-kernel timing of the
+    fused step. Thin wrapper so callers don't import jax.profiler
+    directly; swallows nothing — an unsupported backend raises.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def timed_call(timer: StepTimer, kind: str, batch_n: int, fn, *args):
+    """Run ``fn(*args)`` (a jitted dispatch returning a pytree), recording
+    enqueue wall always and blocking for a true step wall on sampled
+    dispatches."""
+    do_sync = timer.should_sync(kind)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    enqueue_ms = (time.perf_counter() - t0) * 1e3
+    sync_ms = None
+    if do_sync:
+        import jax
+
+        jax.block_until_ready(out)
+        sync_ms = (time.perf_counter() - t0) * 1e3
+    timer.record(kind, batch_n, enqueue_ms, sync_ms)
+    return out

@@ -159,7 +159,8 @@ def _target_rows(cluster_row, dn_row, origin_row, entry_in):
     return jnp.stack([dn_row, cluster_row, origin_row, entry_row], axis=1)
 
 
-def _event_delta(rows4: jax.Array, pairs, num_rows: int) -> jax.Array:
+def _event_delta(rows4: jax.Array, pairs, num_rows: int,
+                 extra_cols=()) -> Tuple[jax.Array, jax.Array]:
     """All (event, values4) commits as one dense int32[E, R] delta.
 
     ``pairs``: list of (MetricEvent, values4, wide) with values4 shaped like
@@ -167,6 +168,11 @@ def _event_delta(rows4: jax.Array, pairs, num_rows: int) -> jax.Array:
     TPU scatters serialize per update and measured ~0.4ms per commit at 64k
     updates; the MXU form is microseconds. ``wide=True`` values (RT sums,
     up to 2^16) are split into byte limbs so the bf16 operands stay exact.
+
+    ``extra_cols``: further [N, 4] value sets (e.g. the thread-gauge
+    deltas) folded into the SAME bincount call — the one-hot operands are
+    the expensive part and are shared. Returns ``(delta, extras)`` with
+    ``extras`` float32[len(extra_cols), R].
     """
     rows_flat = rows4.reshape(-1)
     cols = []
@@ -177,6 +183,8 @@ def _event_delta(rows4: jax.Array, pairs, num_rows: int) -> jax.Array:
             cols += [vf % 256, vf // 256]
         else:
             cols.append(vf)
+    n_event_cols = len(cols)
+    cols += [v.reshape(-1) for v in extra_cols]
     out = seg.bincount_matmul(
         rows_flat, jnp.stack(cols, axis=1), num_rows
     )  # [C, R] float32, exact
@@ -190,7 +198,7 @@ def _event_delta(rows4: jax.Array, pairs, num_rows: int) -> jax.Array:
             combined = out[i]
             i += 1
         delta = delta.at[ev].set(combined.astype(jnp.int32))
-    return delta
+    return delta, out[n_event_cols:]
 
 
 def _apply_delta(w1: W.Window, sec: SecondAccum, delta: jax.Array, now_ms) -> Tuple[W.Window, SecondAccum]:
@@ -301,8 +309,11 @@ def entry_step(
     pass4 = jnp.broadcast_to(pass_counts[:, None], rows4.shape)
     block4 = jnp.broadcast_to(block_counts[:, None], rows4.shape)
 
-    delta = _event_delta(rows4, [(C.MetricEvent.PASS, pass4, False),
-                                 (C.MetricEvent.BLOCK, block4, False)], w1.num_rows)
+    thread_inc = jnp.broadcast_to(jnp.where(admit, 1, 0)[:, None], rows4.shape)
+    delta, extras = _event_delta(
+        rows4, [(C.MetricEvent.PASS, pass4, False),
+                (C.MetricEvent.BLOCK, block4, False)], w1.num_rows,
+        extra_cols=[thread_inc])
     w1, sec = _apply_delta(w1, sec, delta, now_ms)
     occupied_next = occupied_next + fv.occ_add
     occupied_stamp = cur_start
@@ -310,10 +321,7 @@ def entry_step(
                        .at[C.MetricEvent.PASS].add(fv.occ_add)
                        .at[C.MetricEvent.OCCUPIED_PASS].add(fv.occ_add))
 
-    thread_inc = jnp.broadcast_to(jnp.where(admit, 1, 0)[:, None], rows4.shape)
-    cur_threads = state.cur_threads + seg.bincount_matmul(
-        rows4.reshape(-1), thread_inc.reshape(-1), state.cur_threads.shape[0]
-    ).astype(jnp.int32)
+    cur_threads = state.cur_threads + extras[0].astype(jnp.int32)
 
     wait_us = jnp.where(admit, jnp.maximum(fv.wait_us, pv.wait_us), 0)
 
@@ -350,9 +358,12 @@ def exit_step(
     exc4 = jnp.broadcast_to(exc[:, None], rows4.shape)
     rt4 = jnp.broadcast_to(rt[:, None], rows4.shape)
 
-    delta = _event_delta(rows4, [(C.MetricEvent.SUCCESS, succ4, False),
-                                 (C.MetricEvent.EXCEPTION, exc4, False),
-                                 (C.MetricEvent.RT, rt4, True)], w1.num_rows)
+    thread_dec = jnp.broadcast_to(jnp.where(valid, -1, 0)[:, None], rows4.shape)
+    delta, extras = _event_delta(
+        rows4, [(C.MetricEvent.SUCCESS, succ4, False),
+                (C.MetricEvent.EXCEPTION, exc4, False),
+                (C.MetricEvent.RT, rt4, True)], w1.num_rows,
+        extra_cols=[thread_dec])
     w1, sec = _apply_delta(w1, sec, delta, now_ms)
 
     # min-RT: stage one dense [R] min then fold into the current buckets.
@@ -366,10 +377,7 @@ def exit_step(
         jnp.minimum(w1.min_rt[idx1], mstage)))
     sec = sec._replace(min_rt=jnp.minimum(sec.min_rt, mstage))
 
-    thread_dec = jnp.broadcast_to(jnp.where(valid, -1, 0)[:, None], rows4.shape)
-    cur_threads = state.cur_threads + seg.bincount_matmul(
-        rows4.reshape(-1), thread_dec.reshape(-1), state.cur_threads.shape[0]
-    ).astype(jnp.int32)
+    cur_threads = state.cur_threads + extras[0].astype(jnp.int32)
 
     degrade = D.feed_degrade(rules.degrade, state.degrade, batch, now_ms)
     param = P.feed_param_exit(rules.param, state.param, batch)

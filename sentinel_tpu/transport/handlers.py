@@ -187,6 +187,17 @@ def cmd_system_status(req: CommandRequest) -> CommandResponse:
     })
 
 
+@command_mapping("profile", "device step timing stats")
+def cmd_profile(req: CommandRequest) -> CommandResponse:
+    """Per-step timing snapshot (SURVEY §5 — no reference twin: the
+    upstream has no in-process profiler; the TPU build's dispatch timing
+    is the analog of its entry-overhead JMH harness, live). ``reset=true``
+    clears the rings after reading."""
+    reset = (req.get_param("reset") or "").lower() == "true"
+    return CommandResponse.of_success(
+        req.engine.step_timer.snapshot(reset=reset))
+
+
 @command_mapping("getSwitch", "global protection switch state")
 def cmd_get_switch(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success(

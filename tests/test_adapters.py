@@ -830,3 +830,109 @@ class TestStreams:
         assert asyncio.run(run()) == [0, 1]
         assert engine.node_snapshot()["decoStream"]["passQps"] == 1
         assert numbers.__sentinel_resource__ == "decoStream"
+
+
+class TestFrameworkSugar:
+    """Flask extension + Django-style middleware (duck-typed, no frameworks
+    installed in this image)."""
+
+    def test_flask_extension_wraps_wsgi_app(self, engine):
+        from sentinel_tpu.adapters.flask_ext import SentinelFlask
+
+        class FakeFlask:
+            def wsgi_app(self, environ, start_response):
+                start_response("200 OK", [])
+                return [b"hello"]
+
+        app = FakeFlask()
+        SentinelFlask(app=app, url_cleaner=lambda p: "/flask")
+        st.load_flow_rules([st.FlowRule(resource="/flask", count=1)])
+        statuses = []
+        for _ in range(2):
+            body = app.wsgi_app({"PATH_INFO": "/x"},
+                                lambda s, h: statuses.append(s))
+            list(body)
+        assert statuses[0].startswith("200")
+        assert statuses[1].startswith("429")
+
+    def test_django_middleware_blocks_and_traces(self, engine):
+        from sentinel_tpu.adapters.django_mw import SentinelMiddleware
+
+        class Req:
+            path = "/dj"
+
+        st.load_flow_rules([st.FlowRule(resource="/dj", count=1)])
+        mw = SentinelMiddleware(lambda request: "downstream-ok")
+        assert mw(Req()) == "downstream-ok"
+        blocked = mw(Req())
+        assert blocked.status_code == 429
+
+        # downstream exception is traced and re-raised
+        class Boom(Exception):
+            pass
+
+        def bad(request):
+            raise Boom()
+
+        st.load_flow_rules([st.FlowRule(resource="/dj", count=100)])
+        with pytest.raises(Boom):
+            SentinelMiddleware(bad)(Req())
+        assert engine.node_snapshot()["/dj"]["exceptionQps"] == 1
+
+    def test_django_middleware_custom_block_handler(self, engine):
+        from sentinel_tpu.adapters.django_mw import SentinelMiddleware
+
+        class Handled(SentinelMiddleware):
+            block_handler = staticmethod(lambda req, ex: "custom-blocked")
+
+        class Req:
+            path = "/djh"
+
+        st.load_flow_rules([st.FlowRule(resource="/djh", count=0)])
+        assert Handled(lambda r: "nope")(Req()) == "custom-blocked"
+
+    def test_flask_init_app_idempotent(self, engine):
+        from sentinel_tpu.adapters.flask_ext import SentinelFlask
+        from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+
+        class FakeFlask:
+            def wsgi_app(self, environ, start_response):
+                start_response("200 OK", [])
+                return [b"ok"]
+
+        app = FakeFlask()
+        ext = SentinelFlask(app=app, url_cleaner=lambda p: "/idem")
+        ext.init_app(app)  # app-factory pattern double-registration
+        assert isinstance(app.wsgi_app, SentinelWSGIMiddleware)
+        assert not isinstance(app.wsgi_app.app, SentinelWSGIMiddleware)
+        st.load_flow_rules([st.FlowRule(resource="/idem", count=10)])
+        list(app.wsgi_app({"PATH_INFO": "/x"}, lambda s, h: None))
+        assert engine.node_snapshot()["/idem"]["passQps"] == 1  # not 2
+
+    def test_django_streaming_response_keeps_entry_live(self, engine):
+        from sentinel_tpu.adapters.django_mw import SentinelMiddleware
+
+        class StreamingResp:
+            def __init__(self, gen):
+                self.streaming_content = gen
+
+        class Boom(Exception):
+            pass
+
+        def body():
+            yield b"a"
+            raise Boom()
+
+        class Req:
+            path = "/stream"
+
+        st.load_flow_rules([st.FlowRule(resource="/stream", count=100)])
+        mw = SentinelMiddleware(lambda request: StreamingResp(body()))
+        resp = mw(Req())
+        # entry still live until the body is consumed
+        assert engine.node_snapshot()["/stream"]["curThreadNum"] == 1
+        with pytest.raises(Boom):
+            list(resp.streaming_content)
+        snap = engine.node_snapshot()["/stream"]
+        assert snap["curThreadNum"] == 0
+        assert snap["exceptionQps"] == 1  # mid-stream error traced

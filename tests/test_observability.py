@@ -6,6 +6,7 @@ end-to-end seal (entries -> sealed second -> byte-compatible line).
 """
 
 import json
+import urllib.request
 import os
 
 import pytest
@@ -241,3 +242,60 @@ def test_named_origin_rules_fresh_before_first_compile(engine, frozen_time):
     # appA's own limit (1) governs, not the default rule's 100.
     assert st.entry_ok("r") is None
     st.exit_context()
+
+
+# -- step timing / profiling (SURVEY §5 tracing) ----------------------------
+
+def test_step_timer_records_and_samples():
+    from sentinel_tpu.metrics import StepTimer
+
+    t = StepTimer(ring=4, sync_every=2)
+    # sampling cadence: dispatch 0, 2, 4... are sync-sampled
+    assert t.should_sync("entry")
+    t.record("entry", 8, 0.5, 1.5)
+    assert not t.should_sync("entry")
+    t.record("entry", 8, 0.6)
+    assert t.should_sync("entry")
+    snap = t.snapshot()["entry"]
+    assert snap["dispatches"] == 2 and snap["entries"] == 16
+    assert snap["stepSamples"] == 1 and snap["stepP50Ms"] == 1.5
+    assert snap["enqueueP50Ms"] > 0
+    t.reset()
+    assert t.snapshot() == {}
+
+
+def test_step_timer_ring_bounded():
+    from sentinel_tpu.metrics import StepTimer
+
+    t = StepTimer(ring=4, sync_every=1)
+    for i in range(20):
+        t.record("exit", 1, float(i), float(i))
+    snap = t.snapshot()["exit"]
+    assert snap["dispatches"] == 20
+    # only the last 4 samples survive: p50 of {16..19}
+    assert snap["stepP50Ms"] >= 16.0
+
+
+def test_engine_step_timing_via_profile_command(engine, frozen_time):
+    """Entries produce timing; the `profile` ops command serves + resets."""
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    st.load_flow_rules([st.FlowRule(resource="profRes", count=100)])
+    for _ in range(3):
+        h = st.entry_ok("profRes")
+        if h:
+            h.exit()
+    snap = engine.step_timer.snapshot()
+    assert snap["entry"]["dispatches"] >= 3
+    assert snap["entry"]["stepSamples"] >= 1  # first dispatch is sampled
+    assert snap["exit"]["dispatches"] >= 3
+
+    center = CommandCenter(engine, port=0).start()
+    try:
+        url = f"http://127.0.0.1:{center.bound_port}/profile?reset=true"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            out = json.loads(r.read().decode())
+        assert out["entry"]["dispatches"] >= 3
+        assert engine.step_timer.snapshot() == {}  # reset applied
+    finally:
+        center.stop()

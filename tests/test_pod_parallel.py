@@ -415,3 +415,51 @@ def test_pod_cluster_param_full_quota_every_window(mesh):
         # and the second step proves global stop once counts propagate
         assert a1 >= thr, (w, a1)
         assert a1 + a2 <= thr + (NDEV - 1) * 2, (w, a1, a2)
+
+
+def test_pod_degrade_breaker_per_device_instance_semantics(mesh):
+    """Circuit breakers are PER-INSTANCE in the reference (no cluster mode
+    for degrade); on the pod each device is an instance: a device whose
+    local exit stream crosses the threshold opens ITS breaker; devices
+    that saw no failures stay CLOSED."""
+    reg = NodeRegistry(CAPACITY)
+    row = reg.cluster_row("shared")
+    ft, _ = F.compile_flow_rules([], reg, CAPACITY)
+    dt, di = D_.compile_degrade_rules(
+        [D_.DegradeRule(resource="shared", grade=C.DEGRADE_GRADE_EXCEPTION_COUNT,
+                        count=3, time_window=5, min_request_amount=1)],
+        reg, CAPACITY)
+    pt = PF.compile_param_rules([], reg, CAPACITY)
+    pack = S.RulePack(flow=ft, degrade=dt,
+                      authority=A.compile_authority_rules([], reg, CAPACITY),
+                      system=Y.compile_system_rules([]), param=pt)
+    one = S.make_state(CAPACITY, ft.num_rules, NOW0,
+                       degrade=D_.make_degrade_state(dt, di),
+                       param=PF.make_param_state(pt.num_rules))
+    pod = PC.make_pod_state(NDEV, one)
+    entry_fn, exit_fn = _steps(mesh)
+
+    per_dev = 4
+    # admit everywhere first
+    pod, dec = entry_fn(pod, pack, _entry_batch(row, per_dev),
+                        jnp.asarray(NOW0, jnp.int64))
+    assert _admitted(dec) == NDEV * per_dev
+
+    # device 0's lanes fail (4 errors >= count=3); all other devices succeed
+    buf = make_exit_batch_np(NDEV * per_dev)
+    buf["cluster_row"][:] = row
+    buf["dn_row"][:] = -1
+    buf["count"][:] = 1
+    fail_lane = np.zeros(NDEV * per_dev, bool)
+    fail_lane[:per_dev] = True  # shard 0 (first per_dev lanes)
+    buf["success"][:] = ~fail_lane
+    buf["error"][:] = fail_lane
+    xbatch = ExitBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+    pod = exit_fn(pod, pack, xbatch, jnp.asarray(NOW0 + 10, jnp.int64))
+
+    # next entries: shard 0 OPEN (DEGRADE), other shards still CLOSED
+    pod, dec = entry_fn(pod, pack, _entry_batch(row, per_dev),
+                        jnp.asarray(NOW0 + 20, jnp.int64))
+    reasons = np.asarray(dec.reason).reshape(NDEV, per_dev)
+    assert (reasons[0] == C.BlockReason.DEGRADE).all()
+    assert (reasons[1:] == C.BlockReason.PASS).all()
