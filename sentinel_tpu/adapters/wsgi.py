@@ -38,7 +38,11 @@ def enter_web_entries(resource: str, origin: str,
             entries.append(st.entry(total_resource, entry_type=C.EntryType.IN))
         if resource:
             entries.append(st.entry(resource, entry_type=C.EntryType.IN))
-    except BlockException:
+    except BaseException:
+        # BlockException AND unexpected errors (an SPI host slot raising,
+        # say) both roll back: a leaked partial entry would pin the
+        # aggregate resource's thread gauge and leave the web context on
+        # the worker thread for the NEXT request.
         cleanup()
         raise
     return entries, cleanup

@@ -31,17 +31,20 @@ import numpy as np
 CHECKPOINT_VERSION = 1
 
 
-def _tensor_schema(capacity: int):
+def _tensor_schema(capacity: int, w1_buckets: Optional[int] = None):
     """name -> (shape, dtype) of every persisted tensor — the single list
     driving save and restore-validation, derivable WITHOUT compiling (so
-    restore can reject an incompatible file before mutating anything)."""
+    restore can reject an incompatible file before mutating anything).
+    ``w1_buckets`` defaults to the static sample count; engines with a
+    retuned instant window (set_window_geometry) pass their own."""
     from sentinel_tpu.core import constants as C
 
     E, R = C.NUM_EVENTS, capacity
+    b1 = C.SECOND_BUCKETS if w1_buckets is None else w1_buckets
     return {
-        "w1_counts": ((C.SECOND_BUCKETS, E, R), np.int32),
-        "w1_min_rt": ((C.SECOND_BUCKETS, R), np.int32),
-        "w1_starts": ((C.SECOND_BUCKETS,), np.int64),
+        "w1_counts": ((b1, E, R), np.int32),
+        "w1_min_rt": ((b1, R), np.int32),
+        "w1_starts": ((b1,), np.int64),
         "w60_counts": ((C.MINUTE_BUCKETS, E, R), np.int32),
         "w60_min_rt": ((C.MINUTE_BUCKETS, R), np.int32),
         "w60_starts": ((C.MINUTE_BUCKETS,), np.int64),
@@ -81,6 +84,11 @@ def save_checkpoint(engine, path: str) -> None:
             "capacity": engine.capacity,
             "sealed_sec": engine._sealed_sec,
             "registry": engine.registry.to_dict(),
+            # w1 geometry: bucket COUNT alone can't distinguish a 1s/2 from
+            # a 2s/2 window, and grafting counts that covered a different
+            # span misreads QPS until rotation flushes them.
+            "w1_interval_ms": engine._spec1.interval_ms,
+            "w1_sample_count": engine._spec1.buckets,
         }
         arrays = {k: np.asarray(v) for k, v in _state_arrays(state).items()}
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
@@ -136,12 +144,23 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
             raise ValueError(
                 f"checkpoint capacity {header['capacity']} != engine "
                 f"capacity {engine.capacity}")
+        ck_spec = (header.get("w1_interval_ms", 1000),
+                   header.get("w1_sample_count",
+                              engine._spec1.buckets))
+        if ck_spec != (engine._spec1.interval_ms, engine._spec1.buckets):
+            raise ValueError(
+                f"checkpoint w1 geometry {ck_spec[0]}ms/{ck_spec[1]} buckets"
+                f" != engine {engine._spec1.interval_ms}ms/"
+                f"{engine._spec1.buckets}; retune with set_window_geometry"
+                " before restoring")
         arrays = {k: z[k] for k in z.files if k != "__header__"}
 
     # Validate BEFORE any mutation (shapes are derivable from capacity +
     # window constants, no compile needed): an incompatible or truncated
     # file must leave the engine exactly as it was.
-    for name, (shape, dtype) in _tensor_schema(engine.capacity).items():
+    schema = _tensor_schema(engine.capacity,
+                            w1_buckets=engine._spec1.buckets)
+    for name, (shape, dtype) in schema.items():
         got = arrays.get(name)
         if got is None:
             raise ValueError(f"incompatible checkpoint: missing {name}")

@@ -38,6 +38,7 @@ from sentinel_tpu.core.batch import (
 )
 from sentinel_tpu.core.exceptions import BlockException, exception_for_reason
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
+from sentinel_tpu.metrics.profiling import StepTimer, timed_call
 from sentinel_tpu.models import authority as A
 from sentinel_tpu.models import degrade as D
 from sentinel_tpu.models import flow as F
@@ -103,6 +104,29 @@ class SentinelEngine:
     def __init__(self, capacity: int = 4096):
         self.registry = NodeRegistry(capacity)
         self.capacity = capacity
+        # Instant-window geometry (reference: IntervalProperty /
+        # SampleCountProperty — core:node/). Config-seeded, runtime-tunable
+        # via set_window_geometry(); the minute window stays fixed (as
+        # upstream's minute log does).
+        from sentinel_tpu.core.config import config as _cfg
+        from sentinel_tpu.ops import window as W_
+
+        interval = _cfg.get_int("csp.sentinel.statistic.interval.ms",
+                                C.SECOND_WINDOW_MS)
+        samples = _cfg.get_int("csp.sentinel.statistic.sample.count",
+                               C.SECOND_BUCKETS)
+        self._spec1 = W_.WindowSpec(interval, samples)
+        # Push-property form, like upstream's SampleCountProperty /
+        # IntervalProperty (datasource-bindable):
+        #   engine.window_geometry_property.update_value(
+        #       {"intervalMs": 2000, "sampleCount": 4})
+        from sentinel_tpu.core.property import (
+            DynamicSentinelProperty, SimplePropertyListener)
+
+        self.window_geometry_property = DynamicSentinelProperty()
+        self.window_geometry_property.add_listener(SimplePropertyListener(
+            lambda v: self.set_window_geometry(
+                v.get("intervalMs"), v.get("sampleCount"))))
         # Global kill switch (reference: Constants.ON via the setSwitch /
         # getSwitch command handlers). Off => every entry passes unguarded.
         self.enabled = True
@@ -136,8 +160,6 @@ class SentinelEngine:
         self._fail_open_logged_ms = 0
         # Per-step timing (SURVEY §5): enqueue wall per dispatch + sampled
         # synchronous step wall; surfaced via the `profile` ops command.
-        from sentinel_tpu.metrics.profiling import StepTimer
-
         self.step_timer = StepTimer()
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
@@ -145,16 +167,8 @@ class SentinelEngine:
         self._named_origins: Dict[str, set] = {}
         self._dirty = {"flow": True, "degrade": True, "authority": True,
                        "system": True, "param": True}
-        self._exit_jit = jax.jit(S.exit_step, donate_argnums=(0,))
+        self._rebuild_w1_jits()
         self._flush_jit = jax.jit(S.flush_seconds, donate_argnums=(0,))
-        # Jitted read paths: unjitted window rotation dispatches op-by-op
-        # and measured ~100ms/read at 32k rows; one compiled program is
-        # ~1ms (see seal_metrics docstring for the 10k-resource numbers).
-        from sentinel_tpu.ops import window as W_
-
-        self._w1_read_jit = jax.jit(lambda st_, now: (
-            W_.all_totals(W_.rotate(st_.w1, now, S.SPEC_1S)),
-            st_.cur_threads))
         self._w60_read_jit = jax.jit(lambda st_, now, idx: jnp.transpose(
             W_.rotate(st_.w60, now, S.SPEC_60S).counts[idx], (2, 0, 1)))
         # SPI boot (reference: Env static init -> InitExecutor.doInit) +
@@ -170,6 +184,29 @@ class SentinelEngine:
         # fires them once the default engine is installed (the reference's
         # "first SphU.entry triggers doInit" ordering).
 
+    def _rebuild_w1_jits(self):
+        """(Re)build the spec1-dependent jits — one construction site shared
+        by __init__ and set_window_geometry, so a retuned engine cannot
+        drift from boot behavior.
+
+        Jitted read paths: unjitted window rotation dispatches op-by-op and
+        measured ~100ms/read at 32k rows; one compiled program is ~1ms (see
+        seal_metrics docstring for the 10k-resource numbers). The totals
+        read normalizes window sums to per-second QPS (reference
+        ``StatisticNode.passQps`` divides by the interval in seconds), the
+        same scaling the flow checker applies on-device.
+        """
+        from sentinel_tpu.ops import window as W_
+
+        spec1 = self._spec1
+        qps_scale = jnp.float32(1000.0 / spec1.interval_ms)
+        self._exit_jit = jax.jit(
+            functools.partial(S.exit_step, spec1=spec1), donate_argnums=(0,))
+        self._w1_read_jit = jax.jit(lambda st_, now: (
+            W_.all_totals(W_.rotate(st_.w1, now, spec1)).astype(jnp.float32)
+            * qps_scale,
+            st_.cur_threads))
+
     def _rebuild_entry_jit(self):
         # Version BEFORE checkers: a registration racing between the two
         # reads then leaves version != snapshot and the next
@@ -177,7 +214,8 @@ class SentinelEngine:
         # stale checker set forever).
         self._spi_version = self._spi.device_version()
         checkers = self._spi.device_checkers()
-        step = functools.partial(S.entry_step, extra_checkers=checkers)
+        step = functools.partial(S.entry_step, extra_checkers=checkers,
+                                 spec1=self._spec1)
         self._entry_jit = jax.jit(step, donate_argnums=(0,))
 
     # -- rule compilation --------------------------------------------------
@@ -231,7 +269,8 @@ class SentinelEngine:
             )
             self._state = S.make_state(self.capacity, ft.num_rules, now,
                                        degrade=D.make_degrade_state(dt, di),
-                                       param=P.make_param_state(pt.num_rules))
+                                       param=P.make_param_state(pt.num_rules),
+                                       spec1=self._spec1)
             self._dirty = {k: False for k in self._dirty}
             self._maybe_start_system_listener()
             return
@@ -277,6 +316,42 @@ class SentinelEngine:
             for r in self.system_rules.get_rules()
         ):
             self.system_status.start()
+
+    def set_window_geometry(self, interval_ms: Optional[int] = None,
+                            sample_count: Optional[int] = None) -> None:
+        """Retune the instant window at runtime (reference:
+        ``IntervalProperty`` / ``SampleCountProperty`` — core:node/).
+
+        The 1s-window statistics RESET under the new geometry (upstream
+        rebuilds the LeapArray the same way); breakers, param buckets, the
+        minute window, and the concurrency gauge survive. Pending occupy
+        borrows are dropped — their bucket geometry no longer exists.
+        Device shapes are static under jit, so this recompiles the step on
+        next use (~one compile, same as a capacity change would).
+        """
+        from sentinel_tpu.ops import window as W_
+
+        with self._lock:
+            cur = self._spec1
+            interval_ms = cur.interval_ms if interval_ms is None else int(interval_ms)
+            sample_count = cur.buckets if sample_count is None else int(sample_count)
+            if interval_ms <= 0 or sample_count <= 0 \
+                    or interval_ms % sample_count != 0:
+                raise ValueError(
+                    f"invalid window geometry: interval {interval_ms}ms must "
+                    f"be a positive multiple of sample count {sample_count}")
+            new = W_.WindowSpec(interval_ms, sample_count)
+            if new == cur:
+                return
+            self._spec1 = new
+            self._rebuild_w1_jits()
+            self._rebuild_entry_jit()  # closes over the new spec
+            if self._state is not None:
+                self._state = self._state._replace(
+                    w1=W_.make_window(self.capacity, new),
+                    occupied_next=jnp.zeros((self.capacity,), jnp.int32),
+                    occupied_stamp=jnp.int64(-1),
+                )
 
     def close(self) -> None:
         """Stop background workers (pipeline, host OS sampler, cluster role)."""
@@ -514,8 +589,6 @@ class SentinelEngine:
             return int(dec.reason[0]), int(dec.wait_us[0])
 
     def _run_entry_batch_locked(self, batch: EntryBatch) -> Decisions:
-        from sentinel_tpu.metrics.profiling import timed_call
-
         self._ensure_compiled()
         now = time_util.current_time_millis()
         self._refresh_signals(now)
@@ -529,8 +602,6 @@ class SentinelEngine:
             return self._run_entry_batch_locked(batch)
 
     def _run_exit_batch(self, batch: ExitBatch) -> None:
-        from sentinel_tpu.metrics.profiling import timed_call
-
         with self._lock:
             self._ensure_compiled()
             now = time_util.current_time_millis()
@@ -696,7 +767,11 @@ class SentinelEngine:
     # -- introspection (ops plane) ----------------------------------------
 
     def row_stats(self):
-        """(totals int[R, E] over the 1s window, threads int[R]) as numpy."""
+        """(per-second QPS totals f32[R, E], threads int[R]) as numpy.
+
+        Totals are normalized by the instant-window interval, so they stay
+        per-second rates whatever geometry set_window_geometry picked.
+        """
         with self._lock:
             self._ensure_compiled()
             now = time_util.current_time_millis()
@@ -716,17 +791,19 @@ class SentinelEngine:
         def render(row: int) -> Dict:
             m = self.registry.meta[row]
             t = totals[row]
-            succ = max(int(t[C.MetricEvent.SUCCESS]), 1)
+            succ = float(t[C.MetricEvent.SUCCESS])
             return {
                 "id": m.row,
                 "resource": m.resource,
                 "threadNum": int(threads[row]),
-                "passQps": int(t[C.MetricEvent.PASS]),
-                "blockQps": int(t[C.MetricEvent.BLOCK]),
-                "totalQps": int(t[C.MetricEvent.PASS]) + int(t[C.MetricEvent.BLOCK]),
-                "successQps": int(t[C.MetricEvent.SUCCESS]),
-                "exceptionQps": int(t[C.MetricEvent.EXCEPTION]),
-                "averageRt": float(t[C.MetricEvent.RT]) / succ,
+                "passQps": float(t[C.MetricEvent.PASS]),
+                "blockQps": float(t[C.MetricEvent.BLOCK]),
+                "totalQps": float(t[C.MetricEvent.PASS]) + float(t[C.MetricEvent.BLOCK]),
+                "successQps": succ,
+                "exceptionQps": float(t[C.MetricEvent.EXCEPTION]),
+                # scale cancels in the ratio: RT and SUCCESS carry the same
+                # per-second normalization
+                "averageRt": float(t[C.MetricEvent.RT]) / succ if succ > 0 else 0.0,
                 "children": [render(c) for c in m.children],
             }
 
@@ -744,13 +821,13 @@ class SentinelEngine:
         out = {}
         for res, row in self.registry.resources().items():
             t = totals[row]
-            succ = max(int(t[C.MetricEvent.SUCCESS]), 1)
+            succ = float(t[C.MetricEvent.SUCCESS])
             out[res] = {
                 "passQps": float(t[C.MetricEvent.PASS]),
                 "blockQps": float(t[C.MetricEvent.BLOCK]),
-                "successQps": float(t[C.MetricEvent.SUCCESS]),
+                "successQps": succ,
                 "exceptionQps": float(t[C.MetricEvent.EXCEPTION]),
-                "avgRt": float(t[C.MetricEvent.RT]) / succ,
+                "avgRt": float(t[C.MetricEvent.RT]) / succ if succ > 0 else 0.0,
                 "curThreadNum": int(threads[row]),
             }
         return out

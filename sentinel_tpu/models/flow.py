@@ -329,6 +329,7 @@ def check_flow(
     extra_next: Optional[jax.Array] = None,  # int32[R] other-device next-window use
     extra_pass_global: Optional[jax.Array] = None,  # int32[R] cross-POD passes
     extra_next_global: Optional[jax.Array] = None,  # int32[R] cross-POD next use
+    spec: Optional[W.WindowSpec] = None,  # w1 geometry (engine may retune)
 ) -> FlowVerdict:
     """Vectorized ``FlowRuleChecker.checkFlow`` over the micro-batch.
 
@@ -345,7 +346,8 @@ def check_flow(
     interacting rules the residual error is second-order and bounded by one
     micro-batch (documented delta, SURVEY.md §7 hard part #2).
     """
-    spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
+    if spec is None:
+        spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
     candidate = (~already_blocked) & (batch.cluster_row >= 0)
 
     # Warm-up token sync (per rule, once per second) against the node the
@@ -359,12 +361,14 @@ def check_flow(
         rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
         extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
+        spec=spec,
     )
     blocked, wait_us, consumed, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
         survivors=candidate & (~blocked1), extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
         extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
+        spec=spec,
     )
 
     # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
@@ -391,6 +395,7 @@ def _eval_flow_slots(
     extra_next: Optional[jax.Array] = None,
     extra_pass_global: Optional[jax.Array] = None,
     extra_next_global: Optional[jax.Array] = None,
+    spec: Optional[W.WindowSpec] = None,
 ):
     """One vectorized sweep over all rule slots.
 
@@ -425,7 +430,8 @@ def _eval_flow_slots(
     # Occupy-next-window geometry (DefaultController.tryOccupyNext): at the
     # next bucket boundary the OLDEST bucket's counts leave the window, so
     # next-window usage = window pass − oldest-bucket pass + already-borrowed.
-    spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
+    if spec is None:
+        spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
     cur_idx = W.current_index(now_ms, spec)
     oldest_idx = jnp.mod(cur_idx + 1, spec.buckets)
     oldest_pass_all = jnp.take(w1.counts[:, C.MetricEvent.PASS, :], oldest_idx, axis=0)  # [R]
@@ -498,6 +504,12 @@ def _eval_flow_slots(
                     _gather(extra_pass_global, sel_row, 0).astype(jnp.float32),
                     extra)
             used_qps = used_qps + jnp.where(cm, extra, 0.0)
+        # Normalize window sums to per-second QPS (reference
+        # StatisticNode.passQps divides by the interval in seconds) — a
+        # constant 1.0 under the default 1s geometry, load-bearing when
+        # the engine retunes the window (set_window_geometry).
+        qps_scale = jnp.float32(1000.0 / spec.interval_ms)
+        used_qps = used_qps * qps_scale
         used_thr = (
             _gather(cur_threads, sel_row, 0).astype(jnp.float32)
             + ent_prefix.astype(jnp.float32)
@@ -579,7 +591,7 @@ def _eval_flow_slots(
                         en)
                 next_used = next_used + jnp.where(
                     g(rt.cluster_mode, False), en, 0.0)
-            grant = occ_cand & (next_used + acq <= thr) & (
+            grant = occ_cand & (next_used * qps_scale + acq <= thr) & (
                 occ_wait_us <= C.DEFAULT_OCCUPY_TIMEOUT_MS * 1000
             )
             occupied = occupied | grant

@@ -108,6 +108,7 @@ def check_system(
     batch: EntryBatch,
     candidate: jax.Array,    # bool[N]
     now_ms: jax.Array,
+    spec1: Optional[W.WindowSpec] = None,  # w1 geometry (engine may retune)
 ) -> jax.Array:
     """Vectorized ``SystemRuleManager.checkSystem``: bool[N] blocked.
 
@@ -117,10 +118,11 @@ def check_system(
     "blocked requests never count" rule (same convention as check_flow).
     """
     pass1 = _eval_system(rt, signals, w1, w60, sec_counts, cur_threads, batch,
-                         candidate, survivors=candidate, now_ms=now_ms)
+                         candidate, survivors=candidate, now_ms=now_ms,
+                         spec1=spec1)
     return _eval_system(rt, signals, w1, w60, sec_counts, cur_threads, batch,
                         candidate, survivors=candidate & (~pass1),
-                        now_ms=now_ms)
+                        now_ms=now_ms, spec1=spec1)
 
 
 def _eval_system(
@@ -134,6 +136,7 @@ def _eval_system(
     candidate: jax.Array,
     survivors: jax.Array,
     now_ms: jax.Array,
+    spec1: Optional[W.WindowSpec] = None,
 ) -> jax.Array:
     n = batch.size
     applicable = candidate & batch.entry_in & rt.enabled
@@ -145,8 +148,14 @@ def _eval_system(
     ent_contrib = jnp.where(survivors & batch.entry_in, 1, 0)
     ent_prefix = jnp.cumsum(ent_contrib) - ent_contrib
 
+    # Per-second normalization of window sums (reference passQps divides by
+    # the interval seconds) — 1.0 under the default geometry.
+    qps_scale = jnp.float32(
+        1000.0 / (spec1.interval_ms if spec1 is not None
+                  else C.SECOND_WINDOW_MS))
     totals = W.all_totals(w1)[ENTRY_ROW]  # [E]
-    pass_qps = totals[C.MetricEvent.PASS].astype(jnp.float32) + tok_prefix.astype(jnp.float32)
+    pass_qps = (totals[C.MetricEvent.PASS].astype(jnp.float32)
+                + tok_prefix.astype(jnp.float32)) * qps_scale
     succ = jnp.maximum(totals[C.MetricEvent.SUCCESS].astype(jnp.float32), 1.0)
     cur_rt = totals[C.MetricEvent.RT].astype(jnp.float32) / succ
     threads = cur_threads[ENTRY_ROW].astype(jnp.float32) + ent_prefix.astype(jnp.float32)

@@ -90,14 +90,15 @@ class RulePack(NamedTuple):
 
 def make_state(num_rows: int, flow_rules: int, now_ms: int,
                degrade: D.DegradeState = None,
-               param: P.ParamFlowState = None) -> SentinelState:
+               param: P.ParamFlowState = None,
+               spec1: W.WindowSpec = SPEC_1S) -> SentinelState:
     if degrade is None:
         dt, di = D.compile_degrade_rules([], None, num_rows)
         degrade = D.make_degrade_state(dt, di)
     if param is None:
         param = P.make_param_state(0)
     return SentinelState(
-        w1=W.make_window(num_rows, SPEC_1S),
+        w1=W.make_window(num_rows, spec1),
         w60=W.make_window(num_rows, SPEC_60S),
         cur_threads=jnp.zeros((num_rows,), jnp.int32),
         flow=F.make_flow_state(flow_rules, now_ms),
@@ -201,9 +202,10 @@ def _event_delta(rows4: jax.Array, pairs, num_rows: int,
     return delta, out[n_event_cols:]
 
 
-def _apply_delta(w1: W.Window, sec: SecondAccum, delta: jax.Array, now_ms) -> Tuple[W.Window, SecondAccum]:
+def _apply_delta(w1: W.Window, sec: SecondAccum, delta: jax.Array, now_ms,
+                 spec1: W.WindowSpec) -> Tuple[W.Window, SecondAccum]:
     """Fold a dense [E, R] delta into w1's current bucket + the second acc."""
-    idx1 = W.current_index(now_ms, SPEC_1S)
+    idx1 = W.current_index(now_ms, spec1)
     w1 = w1._replace(counts=w1.counts.at[idx1].add(delta))
     return w1, sec._replace(counts=sec.counts + delta)
 
@@ -219,6 +221,7 @@ def entry_step(
     extra_checkers: tuple = (),
     extra_pass_global=None,
     extra_next_global=None,
+    spec1: W.WindowSpec = SPEC_1S,
 ) -> Tuple[SentinelState, Decisions]:
     """One admission step. ``extra_pass`` / ``extra_next`` (int32[R]) /
     ``extra_cms`` (f32[PR, D, W] param sketch), all optional, are the
@@ -229,7 +232,7 @@ def entry_step(
     spliced between the param-flow and flow slots — the reference's
     SlotChainBuilder splice point. Static (closed over at jit time)."""
     now_ms = jnp.asarray(now_ms, jnp.int64)
-    w1 = W.rotate(state.w1, now_ms, SPEC_1S)
+    w1 = W.rotate(state.w1, now_ms, spec1)
     # Minute-window commits are staged in the [E, R] second accumulator and
     # folded at most once per second; readers (BBR check below, host metric
     # sealing) combine w60 + the live accumulator themselves.
@@ -240,10 +243,10 @@ def entry_step(
     # OccupiableBucketLeapArray.resetWindowTo transfers the borrow bucket).
     # A jump of 2+ buckets means the target bucket already expired — the
     # borrows are dropped, like a borrow bucket the ring rotated past.
-    idx1 = W.current_index(now_ms, SPEC_1S)
-    cur_start = now_ms - now_ms % SPEC_1S.bucket_ms
+    idx1 = W.current_index(now_ms, spec1)
+    cur_start = now_ms - now_ms % spec1.bucket_ms
     moved = (state.occupied_stamp >= 0) & (cur_start != state.occupied_stamp)
-    land = moved & (cur_start == state.occupied_stamp + SPEC_1S.bucket_ms)
+    land = moved & (cur_start == state.occupied_stamp + spec1.bucket_ms)
     w1 = w1._replace(counts=w1.counts.at[idx1, C.MetricEvent.PASS].add(
         jnp.where(land, state.occupied_next, 0)))
     occupied_next = jnp.where(moved, 0, state.occupied_next)
@@ -264,7 +267,8 @@ def entry_step(
 
     cand = valid & (~blocked)
     sys_blocked = Y.check_system(rules.system, state.sys_signals, w1, w60,
-                                 sec.counts, state.cur_threads, batch, cand, now_ms)
+                                 sec.counts, state.cur_threads, batch, cand,
+                                 now_ms, spec1=spec1)
     reason = jnp.where(cand & sys_blocked, C.BlockReason.SYSTEM, reason)
     blocked = blocked | sys_blocked
 
@@ -285,7 +289,7 @@ def entry_step(
                       extra_pass=extra_pass, occupied_next=occupied_next,
                       extra_next=extra_next,
                       extra_pass_global=extra_pass_global,
-                      extra_next_global=extra_next_global)
+                      extra_next_global=extra_next_global, spec=spec1)
     reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
     blocked = blocked | fv.blocked
 
@@ -314,7 +318,7 @@ def entry_step(
         rows4, [(C.MetricEvent.PASS, pass4, False),
                 (C.MetricEvent.BLOCK, block4, False)], w1.num_rows,
         extra_cols=[thread_inc])
-    w1, sec = _apply_delta(w1, sec, delta, now_ms)
+    w1, sec = _apply_delta(w1, sec, delta, now_ms, spec1)
     occupied_next = occupied_next + fv.occ_add
     occupied_stamp = cur_start
     sec = sec._replace(counts=sec.counts
@@ -338,6 +342,7 @@ def exit_step(
     rules: RulePack,
     batch: ExitBatch,
     now_ms: jax.Array,
+    spec1: W.WindowSpec = SPEC_1S,
 ) -> SentinelState:
     """Completion commit: RT + success/exception, thread decrement.
 
@@ -345,7 +350,7 @@ def exit_step(
     (SURVEY.md §3.1 "LeapArray write #2").
     """
     now_ms = jnp.asarray(now_ms, jnp.int64)
-    w1 = W.rotate(state.w1, now_ms, SPEC_1S)
+    w1 = W.rotate(state.w1, now_ms, spec1)
     w60, sec = _roll_second(state.w60, state.sec, now_ms)
 
     valid = batch.cluster_row >= 0
@@ -364,7 +369,7 @@ def exit_step(
                 (C.MetricEvent.EXCEPTION, exc4, False),
                 (C.MetricEvent.RT, rt4, True)], w1.num_rows,
         extra_cols=[thread_dec])
-    w1, sec = _apply_delta(w1, sec, delta, now_ms)
+    w1, sec = _apply_delta(w1, sec, delta, now_ms, spec1)
 
     # min-RT: stage one dense [R] min then fold into the current buckets.
     num_rows = w1.num_rows
@@ -372,7 +377,7 @@ def exit_step(
     mstage = jnp.full((num_rows,), W.MIN_RT_EMPTY, jnp.int32).at[
         W.oob(rows4.reshape(-1), num_rows)
     ].min(rt_obs.reshape(-1).astype(jnp.int32), mode="drop")
-    idx1 = W.current_index(now_ms, SPEC_1S)
+    idx1 = W.current_index(now_ms, spec1)
     w1 = w1._replace(min_rt=w1.min_rt.at[idx1].set(
         jnp.minimum(w1.min_rt[idx1], mstage)))
     sec = sec._replace(min_rt=jnp.minimum(sec.min_rt, mstage))

@@ -176,12 +176,12 @@ def cmd_system_status(req: CommandRequest) -> CommandResponse:
     from sentinel_tpu.core.registry import ENTRY_ROW
 
     t = totals[ENTRY_ROW]
-    succ = max(int(t[C.MetricEvent.SUCCESS]), 1)
+    succ = float(t[C.MetricEvent.SUCCESS])
     return CommandResponse.of_success({
         "load": float(sig[0]),
         "cpuUsage": float(sig[1]),
-        "qps": int(t[C.MetricEvent.PASS]),
-        "avgRt": float(t[C.MetricEvent.RT]) / succ,
+        "qps": float(t[C.MetricEvent.PASS]),
+        "avgRt": float(t[C.MetricEvent.RT]) / succ if succ > 0 else 0.0,
         "maxThread": int(threads[ENTRY_ROW]),
         "failOpenCount": int(getattr(eng, "fail_open_count", 0)),
     })

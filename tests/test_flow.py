@@ -186,3 +186,80 @@ def test_warmup_cold_start_throttles(engine, frozen_time):
             passed += 1
     # cold threshold is count/coldFactor = 30
     assert passed == pytest.approx(30, abs=1)
+
+
+# -- dynamic window geometry (IntervalProperty / SampleCountProperty) -------
+
+class TestWindowGeometry:
+    def test_default_geometry_from_config(self, engine):
+        assert engine._spec1.interval_ms == 1000
+        assert engine._spec1.buckets == 2
+
+    def test_invalid_geometry_rejected(self, engine):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            engine.set_window_geometry(interval_ms=1000, sample_count=3)
+        with _pytest.raises(ValueError):
+            engine.set_window_geometry(interval_ms=0)
+
+    def test_retune_resets_instant_window_and_keeps_quota_rate(
+            self, engine, frozen_time):
+        """After retuning to a 2s/4-bucket window the QPS threshold still
+        means per-SECOND (passQps normalization), and the instant stats
+        reset under the new geometry."""
+        st.load_flow_rules([st.FlowRule(resource="geo", count=3)])
+        assert sum(1 for _ in range(5) if st.entry_ok("geo")) == 3
+
+        engine.set_window_geometry(interval_ms=2000, sample_count=4)
+        assert engine._spec1.bucket_ms == 500
+        # Stats reset + per-second normalization (passQps = window sum
+        # * 1000/interval): the i-th burst entry sees used = i*0.5 QPS, so
+        # i=0..4 satisfy used + 1 <= 3 and the 6th blocks — a 2s window
+        # smooths the instantaneous burst to its per-second average,
+        # exactly the reference's IntervalProperty behavior.
+        got = [bool(st.entry_ok("geo")) for _ in range(7)]
+        assert got == [True] * 5 + [False] * 2
+
+    def test_retune_survives_minute_window_and_breakers(self, engine,
+                                                        frozen_time):
+        """Minute-window history and param/degrade state survive a retune;
+        only the instant window resets."""
+        st.load_flow_rules([st.FlowRule(resource="geo2", count=100)])
+        for _ in range(4):
+            h = st.entry_ok("geo2")
+            if h:
+                h.exit()
+        frozen_time.advance_time(2_000)  # seal the second into w60
+        lines = engine.seal_metrics()
+        assert any("geo2" in ln for ln in map(str, lines))
+
+        engine.set_window_geometry(interval_ms=500, sample_count=1)
+        # minute window kept: sealing again right after the retune must not
+        # lose the already-staged history (only the INSTANT window reset)
+        snap = engine.node_snapshot()["geo2"]
+        assert snap["passQps"] == 0.0  # instant window was reset
+        assert snap["curThreadNum"] == 0
+        # the engine still admits under the new geometry
+        assert st.entry_ok("geo2")
+
+    def test_sample_count_config_key(self, engine, monkeypatch):
+        from sentinel_tpu.core.config import config
+
+        monkeypatch.setenv("CSP_SENTINEL_STATISTIC_SAMPLE_COUNT", "4")
+        config.reset_for_tests()
+        try:
+            eng = st.reset(capacity=256)
+            assert eng._spec1.buckets == 4
+        finally:
+            monkeypatch.delenv("CSP_SENTINEL_STATISTIC_SAMPLE_COUNT")
+            config.reset_for_tests()
+            st.reset(capacity=256)
+
+    def test_geometry_property_push(self, engine):
+        """SampleCountProperty/IntervalProperty push form: a datasource can
+        drive the geometry like any rule property."""
+        engine.window_geometry_property.update_value(
+            {"intervalMs": 2000, "sampleCount": 4})
+        assert engine._spec1.interval_ms == 2000
+        assert engine._spec1.buckets == 4
