@@ -138,7 +138,16 @@ def _tunnel_rtt_ms() -> float:
 
 
 def bench_p99_latency() -> dict:
-    """p99 entry-to-verdict through the pipelined engine, 8 submitters."""
+    """p99 entry-to-verdict, two paths:
+
+    1. the TOKEN-LEASE sync path (core/lease.py) — the default mode for
+       simple QPS-ruled resources: host admission, async device commit.
+       This is the number comparable to the reference's in-JVM entry
+       overhead (the <50µs north star).
+    2. the pipelined device path, with the tunnel-RTT decomposition —
+       the floor for resources that genuinely need per-entry device
+       verdicts (cluster mode, breakers, hot params).
+    """
     import sentinel_tpu as st
     from sentinel_tpu.core.batch import (
         EntryBatch, ExitBatch, make_entry_batch_np, make_exit_batch_np,
@@ -148,6 +157,40 @@ def bench_p99_latency() -> dict:
     st.load_flow_rules([st.FlowRule(resource=f"lat{i}", count=1e9)
                         for i in range(8)])
     rows = [eng.registry.cluster_row(f"lat{i}") for i in range(8)]
+
+    # --- 1. leased sync path ------------------------------------------
+    assert all(f"lat{i}" in eng._leases for i in range(8)), \
+        "latency resources must be lease-eligible"
+    for i in range(8):  # absorb lazy committer start + first flush widths
+        h = st.entry_ok(f"lat{i}")
+        if h:
+            h.exit()
+    lease_lat = [[] for _ in range(8)]
+    barrier = threading.Barrier(8)
+
+    def lease_worker(tid: int):
+        res = f"lat{tid}"
+        sink = lease_lat[tid]
+        barrier.wait()
+        for _ in range(2000):
+            t0 = time.perf_counter()
+            h = st.entry_ok(res)
+            sink.append((time.perf_counter() - t0) * 1e6)
+            if h:
+                h.exit()
+
+    threads = [threading.Thread(target=lease_worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lease_flat = np.concatenate(
+        [np.asarray(x)[len(x) // 10:] for x in lease_lat])
+    leased = {
+        "leased_p50_entry_us": round(float(np.percentile(lease_flat, 50)), 1),
+        "leased_p99_entry_us": round(float(np.percentile(lease_flat, 99)), 1),
+    }
 
     # Pre-compile the ladder widths 8 concurrent submitters actually hit,
     # for entry AND exit, so the timed section never absorbs an XLA compile
@@ -210,6 +253,7 @@ def bench_p99_latency() -> dict:
     rtt_ms = _tunnel_rtt_ms()
     p99 = float(np.percentile(flat, 99))
     return {
+        **leased,
         "p50_entry_us": round(float(np.percentile(flat, 50)), 1),
         "p99_entry_us": round(p99, 1),
         "pipeline_qps": round(n_threads * per_thread / wall, 1),

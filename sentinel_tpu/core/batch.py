@@ -35,6 +35,8 @@ class EntryBatch(NamedTuple):
                              # a remote token server for this request
     pre_blocked: jax.Array   # bool[N] a remote token server already rejected
                              # this request; commit block stats, skip slots
+    pre_passed: jax.Array    # bool[N] already admitted host-side (token
+                             # lease) or remotely; commit PASS, skip slots
     param_hash: jax.Array   # uint32[N, MAX_PARAMS] hot-param value hashes
     param_present: jax.Array  # bool[N, MAX_PARAMS]
 
@@ -95,6 +97,7 @@ def make_entry_batch_np(n: int):
         entry_in=np.zeros(n, bool),
         skip_cluster=np.zeros(n, bool),
         pre_blocked=np.zeros(n, bool),
+        pre_passed=np.zeros(n, bool),
         param_hash=np.zeros((n, MAX_PARAMS), np.uint32),
         param_present=np.zeros((n, MAX_PARAMS), bool),
     )

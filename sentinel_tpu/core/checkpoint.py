@@ -192,6 +192,9 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
             occupied_next=jnp.asarray(arrays["occupied_next"]),
             occupied_stamp=jnp.asarray(arrays["occupied_stamp"]),
         )
+    # Lease mirrors must match the restored windows, or host admission
+    # would re-grant quota the snapshot already spent.
+    engine._seed_leases_from_state()
 
 
 class CheckpointTimer:

@@ -54,10 +54,11 @@ class EntryHandle:
     __slots__ = (
         "engine", "resource", "context", "cluster_row", "dn_row", "origin_row",
         "entry_in", "count", "created_ms", "error", "exited", "params",
+        "leased",
     )
 
     def __init__(self, engine, resource, context, cluster_row, dn_row,
-                 origin_row, entry_in, count, params):
+                 origin_row, entry_in, count, params, leased=False):
         self.engine = engine
         self.resource = resource
         self.context = context
@@ -70,6 +71,7 @@ class EntryHandle:
         self.error = False
         self.exited = False
         self.params = params
+        self.leased = leased
 
     def trace(self, ex: Optional[BaseException] = None) -> None:
         """Record a business exception (reference: ``Tracer.trace``)."""
@@ -115,6 +117,15 @@ class SentinelEngine:
                                 C.SECOND_WINDOW_MS)
         samples = _cfg.get_int("csp.sentinel.statistic.sample.count",
                                C.SECOND_BUCKETS)
+        if interval <= 0 or samples <= 0 or interval % samples != 0:
+            # Same validation set_window_geometry enforces; a bad config
+            # value must not brick boot (sample_count=0 would divide by
+            # zero on the first rotate) — fall back to defaults, loudly.
+            from sentinel_tpu.log.record_log import record_log
+
+            record_log.warn("invalid csp.sentinel.statistic geometry "
+                            "%sms/%s; using defaults", interval, samples)
+            interval, samples = C.SECOND_WINDOW_MS, C.SECOND_BUCKETS
         self._spec1 = W_.WindowSpec(interval, samples)
         # Push-property form, like upstream's SampleCountProperty /
         # IntervalProperty (datasource-bindable):
@@ -161,6 +172,13 @@ class SentinelEngine:
         # Per-step timing (SURVEY §5): enqueue wall per dispatch + sampled
         # synchronous step wall; surfaced via the `profile` ops command.
         self.step_timer = StepTimer()
+        # Token-lease fast path (core/lease.py): host-admitted resources +
+        # the async stats committer. Rebuilt on every rule push.
+        self.lease_enabled = (
+            (_cfg.get("csp.sentinel.lease.enabled") or "true").lower()
+            != "false")
+        self._leases: Dict[str, "object"] = {}
+        self._committer = None
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
@@ -183,6 +201,65 @@ class SentinelEngine:
         # mid-construction would hit a half-assigned singleton. get_engine()
         # fires them once the default engine is installed (the reference's
         # "first SphU.entry triggers doInit" ordering).
+
+    def _rebuild_leases(self) -> None:
+        """Recompute the token-lease table from current rules + geometry.
+
+        Mirrors must NOT reset to zero on a rule push — re-granting quota
+        already spent this window would double-admit. Surviving resources
+        carry their mirror over; newly-eligible ones seed from the device
+        window (their past traffic took the device path, so the window IS
+        their usage)."""
+        from sentinel_tpu.core.lease import build_lease_table
+
+        old = self._leases
+        new = build_lease_table(self) if self.lease_enabled else {}
+        fresh = []
+        for res, lease in new.items():
+            prev = old.get(res)
+            if prev is not None and prev.buckets == lease.buckets \
+                    and prev.bucket_ms == lease.bucket_ms:
+                lease.seed(*prev.snapshot())
+            else:
+                fresh.append(res)
+        self._leases = new
+        if fresh and self._state is not None:
+            self._seed_leases_from_state(only=fresh)
+
+    def _ensure_committer(self):
+        committer = self._committer
+        if committer is None:
+            from sentinel_tpu.core.lease import StatsCommitter
+
+            with self._lock:
+                if self._committer is None:
+                    self._committer = StatsCommitter(self).start()
+                committer = self._committer
+        return committer
+
+    def _flush_committer(self) -> None:
+        """Drain pending leased commits so reads are deterministic."""
+        committer = self._committer
+        if committer is not None:
+            committer.flush()
+
+    def _seed_leases_from_state(self, only: Optional[List[str]] = None) -> None:
+        """Adopt device windows into the lease mirrors (checkpoint warm
+        restart; newly-eligible resources) — a fresh mirror would re-grant
+        spent quota."""
+        targets = {res: lease for res, lease in self._leases.items()
+                   if only is None or res in only}
+        if not targets:
+            return
+        with self._lock:
+            if self._state is None:
+                return
+            pass_counts = np.asarray(
+                self._state.w1.counts[:, C.MetricEvent.PASS, :])
+            starts = np.asarray(self._state.w1.starts)
+            rows = {res: self.registry.cluster_row(res) for res in targets}
+        for res, lease in targets.items():
+            lease.seed(starts, pass_counts[:, rows[res]])
 
     def _rebuild_w1_jits(self):
         """(Re)build the spec1-dependent jits — one construction site shared
@@ -223,6 +300,7 @@ class SentinelEngine:
     def _mark_dirty(self, family: str):
         with self._lock:
             self._dirty[family] = True
+            self._rebuild_leases()
 
     def _on_rules_changed(self, family: str):
         """Flow/param loads also rebuild the host-side cluster-rule maps
@@ -230,6 +308,7 @@ class SentinelEngine:
         lock-free: the dicts are replaced wholesale, never mutated."""
         with self._lock:
             self._dirty[family] = True
+            self._rebuild_leases()
             if family == "flow":
                 rules = self.flow_rules.get_rules()
                 self._cluster_flow_info = self._cluster_info(rules)
@@ -346,6 +425,7 @@ class SentinelEngine:
             self._spec1 = new
             self._rebuild_w1_jits()
             self._rebuild_entry_jit()  # closes over the new spec
+            self._rebuild_leases()  # mirrors carry the window geometry
             if self._state is not None:
                 self._state = self._state._replace(
                     w1=W_.make_window(self.capacity, new),
@@ -355,6 +435,13 @@ class SentinelEngine:
 
     def close(self) -> None:
         """Stop background workers (pipeline, host OS sampler, cluster role)."""
+        # Leases off FIRST so no new entry takes the fast path, then drain
+        # and stop the committer; a leased handle exiting after close falls
+        # back to the synchronous device path (_do_exit checks _committer).
+        self._leases = {}
+        committer, self._committer = self._committer, None
+        if committer is not None:
+            committer.stop()
         self.stop_pipeline()
         self.system_status.stop()
         self.cluster.stop()
@@ -463,6 +550,39 @@ class SentinelEngine:
                       time_util.current_time_millis())
             raise custom_ex
 
+        # Token-lease fast path (core/lease.py): eligible resources admit
+        # host-side (device-exact DEFAULT math, serially exact under one
+        # lock) and stream their stats to the device asynchronously —
+        # sync-path latency drops from one device dispatch to microseconds.
+        # (prioritized requests keep the device path: a rejected one may
+        # still be granted an occupy-next-window borrow there.)
+        lease = self._leases.get(resource)
+        if lease is not None and not prioritized and not slots \
+                and self._pipeline is None \
+                and not self._spi.device_checkers():
+            now = time_util.current_time_millis()
+            passed = lease.try_acquire(count, now)
+            self._ensure_committer().add_entry(
+                cluster_row, dn_row, origin_row, entry_in, count, passed)
+            if not passed:
+                ctx_mod.auto_exit_context()
+                ex = exception_for_reason(int(C.BlockReason.FLOW), resource)
+                from sentinel_tpu.log.record_log import log_block
+
+                log_block(resource, type(ex).__name__, ctx.origin, count, now)
+                raise ex
+            handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
+                                 origin_row, entry_in, count, params,
+                                 leased=True)
+            ctx.entry_stack.append(handle)
+            return handle
+
+        if lease is not None:
+            # Device path on a LEASED resource (prioritized request or the
+            # pipeline mode): land pending leased commits first so the
+            # device check sees them, and mirror the verdict below so the
+            # lease never drifts from the device window.
+            self._flush_committer()
         skip_cluster, pre_blocked = self._cluster_token_check(
             resource, count, prioritized, args)
         reason, wait_us = self._submit_entry(
@@ -482,6 +602,10 @@ class SentinelEngine:
             raise ex
         if wait_us > 0:
             time.sleep(wait_us / 1e6)
+        if lease is not None:
+            # Occupy grants land in the bucket after the wait — recording
+            # post-sleep stamps them there.
+            lease.add(count, time_util.current_time_millis())
 
         handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
                              origin_row, entry_in, count, params)
@@ -656,6 +780,17 @@ class SentinelEngine:
 
                     record_log.warn("SPI slot %r on_exit failed: %r",
                                     type(slot).__name__, ex)
+        if handle.leased and self._committer is not None:
+            # Leased entries complete through the async committer too; the
+            # device's RT/success/exception stats converge within one flush.
+            # (After close() the committer is gone — fall through to the
+            # synchronous device commit below rather than resurrecting it.)
+            self._committer.add_exit(
+                handle.cluster_row, handle.dn_row, handle.origin_row,
+                handle.entry_in, count, min(rt, C.DEFAULT_MAX_RT_MS),
+                True, handle.error)
+            ctx_mod.auto_exit_context()
+            return
         fields = dict(
             cluster_row=handle.cluster_row, dn_row=handle.dn_row,
             origin_row=handle.origin_row, entry_in=handle.entry_in,
@@ -708,6 +843,7 @@ class SentinelEngine:
 
         now = now_ms if now_ms is not None else time_util.current_time_millis()
         now_sec = now // 1000
+        self._flush_committer()  # leased commits land before sealing
         with self._lock:
             self._ensure_compiled()
             first = max(self._sealed_sec + 1, now_sec - C.MINUTE_BUCKETS + 1)
@@ -772,6 +908,7 @@ class SentinelEngine:
         Totals are normalized by the instant-window interval, so they stay
         per-second rates whatever geometry set_window_geometry picked.
         """
+        self._flush_committer()
         with self._lock:
             self._ensure_compiled()
             now = time_util.current_time_millis()
@@ -811,6 +948,7 @@ class SentinelEngine:
 
     def node_snapshot(self) -> Dict[str, Dict[str, float]]:
         """Per-resource live stats (command-API ``cnode`` source)."""
+        self._flush_committer()
         with self._lock:
             self._ensure_compiled()
             now = time_util.current_time_millis()

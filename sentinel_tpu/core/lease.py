@@ -1,0 +1,281 @@
+"""Token-lease fast path: host-side admission for simple hot resources.
+
+SURVEY.md §7 hard part #1: a synchronous device dispatch costs ~10-100µs
+(65ms+ through a remote tunnel), which no per-request path can hide. For
+the narrow-but-dominant case — a resource guarded ONLY by local
+QPS/DEFAULT flow rules — admission arithmetic is a handful of integer
+ops, so the host runs it directly against a mirrored sliding window
+("the quota is leased from the device view") and streams the decided
+outcomes to the device as pre-decided statistic commits
+(``EntryBatch.pre_passed`` / ``pre_blocked``) from a background
+committer. Reference analog: ``FlowRuleChecker.passLocalCheck`` +
+``DefaultController.canPass`` — the in-JVM fast path this reproduces at
+host speed, with the device remaining the source of truth for
+statistics, the ops plane, and every other rule family.
+
+Eligibility is conservative; anything else takes the device path:
+
+  * every flow rule on the resource: QPS grade, DEFAULT behavior, DIRECT
+    strategy, ``limit_app`` default, local (no cluster mode);
+  * no degrade / authority / param-flow rules on the resource;
+  * no system rules active, no SPI host slots or device checkers.
+
+Exactness: the mirror ring reproduces the device's DEFAULT math
+(``window_sum × 1000/interval + count ≤ threshold``) under one lock, so
+process-local admission is serially exact — tighter than the device
+path's documented within-micro-batch approximation. Device-resident
+stats converge within one committer flush (default 2ms); entries
+admitted by OTHER processes of a cluster are not leased (cluster-mode
+rules are ineligible), so no cross-process quota is bypassed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import (
+    BATCH_WIDTHS,
+    EntryBatch,
+    ExitBatch,
+    make_entry_batch_np,
+    make_exit_batch_np,
+)
+
+
+def _ladder_width(n: int) -> int:
+    for w in BATCH_WIDTHS:
+        if n <= w:
+            return w
+    return BATCH_WIDTHS[-1]
+
+
+class LocalLease:
+    """Host mirror of one resource's instant window + thresholds."""
+
+    __slots__ = ("thresholds", "interval_ms", "bucket_ms", "buckets",
+                 "_counts", "_starts", "_lock")
+
+    def __init__(self, thresholds: List[float], interval_ms: int,
+                 buckets: int):
+        self.thresholds = thresholds  # every rule must admit (AND)
+        self.interval_ms = interval_ms
+        self.buckets = buckets
+        self.bucket_ms = interval_ms // buckets
+        self._counts = [0] * buckets
+        self._starts = [-1] * buckets
+        self._lock = threading.Lock()
+
+    def _rotate(self, now_ms: int) -> int:
+        """Lazy bucket reset (caller holds the lock); returns current idx."""
+        idx = (now_ms // self.bucket_ms) % self.buckets
+        cur_start = now_ms - now_ms % self.bucket_ms
+        for b in range(self.buckets):
+            expected = cur_start - ((idx - b) % self.buckets) * self.bucket_ms
+            if self._starts[b] != expected:
+                self._starts[b] = expected
+                self._counts[b] = 0
+        return idx
+
+    def try_acquire(self, count: int, now_ms: int) -> bool:
+        """Device-exact DEFAULT admission against the mirrored ring."""
+        with self._lock:
+            idx = self._rotate(now_ms)
+            used = sum(self._counts) * (1000.0 / self.interval_ms)
+            for thr in self.thresholds:
+                if used + count > thr:
+                    return False
+            self._counts[idx] += count
+            return True
+
+    def add(self, count: int, now_ms: int) -> None:
+        """Record a DEVICE-decided pass so the mirror tracks the window in
+        every mode (pipeline / prioritized / occupy-granted entries)."""
+        with self._lock:
+            idx = self._rotate(now_ms)
+            self._counts[idx] += count
+
+    def seed(self, starts, counts) -> None:
+        """Adopt the device window's buckets wholesale (checkpoint warm
+        restart: the restored stats are the truth the mirror must match)."""
+        with self._lock:
+            self._starts = [int(s) for s in starts]
+            self._counts = [int(c) for c in counts]
+
+    def snapshot(self):
+        """(starts, counts) under the lock — for mirror carry-over."""
+        with self._lock:
+            return list(self._starts), list(self._counts)
+
+
+def build_lease_table(engine) -> Dict[str, LocalLease]:
+    """Recompute leases from the engine's CURRENT rules (called under the
+    engine lock on every rule push / geometry change)."""
+    if engine.system_rules.get_rules():
+        return {}
+    if engine._spi.host_slots() or engine._spi.device_checkers():
+        return {}
+    flow_rules = engine.flow_rules.get_rules()
+    ruled = {}
+    for r in flow_rules:
+        ruled.setdefault(r.resource, []).append(r)
+    # A resource another rule RELATEs/CHAINs to must stay on the device
+    # path: its window feeds that rule's check, and leased commits land
+    # with up to one flush of lag.
+    refs = {r.ref_resource for r in flow_rules if r.ref_resource}
+    blocked_resources = set()
+    for mgr in (engine.degrade_rules, engine.authority_rules,
+                engine.param_rules):
+        for r in mgr.get_rules():
+            blocked_resources.add(r.resource)
+    spec = engine._spec1
+    out = {}
+    for resource, rules in ruled.items():
+        if resource in blocked_resources or resource in refs:
+            continue
+        ok = all(
+            r.grade == C.FLOW_GRADE_QPS
+            and r.control_behavior == C.CONTROL_BEHAVIOR_DEFAULT
+            and r.strategy == C.FLOW_STRATEGY_DIRECT
+            and r.limit_app == C.LIMIT_APP_DEFAULT
+            and not r.cluster_mode
+            for r in rules
+        )
+        if ok:
+            out[resource] = LocalLease([float(r.count) for r in rules],
+                                       spec.interval_ms, spec.buckets)
+    return out
+
+
+class StatsCommitter:
+    """Streams host-decided outcomes to the device in micro-batches.
+
+    One daemon thread; entries and exits queue lock-free-ish (GIL deque)
+    and flush every ``linger_s`` or at ``max_batch``. ENTRIES flush
+    before exits each cycle: unlike the pipeline (where an entry is
+    device-committed before its caller can exit), a leased pair can have
+    BOTH halves queued, and dispatching the exit first would drive the
+    thread gauge negative and let SUCCESS outrun PASS across a second
+    boundary."""
+
+    def __init__(self, engine, linger_s: float = 0.002,
+                 max_batch: int = 2048):
+        self.engine = engine
+        self.linger_s = linger_s
+        self.max_batch = max_batch
+        self._entries: List[tuple] = []
+        self._exits: List[tuple] = []
+        self._lock = threading.Lock()
+        # Serializes whole flush passes: a reader's flush() must WAIT for
+        # an in-flight background flush (which already swapped the queues)
+        # or it would return with the items still un-committed.
+        self._flush_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StatsCommitter":
+        import atexit
+
+        from sentinel_tpu.utils import time_util
+
+        # Under a frozen test clock, flush BEFORE every advance so queued
+        # commits land in the second they were decided in (under the real
+        # clock the hook list is never invoked).
+        self._off_advance = time_util.on_advance(self.flush)
+        self._thread = threading.Thread(
+            target=self._run, name="sentinel-stats-committer", daemon=True)
+        self._thread.start()
+        # A daemon thread killed mid-XLA-call aborts the interpreter with
+        # "FATAL: exception not rethrown"; stop cleanly at exit instead.
+        self._atexit = atexit.register(self.stop)
+        return self
+
+    def stop(self) -> None:
+        import atexit
+
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if getattr(self, "_off_advance", None) is not None:
+            self._off_advance()
+            self._off_advance = None
+        if getattr(self, "_atexit", None) is not None:
+            atexit.unregister(self._atexit)
+            self._atexit = None
+        self.flush()  # drain stragglers synchronously
+
+    def add_entry(self, cluster_row: int, dn_row: int, origin_row: int,
+                  entry_in: bool, count: int, passed: bool) -> None:
+        with self._lock:
+            self._entries.append(
+                (cluster_row, dn_row, origin_row, entry_in, count, passed))
+            n = len(self._entries)
+        if n >= self.max_batch:
+            self._wake.set()
+
+    def add_exit(self, cluster_row: int, dn_row: int, origin_row: int,
+                 entry_in: bool, count: int, rt_ms: int, success: bool,
+                 error: bool) -> None:
+        with self._lock:
+            self._exits.append((cluster_row, dn_row, origin_row, entry_in,
+                                count, rt_ms, success, error))
+            n = len(self._exits)
+        if n >= self.max_batch:
+            self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.linger_s)
+            self._wake.clear()
+            try:
+                self.flush()
+            except Exception as ex:
+                from sentinel_tpu.log.record_log import record_log
+
+                record_log.warn("stats committer flush failed: %r", ex)
+
+    def flush(self) -> None:
+        """Drain both queues to the device (also used by tests/seal).
+
+        Holds ``_flush_lock`` across swap AND dispatch, so a concurrent
+        reader's flush returns only after everything enqueued before its
+        call is actually committed."""
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        with self._lock:
+            exits, self._exits = self._exits, []
+            entries, self._entries = self._entries, []
+        eng = self.engine
+        while entries:
+            chunk, entries = entries[:self.max_batch], entries[self.max_batch:]
+            width = _ladder_width(len(chunk))
+            buf = make_entry_batch_np(width)
+            for i, (cr, dr, orow, ein, cnt, passed) in enumerate(chunk):
+                buf["cluster_row"][i] = cr
+                buf["dn_row"][i] = dr
+                buf["origin_row"][i] = orow
+                buf["entry_in"][i] = ein
+                buf["count"][i] = cnt
+                buf["pre_passed"][i] = passed
+                buf["pre_blocked"][i] = not passed
+            eng._run_entry_batch(EntryBatch(**buf))
+        while exits:
+            chunk, exits = exits[:self.max_batch], exits[self.max_batch:]
+            width = _ladder_width(len(chunk))
+            buf = make_exit_batch_np(width)
+            for i, (cr, dr, orow, ein, cnt, rt, succ, err) in enumerate(chunk):
+                buf["cluster_row"][i] = cr
+                buf["dn_row"][i] = dr
+                buf["origin_row"][i] = orow
+                buf["entry_in"][i] = ein
+                buf["count"][i] = cnt
+                buf["rt_ms"][i] = rt
+                buf["success"][i] = succ
+                buf["error"][i] = err
+            eng._run_exit_batch(ExitBatch(**buf))

@@ -11,6 +11,10 @@ set as a small JSON API plus one static page:
   * ``GET  /v1/rules?app=&type=``             rule CRUD, V1 style: read from
   * ``POST /v1/rules?app=&type=``             the machines, push to ALL
     (``FlowControllerV1`` et al. via ``SentinelApiClient``)
+  * ``GET/POST /v2/rules?app=&type=``         rule CRUD, V2 style: through a
+    registered config-source provider/publisher pair
+    (``FlowControllerV2`` + ``DynamicRuleProvider``/``Publisher``;
+    see :meth:`DashboardServer.register_rule_source`)
   * ``GET  /metric/queryTopResourceMetric.json?app=``    live QPS series
   * ``GET  /metric/queryByAppAndResource.json?app=&identity=``
     (``MetricController`` over ``InMemoryMetricsRepository``)
@@ -65,6 +69,8 @@ class DashboardServer:
         self.auth = auth if auth is not None else AuthService()
         self.apps = AppManagement()
         self.api = SentinelApiClient()
+        # (app, rule_type) -> (provider, publisher) — the V2 pipeline.
+        self.rule_sources: Dict = {}
         self.repository = InMemoryMetricsRepository()
         self.fetcher = MetricFetcher(self.apps, self.repository,
                                      interval_s=fetch_interval_s)
@@ -116,6 +122,36 @@ class DashboardServer:
         if not ms:
             raise ApiError(f"no healthy machine for app {app!r}")
         return ms[0]
+
+    def register_rule_source(self, app: str, rule_type: str,
+                             provider, publisher) -> None:
+        """V2 rule pipeline (reference ``FlowControllerV2`` +
+        ``DynamicRuleProvider``/``DynamicRulePublisher``): rules for
+        (app, type) are read from and published to a CONFIG SOURCE (e.g.
+        a broker key the engines' push datasources listen on) instead of
+        the machines' command API — the dashboard writes config, engines
+        converge via their own datasource bindings.
+
+        ``provider()`` returns the current rule list (dicts);
+        ``publisher(rules)`` persists it to the source."""
+        if rule_type not in RULE_TYPES:
+            raise ValueError(f"invalid rule type {rule_type!r}")
+        self.rule_sources[(app, rule_type)] = (provider, publisher)
+
+    def get_rules_v2(self, app: str, rule_type: str):
+        src = self.rule_sources.get((app, rule_type))
+        if src is None:
+            raise ApiError(
+                f"no v2 rule source registered for ({app}, {rule_type})")
+        return src[0]()
+
+    def set_rules_v2(self, app: str, rule_type: str, rules) -> str:
+        src = self.rule_sources.get((app, rule_type))
+        if src is None:
+            raise ApiError(
+                f"no v2 rule source registered for ({app}, {rule_type})")
+        src[1](rules)
+        return "published"
 
     def get_rules(self, app: str, rule_type: str):
         m = self._first_healthy(app)
@@ -282,16 +318,19 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/app/machines.json":
                 return self._ok([m.to_dict()
                                  for m in d.apps.machines(q.get("app", ""))])
-            if path == "/v1/rules":
+            if path in ("/v1/rules", "/v2/rules"):
                 app, rtype = q.get("app", ""), q.get("type", "flow")
                 if rtype not in RULE_TYPES:
                     return self._fail(f"invalid type {rtype!r}")
+                v2 = path == "/v2/rules"
                 if self.command == "GET":
-                    return self._ok(d.get_rules(app, rtype))
+                    return self._ok(d.get_rules_v2(app, rtype) if v2
+                                    else d.get_rules(app, rtype))
                 rules = json.loads(body or "[]")
                 if not isinstance(rules, list):
                     return self._fail("expected a JSON list")
-                return self._ok(d.set_rules(app, rtype, rules))
+                return self._ok(d.set_rules_v2(app, rtype, rules) if v2
+                                else d.set_rules(app, rtype, rules))
             if path == "/metric/queryTopResourceMetric.json":
                 return self._metric_top(d, q)
             if path == "/metric/queryByAppAndResource.json":

@@ -258,47 +258,58 @@ def entry_step(
     # skip every local slot.
     blocked = valid & batch.pre_blocked
     reason = jnp.where(blocked, C.BlockReason.FLOW, reason)
+    # Host-leased admissions (core/lease.py) arrive pre-PASSED: commit
+    # their statistics, skip every slot. Their counts join the window via
+    # this step's commit, so slot-checked peers in the SAME batch see them
+    # with one-batch staleness — the documented micro-batch delta class.
+    pre_ok = valid & batch.pre_passed & (~blocked)
+    decided = blocked | pre_ok
 
     # --- rule slots (order mirrors the reference chain: authority →
     # system → param-flow → flow → degrade) --------------------------------
-    auth_blocked = A.check_authority(rules.authority, batch, valid & (~blocked))
-    reason = jnp.where(valid & (~blocked) & auth_blocked, C.BlockReason.AUTHORITY, reason)
+    auth_blocked = A.check_authority(rules.authority, batch, valid & (~decided))
+    reason = jnp.where(valid & (~decided) & auth_blocked, C.BlockReason.AUTHORITY, reason)
     blocked = blocked | auth_blocked
+    decided = decided | blocked
 
-    cand = valid & (~blocked)
+    cand = valid & (~decided)
     sys_blocked = Y.check_system(rules.system, state.sys_signals, w1, w60,
                                  sec.counts, state.cur_threads, batch, cand,
                                  now_ms, spec1=spec1)
     reason = jnp.where(cand & sys_blocked, C.BlockReason.SYSTEM, reason)
     blocked = blocked | sys_blocked
+    decided = decided | blocked
 
-    cand = valid & (~blocked)
+    cand = valid & (~decided)
     pv = P.check_param_flow(rules.param, state.param, batch, now_ms, cand,
                             extra_cms=extra_cms)
     reason = jnp.where(cand & pv.blocked, C.BlockReason.PARAM_FLOW, reason)
     blocked = blocked | pv.blocked
+    decided = decided | blocked
 
     for chk in extra_checkers:
-        cand = valid & (~blocked)
+        cand = valid & (~decided)
         custom_blocked = cand & chk(state._replace(w1=w1), rules, batch,
                                     now_ms, cand)
         reason = jnp.where(custom_blocked, C.BlockReason.CUSTOM, reason)
         blocked = blocked | custom_blocked
+        decided = decided | blocked
 
-    fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, blocked,
+    fv = F.check_flow(rules.flow, state.flow, w1, state.cur_threads, batch, now_ms, decided,
                       extra_pass=extra_pass, occupied_next=occupied_next,
                       extra_next=extra_next,
                       extra_pass_global=extra_pass_global,
                       extra_next_global=extra_next_global, spec=spec1)
-    reason = jnp.where(valid & (~blocked) & fv.blocked, C.BlockReason.FLOW, reason)
+    reason = jnp.where(valid & (~decided) & fv.blocked, C.BlockReason.FLOW, reason)
     blocked = blocked | fv.blocked
+    decided = decided | blocked
 
     # Occupy grants leave the chain before the degrade slot (reference:
     # PriorityWaitException propagates out of FlowSlot).
-    granted = valid & (~blocked) & fv.occupied
+    granted = valid & (~decided) & fv.occupied
     dv = D.check_degrade(rules.degrade, state.degrade, batch, now_ms,
-                         valid & (~blocked) & (~granted))
-    reason = jnp.where(valid & (~blocked) & dv.blocked, C.BlockReason.DEGRADE, reason)
+                         valid & (~decided) & (~granted))
+    reason = jnp.where(valid & (~decided) & dv.blocked, C.BlockReason.DEGRADE, reason)
     blocked = blocked | dv.blocked
 
     # --- StatisticSlot commit --------------------------------------------

@@ -3,6 +3,7 @@ an embedded HTTP command center for remote rule CRUD + metric scraping, and
 a heartbeat sender registering with the dashboard.
 """
 
+from sentinel_tpu.transport.aio_command_center import AsyncCommandCenter
 from sentinel_tpu.transport.command_center import (
     CommandCenter,
     CommandRequest,
@@ -12,6 +13,6 @@ from sentinel_tpu.transport.command_center import (
 from sentinel_tpu.transport.heartbeat import HeartbeatSender
 
 __all__ = [
-    "CommandCenter", "CommandRequest", "CommandResponse", "HeartbeatSender",
-    "command_mapping",
+    "AsyncCommandCenter", "CommandCenter", "CommandRequest",
+    "CommandResponse", "HeartbeatSender", "command_mapping",
 ]

@@ -83,6 +83,31 @@ def registered_commands() -> Dict[str, str]:
     return dict(_descriptions)
 
 
+def dispatch_command(center, path: str, body: str):
+    """Shared request->handler dispatch: ``(status_code, text)``.
+
+    Used by both transports (threaded simple-http here, the event-loop
+    center in ``aio_command_center.py``) so command semantics cannot
+    drift between them."""
+    parsed = urllib.parse.urlparse(path)
+    name = parsed.path.strip("/")
+    params = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+    # Reference simple-http also accepts form-encoded bodies as params.
+    if body and "=" in body and not body.lstrip().startswith(("[", "{")):
+        for k, v in urllib.parse.parse_qs(body).items():
+            params.setdefault(k, v[0])
+        body = ""
+    handler = get_handler(name)
+    if handler is None:
+        return 400, f"Unknown command `{name}`"
+    try:
+        resp = handler(CommandRequest(parameters=params, body=body,
+                                      engine=center.engine, center=center))
+    except Exception as ex:
+        return 500, f"command error: {ex!r}"
+    return (200 if resp.success else 400), resp.result
+
+
 class _HttpHandler(BaseHTTPRequestHandler):
     server_version = "sentinel-tpu"
 
@@ -90,26 +115,9 @@ class _HttpHandler(BaseHTTPRequestHandler):
         pass
 
     def _dispatch(self, body: str):
-        parsed = urllib.parse.urlparse(self.path)
-        name = parsed.path.strip("/")
-        params = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
-        # Reference simple-http also accepts form-encoded bodies as params.
-        if body and "=" in body and not body.lstrip().startswith(("[", "{")):
-            for k, v in urllib.parse.parse_qs(body).items():
-                params.setdefault(k, v[0])
-            body = ""
-        handler = get_handler(name)
-        if handler is None:
-            self._reply(400, f"Unknown command `{name}`")
-            return
-        center = self.server.command_center
-        try:
-            resp = handler(CommandRequest(parameters=params, body=body,
-                                          engine=center.engine, center=center))
-        except Exception as ex:
-            self._reply(500, f"command error: {ex!r}")
-            return
-        self._reply(200 if resp.success else 400, resp.result)
+        code, text = dispatch_command(self.server.command_center, self.path,
+                                      body)
+        self._reply(code, text)
 
     def _reply(self, code: int, text: str):
         data = text.encode("utf-8")

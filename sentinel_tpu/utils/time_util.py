@@ -11,9 +11,29 @@ device step receives time as an explicit argument.
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Callable, List, Optional
 
 _frozen_ms: Optional[int] = None
+
+# Pre-advance hooks: async machinery (the token-lease stats committer)
+# registers here so pending work stamped "now" lands BEFORE the frozen
+# clock moves — otherwise a test's advance_time() would time-travel
+# commits into the wrong second. No-ops under the real clock.
+_pre_advance_hooks: List[Callable[[], None]] = []
+
+
+def on_advance(hook: Callable[[], None]) -> Callable[[], None]:
+    """Register a hook run before every frozen-clock advance; returns an
+    unregister callable."""
+    _pre_advance_hooks.append(hook)
+
+    def off():
+        try:
+            _pre_advance_hooks.remove(hook)
+        except ValueError:
+            pass
+
+    return off
 
 
 def current_time_millis() -> int:
@@ -31,6 +51,8 @@ def freeze_time(ms: int) -> None:
 def advance_time(delta_ms: int) -> None:
     global _frozen_ms
     assert _frozen_ms is not None, "advance_time requires freeze_time first"
+    for hook in list(_pre_advance_hooks):
+        hook()
     _frozen_ms += int(delta_ms)
 
 

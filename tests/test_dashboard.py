@@ -288,3 +288,36 @@ def test_cluster_assign_flow(dash, engine):
     finally:
         c1.stop()
         c2.stop()
+
+
+def test_v2_rules_through_config_source(dash, engine):
+    """FlowControllerV2 analog: the dashboard publishes rules to a config
+    source (broker key); the engine converges via its OWN push datasource
+    binding — no machine command API involved."""
+    from sentinel_tpu.datasource import bind, flow_rules_from_json
+    from sentinel_tpu.datasource.push import BrokerDataSource, InProcessBroker
+
+    broker = InProcessBroker()
+    key = "sentinel:rules:appV2:flow"
+    src = BrokerDataSource(broker, key, converter=flow_rules_from_json)
+    bind(src, st.load_flow_rules)
+
+    dash.register_rule_source(
+        "appV2", "flow",
+        provider=lambda: json.loads(broker.get(key) or "[]"),
+        publisher=lambda rules: broker.set(key, json.dumps(rules)))
+
+    # unregistered (app, type) pair fails loudly
+    code, _, out = _raw(dash, "/v2/rules?app=appV2&type=degrade")
+    assert code == 502 and not out["success"]
+
+    pushed = _post(dash, "/v2/rules?app=appV2&type=flow",
+                   json.dumps([{"resource": "v2res", "count": 1.0}]))
+    assert pushed == "published"
+    # engine enforces immediately (broker delivery is synchronous)
+    assert st.entry_ok("v2res")
+    assert not st.entry_ok("v2res")
+
+    shown = _get(dash, "/v2/rules?app=appV2&type=flow")
+    assert shown[0]["resource"] == "v2res"
+    src.close()

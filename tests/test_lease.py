@@ -1,0 +1,197 @@
+"""Token-lease fast path tests (core/lease.py — SURVEY §7 hard part #1).
+
+Host-side admission must be device-exact for eligible resources, stream
+its statistics to the device, and conservatively refuse every case where
+another rule family (or another process) could see different state.
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.lease import LocalLease, build_lease_table
+
+
+def _leased(engine, resource):
+    return resource in engine._leases
+
+
+def test_simple_qps_rule_is_leased(engine):
+    st.load_flow_rules([st.FlowRule(resource="fast", count=5)])
+    assert _leased(engine, "fast")
+
+
+def test_ineligible_shapes_stay_on_device_path(engine):
+    st.load_flow_rules([
+        st.FlowRule(resource="warm", count=5,
+                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP),
+        st.FlowRule(resource="thr", count=5, grade=C.FLOW_GRADE_THREAD),
+        st.FlowRule(resource="orig", count=5, limit_app="appA"),
+        st.FlowRule(resource="clus", count=5, cluster_mode=True,
+                    cluster_config={"flowId": 1}),
+        st.FlowRule(resource="rel", count=5,
+                    strategy=C.FLOW_STRATEGY_RELATE, ref_resource="ref"),
+        st.FlowRule(resource="ref", count=5),  # RELATE target
+        st.FlowRule(resource="ok", count=5),
+    ])
+    for r in ("warm", "thr", "orig", "clus", "rel", "ref"):
+        assert not _leased(engine, r), r
+    assert _leased(engine, "ok")
+
+
+def test_other_rule_families_disable_lease(engine):
+    st.load_flow_rules([st.FlowRule(resource="d", count=5),
+                        st.FlowRule(resource="p", count=5)])
+    assert _leased(engine, "d") and _leased(engine, "p")
+    st.load_degrade_rules([st.DegradeRule(resource="d", count=1,
+                                          time_window=5)])
+    assert not _leased(engine, "d")
+    assert _leased(engine, "p")
+    st.load_param_flow_rules([st.ParamFlowRule("p", param_idx=0, count=5)])
+    assert not _leased(engine, "p")
+
+
+def test_system_rules_disable_all_leases(engine):
+    st.load_flow_rules([st.FlowRule(resource="s", count=5)])
+    assert _leased(engine, "s")
+    st.load_system_rules([st.SystemRule(qps=1e6)])
+    assert not _leased(engine, "s")
+    st.load_system_rules([])
+    assert _leased(engine, "s")
+
+
+def test_lease_admission_is_exact(engine, frozen_time):
+    """Same verdicts as the device DEFAULT controller, serially exact."""
+    st.load_flow_rules([st.FlowRule(resource="fast", count=3)])
+    got = [bool(st.entry_ok("fast")) for _ in range(6)]
+    assert got == [True] * 3 + [False] * 3
+    frozen_time.advance_time(1100)  # window rolls -> quota refreshed
+    assert st.entry_ok("fast")
+
+
+def test_lease_stats_reach_the_device(engine, frozen_time):
+    """Leased admissions + exits land in device stats (flush-on-read)."""
+    st.load_flow_rules([st.FlowRule(resource="fast", count=3)])
+    for _ in range(5):
+        h = st.entry_ok("fast")
+        if h:
+            h.exit()
+    snap = engine.node_snapshot()["fast"]
+    assert snap["passQps"] == 3
+    assert snap["blockQps"] == 2
+    assert snap["successQps"] == 3
+    assert snap["curThreadNum"] == 0
+
+
+def test_lease_blocks_feed_metric_log(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="fast", count=1)])
+    for _ in range(3):
+        st.entry_ok("fast")
+    frozen_time.advance_time(2000)
+    lines = [str(n) for n in engine.seal_metrics()]
+    assert any("fast" in ln for ln in lines)
+
+
+def test_device_path_verdicts_keep_mirror_in_sync(engine, frozen_time):
+    """Entries served while the PIPELINE owns admission must still count
+    against the lease mirror once the pipeline stops."""
+    st.load_flow_rules([st.FlowRule(resource="fast", count=2)])
+    engine.start_pipeline()
+    assert st.entry_ok("fast") is not None  # device path (pipeline)
+    engine.stop_pipeline()
+    assert st.entry_ok("fast") is not None  # lease path
+    assert st.entry_ok("fast") is None      # quota shared across modes
+
+
+def test_mixed_rules_on_one_resource_disable_lease(engine):
+    st.load_flow_rules([
+        st.FlowRule(resource="mix", count=100),
+        st.FlowRule(resource="mix", count=50,
+                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER),
+    ])
+    assert not _leased(engine, "mix")
+
+
+def test_multiple_default_rules_all_enforced(engine, frozen_time):
+    st.load_flow_rules([
+        st.FlowRule(resource="two", count=10),
+        st.FlowRule(resource="two", count=4),
+    ])
+    assert _leased(engine, "two")
+    got = sum(1 for _ in range(8) if st.entry_ok("two"))
+    assert got == 4  # tightest rule wins
+
+
+def test_local_lease_window_mirror_math():
+    lease = LocalLease([3.0], interval_ms=1000, buckets=2)
+    t0 = 1_700_000_000_000
+    assert all(lease.try_acquire(1, t0) for _ in range(3))
+    assert not lease.try_acquire(1, t0)
+    # sliding, not tumbling: 500ms later the first bucket still counts
+    assert not lease.try_acquire(1, t0 + 500)
+    # 1s later the old bucket expired
+    assert lease.try_acquire(1, t0 + 1000)
+
+
+def test_lease_disabled_by_config(engine, monkeypatch):
+    from sentinel_tpu.core.config import config
+
+    monkeypatch.setenv("CSP_SENTINEL_LEASE_ENABLED", "false")
+    config.reset_for_tests()
+    try:
+        eng = st.reset(capacity=256)
+        st.load_flow_rules([st.FlowRule(resource="fast", count=5)])
+        assert not eng._leases
+    finally:
+        monkeypatch.delenv("CSP_SENTINEL_LEASE_ENABLED")
+        config.reset_for_tests()
+        st.reset(capacity=256)
+
+
+def test_lease_latency_is_sub_millisecond(engine, frozen_time):
+    """The point of the feature: admission without a device dispatch."""
+    import time as _time
+
+    st.load_flow_rules([st.FlowRule(resource="fast", count=10_000_000)])
+    h = st.entry_ok("fast")  # absorb any lazy init
+    if h:
+        h.exit()
+    t0 = _time.perf_counter()
+    n = 200
+    for _ in range(n):
+        h = st.entry_ok("fast")
+        if h:
+            h.exit()
+    per_entry_us = (_time.perf_counter() - t0) / n * 1e6
+    assert per_entry_us < 1000, f"leased entry took {per_entry_us:.0f}µs"
+
+
+def test_rule_push_does_not_regrant_spent_quota(engine, frozen_time):
+    """Rebuilding leases on a rule push must carry the mirror over —
+    a zeroed mirror would admit 2x the quota in the current window."""
+    st.load_flow_rules([st.FlowRule(resource="fast", count=3)])
+    assert sum(1 for _ in range(3) if st.entry_ok("fast")) == 3
+    # unrelated rule push for ANOTHER family rebuilds the lease table
+    st.load_degrade_rules([st.DegradeRule(resource="other", count=1,
+                                          time_window=5)])
+    assert _leased(engine, "fast")
+    assert st.entry_ok("fast") is None  # quota still spent
+
+
+def test_newly_eligible_resource_seeds_from_device_window(engine,
+                                                          frozen_time):
+    """A resource that WAS ineligible (device path) and becomes eligible
+    must inherit the device window, not a zero mirror."""
+    st.load_flow_rules([
+        st.FlowRule(resource="born", count=3),
+        st.FlowRule(resource="born", count=3,
+                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                    max_queueing_time_ms=0),
+    ])
+    assert not _leased(engine, "born")
+    assert st.entry_ok("born") is not None  # device path, 1 pass committed
+    # drop the pacing rule: resource becomes lease-eligible
+    st.load_flow_rules([st.FlowRule(resource="born", count=3)])
+    assert _leased(engine, "born")
+    got = sum(1 for _ in range(4) if st.entry_ok("born"))
+    assert got == 2  # 1 device-path pass + 2 leased = 3 total, 4th blocks

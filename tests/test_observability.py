@@ -285,17 +285,21 @@ def test_engine_step_timing_via_profile_command(engine, frozen_time):
         h = st.entry_ok("profRes")
         if h:
             h.exit()
+    # leased entries commit through the async committer in batches: flush,
+    # then expect >= 1 dispatch carrying all 3 entries
+    engine._flush_committer()
     snap = engine.step_timer.snapshot()
-    assert snap["entry"]["dispatches"] >= 3
+    assert snap["entry"]["dispatches"] >= 1
+    assert snap["entry"]["entries"] >= 3
     assert snap["entry"]["stepSamples"] >= 1  # first dispatch is sampled
-    assert snap["exit"]["dispatches"] >= 3
+    assert snap["exit"]["dispatches"] >= 1
 
     center = CommandCenter(engine, port=0).start()
     try:
         url = f"http://127.0.0.1:{center.bound_port}/profile?reset=true"
         with urllib.request.urlopen(url, timeout=5) as r:
             out = json.loads(r.read().decode())
-        assert out["entry"]["dispatches"] >= 3
+        assert out["entry"]["dispatches"] >= 1
         assert engine.step_timer.snapshot() == {}  # reset applied
     finally:
         center.stop()

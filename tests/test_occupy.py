@@ -27,6 +27,25 @@ def _row(engine, resource):
     return engine.registry.cluster_row(resource)
 
 
+def _occ(engine, row):
+    """occupied_next[row]: flush the lease committer first (borrow landing
+    runs inside a device step) and read under the engine lock (the
+    committer thread donates state buffers on flush)."""
+    import numpy as np
+
+    engine._flush_committer()
+    with engine._lock:
+        return int(np.asarray(engine._state.occupied_next)[row])
+
+
+def _sec_count(engine, event, row):
+    import numpy as np
+
+    engine._flush_committer()
+    with engine._lock:
+        return int(np.asarray(engine._state.sec.counts)[event, row])
+
+
 def test_non_prioritized_never_borrows(engine, frozen_time):
     st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
     _fill("occ", 10)
@@ -43,7 +62,7 @@ def test_borrow_denied_while_next_window_is_full(engine, frozen_time):
     # (only the empty oldest bucket expires), so there is nothing to borrow.
     with pytest.raises(st.FlowException):
         st.entry("occ", prioritized=True)
-    assert int(engine._state.occupied_next[_row(engine, "occ")]) == 0
+    assert _occ(engine, _row(engine, "occ")) == 0
 
 
 def test_prioritized_borrows_once_bucket_expires(engine, frozen_time):
@@ -53,7 +72,7 @@ def test_prioritized_borrows_once_bucket_expires(engine, frozen_time):
     e = st.entry("occ", prioritized=True)  # sleeps ~100ms, then passes
     e.exit()
     row = _row(engine, "occ")
-    assert int(engine._state.occupied_next[row]) == 1
+    assert _occ(engine, row) == 1
     # The granted pass is deferred to the borrowed bucket: the live window
     # still reads 10 passes, and no block was recorded.
     snap = engine.node_snapshot()["occ"]
@@ -69,7 +88,7 @@ def test_borrow_capacity_is_the_rule_count(engine, frozen_time):
     st.entry("occ", prioritized=True).exit()
     with pytest.raises(st.FlowException):  # next window now full of borrows
         st.entry("occ", prioritized=True)
-    assert int(engine._state.occupied_next[_row(engine, "occ")]) == 2
+    assert _occ(engine, _row(engine, "occ")) == 2
 
 
 def test_borrow_lands_as_pass_in_next_bucket(engine, frozen_time):
@@ -84,7 +103,7 @@ def test_borrow_lands_as_pass_in_next_bucket(engine, frozen_time):
     with pytest.raises(st.FlowException):
         st.entry("occ")
     row = _row(engine, "occ")
-    assert int(engine._state.occupied_next[row]) == 0
+    assert _occ(engine, row) == 0
     snap = engine.node_snapshot()["occ"]
     # 2 original passes expired with their bucket; the 2 borrows landed.
     assert snap["passQps"] == 2
@@ -101,7 +120,7 @@ def test_stale_borrows_deprecate_when_buckets_skip(engine, frozen_time):
     with st.entry("occ"):
         pass
     row = _row(engine, "occ")
-    assert int(engine._state.occupied_next[row]) == 0
+    assert _occ(engine, row) == 0
     assert engine.node_snapshot()["occ"]["passQps"] == 1
 
 
@@ -131,7 +150,8 @@ def test_earlier_slot_block_denies_later_slot_borrow(engine, frozen_time):
     with pytest.raises(st.FlowException):
         st.entry("r", prioritized=True)
     st.exit_context()
-    assert int(np.asarray(engine._state.occupied_next).sum()) == 0
+    with engine._lock:
+        assert int(np.asarray(engine._state.occupied_next).sum()) == 0
 
 
 def test_occupied_pass_reaches_minute_metrics(engine, frozen_time):
@@ -142,8 +162,7 @@ def test_occupied_pass_reaches_minute_metrics(engine, frozen_time):
     from sentinel_tpu.core import constants as C
 
     row = _row(engine, "occ")
-    state = engine._state
-    assert int(state.sec.counts[C.MetricEvent.OCCUPIED_PASS, row]) == 1
+    assert _sec_count(engine, C.MetricEvent.OCCUPIED_PASS, row) == 1
     # Minute staging records the grant's pass immediately (reference:
     # StatisticNode.addOccupiedPass hits the minute counter at grant time).
-    assert int(state.sec.counts[C.MetricEvent.PASS, row]) == 11
+    assert _sec_count(engine, C.MetricEvent.PASS, row) == 11

@@ -309,3 +309,77 @@ def test_cluster_client_modify_rejects_bad_port(center, engine):
     assert e.value.code == 400
     cfg = json.loads(_get(center, "cluster/client/fetchConfig")[1])
     assert cfg["serverPort"] == 12345  # earlier staged value intact
+
+
+class TestAsyncCommandCenter:
+    """Event-loop transport twin (netty-http analog): same command SPI,
+    same responses, keep-alive connections."""
+
+    def test_same_commands_as_threaded_center(self, engine):
+        import http.client
+
+        from sentinel_tpu.transport.aio_command_center import AsyncCommandCenter
+
+        st.load_flow_rules([st.FlowRule(resource="aioRes", count=7)])
+        c = AsyncCommandCenter(engine, port=0).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", c.bound_port,
+                                              timeout=5)
+            # keep-alive: three commands over ONE connection
+            conn.request("GET", "/version")
+            v = conn.getresponse().read().decode()
+            assert "sentinel" in v.lower()
+            conn.request("GET", "/getRules?type=flow")
+            rules = json.loads(conn.getresponse().read().decode())
+            assert rules[0]["resource"] == "aioRes"
+            conn.request("POST", "/setRules", body=json.dumps(
+                {"type": "flow",
+                 "data": json.dumps([{"resource": "aioRes", "count": 1}])}))
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status in (200, 400)
+            conn.close()
+            # unknown command -> 400, same as the threaded transport
+            conn2 = http.client.HTTPConnection("127.0.0.1", c.bound_port,
+                                               timeout=5)
+            conn2.request("GET", "/nope")
+            assert conn2.getresponse().status == 400
+            conn2.close()
+        finally:
+            c.stop()
+
+    def test_start_async_on_callers_loop(self, engine):
+        import asyncio
+        import urllib.request
+
+        from sentinel_tpu.transport.aio_command_center import AsyncCommandCenter
+
+        async def run():
+            c = await AsyncCommandCenter(engine, port=0).start_async()
+            port = c.bound_port
+            # do the blocking HTTP call off-loop
+            out = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/clusterNode", timeout=5
+                ).read().decode())
+            await c.stop_async()
+            return out
+
+        body = asyncio.run(run())
+        assert body.startswith("[") or body.startswith("{")
+
+    def test_bad_content_length_gets_400(self, engine):
+        import socket
+
+        from sentinel_tpu.transport.aio_command_center import AsyncCommandCenter
+
+        c = AsyncCommandCenter(engine, port=0).start()
+        try:
+            s = socket.create_connection(("127.0.0.1", c.bound_port),
+                                         timeout=5)
+            s.sendall(b"GET /version HTTP/1.1\r\ncontent-length: abc\r\n\r\n")
+            data = s.recv(4096)
+            assert b"400" in data.split(b"\r\n", 1)[0]
+            s.close()
+        finally:
+            c.stop()
