@@ -7,9 +7,7 @@ what a device dispatch would cost (~ms on CPU, ~65ms through a remote
 TPU tunnel).
 """
 
-import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import _demo_env  # noqa: F401  (pins JAX platform; import first)
 
 import time
 

@@ -172,3 +172,39 @@ def test_admission_totals_invariant_under_permutation(engine, frozen_time):
                             st.FlowRule(resource="pb", count=7)])
         frozen_time.advance_time(2_000)  # fresh window per trial
     assert all(t == {"pa": 4, "pb": 7} for t in totals), totals
+
+
+def test_pre_passed_skips_slots_and_commits_pass(engine, frozen_time):
+    """A host-leased (pre_passed) entry must commit PASS + thread even
+    when every rule would block it, and must not consume any slot state
+    that device-checked peers in the batch rely on."""
+    st.load_flow_rules([st.FlowRule(resource="pp", count=0)])  # blocks all
+    reg = engine.registry
+    cl = reg.cluster_row("pp")
+    engine._ensure_compiled()
+
+    dec = engine.check_batch(_batch(engine, [
+        {"cluster_row": cl, "dn_row": -1, "count": 1, "pre_passed": True},
+        {"cluster_row": cl, "dn_row": -1, "count": 1},  # device-checked
+    ]))
+    reasons = np.asarray(dec.reason)
+    assert reasons[0] == C.BlockReason.PASS   # slots skipped entirely
+    assert reasons[1] == C.BlockReason.FLOW   # count=0 still blocks peers
+
+    snap = engine.node_snapshot()["pp"]
+    assert snap["passQps"] == 1
+    assert snap["blockQps"] == 1
+    assert snap["curThreadNum"] == 1  # pre_passed holds a concurrency slot
+
+
+def test_pre_blocked_wins_over_pre_passed(engine, frozen_time):
+    """Both flags set: the remote rejection wins (block committed)."""
+    reg = engine.registry
+    cl = reg.cluster_row("pb")
+    engine._ensure_compiled()
+    dec = engine.check_batch(_batch(engine, [
+        {"cluster_row": cl, "dn_row": -1, "count": 1,
+         "pre_passed": True, "pre_blocked": True},
+    ]))
+    assert np.asarray(dec.reason)[0] == C.BlockReason.FLOW
+    assert engine.node_snapshot()["pb"]["blockQps"] == 1
