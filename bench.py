@@ -264,11 +264,14 @@ def bench_p99_latency() -> dict:
     }
 
 
-def _backend_alive(timeout_s: float = 90.0) -> bool:
+def _probe_backend(timeout_s: float = 90.0):
     """Probe jax backend init in a SUBPROCESS: when the axon tunnel is
     down, ``jax.devices()`` blocks forever inside ``make_c_api_client``
     (observed 2026-07-30, 1h+ outage) — a hang in-process would zero the
-    whole bench with no JSON line at all."""
+    whole bench with no JSON line at all.
+
+    Returns the platform string ("axon"/"tpu"/"cpu"/...) on a clean
+    probe, or None on a hang/error (the retry-worthy cases)."""
     import subprocess
     import sys
 
@@ -277,11 +280,24 @@ def _backend_alive(timeout_s: float = 90.0) -> bool:
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, timeout=timeout_s, text=True)
-        # The platform must actually be the accelerator: a CPU-only env
-        # would "pass" on returncode and then mislabel the run as tpu.
-        return out.returncode == 0 and out.stdout.strip() in ("tpu", "axon")
+        if out.returncode == 0:
+            return out.stdout.strip()
+        return None
     except subprocess.TimeoutExpired:
-        return False
+        return None
+
+
+def _reexec_cpu(reason: str) -> None:
+    """Re-exec this bench on host CPU with a cleaned env (the axon hook
+    is installed by sitecustomize, so an in-process switch can't work)."""
+    import os
+    import sys
+
+    print(f"{reason}; re-exec on CPU", file=sys.stderr)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FORCED_CPU="1")
+    env.pop("PYTHONPATH", None)
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
 def main() -> None:
@@ -293,46 +309,48 @@ def main() -> None:
     # Probe in a subprocess (a dead tunnel HANGS rather than erroring),
     # retry with backoff, and as a last resort fall back to CPU with the
     # platform reported honestly in the JSON line.
-    platform = "tpu"
     if os.environ.get("BENCH_FORCED_CPU") == "1":
         platform = "cpu-fallback"
     else:
-        alive = False
+        platform = None
         for attempt in range(5):
-            if _backend_alive():
-                alive = True
+            probed = _probe_backend()
+            if probed in ("tpu", "axon"):
+                platform = probed
                 break
-            print(f"backend probe {attempt + 1}/5 failed (tunnel down?)",
-                  file=sys.stderr)
+            if probed is not None:
+                # A clean non-accelerator answer is definitive, not a
+                # transient outage — no point retrying for 15 minutes.
+                _reexec_cpu(f"no accelerator (probe says {probed!r})")
+            print(f"backend probe {attempt + 1}/5 hung/errored "
+                  f"(tunnel down?)", file=sys.stderr)
             if attempt < 4:  # no pointless sleep after the final attempt
                 time.sleep(90 * (attempt + 1))
-        if not alive:
-            # Honest fallback: same workload on host CPU. The axon hook is
-            # already installed in THIS process (sitecustomize), so re-exec
-            # with a cleaned env — clearing PYTHONPATH skips the axon
-            # sitecustomize entirely and the dead tunnel can't hang init.
-            print("tunnel unreachable after 5 probes; re-exec on CPU",
-                  file=sys.stderr)
-            env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FORCED_CPU="1")
-            env.pop("PYTHONPATH", None)
-            sys.stderr.flush()
-            os.execve(sys.executable,
-                      [sys.executable, os.path.abspath(__file__)], env)
+        if platform is None:
+            _reexec_cpu("tunnel unreachable after 5 probes")
 
-    last_err = None
-    checks_per_sec = None
-    for attempt in range(3):
-        try:
-            checks_per_sec = bench_throughput()
-            break
-        except RuntimeError as ex:  # jax backend init / transport errors
-            last_err = ex
-            print(f"bench attempt {attempt + 1} failed: {ex}", file=sys.stderr)
-            if attempt < 2:  # no pointless sleep after the final attempt
-                time.sleep(60 * (attempt + 1))
-    if checks_per_sec is None:
-        raise last_err
-    extras = bench_p99_latency()
+    # The CPU fallback must also catch a tunnel that dies MID-BENCH —
+    # otherwise these retries end in a raise with no JSON line at all.
+    try:
+        last_err = None
+        checks_per_sec = None
+        for attempt in range(3):
+            try:
+                checks_per_sec = bench_throughput()
+                break
+            except RuntimeError as ex:  # backend init / transport errors
+                last_err = ex
+                print(f"bench attempt {attempt + 1} failed: {ex}",
+                      file=sys.stderr)
+                if attempt < 2:  # no pointless sleep after the final attempt
+                    time.sleep(60 * (attempt + 1))
+        if checks_per_sec is None:
+            raise last_err
+        extras = bench_p99_latency()
+    except RuntimeError as ex:
+        if platform != "cpu-fallback":
+            _reexec_cpu(f"accelerator died mid-bench ({ex!r:.120})")
+        raise
     extras["platform"] = platform
     target = 1_000_000.0  # BASELINE.json north star: 1M aggregate QPS
     out = {

@@ -1,0 +1,43 @@
+"""Demo smoke tests: every advertised quickstart must actually run.
+
+The reference treats ``sentinel-demo/`` as living documentation; these
+run each SELF-TERMINATING demo as a real subprocess (fresh interpreter,
+the exact command the README documents) and assert a clean exit. The
+dashboard demo serves forever by design and is exercised through
+``tests/test_dashboard.py`` instead.
+
+Each subprocess clears PYTHONPATH (the demos' ``_demo_env`` puts the
+repo root on sys.path themselves), which also keeps the smoke tests
+alive when a host accelerator plugin is unreachable.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+SELF_TERMINATING = [
+    "flow_qps_demo.py",
+    "warm_up_demo.py",
+    "degrade_demo.py",
+    "param_flow_demo.py",
+    "annotation_demo.py",
+    "cluster_demo.py",
+    "lease_demo.py",
+]
+
+
+@pytest.mark.parametrize("script", SELF_TERMINATING)
+def test_demo_runs_clean(script):
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["SENTINEL_DEMO_PLATFORM"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, str(REPO / "demos" / script)],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=str(REPO))
+    assert out.returncode == 0, (script, out.stdout[-800:], out.stderr[-800:])
+    assert out.stdout.strip(), f"{script} printed nothing"
