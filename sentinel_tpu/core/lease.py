@@ -78,11 +78,16 @@ class LocalLease:
                 self._counts[b] = 0
         return idx
 
+    def _used(self) -> float:
+        """Per-second QPS of the mirrored window (caller holds the lock) —
+        the ONE site for the normalization admission and ops both use."""
+        return sum(self._counts) * (1000.0 / self.interval_ms)
+
     def try_acquire(self, count: int, now_ms: int) -> bool:
         """Device-exact DEFAULT admission against the mirrored ring."""
         with self._lock:
             idx = self._rotate(now_ms)
-            used = sum(self._counts) * (1000.0 / self.interval_ms)
+            used = self._used()
             for thr in self.thresholds:
                 if used + count > thr:
                     return False
@@ -107,6 +112,12 @@ class LocalLease:
         """(starts, counts) under the lock — for mirror carry-over."""
         with self._lock:
             return list(self._starts), list(self._counts)
+
+    def usage(self, now_ms: int) -> float:
+        """Current per-second QPS usage of the mirrored window (ops)."""
+        with self._lock:
+            self._rotate(now_ms)
+            return self._used()
 
 
 def build_lease_table(engine) -> Dict[str, LocalLease]:

@@ -198,6 +198,23 @@ def cmd_profile(req: CommandRequest) -> CommandResponse:
         req.engine.step_timer.snapshot(reset=reset))
 
 
+@command_mapping("leases", "token-lease fast-path state")
+def cmd_leases(req: CommandRequest) -> CommandResponse:
+    """Which resources admit host-side (core/lease.py) and their mirrored
+    window usage — the ops view of the fast path (no reference twin; the
+    lease itself has none)."""
+    from sentinel_tpu.utils import time_util
+
+    eng = req.engine
+    now = time_util.current_time_millis()
+    out = {res: {"thresholds": lease.thresholds,
+                 "intervalMs": lease.interval_ms,
+                 "usageQps": round(lease.usage(now), 2)}
+           for res, lease in sorted(eng._leases.items())}
+    return CommandResponse.of_success(
+        {"enabled": eng.lease_enabled, "resources": out})
+
+
 @command_mapping("getSwitch", "global protection switch state")
 def cmd_get_switch(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success(

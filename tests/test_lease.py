@@ -195,3 +195,28 @@ def test_newly_eligible_resource_seeds_from_device_window(engine,
     assert _leased(engine, "born")
     got = sum(1 for _ in range(4) if st.entry_ok("born"))
     assert got == 2  # 1 device-path pass + 2 leased = 3 total, 4th blocks
+
+
+def test_leases_ops_command(engine, frozen_time):
+    """The `leases` command exposes fast-path membership + live usage."""
+    import json
+    import urllib.request
+
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    st.load_flow_rules([st.FlowRule(resource="fast", count=10)])
+    for _ in range(4):
+        h = st.entry_ok("fast")
+        if h:
+            h.exit()
+    center = CommandCenter(engine, port=0).start()
+    try:
+        url = f"http://127.0.0.1:{center.bound_port}/leases"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            out = json.loads(r.read().decode())
+        assert out["enabled"] is True
+        row = out["resources"]["fast"]
+        assert row["thresholds"] == [10.0]
+        assert row["usageQps"] == 4.0
+    finally:
+        center.stop()
