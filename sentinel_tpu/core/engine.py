@@ -39,6 +39,20 @@ from sentinel_tpu.core.batch import (
 from sentinel_tpu.core.exceptions import BlockException, exception_for_reason
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
 from sentinel_tpu.metrics.profiling import StepTimer, timed_call
+
+
+class _FastPathState:
+    """One atomically-swapped snapshot of the host fast-path config:
+    entry() reads a single attribute, so a rule push can never expose a
+    torn (leases, guarded, unruled) combination to a lock-free reader."""
+
+    __slots__ = ("leases", "guarded", "unruled")
+
+    def __init__(self, leases, guarded, unruled):
+        self.leases = leases
+        self.guarded = guarded
+        self.unruled = unruled
+
 from sentinel_tpu.models import authority as A
 from sentinel_tpu.models import degrade as D
 from sentinel_tpu.models import flow as F
@@ -177,7 +191,9 @@ class SentinelEngine:
         self.lease_enabled = (
             (_cfg.get("csp.sentinel.lease.enabled") or "true").lower()
             != "false")
-        self._leases: Dict[str, "object"] = {}
+        # Unruled resources may skip the device check entirely (always
+        # pass + async stats commit); flipped off with system rules / SPI.
+        self._fastpath = _FastPathState({}, frozenset(), self.lease_enabled)
         self._committer = None
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
@@ -202,6 +218,18 @@ class SentinelEngine:
         # fires them once the default engine is installed (the reference's
         # "first SphU.entry triggers doInit" ordering).
 
+    @property
+    def _leases(self):
+        return self._fastpath.leases
+
+    @property
+    def _guarded_resources(self):
+        return self._fastpath.guarded
+
+    @property
+    def _unruled_fastpath(self):
+        return self._fastpath.unruled
+
     def _rebuild_leases(self) -> None:
         """Recompute the token-lease table from current rules + geometry.
 
@@ -213,7 +241,10 @@ class SentinelEngine:
         from sentinel_tpu.core.lease import build_lease_table
 
         old = self._leases
-        new = build_lease_table(self) if self.lease_enabled else {}
+        if self.lease_enabled:
+            new, guarded, unruled_ok = build_lease_table(self)
+        else:
+            new, guarded, unruled_ok = {}, set(), False
         fresh = []
         for res, lease in new.items():
             prev = old.get(res)
@@ -222,9 +253,9 @@ class SentinelEngine:
                 lease.seed(*prev.snapshot())
             else:
                 fresh.append(res)
-        self._leases = new
-        if fresh and self._state is not None:
-            self._seed_leases_from_state(only=fresh)
+        if fresh:
+            self._seed_leases_into(new, fresh)
+        self._fastpath = _FastPathState(new, guarded, unruled_ok)
 
     def _ensure_committer(self):
         committer = self._committer
@@ -245,21 +276,39 @@ class SentinelEngine:
 
     def _seed_leases_from_state(self, only: Optional[List[str]] = None) -> None:
         """Adopt device windows into the lease mirrors (checkpoint warm
-        restart; newly-eligible resources) — a fresh mirror would re-grant
-        spent quota."""
-        targets = {res: lease for res, lease in self._leases.items()
-                   if only is None or res in only}
+        restart)."""
+        targets = [res for res in self._leases
+                   if only is None or res in only]
+        self._seed_leases_into(self._leases, targets)
+
+    def _seed_leases_into(self, table, targets) -> None:
+        """Seed ``targets``' mirrors in ``table`` from the device window
+        PLUS any un-flushed committer commits (a previously-unruled
+        resource's recent traffic may still sit in the queue; flushing
+        here would deadlock against the background flush, which takes the
+        engine lock we may already hold — so count, don't flush)."""
+        targets = [res for res in targets if res in table]
         if not targets:
             return
         with self._lock:
-            if self._state is None:
-                return
-            pass_counts = np.asarray(
-                self._state.w1.counts[:, C.MetricEvent.PASS, :])
-            starts = np.asarray(self._state.w1.starts)
+            state = self._state
+            if state is not None:
+                pass_counts = np.asarray(
+                    state.w1.counts[:, C.MetricEvent.PASS, :])
+                starts = np.asarray(state.w1.starts)
             rows = {res: self.registry.cluster_row(res) for res in targets}
-        for res, lease in targets.items():
-            lease.seed(starts, pass_counts[:, rows[res]])
+        committer = self._committer
+        pending = committer.pending_pass_counts() if committer else {}
+        now = time_util.current_time_millis()
+        for res in targets:
+            lease = table[res]
+            if state is not None:
+                lease.seed(starts, pass_counts[:, rows[res]])
+            # Queued (not yet flushed) commits are real usage too — with no
+            # device state yet (nothing ever flushed) they are ALL of it.
+            queued = pending.get(rows[res], 0)
+            if queued:
+                lease.add(queued, now)
 
     def _rebuild_w1_jits(self):
         """(Re)build the spec1-dependent jits — one construction site shared
@@ -435,10 +484,10 @@ class SentinelEngine:
 
     def close(self) -> None:
         """Stop background workers (pipeline, host OS sampler, cluster role)."""
-        # Leases off FIRST so no new entry takes the fast path, then drain
-        # and stop the committer; a leased handle exiting after close falls
-        # back to the synchronous device path (_do_exit checks _committer).
-        self._leases = {}
+        # Fast path off FIRST (one atomic swap) so no new entry takes it,
+        # then drain and stop the committer; a leased handle exiting after
+        # close falls back to the synchronous device path.
+        self._fastpath = _FastPathState({}, frozenset(), False)
         committer, self._committer = self._committer, None
         if committer is not None:
             committer.stop()
@@ -556,10 +605,11 @@ class SentinelEngine:
         # sync-path latency drops from one device dispatch to microseconds.
         # (prioritized requests keep the device path: a rejected one may
         # still be granted an occupy-next-window borrow there.)
-        lease = self._leases.get(resource)
-        if lease is not None and not prioritized and not slots \
-                and self._pipeline is None \
-                and not self._spi.device_checkers():
+        fp = self._fastpath  # ONE read: never a torn (leases, guarded, unruled)
+        lease = fp.leases.get(resource)
+        fast_ok = (not slots and self._pipeline is None
+                   and not self._spi.device_checkers())
+        if lease is not None and not prioritized and fast_ok:
             now = time_util.current_time_millis()
             passed = lease.try_acquire(count, now)
             self._ensure_committer().add_entry(
@@ -571,6 +621,18 @@ class SentinelEngine:
 
                 log_block(resource, type(ex).__name__, ctx.origin, count, now)
                 raise ex
+            handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
+                                 origin_row, entry_in, count, params,
+                                 leased=True)
+            ctx.entry_stack.append(handle)
+            return handle
+        if lease is None and fast_ok and fp.unruled \
+                and resource not in fp.guarded:
+            # NO rules of any family on this resource (and nothing
+            # RELATEs to it): always pass, stats stream via the committer
+            # — the dominant real-world case never pays a device dispatch.
+            self._ensure_committer().add_entry(
+                cluster_row, dn_row, origin_row, entry_in, count, True)
             handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
                                  origin_row, entry_in, count, params,
                                  leased=True)

@@ -120,13 +120,24 @@ class LocalLease:
             return self._used()
 
 
-def build_lease_table(engine) -> Dict[str, LocalLease]:
-    """Recompute leases from the engine's CURRENT rules (called under the
-    engine lock on every rule push / geometry change)."""
+def build_lease_table(engine):
+    """Recompute the fast-path state from the engine's CURRENT rules
+    (called under the engine lock on every rule push / geometry change).
+
+    Returns ``(leases, guarded, unruled_ok)``:
+      * ``leases`` — resource -> LocalLease for lease-ELIGIBLE ruled
+        resources;
+      * ``guarded`` — every resource carrying ANY rule of any family, or
+        RELATEd/CHAINed to by a flow rule: these must use the device
+        path when not in ``leases``;
+      * ``unruled_ok`` — True when a resource carrying NO rules at all
+        may skip the device check entirely (always-pass + async stats):
+        the same global gates as leasing (no system rules, no SPI).
+    """
     if engine.system_rules.get_rules():
-        return {}
+        return {}, set(), False
     if engine._spi.host_slots() or engine._spi.device_checkers():
-        return {}
+        return {}, set(), False
     flow_rules = engine.flow_rules.get_rules()
     ruled = {}
     for r in flow_rules:
@@ -140,6 +151,7 @@ def build_lease_table(engine) -> Dict[str, LocalLease]:
                 engine.param_rules):
         for r in mgr.get_rules():
             blocked_resources.add(r.resource)
+    guarded = set(ruled) | refs | blocked_resources
     spec = engine._spec1
     out = {}
     for resource, rules in ruled.items():
@@ -156,7 +168,7 @@ def build_lease_table(engine) -> Dict[str, LocalLease]:
         if ok:
             out[resource] = LocalLease([float(r.count) for r in rules],
                                        spec.interval_ms, spec.buckets)
-    return out
+    return out, guarded, True
 
 
 class StatsCommitter:
@@ -237,6 +249,19 @@ class StatsCommitter:
             n = len(self._exits)
         if n >= self.max_batch:
             self._wake.set()
+
+    def pending_pass_counts(self) -> Dict[int, int]:
+        """Un-flushed PASS counts per cluster row (no dispatch, no flush
+        lock) — lets lease seeding account for in-flight commits without
+        flushing under the engine lock (which the background flush also
+        takes: flushing there would deadlock)."""
+        with self._lock:
+            items = list(self._entries)
+        out: Dict[int, int] = {}
+        for (cr, _dr, _orow, _ein, cnt, passed) in items:
+            if passed:
+                out[cr] = out.get(cr, 0) + cnt
+        return out
 
     def _run(self) -> None:
         while not self._stop.is_set():

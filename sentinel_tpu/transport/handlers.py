@@ -211,8 +211,15 @@ def cmd_leases(req: CommandRequest) -> CommandResponse:
                  "intervalMs": lease.interval_ms,
                  "usageQps": round(lease.usage(now), 2)}
            for res, lease in sorted(eng._leases.items())}
-    return CommandResponse.of_success(
-        {"enabled": eng.lease_enabled, "resources": out})
+    return CommandResponse.of_success({
+        # configured vs EFFECTIVE: system rules / SPI registrations turn
+        # the whole fast path off even when the config flag is on.
+        "enabled": eng.lease_enabled,
+        "effective": bool(eng._leases) or eng._unruled_fastpath,
+        "unruledFastpath": eng._unruled_fastpath,
+        "guardedResources": sorted(eng._guarded_resources),
+        "resources": out,
+    })
 
 
 @command_mapping("getSwitch", "global protection switch state")

@@ -220,3 +220,76 @@ def test_leases_ops_command(engine, frozen_time):
         assert row["usageQps"] == 4.0
     finally:
         center.stop()
+
+
+def test_unruled_resource_skips_device_dispatch(engine, frozen_time):
+    """A resource with NO rules always passes host-side; stats converge."""
+    import time as _time
+
+    h = st.entry_ok("free")  # absorb committer start
+    if h:
+        h.exit()
+    t0 = _time.perf_counter()
+    for _ in range(100):
+        h = st.entry_ok("free")
+        if h:
+            h.exit()
+    per_entry_us = (_time.perf_counter() - t0) / 100 * 1e6
+    assert per_entry_us < 1000, f"unruled entry took {per_entry_us:.0f}µs"
+    snap = engine.node_snapshot()["free"]
+    assert snap["passQps"] == 101
+    assert snap["curThreadNum"] == 0
+
+
+def test_unruled_relate_ref_stays_on_device_path(engine, frozen_time):
+    """An unruled resource another rule RELATEs to must keep committing
+    synchronously — its window feeds that rule's device check."""
+    st.load_flow_rules([
+        st.FlowRule(resource="write_db", count=3,
+                    strategy=C.FLOW_STRATEGY_RELATE, ref_resource="read_db")
+    ])
+    assert "read_db" in engine._guarded_resources
+    for _ in range(4):  # read_db busy: must be visible IMMEDIATELY
+        with st.entry("read_db"):
+            pass
+    with pytest.raises(st.FlowException):
+        st.entry("write_db")
+
+
+def test_system_rules_disable_unruled_fastpath(engine):
+    assert engine._unruled_fastpath
+    st.load_system_rules([st.SystemRule(qps=10)])
+    assert not engine._unruled_fastpath
+    st.load_system_rules([])
+    assert engine._unruled_fastpath
+
+
+def test_rule_on_previously_unruled_resource_counts_queued_traffic(
+        engine, frozen_time):
+    """Un-flushed always-pass commits must count when a rule first lands
+    on the resource — otherwise the brand-new limit over-admits."""
+    for _ in range(5):  # unruled fast path: commits queue in the committer
+        h = st.entry_ok("newly")
+        if h:
+            h.exit()
+    # push a rule WITHOUT flushing: seeding must add the queued 5
+    st.load_flow_rules([st.FlowRule(resource="newly", count=6)])
+    assert "newly" in engine._leases
+    got = sum(1 for _ in range(4) if st.entry_ok("newly"))
+    assert got == 1  # 5 queued + 1 = 6; the 7th would exceed the limit
+
+
+def test_leases_command_reports_effective_state(engine):
+    from sentinel_tpu.transport.command_center import (
+        CommandCenter, CommandRequest,
+    )
+    from sentinel_tpu.transport.handlers import cmd_leases
+    import json
+
+    out = json.loads(cmd_leases(CommandRequest(engine=engine)).result)
+    assert out["enabled"] and out["effective"] and out["unruledFastpath"]
+    st.load_system_rules([st.SystemRule(qps=10)])
+    out = json.loads(cmd_leases(CommandRequest(engine=engine)).result)
+    assert out["enabled"] is True  # configured on...
+    assert out["effective"] is False  # ...but system rules disable it
+    assert out["unruledFastpath"] is False

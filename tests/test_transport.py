@@ -82,6 +82,9 @@ def test_set_rules_every_family(center, engine):
 def test_cnode_and_cluster_node(center, engine):
     with st.entry("res1"):
         pass
+    # absorb the committer's width compile outside the HTTP timeout
+    # (unruled entries stream stats asynchronously)
+    engine._flush_committer()
     status, body = _get(center, "cnode?id=res1")
     node = json.loads(body)
     assert node["resource"] == "res1" and node["passQps"] == 1
@@ -94,6 +97,7 @@ def test_tree_commands(center, engine):
     with st.entry("deep"):
         pass
     st.exit_context()
+    engine._flush_committer()  # absorb the width compile (async stats)
     status, body = _get(center, "jsonTree")
     tree = json.loads(body)
     assert tree["resource"] == "machine-root"
