@@ -76,9 +76,19 @@ def get_engine() -> SentinelEngine:
 def reset(capacity: int = 4096) -> SentinelEngine:
     """Tear down and rebuild the default engine (tests)."""
     global _default_engine
-    if _default_engine is not None:
+    had_engine = _default_engine is not None
+    if had_engine:
         _default_engine.close()
     _default_engine = SentinelEngine(capacity)
+    if had_engine:
+        # Surviving contexts (on ANY thread) hold row ids into the dead
+        # engine's registry; the next entry through one would index a
+        # foreign (shorter) meta table. The stamp invalidates them all.
+        # Bump AFTER installing the new engine: a context created through
+        # the old engine mid-reset must carry a pre-bump stamp.
+        from sentinel_tpu.core.context import bump_generation
+
+        bump_generation()
     from sentinel_tpu.core.spi import run_init_funcs
 
     run_init_funcs()

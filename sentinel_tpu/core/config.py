@@ -34,6 +34,10 @@ DASHBOARD_SERVER = "csp.sentinel.dashboard.server"
 API_PORT = "csp.sentinel.api.port"
 HEARTBEAT_INTERVAL_MS = "csp.sentinel.heartbeat.interval.ms"
 HEARTBEAT_CLIENT_IP = "csp.sentinel.heartbeat.client.ip"
+# Shared secret for /registry/machine (dashboard-side keys follow the
+# sentinel.dashboard.* naming auth.py established); ONE constant so the
+# sender and the gate cannot drift onto different keys.
+HEARTBEAT_TOKEN = "sentinel.dashboard.heartbeat.token"
 
 DEFAULT_CHARSET = "utf-8"
 DEFAULT_SINGLE_METRIC_FILE_SIZE = 50 * 1024 * 1024

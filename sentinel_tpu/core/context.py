@@ -15,9 +15,21 @@ from typing import List, Optional
 from sentinel_tpu.core.constants import CONTEXT_DEFAULT_NAME, MAX_CONTEXT_NAME_SIZE
 
 
+# Bumped on every engine reset: a context created under a previous engine
+# holds row ids interned in that engine's registry, and using them against
+# a fresh (possibly smaller) registry corrupts stats or raises. The stamp
+# invalidates stale contexts on EVERY thread, not just the resetting one.
+_generation = 0
+
+
+def bump_generation() -> None:
+    global _generation
+    _generation += 1
+
+
 class Context:
     __slots__ = ("name", "origin", "entry_stack", "entrance_row", "is_null",
-                 "auto_created")
+                 "auto_created", "generation")
 
     def __init__(self, name: str, origin: str = "", entrance_row: int = -1):
         self.name = name
@@ -29,6 +41,7 @@ class Context:
         # contexts are torn down automatically when their last entry exits
         # (reference: default-context auto-exit in CtEntry.trueExit).
         self.auto_created = False
+        self.generation = _generation
 
     @property
     def cur_entry(self):
@@ -50,7 +63,11 @@ _ctx_var: contextvars.ContextVar[Optional[Context]] = contextvars.ContextVar(
 
 
 def get_context() -> Optional[Context]:
-    return _ctx_var.get()
+    ctx = _ctx_var.get()
+    if ctx is not None and ctx.generation != _generation:
+        _ctx_var.set(None)  # stale: predates the current engine
+        return None
+    return ctx
 
 
 def enter(name: str = CONTEXT_DEFAULT_NAME, origin: str = "") -> Context:

@@ -195,6 +195,7 @@ class SentinelEngine:
         # pass + async stats commit); flipped off with system rules / SPI.
         self._fastpath = _FastPathState({}, frozenset(), self.lease_enabled)
         self._committer = None
+        self._closed = False
         self._lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
@@ -260,9 +261,14 @@ class SentinelEngine:
     def _ensure_committer(self):
         committer = self._committer
         if committer is None:
-            from sentinel_tpu.core.lease import StatsCommitter
+            from sentinel_tpu.core.lease import StatsCommitter, SyncCommitter
 
             with self._lock:
+                if self._closed:
+                    # An entry racing close() read the fast path before the
+                    # swap; committing inline beats silently resurrecting a
+                    # daemon thread (+hooks) on a closed engine.
+                    return SyncCommitter(self)
                 if self._committer is None:
                     self._committer = StatsCommitter(self).start()
                 committer = self._committer
@@ -286,7 +292,12 @@ class SentinelEngine:
         PLUS any un-flushed committer commits (a previously-unruled
         resource's recent traffic may still sit in the queue; flushing
         here would deadlock against the background flush, which takes the
-        engine lock we may already hold — so count, don't flush)."""
+        engine lock we may already hold — so count, don't flush).
+
+        Row lookup is NON-allocating: a resource with no registry row has
+        never served traffic, so there is nothing to seed (and allocating
+        here would make a mere rule load consume rows, tripping
+        ``restore_checkpoint``'s fresh-engine guard)."""
         targets = [res for res in targets if res in table]
         if not targets:
             return
@@ -296,11 +307,17 @@ class SentinelEngine:
                 pass_counts = np.asarray(
                     state.w1.counts[:, C.MetricEvent.PASS, :])
                 starts = np.asarray(state.w1.starts)
-            rows = {res: self.registry.cluster_row(res) for res in targets}
+            rows = {}
+            for res in targets:
+                row = self.registry.get_cluster_row(res)
+                if row is not None:
+                    rows[res] = row
         committer = self._committer
         pending = committer.pending_pass_counts() if committer else {}
         now = time_util.current_time_millis()
         for res in targets:
+            if res not in rows:
+                continue  # never served traffic: mirror stays empty
             lease = table[res]
             if state is not None:
                 lease.seed(starts, pass_counts[:, rows[res]])
@@ -459,6 +476,11 @@ class SentinelEngine:
         """
         from sentinel_tpu.ops import window as W_
 
+        # Pre-retune queued commits belong to the OLD window: land them in
+        # it before it is discarded, so neither the reset device window nor
+        # the fresh lease mirrors inherit pre-retune usage. (Must happen
+        # outside self._lock — the flush dispatch takes it.)
+        self._flush_committer()
         with self._lock:
             cur = self._spec1
             interval_ms = cur.interval_ms if interval_ms is None else int(interval_ms)
@@ -474,21 +496,33 @@ class SentinelEngine:
             self._spec1 = new
             self._rebuild_w1_jits()
             self._rebuild_entry_jit()  # closes over the new spec
-            self._rebuild_leases()  # mirrors carry the window geometry
+            # Reset the device window BEFORE rebuilding leases: the fresh
+            # mirrors (new bucket count) must seed from the new-geometry
+            # window, not the stale one — seeding old-geometry buckets into
+            # new-geometry mirrors corrupts the ring (wrong length) and
+            # re-grants/withholds quota the reset already discarded.
             if self._state is not None:
                 self._state = self._state._replace(
                     w1=W_.make_window(self.capacity, new),
                     occupied_next=jnp.zeros((self.capacity,), jnp.int32),
                     occupied_stamp=jnp.int64(-1),
                 )
+            self._rebuild_leases()  # mirrors carry the window geometry
 
     def close(self) -> None:
         """Stop background workers (pipeline, host OS sampler, cluster role)."""
         # Fast path off FIRST (one atomic swap) so no new entry takes it,
         # then drain and stop the committer; a leased handle exiting after
-        # close falls back to the synchronous device path.
-        self._fastpath = _FastPathState({}, frozenset(), False)
-        committer, self._committer = self._committer, None
+        # close falls back to the synchronous device path. The flag and the
+        # swap happen under the lock _ensure_committer checks them under, so
+        # a racing entry either installs its committer before the swap (we
+        # stop that one below) or sees _closed and commits inline; stop()
+        # runs OUTSIDE the lock — the background flush takes the engine
+        # lock, and joining it while holding that lock would deadlock.
+        with self._lock:
+            self._closed = True
+            self._fastpath = _FastPathState({}, frozenset(), False)
+            committer, self._committer = self._committer, None
         if committer is not None:
             committer.stop()
         self.stop_pipeline()
@@ -842,12 +876,13 @@ class SentinelEngine:
 
                     record_log.warn("SPI slot %r on_exit failed: %r",
                                     type(slot).__name__, ex)
-        if handle.leased and self._committer is not None:
+        committer = self._committer  # one read: close() nulls it concurrently
+        if handle.leased and committer is not None:
             # Leased entries complete through the async committer too; the
             # device's RT/success/exception stats converge within one flush.
             # (After close() the committer is gone — fall through to the
             # synchronous device commit below rather than resurrecting it.)
-            self._committer.add_exit(
+            committer.add_exit(
                 handle.cluster_row, handle.dn_row, handle.origin_row,
                 handle.entry_in, count, min(rt, C.DEFAULT_MAX_RT_MS),
                 True, handle.error)

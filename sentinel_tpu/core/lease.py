@@ -103,10 +103,20 @@ class LocalLease:
 
     def seed(self, starts, counts) -> None:
         """Adopt the device window's buckets wholesale (checkpoint warm
-        restart: the restored stats are the truth the mirror must match)."""
+        restart: the restored stats are the truth the mirror must match).
+
+        Geometry-mismatched seeds are dropped: a ring of the wrong length
+        would index out of range on the next acquire, killing admission on
+        the resource. The mirror then starts empty — over-admitting by at
+        most one window, never crashing (the engine orders reset-then-seed
+        so this is pure defense in depth)."""
+        starts = [int(s) for s in starts]
+        counts = [int(c) for c in counts]
+        if len(starts) != self.buckets or len(counts) != self.buckets:
+            return
         with self._lock:
-            self._starts = [int(s) for s in starts]
-            self._counts = [int(c) for c in counts]
+            self._starts = starts
+            self._counts = counts
 
     def snapshot(self):
         """(starts, counts) under the lock — for mirror carry-over."""
@@ -169,6 +179,64 @@ def build_lease_table(engine):
             out[resource] = LocalLease([float(r.count) for r in rules],
                                        spec.interval_ms, spec.buckets)
     return out, guarded, True
+
+
+def _entry_batch_from(chunk: List[tuple]) -> EntryBatch:
+    """(cluster_row, dn_row, origin_row, entry_in, count, passed) tuples →
+    a pre-decided EntryBatch (the ONE fill site both committers share)."""
+    buf = make_entry_batch_np(_ladder_width(len(chunk)))
+    for i, (cr, dr, orow, ein, cnt, passed) in enumerate(chunk):
+        buf["cluster_row"][i] = cr
+        buf["dn_row"][i] = dr
+        buf["origin_row"][i] = orow
+        buf["entry_in"][i] = ein
+        buf["count"][i] = cnt
+        buf["pre_passed"][i] = passed
+        buf["pre_blocked"][i] = not passed
+    return EntryBatch(**buf)
+
+
+def _exit_batch_from(chunk: List[tuple]) -> ExitBatch:
+    """(cluster_row, dn_row, origin_row, entry_in, count, rt_ms, success,
+    error) tuples → an ExitBatch."""
+    buf = make_exit_batch_np(_ladder_width(len(chunk)))
+    for i, (cr, dr, orow, ein, cnt, rt, succ, err) in enumerate(chunk):
+        buf["cluster_row"][i] = cr
+        buf["dn_row"][i] = dr
+        buf["origin_row"][i] = orow
+        buf["entry_in"][i] = ein
+        buf["count"][i] = cnt
+        buf["rt_ms"][i] = rt
+        buf["success"][i] = succ
+        buf["error"][i] = err
+    return ExitBatch(**buf)
+
+
+class SyncCommitter:
+    """Inline fallback handed out after ``engine.close()``: commits each
+    outcome synchronously on the device instead of resurrecting the daemon
+    thread for an entry that raced the shutdown."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def add_entry(self, cluster_row: int, dn_row: int, origin_row: int,
+                  entry_in: bool, count: int, passed: bool) -> None:
+        self.engine._run_entry_batch(_entry_batch_from(
+            [(cluster_row, dn_row, origin_row, entry_in, count, passed)]))
+
+    def add_exit(self, cluster_row: int, dn_row: int, origin_row: int,
+                 entry_in: bool, count: int, rt_ms: int, success: bool,
+                 error: bool) -> None:
+        self.engine._run_exit_batch(_exit_batch_from(
+            [(cluster_row, dn_row, origin_row, entry_in, count, rt_ms,
+              success, error)]))
+
+    def flush(self) -> None:
+        pass
+
+    def pending_pass_counts(self) -> Dict[int, int]:
+        return {}
 
 
 class StatsCommitter:
@@ -237,7 +305,9 @@ class StatsCommitter:
             self._entries.append(
                 (cluster_row, dn_row, origin_row, entry_in, count, passed))
             n = len(self._entries)
-        if n >= self.max_batch:
+        # First enqueue wakes the idle loop (which then lingers linger_s to
+        # accumulate a micro-batch); max_batch wakes a mid-linger loop too.
+        if n == 1 or n >= self.max_batch:
             self._wake.set()
 
     def add_exit(self, cluster_row: int, dn_row: int, origin_row: int,
@@ -247,7 +317,7 @@ class StatsCommitter:
             self._exits.append((cluster_row, dn_row, origin_row, entry_in,
                                 count, rt_ms, success, error))
             n = len(self._exits)
-        if n >= self.max_batch:
+        if n == 1 or n >= self.max_batch:
             self._wake.set()
 
     def pending_pass_counts(self) -> Dict[int, int]:
@@ -265,7 +335,13 @@ class StatsCommitter:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            self._wake.wait(timeout=self.linger_s)
+            # Idle engines sleep here indefinitely (no 2ms polling): the
+            # first enqueue sets the event, then we linger briefly so the
+            # flush carries a micro-batch rather than a single item.
+            self._wake.wait()
+            if self._stop.is_set():
+                break
+            self._stop.wait(self.linger_s)
             self._wake.clear()
             try:
                 self.flush()
@@ -290,28 +366,7 @@ class StatsCommitter:
         eng = self.engine
         while entries:
             chunk, entries = entries[:self.max_batch], entries[self.max_batch:]
-            width = _ladder_width(len(chunk))
-            buf = make_entry_batch_np(width)
-            for i, (cr, dr, orow, ein, cnt, passed) in enumerate(chunk):
-                buf["cluster_row"][i] = cr
-                buf["dn_row"][i] = dr
-                buf["origin_row"][i] = orow
-                buf["entry_in"][i] = ein
-                buf["count"][i] = cnt
-                buf["pre_passed"][i] = passed
-                buf["pre_blocked"][i] = not passed
-            eng._run_entry_batch(EntryBatch(**buf))
+            eng._run_entry_batch(_entry_batch_from(chunk))
         while exits:
             chunk, exits = exits[:self.max_batch], exits[self.max_batch:]
-            width = _ladder_width(len(chunk))
-            buf = make_exit_batch_np(width)
-            for i, (cr, dr, orow, ein, cnt, rt, succ, err) in enumerate(chunk):
-                buf["cluster_row"][i] = cr
-                buf["dn_row"][i] = dr
-                buf["origin_row"][i] = orow
-                buf["entry_in"][i] = ein
-                buf["count"][i] = cnt
-                buf["rt_ms"][i] = rt
-                buf["success"][i] = succ
-                buf["error"][i] = err
-            eng._run_exit_batch(ExitBatch(**buf))
+            eng._run_exit_batch(_exit_batch_from(chunk))

@@ -63,10 +63,20 @@ def _flat_qs(qs: str) -> Dict[str, str]:
 class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 8080,
                  fetch_interval_s: float = 1.0,
-                 auth: Optional[AuthService] = None):
+                 auth: Optional[AuthService] = None,
+                 heartbeat_token: Optional[str] = None):
+        from sentinel_tpu.core.config import HEARTBEAT_TOKEN
+        from sentinel_tpu.core.config import config as _cfg
+
         self.host = host
         self.port = port
         self.auth = auth if auth is not None else AuthService()
+        # Optional shared secret for /registry/machine (auth-exempt by
+        # reference parity): without it, any network peer can register a
+        # rogue machine the dashboard will then poll and trust.
+        self.heartbeat_token = (
+            heartbeat_token if heartbeat_token is not None
+            else (_cfg.get(HEARTBEAT_TOKEN, "") or ""))
         self.apps = AppManagement()
         self.api = SentinelApiClient()
         # (app, rule_type) -> (provider, publisher) — the V2 pipeline.
@@ -309,6 +319,16 @@ class _Handler(BaseHTTPRequestHandler):
                     and self.command != "POST":
                 return self._fail("POST required", 405)
             if path == "/registry/machine":
+                if d.heartbeat_token:
+                    import hmac
+
+                    # Compare as bytes: compare_digest raises TypeError on
+                    # non-ASCII str, and header bytes arrive latin-1-decoded.
+                    got = self.headers.get("X-Sentinel-Heartbeat-Token", "")
+                    if not hmac.compare_digest(
+                            got.encode("utf-8"),
+                            d.heartbeat_token.encode("utf-8")):
+                        return self._fail("bad heartbeat token", 403)
                 form = _flat_qs(body)
                 form.update(q)
                 d.register_machine(form)

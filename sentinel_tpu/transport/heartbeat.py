@@ -68,8 +68,16 @@ class HeartbeatSender:
         target = self.dashboards[self._idx % len(self.dashboards)]
         url = f"http://{target}/registry/machine"
         data = urllib.parse.urlencode(self.heartbeat_message()).encode("ascii")
+        req = urllib.request.Request(url, data=data)
+        # Optional shared secret: deployments that enable dashboard auth can
+        # also close the (auth-exempt) registration endpoint to strangers.
+        from sentinel_tpu.core.config import HEARTBEAT_TOKEN
+
+        token = config.get(HEARTBEAT_TOKEN, "") or ""
+        if token:
+            req.add_header("X-Sentinel-Heartbeat-Token", token)
         try:
-            with urllib.request.urlopen(url, data=data, timeout=3) as resp:
+            with urllib.request.urlopen(req, timeout=3) as resp:
                 return 200 <= resp.status < 300
         except OSError:
             self._idx += 1  # try the next dashboard next beat

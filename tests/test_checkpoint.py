@@ -125,3 +125,28 @@ def test_registry_roundtrip_with_hostile_names(engine, frozen_time, tmp_path):
     assert restored._origin == reg._origin
     assert restored.get_cluster_row("res\x00name") == \
         reg.get_cluster_row("res\x00name")
+
+
+def test_restore_after_rule_load_seeds_lease_mirror(engine, frozen_time,
+                                                    tmp_path):
+    """A mere rule load must not consume registry rows (round-3 regression:
+    the allocating seed path tripped the fresh-engine guard), and after
+    restore the lease mirror must equal the restored device window."""
+    from sentinel_tpu.utils import time_util
+
+    st.load_flow_rules([st.FlowRule(resource="mir", count=10)])
+    for _ in range(4):
+        assert st.entry_ok("mir")
+    engine._flush_committer()
+    ckpt = str(tmp_path / "mir.npz")
+    save_checkpoint(engine, ckpt)
+
+    fresh = st.reset(capacity=512)
+    st.load_flow_rules([st.FlowRule(resource="mir", count=10)])
+    # Must NOT raise: loading rules allocated no rows on the fresh engine.
+    restore_checkpoint(fresh, ckpt)
+
+    now = time_util.current_time_millis()
+    assert fresh._leases["mir"].usage(now) == pytest.approx(4.0)
+    # Quota continuity through the mirror: 6 more admits, then block.
+    assert sum(1 for _ in range(8) if st.entry_ok("mir")) == 6

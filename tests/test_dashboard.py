@@ -321,3 +321,34 @@ def test_v2_rules_through_config_source(dash, engine):
     shown = _get(dash, "/v2/rules?app=appV2&type=flow")
     assert shown[0]["resource"] == "v2res"
     src.close()
+
+
+def test_heartbeat_token_closes_registration():
+    """Optional shared secret (``sentinel.dashboard.heartbeat.token``): when
+    set, /registry/machine rejects strangers; senders configured with the
+    same token register fine (round-3 advisor: rogue-machine SSRF surface)."""
+    d = DashboardServer(port=0, heartbeat_token="hb-secret").start(fetch=False)
+    try:
+        code, _, out = _raw(d, "/registry/machine?app=a&ip=127.0.0.1&port=1",
+                            method="POST")
+        assert code == 403 and not out["success"]
+        code, _, out = _raw(
+            d, "/registry/machine?app=a&ip=127.0.0.1&port=1", method="POST",
+            headers={"X-Sentinel-Heartbeat-Token": "hb-secret"})
+        assert code == 200 and out["success"]
+        assert d.apps.app_names() == ["a"]
+    finally:
+        d.stop()
+
+
+def test_heartbeat_sender_carries_token(monkeypatch):
+    monkeypatch.setenv("SENTINEL_DASHBOARD_HEARTBEAT_TOKEN", "hb-secret")
+    d = DashboardServer(port=0).start(fetch=False)
+    try:
+        assert d.heartbeat_token == "hb-secret"
+        hb = HeartbeatSender(dashboards=[f"127.0.0.1:{d.bound_port}"],
+                             api_port=8719)
+        assert hb.send_once()
+        assert d.apps.app_names()  # registered through the token gate
+    finally:
+        d.stop()

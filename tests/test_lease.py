@@ -293,3 +293,39 @@ def test_leases_command_reports_effective_state(engine):
     assert out["enabled"] is True  # configured on...
     assert out["effective"] is False  # ...but system rules disable it
     assert out["unruledFastpath"] is False
+
+
+def test_retune_with_compiled_leased_engine(engine, frozen_time):
+    """Round-3 advisor high: retuning a COMPILED engine with an active
+    lease seeded old-geometry buckets into new-geometry mirrors, so the
+    next entry raised IndexError and admission died on the resource.
+    Grow and shrink must both leave a clean, full-quota window."""
+    st.load_flow_rules([st.FlowRule(resource="ret", count=5)])
+    for _ in range(3):
+        assert st.entry_ok("ret")
+    engine._flush_committer()          # device state now exists (compiled)
+
+    engine.set_window_geometry(interval_ms=2000, sample_count=4)
+    # Window reset: the 2s window smooths the burst (used rises 0.5/entry),
+    # so i*0.5 + 1 <= 5 admits i=0..8 — and, crucially, no IndexError.
+    got = [bool(st.entry_ok("ret")) for _ in range(12)]
+    assert got == [True] * 9 + [False] * 3
+
+    engine.set_window_geometry(interval_ms=1000, sample_count=2)
+    # Shrink: no stale tail buckets survive; full fresh quota again.
+    got = [bool(st.entry_ok("ret")) for _ in range(7)]
+    assert got == [True] * 5 + [False] * 2
+
+
+def test_retune_drops_pre_retune_queued_usage_from_mirror(engine,
+                                                          frozen_time):
+    """Usage queued in the committer before a retune belongs to the OLD
+    window; the reset window (and its fresh mirror) must not inherit it."""
+    st.load_flow_rules([st.FlowRule(resource="retq", count=4)])
+    for _ in range(3):
+        assert st.entry_ok("retq")     # queued, not yet flushed
+    engine.set_window_geometry(interval_ms=2000, sample_count=4)
+    from sentinel_tpu.utils import time_util
+
+    assert engine._leases["retq"].usage(
+        time_util.current_time_millis()) == pytest.approx(0.0)
