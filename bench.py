@@ -264,6 +264,85 @@ def bench_p99_latency() -> dict:
     }
 
 
+def bench_entry_overhead() -> dict:
+    """JMH-parity entry overhead (reference: ``SentinelEntryBenchmark`` —
+    SURVEY §2.8): mean µs/op of ``entry()+exit()`` vs a bare call at
+    1/4/8 threads, for each admission path:
+
+      * ``leased``  — simple QPS rule, host-side token-lease admission;
+      * ``unruled`` — no rules at all (always-pass + async stats);
+      * ``device_pipelined`` — a degrade rule forces per-entry device
+        verdicts through the micro-batch pipeline (per-op wall includes
+        queue wait + dispatch; through a remote tunnel that is ms-scale
+        by design — see BASELINE.md).
+
+    Python-threads caveat vs the JVM harness: all threads share the GIL,
+    so thread counts probe contention on the admission locks, not
+    parallel speedup."""
+    import sentinel_tpu as st
+
+    eng = st.get_engine()
+    st.load_flow_rules([st.FlowRule(resource="ov_leased", count=1e9)])
+    st.load_degrade_rules([st.DegradeRule(
+        resource="ov_device", count=1e6, grade=0, time_window=10)])
+    assert "ov_leased" in eng._leases
+
+    def bare():
+        return 42
+
+    n_bare = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_bare):
+        bare()
+    bare_us = (time.perf_counter() - t0) / n_bare * 1e6
+
+    def measure(resource: str, n_threads: int, ops: int) -> float:
+        """Mean µs/op of entry+exit (bare call inside) across threads."""
+        per_thread = [0.0] * n_threads
+        barrier = threading.Barrier(n_threads)
+
+        def worker(tid: int):
+            barrier.wait()
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                h = st.entry_ok(resource)
+                bare()
+                if h:
+                    h.exit()
+            per_thread[tid] = (time.perf_counter() - t0) / ops * 1e6
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return float(np.mean(per_thread))
+
+    # warm every path (absorb first-entry compile + committer start)
+    for res in ("ov_leased", "ov_unruled", "ov_device"):
+        h = st.entry_ok(res)
+        if h:
+            h.exit()
+
+    out: dict = {"bare_call_us": round(bare_us, 3)}
+    for path, res, ops in (("leased", "ov_leased", 4000),
+                           ("unruled", "ov_unruled", 4000)):
+        out[path] = {
+            f"t{n}_us_per_op": round(measure(res, n, ops), 1)
+            for n in (1, 4, 8)
+        }
+    eng.start_pipeline(linger_s=0.0002)
+    try:
+        out["device_pipelined"] = {
+            f"t{n}_us_per_op": round(measure("ov_device", n, 100), 1)
+            for n in (1, 4, 8)
+        }
+    finally:
+        eng.stop_pipeline()
+    return out
+
+
 def _probe_backend(timeout_s: float = 90.0):
     """Probe jax backend init in a SUBPROCESS: when the axon tunnel is
     down, ``jax.devices()`` blocks forever inside ``make_c_api_client``
@@ -312,25 +391,43 @@ def main() -> None:
     if os.environ.get("BENCH_FORCED_CPU") == "1":
         platform = "cpu-fallback"
     else:
+        # Round-3 lesson: a 1h+ outage outlasted the old ~30min probe
+        # budget and the round's only bench record became a CPU number.
+        # The bench IS the round's TPU evidence, so wait as long as the
+        # driver allows (default 3h; BENCH_TUNNEL_WAIT_S overrides).
+        try:
+            wait_budget_s = float(
+                os.environ.get("BENCH_TUNNEL_WAIT_S", "10800"))
+        except ValueError:  # malformed override must not kill the record
+            wait_budget_s = 10800.0
+        deadline = time.time() + wait_budget_s
         platform = None
-        for attempt in range(5):
+        attempt = 0
+        while True:
             probed = _probe_backend()
             if probed in ("tpu", "axon"):
                 platform = probed
                 break
             if probed is not None:
                 # A clean non-accelerator answer is definitive, not a
-                # transient outage — no point retrying for 15 minutes.
+                # transient outage — no point waiting hours.
                 _reexec_cpu(f"no accelerator (probe says {probed!r})")
-            print(f"backend probe {attempt + 1}/5 hung/errored "
-                  f"(tunnel down?)", file=sys.stderr)
-            if attempt < 4:  # no pointless sleep after the final attempt
-                time.sleep(90 * (attempt + 1))
+            attempt += 1
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            print(f"backend probe {attempt} hung/errored (tunnel down?); "
+                  f"retrying for up to {remaining / 60:.0f} more min",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            time.sleep(min(150.0, remaining))
         if platform is None:
-            _reexec_cpu("tunnel unreachable after 5 probes")
+            _reexec_cpu(
+                f"tunnel unreachable for {wait_budget_s / 60:.0f} min")
 
-    # The CPU fallback must also catch a tunnel that dies MID-BENCH —
-    # otherwise these retries end in a raise with no JSON line at all.
+    # The CPU fallback must also catch a tunnel that dies DURING the
+    # throughput section — otherwise these retries end in a raise with no
+    # JSON line at all.
     try:
         last_err = None
         checks_per_sec = None
@@ -346,20 +443,42 @@ def main() -> None:
                     time.sleep(60 * (attempt + 1))
         if checks_per_sec is None:
             raise last_err
-        extras = bench_p99_latency()
     except RuntimeError as ex:
         if platform != "cpu-fallback":
             _reexec_cpu(f"accelerator died mid-bench ({ex!r:.120})")
         raise
-    extras["platform"] = platform
+
     target = 1_000_000.0  # BASELINE.json north star: 1M aggregate QPS
     out = {
         "metric": "rule_checks_per_sec",
         "value": round(checks_per_sec, 1),
         "unit": "entries/s",
         "vs_baseline": round(checks_per_sec / target, 4),
+        "platform": platform,
     }
-    out.update(extras)
+
+    def persist(partial: dict) -> None:
+        """Crash-safe partial record: if the tunnel (or the driver's
+        timeout) kills us mid-latency-section, the completed sections
+        survive on disk AND a JSON line is still printable from them."""
+        try:
+            with open("bench_partial.json", "w") as f:
+                json.dump(partial, f)
+        except OSError:
+            pass
+
+    persist(out)
+    # A TPU throughput number in hand must NOT be discarded because a
+    # later section died (round-3: the whole run re-exec'd on CPU) — the
+    # latency/overhead sections degrade to an error note instead.
+    try:
+        out.update(bench_p99_latency())
+        persist(out)
+        out["entry_overhead"] = bench_entry_overhead()
+        persist(out)
+    except Exception as ex:  # noqa: BLE001 — any late failure keeps §1
+        out["latency_section_error"] = f"{ex!r:.160}"
+        persist(out)
     print(json.dumps(out))
 
 

@@ -24,6 +24,11 @@ from sentinel_tpu.datasource.push import (
     PollingKVDataSource,
     PushDataSource,
 )
+from sentinel_tpu.datasource.redis import (
+    MiniRedisServer,
+    RedisDataSource,
+    RedisWritableDataSource,
+)
 from sentinel_tpu.datasource.converters import (
     authority_rules_from_json,
     authority_rules_to_json,
@@ -42,6 +47,7 @@ __all__ = [
     "BrokerDataSource", "BrokerWritableDataSource", "InProcessBroker",
     "PollingKVDataSource", "PushDataSource",
     "FileRefreshableDataSource", "FileWritableDataSource",
+    "MiniRedisServer", "RedisDataSource", "RedisWritableDataSource",
     "ReadableDataSource", "WritableDataSource", "bind",
     "authority_rules_from_json", "authority_rules_to_json",
     "degrade_rules_from_json", "degrade_rules_to_json",

@@ -352,3 +352,43 @@ def test_heartbeat_sender_carries_token(monkeypatch):
         assert d.apps.app_names()  # registered through the token gate
     finally:
         d.stop()
+
+
+def test_metric_history_series_shape(dash, engine, frozen_time, tmp_path,
+                                     monkeypatch):
+    """History-chart contract (VERDICT r3 #5): queryTopResourceMetric.json
+    serves a MULTI-SECOND per-resource time-series with exactly the schema
+    the UI chart/sparklines consume, timestamps sorted ascending."""
+    monkeypatch.setenv("CSP_SENTINEL_LOG_DIR", str(tmp_path))
+    monkeypatch.setenv("PROJECT_NAME", "histApp")
+    st.load_flow_rules([st.FlowRule(resource="hist", count=100)])
+    writer = MetricWriter(app="histApp", base_dir=str(tmp_path))
+    listener = MetricTimerListener(engine, writer)
+    for second, n in enumerate((4, 1, 3)):  # distinct per-second traffic
+        for _ in range(n):
+            h = st.entry_ok("hist")
+            if h:
+                h.exit()
+        frozen_time.advance_time(1_000)
+        listener.tick(frozen_time.current_time_millis())
+    writer.close()
+
+    center = CommandCenter(engine, port=0).start()
+    try:
+        HeartbeatSender(dashboards=[f"127.0.0.1:{dash.bound_port}"],
+                        api_port=center.bound_port).send_once()
+        frozen_time.advance_time(2_000)  # newest second clears the fetch lag
+        now = frozen_time.current_time_millis()
+        dash.fetcher.fetch_once(now_ms=now)  # 6s span covers all three
+        top = _get(dash, f"/metric/queryTopResourceMetric.json?app=histApp"
+                         f"&startTime={now - 60_000}&endTime={now}")
+        pts = top["resource"]["hist"]
+        assert len(pts) == 3
+        ts = [p["timestamp"] for p in pts]
+        assert ts == sorted(ts) and ts[2] - ts[0] == 2_000
+        assert [p["passQps"] for p in pts] == [4, 1, 3]
+        for p in pts:  # exactly the keys the chart consumes
+            assert set(p) == {"resource", "timestamp", "passQps", "blockQps",
+                              "successQps", "exceptionQps", "rt"}
+    finally:
+        center.stop()

@@ -1,0 +1,179 @@
+"""Redis (RESP) datasource connector tests (SURVEY.md §2.2): a real
+protocol over a real socket — initial GET, SUBSCRIBE pushes, writable
+SET+PUBLISH, reconnect with catch-up across a server restart, auth, and
+partial-read reassembly of oversized payloads.
+"""
+
+import json
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import bind
+from sentinel_tpu.datasource.converters import (
+    flow_rules_from_json,
+    flow_rules_to_json,
+)
+from sentinel_tpu.datasource.redis import (
+    MiniRedisServer,
+    RedisDataSource,
+    RedisWritableDataSource,
+    RespConnection,
+    RespError,
+)
+
+
+@pytest.fixture()
+def server():
+    s = MiniRedisServer().start()
+    yield s
+    s.stop()
+
+
+def _wait_for(pred, timeout_s: float = 5.0) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _rules_json(*resources, count=5.0) -> str:
+    return json.dumps([{"resource": r, "count": count} for r in resources])
+
+
+def test_resp_command_basics(server):
+    conn = RespConnection("127.0.0.1", server.port)
+    try:
+        assert conn.command("PING") == "PONG"
+        assert conn.command("SET", "k", "v1") == "OK"
+        assert conn.command("GET", "k") == b"v1"
+        assert conn.command("GET", "missing") is None
+        assert conn.command("DEL", "k", "missing") == 1
+        with pytest.raises(RespError):
+            conn.command("WHATISTHIS")
+    finally:
+        conn.close()
+
+
+def test_initial_get_loads_rules(server, engine):
+    server_kv_preload = RespConnection("127.0.0.1", server.port)
+    server_kv_preload.command("SET", "rules/flow", _rules_json("pre"))
+    server_kv_preload.close()
+    src = RedisDataSource("127.0.0.1", server.port, "rules/flow",
+                          "rules/flow:chan", flow_rules_from_json).start()
+    try:
+        bind(src, st.load_flow_rules)
+        assert [r.resource for r in engine.flow_rules.get_rules()] == ["pre"]
+    finally:
+        src.close()
+
+
+def test_publish_pushes_rules(server, engine):
+    src = RedisDataSource("127.0.0.1", server.port, "rules/flow",
+                          "rules/flow:chan", flow_rules_from_json).start()
+    try:
+        bind(src, st.load_flow_rules)
+        writer = RedisWritableDataSource(
+            "127.0.0.1", server.port, "rules/flow", "rules/flow:chan",
+            flow_rules_to_json)
+        writer.write([st.FlowRule(resource="pushed", count=7)])
+        assert _wait_for(lambda: [r.resource for r in
+                                  engine.flow_rules.get_rules()] == ["pushed"])
+        # the SET half: a later cold reader sees the same rules
+        assert b"pushed" in RedisDataSource(
+            "127.0.0.1", server.port, "rules/flow", "c",
+            flow_rules_from_json).read_source()
+    finally:
+        src.close()
+
+
+def test_bad_payload_keeps_last_good(server, engine):
+    src = RedisDataSource("127.0.0.1", server.port, "rules/flow",
+                          "chan", flow_rules_from_json).start()
+    try:
+        bind(src, st.load_flow_rules)
+        conn = RespConnection("127.0.0.1", server.port)
+        conn.command("SET", "rules/flow", _rules_json("good"))
+        conn.command("PUBLISH", "chan", _rules_json("good"))
+        assert _wait_for(lambda: [r.resource for r in
+                                  engine.flow_rules.get_rules()] == ["good"])
+        conn.command("PUBLISH", "chan", "{not json!")
+        time.sleep(0.1)  # let the bad push (not) land
+        assert [r.resource for r in engine.flow_rules.get_rules()] == ["good"]
+        conn.close()
+    finally:
+        src.close()
+
+
+def test_server_restart_reconnects_and_catches_up(server, engine):
+    """The connector survives a server crash: pushes resume after restart,
+    and an update it MISSED while down is recovered by the catch-up GET."""
+    src = RedisDataSource("127.0.0.1", server.port, "rules/flow",
+                          "chan", flow_rules_from_json,
+                          reconnect_backoff_ms=(20, 200)).start()
+    try:
+        bind(src, st.load_flow_rules)
+        server.stop()                      # crash: subscriber conn dies
+        # rule update happens while the subscriber is down (the restarted
+        # server keeps its KV, like a persistent Redis)
+        server._kv[b"rules/flow"] = _rules_json("missed").encode()
+        server.start()
+        assert _wait_for(lambda: [r.resource for r in
+                                  engine.flow_rules.get_rules()] == ["missed"])
+        assert src.reconnect_count >= 1
+        # live pushes work again on the new connection
+        writer = RedisWritableDataSource(
+            "127.0.0.1", server.port, "rules/flow", "chan",
+            flow_rules_to_json)
+        writer.write([st.FlowRule(resource="after", count=2)])
+        assert _wait_for(lambda: [r.resource for r in
+                                  engine.flow_rules.get_rules()] == ["after"])
+    finally:
+        src.close()
+
+
+def test_auth_required_and_satisfied(engine):
+    server = MiniRedisServer(password="hunter2").start()
+    try:
+        with pytest.raises(RespError, match="NOAUTH"):
+            RespConnection("127.0.0.1", server.port).command("GET", "k")
+        with pytest.raises(RespError, match="invalid password"):
+            RespConnection("127.0.0.1", server.port, password="wrong")
+        src = RedisDataSource("127.0.0.1", server.port, "rules/flow",
+                              "chan", flow_rules_from_json,
+                              password="hunter2").start()
+        try:
+            bind(src, st.load_flow_rules)
+            RedisWritableDataSource(
+                "127.0.0.1", server.port, "rules/flow", "chan",
+                flow_rules_to_json, password="hunter2"
+            ).write([st.FlowRule(resource="authed", count=1)])
+            assert _wait_for(lambda: [r.resource for r in
+                                      engine.flow_rules.get_rules()]
+                             == ["authed"])
+        finally:
+            src.close()
+    finally:
+        server.stop()
+
+
+def test_large_payload_reassembled(server, engine):
+    """A rules payload far larger than one recv() exercises the buffered
+    reader's partial-frame reassembly on both GET and pub/sub paths."""
+    big = _rules_json(*(f"res-{i:05d}" for i in range(3000)))
+    assert len(big) > 100_000
+    src = RedisDataSource("127.0.0.1", server.port, "rules/flow",
+                          "chan", flow_rules_from_json).start()
+    try:
+        bind(src, st.load_flow_rules)
+        conn = RespConnection("127.0.0.1", server.port)
+        conn.command("SET", "rules/flow", big)
+        conn.command("PUBLISH", "chan", big)
+        conn.close()
+        assert _wait_for(lambda: len(engine.flow_rules.get_rules()) == 3000)
+        assert src.read_source().decode() == big
+    finally:
+        src.close()
