@@ -72,6 +72,24 @@ def _state_arrays(state):
     }
 
 
+def _atomic_savez(path: str, header: dict, arrays: dict) -> None:
+    """Write header + arrays as one ``.npz`` via tmp-file + rename, so a
+    crash mid-write can never leave a truncated checkpoint at ``path``."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
+                               or ".", suffix=".ckpt.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, __header__=np.frombuffer(
+                json.dumps(header).encode("utf-8"), dtype=np.uint8), **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save_checkpoint(engine, path: str) -> None:
     """Atomically snapshot the engine's node statistics to ``path``."""
     import jax
@@ -91,19 +109,7 @@ def save_checkpoint(engine, path: str) -> None:
             "w1_sample_count": engine._spec1.buckets,
         }
         arrays = {k: np.asarray(v) for k, v in _state_arrays(state).items()}
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
-                               or ".", suffix=".ckpt.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez_compressed(f, __header__=np.frombuffer(
-                json.dumps(header).encode("utf-8"), dtype=np.uint8), **arrays)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    _atomic_savez(path, header, arrays)
 
 
 def restore_checkpoint(engine, path: str, force: bool = False) -> None:
@@ -185,7 +191,12 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
             w60=Window(jnp.asarray(arrays["w60_counts"]),
                        jnp.asarray(arrays["w60_min_rt"]),
                        jnp.asarray(arrays["w60_starts"])),
-            cur_threads=jnp.asarray(arrays["cur_threads"]),
+            # The gauge measures LIVE in-process concurrency, not history:
+            # entries in flight at the crash died with their process and
+            # will never exit, so grafting their count back would starve
+            # THREAD-grade rules forever. Windows persist; gauges reset.
+            # (docs/SEMANTICS.md "checkpoint warm restart".)
+            cur_threads=jnp.zeros_like(engine._state.cur_threads),
             sec=SecondAccum(jnp.asarray(arrays["sec_counts"]),
                             jnp.asarray(arrays["sec_min_rt"]),
                             jnp.asarray(arrays["sec_stamp"])),
@@ -195,6 +206,48 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
     # Lease mirrors must match the restored windows, or host admission
     # would re-grant quota the snapshot already spent.
     engine._seed_leases_from_state()
+
+
+def save_pod_checkpoint(pod_state, path: str) -> None:
+    """Snapshot a pod-parallel state tree (``parallel.cluster
+    .make_pod_state``): every leaf with its leading device axis, so a
+    restarted pod resumes with each device's share of the global window
+    intact (the psum'd view is reconstructed from the shares)."""
+    import jax
+
+    leaves = jax.tree.leaves(jax.block_until_ready(pod_state))
+    _atomic_savez(
+        path, {"version": CHECKPOINT_VERSION, "n_leaves": len(leaves)},
+        {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+
+
+def restore_pod_checkpoint(like, path: str):
+    """Rebuild a pod state from ``save_pod_checkpoint`` output. ``like``
+    is a template with the target structure/shapes (a fresh
+    ``make_pod_state``); every leaf is validated against it before any
+    value is returned, so a mismatched file cannot half-load."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(like)
+    with np.load(path) as z:
+        header = json.loads(bytes(z["__header__"]).decode("utf-8"))
+        if header.get("version") != CHECKPOINT_VERSION:
+            raise ValueError(
+                f"unsupported pod checkpoint version {header.get('version')}")
+        if header.get("n_leaves") != len(leaves):
+            raise ValueError(
+                f"pod checkpoint has {header.get('n_leaves')} leaves, "
+                f"template expects {len(leaves)}")
+        loaded = [z[f"leaf_{i}"] for i in range(len(leaves))]
+    for i, (got, want) in enumerate(zip(loaded, leaves)):
+        if tuple(got.shape) != tuple(want.shape) \
+                or np.dtype(got.dtype) != np.dtype(want.dtype):
+            raise ValueError(
+                f"pod checkpoint leaf {i} is {got.dtype}{list(got.shape)}, "
+                f"template expects {np.dtype(want.dtype)}"
+                f"{list(want.shape)}")
+    return jax.tree.unflatten(treedef, [jnp.asarray(x) for x in loaded])
 
 
 class CheckpointTimer:

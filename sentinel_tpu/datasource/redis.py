@@ -210,21 +210,27 @@ class RedisDataSource(AbstractDataSource[bytes, T]):
                 conn = RespConnection(self.host, self.port, self.password,
                                       timeout_s=None)
                 self._active = conn
-                # catch-up BEFORE subscribe: a push missed while down is
-                # recovered here; one published during the gap between GET
-                # and SUBSCRIBE arrives as a normal message right after.
-                self._push_raw(conn.command("GET", self.rule_key))
                 sub = conn.command("SUBSCRIBE", self.channel)
                 if not (isinstance(sub, list) and sub
                         and sub[0] == b"subscribe"):
                     raise RespError(f"unexpected SUBSCRIBE reply {sub!r}")
+                # catch-up AFTER subscribe (on a command connection — a
+                # subscribed conn can't GET): an update missed while down
+                # is recovered here, and one racing this instant arrives
+                # as a message too. GET-then-subscribe would have a lossy
+                # gap between the two; this order has none.
+                self._push_raw(self.read_source())
                 backoff_ms = self.backoff_min_ms  # healthy again
                 while not self._stop.is_set():
                     msg = conn.reader.read_reply()
                     if (isinstance(msg, list) and len(msg) == 3
                             and msg[0] == b"message"):
                         self._push_raw(msg[2])
-            except (OSError, ConnectionError, RespError) as ex:
+            except (OSError, ConnectionError, RespError, ValueError,
+                    IndexError, UnicodeDecodeError) as ex:
+                # ValueError/IndexError/UnicodeDecodeError: a corrupt or
+                # desynced RESP frame from the parser — the connection is
+                # unusable but the CONNECTOR must survive and reconnect
                 if self._stop.is_set():
                     break
                 self.reconnect_count += 1
@@ -415,12 +421,19 @@ class MiniRedisServer:
                 elif cmd == b"SUBSCRIBE" and args:
                     for ch in args:
                         subscribed.add(ch)
-                        with self._lock:
-                            self._subs.setdefault(ch, set()).add(
-                                (conn, send_lock))
-                        reply(b"*3\r\n$9\r\nsubscribe\r\n"
-                              b"$%d\r\n%s\r\n:%d\r\n"
-                              % (len(ch), ch, len(subscribed)))
+                        # Registration and ack under ONE send_lock hold:
+                        # a racing PUBLISH (which sends under send_lock
+                        # but never holds self._lock while sending) can
+                        # otherwise deliver its message frame BEFORE the
+                        # +subscribe ack, which clients read as a bogus
+                        # SUBSCRIBE reply.
+                        with send_lock:
+                            with self._lock:
+                                self._subs.setdefault(ch, set()).add(
+                                    (conn, send_lock))
+                            conn.sendall(b"*3\r\n$9\r\nsubscribe\r\n"
+                                         b"$%d\r\n%s\r\n:%d\r\n"
+                                         % (len(ch), ch, len(subscribed)))
                 elif cmd == b"UNSUBSCRIBE":
                     for ch in (args or list(subscribed)):
                         subscribed.discard(ch)

@@ -45,3 +45,45 @@ def engine(frozen_time):
     yield eng
     replace_context(None)
     st.reset(capacity=512)
+
+
+# -- quick tier ---------------------------------------------------------------
+# `pytest -m quick` (< ~2 min): one representative per engine path, chosen to
+# cover the regression classes that shipped broken HEADs in rounds 2-3
+# (engine/lease/checkpoint/retune interactions) plus a smoke per subsystem.
+# Run it before EVERY commit; the full suite before the round's final one.
+
+QUICK = (
+    "test_flow.py::test_flow_qps_demo_golden",
+    "test_flow.py::test_rule_swap_wholesale",
+    "test_flow.py::TestWindowGeometry::test_retune_resets_instant_window_and_keeps_quota_rate",
+    "test_lease.py::test_lease_admission_is_exact",
+    "test_lease.py::test_lease_stats_reach_the_device",
+    "test_lease.py::test_rule_push_does_not_regrant_spent_quota",
+    "test_lease.py::test_retune_with_compiled_leased_engine",
+    "test_checkpoint.py::test_stats_survive_restart",
+    "test_checkpoint.py::test_restore_after_rule_load_seeds_lease_mirror",
+    "test_checkpoint_scenarios.py::test_leased_traffic_checkpoint_crash_restore",
+    "test_occupy.py::test_prioritized_borrows_once_bucket_expires",
+    "test_degrade.py::test_exception_ratio_opens_and_recovers",
+    "test_window.py::test_rotation_drops_old_buckets",
+    "test_cluster.py::test_codec_flow_round_trip",
+    "test_transport.py::test_get_set_rules_round_trip",
+    "test_dashboard.py::test_discovery_from_heartbeats",
+    "test_tlv_fixtures.py",     # whole file: 2.5s
+    "test_redis_datasource.py",  # whole file: 2.5s
+)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: pre-commit smoke tier (pytest -m quick)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        rel = item.nodeid.split("tests/")[-1]
+        for q in QUICK:
+            if rel == q or rel.startswith(q + "::") or rel.startswith(q + "["):
+                item.add_marker(pytest.mark.quick)
+                break

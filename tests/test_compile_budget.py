@@ -1,0 +1,122 @@
+"""Compile-time resource-budget guard (VERDICT r3 #8).
+
+BENCH_r01 died on-chip with "scoped allocation 19.09M > 16.00M" — a VMEM
+blowup in the widest fused-scan step that no CPU test could see, because
+nothing asserted anything about the compiled program's footprint. This
+file lower().compile()s the bench's EXACT widest shape (10k resources /
+32k rows / 8192-wide batch / 16-step scan) and pins its memory and work
+metrics, so a scan/width/step change that balloons intermediates fails
+here instead of only on real hardware.
+
+CPU compilation is not TPU compilation, but the blowup class this guards
+against (materializing per-step state copies, un-fused [steps, batch, R]
+intermediates) inflates the CPU temp allocation the same way. Budgets
+carry ~3x headroom over measured values (temp 155MB, 6.9 GFLOP, 796MB
+accessed per dispatch at pinning time); a legit regression that trips
+them should raise the budget CONSCIOUSLY, with a bench run on chip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentinel_tpu.core.batch import BATCH_WIDTHS, EntryBatch, make_entry_batch_np
+from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.models import authority as A
+from sentinel_tpu.models import degrade as D
+from sentinel_tpu.models import flow as F
+from sentinel_tpu.models import param_flow as P
+from sentinel_tpu.models import system as Y
+from sentinel_tpu.ops import step as S
+
+N_RES, CAPACITY, BATCH_N, SCAN_STEPS = 10_000, 32_768, 8192, 16
+NOW0 = 1_700_000_000_000
+
+TEMP_BYTES_BUDGET = 512 * 1024 * 1024     # measured 155MB
+FLOPS_PER_ENTRY_BUDGET = 150_000          # measured ~53k
+BYTES_ACCESSED_PER_ENTRY_BUDGET = 20_000  # measured ~6.1k
+
+
+def _bench_program():
+    """The bench's widest fused program, byte-for-byte the same shapes."""
+    reg = NodeRegistry(CAPACITY)
+    rules = [F.FlowRule(resource=f"res{i}", count=1e9)
+             for i in range(0, N_RES, 10)]
+    drules = [D.DegradeRule(resource=f"res{i}", count=100, grade=i % 3,
+                            time_window=10) for i in range(0, N_RES, 20)]
+    prules = [P.ParamFlowRule(f"res{i}", param_idx=0, count=1e9)
+              for i in range(0, N_RES, 40)]
+    rows = np.asarray([reg.cluster_row(f"res{i}") for i in range(N_RES)])
+    ft, _ = F.compile_flow_rules(rules, reg, CAPACITY)
+    dt, di = D.compile_degrade_rules(drules, reg, CAPACITY)
+    pt = P.compile_param_rules(prules, reg, CAPACITY)
+    pack = S.RulePack(
+        flow=ft, degrade=dt,
+        authority=A.compile_authority_rules([], reg, CAPACITY),
+        system=Y.compile_system_rules([Y.SystemRule(qps=1e12)]),
+        param=pt)
+    state = S.make_state(CAPACITY, ft.num_rules, NOW0,
+                         degrade=D.make_degrade_state(dt, di),
+                         param=P.make_param_state(pt.num_rules))
+    buf = make_entry_batch_np(BATCH_N)
+    buf["cluster_row"][:] = rows[np.arange(BATCH_N) % N_RES]
+    buf["count"][:] = 1
+    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+    def multi(state, now_start):
+        def body(st_, i):
+            st_, dec = S.entry_step(st_, pack, batch, now_start + i)
+            return st_, dec.reason[0]
+
+        return jax.lax.scan(body, state,
+                            jnp.arange(SCAN_STEPS, dtype=jnp.int64))
+
+    return jax.jit(multi, donate_argnums=(0,)), state
+
+
+def test_widest_fused_step_compiles_within_budget():
+    fn, state = _bench_program()
+    compiled = fn.lower(state, jnp.asarray(NOW0, jnp.int64)).compile()
+
+    mem = compiled.memory_analysis()
+    assert mem.temp_size_in_bytes < TEMP_BYTES_BUDGET, (
+        f"fused-step temp allocation {mem.temp_size_in_bytes / 1e6:.1f}MB "
+        f"blew the {TEMP_BYTES_BUDGET / 1e6:.0f}MB budget — this is the "
+        "BENCH_r01 VMEM-OOM class; check for materialized per-step "
+        "intermediates before raising the budget")
+    # donation must alias the big state buffers, not copy them
+    assert mem.alias_size_in_bytes >= 0.9 * mem.argument_size_in_bytes
+
+    cost = compiled.cost_analysis()
+    entries = SCAN_STEPS * BATCH_N
+    flops_per_entry = cost.get("flops", 0.0) / entries
+    assert flops_per_entry < FLOPS_PER_ENTRY_BUDGET, (
+        f"{flops_per_entry:.0f} flops/entry (budget "
+        f"{FLOPS_PER_ENTRY_BUDGET}) — per-entry work regressed")
+    bytes_per_entry = cost.get("bytes accessed", 0.0) / entries
+    assert bytes_per_entry < BYTES_ACCESSED_PER_ENTRY_BUDGET, (
+        f"{bytes_per_entry:.0f} bytes accessed/entry (budget "
+        f"{BYTES_ACCESSED_PER_ENTRY_BUDGET}) — HBM traffic regressed")
+
+
+def test_engine_ladder_widths_compile_within_budget(engine, frozen_time):
+    """Every interactive ladder width the engine can dispatch stays well
+    under the widest-budget too (these are the pipeline's shapes)."""
+    import sentinel_tpu as st
+
+    st.load_flow_rules([st.FlowRule(resource="w", count=100)])
+    st.load_degrade_rules([st.DegradeRule(resource="w", count=50, grade=0,
+                                          time_window=10)])
+    engine._ensure_compiled()
+    state, pack = engine._state, engine._rules
+    for width in BATCH_WIDTHS:
+        buf = make_entry_batch_np(width)
+        buf["count"][:] = 1
+        batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+        compiled = jax.jit(
+            S.entry_step, static_argnames=(), donate_argnums=(0,)
+        ).lower(state, pack, batch,
+                jnp.asarray(NOW0, jnp.int64)).compile()
+        mem = compiled.memory_analysis()
+        assert mem.temp_size_in_bytes < TEMP_BYTES_BUDGET

@@ -116,6 +116,9 @@ def test_server_restart_reconnects_and_catches_up(server, engine):
                           reconnect_backoff_ms=(20, 200)).start()
     try:
         bind(src, st.load_flow_rules)
+        # the crash must sever a LIVE subscription — under load the first
+        # connect can otherwise land after the restart (reconnect_count 0)
+        assert _wait_for(lambda: server._subs.get(b"chan"))
         server.stop()                      # crash: subscriber conn dies
         # rule update happens while the subscriber is down (the restarted
         # server keeps its KV, like a persistent Redis)
