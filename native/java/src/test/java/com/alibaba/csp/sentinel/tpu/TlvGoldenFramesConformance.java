@@ -1,0 +1,231 @@
+package com.alibaba.csp.sentinel.tpu;
+
+import java.io.ByteArrayOutputStream;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.net.ServerSocket;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.nio.file.Files;
+import java.nio.file.Paths;
+import java.util.ArrayList;
+import java.util.HashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.regex.Matcher;
+import java.util.regex.Pattern;
+
+/**
+ * Wire-format conformance for the Java bridge against the repo's golden
+ * TLV frames ({@code tests/fixtures/tlv/fixtures.json}) — the same bytes
+ * the Python codec and the C shim are pinned to in
+ * {@code tests/test_tlv_fixtures.py}. Run it the day a JVM is available
+ * (see {@code native/java/BUILD.md}, "Wire-format conformance"):
+ *
+ * <pre>
+ *   java -cp out:jna-5.x.jar:sentinel-core-1.8.x.jar \
+ *        -Djna.library.path=native \
+ *        com.alibaba.csp.sentinel.tpu.TlvGoldenFramesConformance \
+ *        tests/fixtures/tlv/fixtures.json
+ * </pre>
+ *
+ * <p>No JUnit / JSON-library dependency on purpose: the fixture file is
+ * repo-controlled, so a two-field regex extraction is sufficient and
+ * keeps this runnable with nothing but the bridge's own classpath.
+ * Exit code 0 = every frame matched byte-for-byte and every scripted
+ * status surfaced through {@code requestToken}.
+ *
+ * <p>PROVENANCE: written without a JVM in the build sandbox — never
+ * compiled here; validate signatures against the fork before use.
+ */
+public final class TlvGoldenFramesConformance {
+
+    public static void main(String[] args) throws Exception {
+        String path = args.length > 0 ? args[0]
+                : "tests/fixtures/tlv/fixtures.json";
+        Map<String, byte[]> fx = loadFixtures(path);
+
+        CaptureServer server = new CaptureServer(new byte[][] {
+                fx.get("ping_response_ok"),
+                fx.get("flow_response_should_wait_350ms"),
+                withXid(fx.get("param_response_blocked"), 3),
+        });
+
+        // The bridge reads its server from ClusterClientConfigManager
+        // (the dashboard's cluster-assign flow); point it at the capture
+        // server. Signature per documented 1.8 SPI — re-verify on first
+        // compile, like the rest of the bridge.
+        com.alibaba.csp.sentinel.cluster.client.config.ClusterClientConfigManager
+                .applyNewAssignConfig(
+                        new com.alibaba.csp.sentinel.cluster.client.config
+                                .ClusterClientAssignConfig(
+                                        "127.0.0.1", server.port()));
+        TpuClusterTokenClient client = new TpuClusterTokenClient();
+        client.start();
+        com.alibaba.csp.sentinel.cluster.TokenResult r1 =
+                client.requestToken(4242L, 1, false);
+        expect(r1.getStatus() == 2 /* SHOULD_WAIT */,
+                "flow status SHOULD_WAIT, got " + r1.getStatus());
+        expect(r1.getWaitInMs() == 350,
+                "waitInMs 350, got " + r1.getWaitInMs());
+        Object[] params = new Object[] {7L, "user-1", Boolean.TRUE, 2.5d};
+        com.alibaba.csp.sentinel.cluster.TokenResult r2 =
+                client.requestParamToken(7100L, 1,
+                        java.util.Arrays.asList(params));
+        expect(r2.getStatus() == 1 /* BLOCKED */,
+                "param status BLOCKED, got " + r2.getStatus());
+        client.stop();
+        server.join();
+
+        // Frames the bridge actually emitted must BE the golden ones.
+        List<byte[]> got = server.frames();
+        expect(got.size() == 3, "expected 3 frames, got " + got.size());
+        expectBytes(got.get(0), body(fx.get("ping_request_default")),
+                "PING-on-connect frame");
+        expectBytes(got.get(1), body(fx.get("flow_request_basic")),
+                "FLOW acquire frame");
+        byte[] goldenParam = body(fx.get("param_request_every_type"));
+        goldenParam[3] = 3; // xid 2 -> 3: third request on the connection
+        expectBytes(got.get(2), goldenParam, "PARAM_FLOW acquire frame");
+
+        System.out.println("TLV conformance OK: 3 frames byte-identical, "
+                + "2 scripted statuses surfaced");
+    }
+
+    // -- fixture plumbing ---------------------------------------------------
+
+    private static Map<String, byte[]> loadFixtures(String path)
+            throws Exception {
+        String json = new String(Files.readAllBytes(Paths.get(path)),
+                StandardCharsets.UTF_8);
+        Map<String, byte[]> out = new HashMap<>();
+        Pattern p = Pattern.compile(
+                "\"name\":\\s*\"([^\"]+)\"[^}]*?\"hex\":\\s*\"([0-9a-f]+)\"",
+                Pattern.DOTALL);
+        Matcher m = p.matcher(json);
+        while (m.find()) {
+            out.put(m.group(1), unhex(m.group(2)));
+        }
+        if (out.isEmpty()) {
+            throw new IllegalStateException("no fixtures parsed from " + path);
+        }
+        return out;
+    }
+
+    private static byte[] unhex(String hex) {
+        byte[] out = new byte[hex.length() / 2];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = (byte) Integer.parseInt(
+                    hex.substring(2 * i, 2 * i + 2), 16);
+        }
+        return out;
+    }
+
+    /** Strip the u16 length prefix: compare bodies like the Python test. */
+    private static byte[] body(byte[] frame) {
+        byte[] out = new byte[frame.length - 2];
+        System.arraycopy(frame, 2, out, 0, out.length);
+        return out;
+    }
+
+    /** Patch the xid's low byte inside a full frame (offset 2+3). */
+    private static byte[] withXid(byte[] frame, int xid) {
+        byte[] out = frame.clone();
+        out[5] = (byte) xid;
+        return out;
+    }
+
+    private static void expect(boolean ok, String what) {
+        if (!ok) {
+            throw new AssertionError("conformance failure: " + what);
+        }
+    }
+
+    private static void expectBytes(byte[] got, byte[] want, String what) {
+        if (!java.util.Arrays.equals(got, want)) {
+            throw new AssertionError("conformance failure: " + what
+                    + "\n  got  " + hex(got) + "\n  want " + hex(want));
+        }
+    }
+
+    private static String hex(byte[] b) {
+        StringBuilder sb = new StringBuilder();
+        for (byte x : b) {
+            sb.append(String.format("%02x", x));
+        }
+        return sb.toString();
+    }
+
+    /**
+     * Raw TCP capture server: records each length-framed request body the
+     * bridge sends and replies with the scripted golden frame — the Java
+     * twin of {@code tests/test_tlv_fixtures.py}'s {@code _CaptureServer}.
+     */
+    private static final class CaptureServer {
+        private final ServerSocket listener;
+        private final byte[][] script;
+        private final List<byte[]> frames = new ArrayList<>();
+        private final Thread thread;
+
+        CaptureServer(byte[][] script) throws Exception {
+            this.script = script;
+            this.listener = new ServerSocket(0);
+            this.thread = new Thread(this::run, "tlv-capture");
+            this.thread.setDaemon(true);
+            this.thread.start();
+        }
+
+        int port() {
+            return listener.getLocalPort();
+        }
+
+        List<byte[]> frames() {
+            return frames;
+        }
+
+        void join() throws InterruptedException {
+            thread.join(5000);
+        }
+
+        private void run() {
+            try (Socket conn = listener.accept()) {
+                InputStream in = conn.getInputStream();
+                OutputStream os = conn.getOutputStream();
+                ByteArrayOutputStream buf = new ByteArrayOutputStream();
+                int served = 0;
+                byte[] chunk = new byte[4096];
+                while (served < script.length) {
+                    int n = in.read(chunk);
+                    if (n < 0) {
+                        return;
+                    }
+                    buf.write(chunk, 0, n);
+                    byte[] all = buf.toByteArray();
+                    int off = 0;
+                    while (all.length - off >= 2 && served < script.length) {
+                        int len = ((all[off] & 0xff) << 8)
+                                | (all[off + 1] & 0xff);
+                        if (all.length - off - 2 < len) {
+                            break;
+                        }
+                        byte[] body = new byte[len];
+                        System.arraycopy(all, off + 2, body, 0, len);
+                        frames.add(body);
+                        os.write(script[served++]);
+                        os.flush();
+                        off += 2 + len;
+                    }
+                    buf.reset();
+                    buf.write(all, off, all.length - off);
+                }
+            } catch (Exception ex) {
+                throw new RuntimeException(ex);
+            } finally {
+                try {
+                    listener.close();
+                } catch (Exception ignored) {
+                }
+            }
+        }
+    }
+}

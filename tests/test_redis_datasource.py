@@ -180,3 +180,53 @@ def test_large_payload_reassembled(server, engine):
         assert src.read_source().decode() == big
     finally:
         src.close()
+
+
+def test_dashboard_v2_publishes_through_redis(server, engine):
+    """The full reference V2 loop with a REAL protocol in the middle:
+    dashboard publisher -> Redis (SET+PUBLISH over a socket) -> engine's
+    own subscribed datasource -> enforcement (the Nacos-publisher demo
+    shape, SURVEY §2.6, with our concrete connector)."""
+    import urllib.request
+
+    from sentinel_tpu.dashboard.server import DashboardServer
+
+    key, chan = "sentinel:rules:appR:flow", "sentinel:rules:appR:flow:chan"
+    src = RedisDataSource("127.0.0.1", server.port, key, chan,
+                          flow_rules_from_json).start()
+    d = DashboardServer(port=0).start(fetch=False)
+    try:
+        bind(src, st.load_flow_rules)
+        writer = RedisWritableDataSource("127.0.0.1", server.port, key,
+                                         chan, flow_rules_to_json)
+        reader = RedisDataSource("127.0.0.1", server.port, key, chan,
+                                 flow_rules_from_json)  # provider, no start
+        d.register_rule_source(
+            "appR", "flow",
+            provider=lambda: json.loads(
+                (reader.read_source() or b"[]").decode()),
+            publisher=lambda rules: writer.write(
+                flow_rules_from_json(rules)))
+
+        body = json.dumps([{"resource": "viaDash", "count": 1.0}])
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{d.bound_port}/v2/rules?app=appR&type=flow",
+            data=body.encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["success"]
+
+        assert _wait_for(lambda: [r.resource for r in
+                                  engine.flow_rules.get_rules()]
+                         == ["viaDash"])
+        assert st.entry_ok("viaDash")      # enforced
+        assert not st.entry_ok("viaDash")  # count=1 spent
+        # the dashboard's provider reads back what it published
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.bound_port}/v2/rules?app=appR&type=flow",
+                timeout=5) as r:
+            shown = json.loads(r.read())["data"]
+        assert shown[0]["resource"] == "viaDash"
+    finally:
+        d.stop()
+        src.close()
+
