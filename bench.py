@@ -149,9 +149,7 @@ def bench_p99_latency() -> dict:
        verdicts (cluster mode, breakers, hot params).
     """
     import sentinel_tpu as st
-    from sentinel_tpu.core.batch import (
-        EntryBatch, ExitBatch, make_entry_batch_np, make_exit_batch_np,
-    )
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
 
     eng = st.get_engine()
     st.load_flow_rules([st.FlowRule(resource=f"lat{i}", count=1e9)
@@ -195,14 +193,7 @@ def bench_p99_latency() -> dict:
     # Pre-compile the ladder widths 8 concurrent submitters actually hit,
     # for entry AND exit, so the timed section never absorbs an XLA compile
     # (20-40s each on first touch).
-    for width in (1, 8, 64):
-        ebuf = make_entry_batch_np(width)
-        ebuf["cluster_row"][: len(rows)] = rows[: min(width, len(rows))]
-        ebuf["count"][:] = 1
-        eng._run_entry_batch(EntryBatch(**ebuf))
-        xbuf = make_exit_batch_np(width)
-        xbuf["cluster_row"][: len(rows)] = rows[: min(width, len(rows))]
-        eng._run_exit_batch(ExitBatch(**xbuf))
+    eng.warmup((1, 8, 64))
 
     eng.start_pipeline(linger_s=0.0002)
     n_threads, per_thread = 8, 150

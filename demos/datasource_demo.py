@@ -1,0 +1,72 @@
+"""Dynamic rules from external config systems (reference:
+``sentinel-demo-dynamic-file-rule`` + ``sentinel-demo-nacos-datasource``):
+the engine's limits follow a Redis key (RESP over a real socket, pub/sub
+push) and an HTTP config endpoint (conditional-GET polling) — both
+against in-repo mini servers, so this runs self-contained; point the
+sources at real Redis / config URLs and nothing else changes."""
+
+import _demo_env  # noqa: F401  (pins JAX platform; import first)
+
+import json
+import time
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import (
+    HttpRefreshableDataSource,
+    MiniConfigHTTPServer,
+    MiniRedisServer,
+    RedisDataSource,
+    RedisWritableDataSource,
+    bind,
+    flow_rules_from_json,
+    flow_rules_to_json,
+)
+
+
+def burst(resource: str, n: int = 30) -> str:
+    passed = sum(1 for _ in range(n) if st.entry_ok(resource))
+    return f"{passed}/{n} passed"
+
+
+# -- Redis-backed rules (push) -------------------------------------------
+redis = MiniRedisServer().start()
+src = RedisDataSource("127.0.0.1", redis.port, "rules/flow", "rules/chan",
+                      flow_rules_from_json).start()
+bind(src, st.load_flow_rules)
+writer = RedisWritableDataSource("127.0.0.1", redis.port, "rules/flow",
+                                 "rules/chan", flow_rules_to_json)
+
+writer.write([st.FlowRule(resource="api", count=5)])
+time.sleep(0.3)  # pub/sub delivery
+# Production boot order: rules loaded, then warmup() precompiles the
+# device step for every batch width — without it, the first burst's
+# stats flush pays an XLA compile while holding the engine lock, and a
+# rule push landing in that window stalls behind the compiler.
+st.get_engine().warmup((1, 8, 64))  # only the widths the bursts hit
+time.sleep(1.05 - time.time() % 1)  # fresh window under the rule
+print("[redis] rule count=5 pushed  ->", burst("api"))
+
+writer.write([st.FlowRule(resource="api", count=20)])
+time.sleep(0.3)
+time.sleep(1.05 - time.time() % 1)
+print("[redis] rule count=20 pushed ->", burst("api"))
+src.close()
+redis.stop()
+
+# -- HTTP-polled rules (conditional GET) ---------------------------------
+http = MiniConfigHTTPServer().start()
+http.set_document(json.dumps([{"resource": "web", "count": 3.0}]))
+poll = HttpRefreshableDataSource(http.url, flow_rules_from_json,
+                                 recommend_refresh_ms=100000)
+bind(poll, st.load_flow_rules)
+poll.first_load()
+print("[http ] doc count=3 loaded   ->", burst("web"))
+poll.refresh()  # unchanged document: a cheap 304
+http.set_document(json.dumps([{"resource": "web", "count": 10.0}]))
+poll.refresh()
+time.sleep(1.05 - time.time() % 1)
+print("[http ] doc count=10 polled  ->", burst("web"),
+      f"(304s on unchanged polls: {http.not_modified_count})")
+poll.close()
+http.stop()
+print("datasource demo done")

@@ -462,6 +462,24 @@ class SentinelEngine:
         ):
             self.system_status.start()
 
+    def warmup(self, widths: Optional[Sequence[int]] = None) -> None:
+        """Precompile the fused entry/exit steps for every micro-batch
+        ladder width under the CURRENT rule shapes.
+
+        XLA specializes per (batch width, rule-tensor shape); the first
+        dispatch of each pair pays a compile (seconds on CPU, 20-40s on
+        TPU) while holding the engine lock — so first traffic, AND any
+        rule push racing it, stalls behind the compiler. Production boot
+        sequence: load initial rules, then ``warmup()``, then serve.
+        No-op batches (all rows -1) commit nothing."""
+        for width in (widths if widths is not None else BATCH_WIDTHS):
+            ebuf = make_entry_batch_np(int(width))  # all rows -1: no-op
+            self._run_entry_batch(
+                EntryBatch(**{k: jnp.asarray(v) for k, v in ebuf.items()}))
+            xbuf = make_exit_batch_np(int(width))
+            self._run_exit_batch(
+                ExitBatch(**{k: jnp.asarray(v) for k, v in xbuf.items()}))
+
     def set_window_geometry(self, interval_ms: Optional[int] = None,
                             sample_count: Optional[int] = None) -> None:
         """Retune the instant window at runtime (reference:

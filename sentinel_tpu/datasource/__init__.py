@@ -24,6 +24,10 @@ from sentinel_tpu.datasource.push import (
     PollingKVDataSource,
     PushDataSource,
 )
+from sentinel_tpu.datasource.http import (
+    HttpRefreshableDataSource,
+    MiniConfigHTTPServer,
+)
 from sentinel_tpu.datasource.redis import (
     MiniRedisServer,
     RedisDataSource,
@@ -47,6 +51,7 @@ __all__ = [
     "BrokerDataSource", "BrokerWritableDataSource", "InProcessBroker",
     "PollingKVDataSource", "PushDataSource",
     "FileRefreshableDataSource", "FileWritableDataSource",
+    "HttpRefreshableDataSource", "MiniConfigHTTPServer",
     "MiniRedisServer", "RedisDataSource", "RedisWritableDataSource",
     "ReadableDataSource", "WritableDataSource", "bind",
     "authority_rules_from_json", "authority_rules_to_json",

@@ -28,6 +28,7 @@ SELF_TERMINATING = [
     "annotation_demo.py",
     "cluster_demo.py",
     "lease_demo.py",
+    "datasource_demo.py",
 ]
 
 

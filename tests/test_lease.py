@@ -329,3 +329,25 @@ def test_retune_drops_pre_retune_queued_usage_from_mirror(engine,
 
     assert engine._leases["retq"].usage(
         time_util.current_time_millis()) == pytest.approx(0.0)
+
+
+def test_warmup_precompiles_ladder_widths(engine, frozen_time):
+    """engine.warmup() pays every (width, rule-shape) compile up front and
+    commits nothing; a rule push right after is not blocked behind XLA
+    (the datasource-demo stall: the committer's first wide flush compiled
+    under the engine lock while a push waited)."""
+    import time as _time
+
+    st.load_flow_rules([st.FlowRule(resource="wu", count=5)])
+    engine.warmup((1, 8, 64))
+    # no-op batches committed nothing (the row exists from rule compile)
+    snap = engine.node_snapshot().get("wu", {})
+    assert snap.get("passQps", 0) == 0 and snap.get("blockQps", 0) == 0
+
+    for _ in range(30):                       # a wide burst queues commits
+        st.entry_ok("wu")
+    t0 = _time.perf_counter()
+    st.load_flow_rules([st.FlowRule(resource="wu", count=20)])
+    push_s = _time.perf_counter() - t0
+    assert engine._leases["wu"].thresholds == [20.0]
+    assert push_s < 2.0, f"rule push stalled {push_s:.1f}s behind a compile"
