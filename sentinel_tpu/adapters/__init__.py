@@ -27,7 +27,13 @@ from sentinel_tpu.adapters.gateway import (
     GatewayParamFlowItem,
     GatewayRuleManager,
     GatewayRequest,
+    api_definitions_from_json,
+    api_definitions_to_json,
     gateway_entry,
+    gateway_rules_from_json,
+    gateway_rules_to_json,
+    get_api_manager,
+    get_gateway_rule_manager,
 )
 from sentinel_tpu.adapters.http_client import SentinelHttpClient, guarded
 from sentinel_tpu.adapters.streams import guard_aiter, sentinel_stream
@@ -37,6 +43,8 @@ __all__ = [
     "ApiDefinition", "ApiPredicateItem", "GatewayApiDefinitionManager",
     "GatewayFlowRule", "GatewayParamFlowItem", "GatewayRequest",
     "GatewayRuleManager", "SentinelASGIMiddleware", "SentinelHttpClient",
-    "SentinelWSGIMiddleware", "gateway_entry", "guard_aiter", "guarded",
-    "sentinel_resource", "sentinel_stream",
+    "SentinelWSGIMiddleware", "api_definitions_from_json",
+    "api_definitions_to_json", "gateway_entry", "gateway_rules_from_json",
+    "gateway_rules_to_json", "get_api_manager", "get_gateway_rule_manager",
+    "guard_aiter", "guarded", "sentinel_resource", "sentinel_stream",
 ]

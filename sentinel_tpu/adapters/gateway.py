@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import re
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -243,6 +244,25 @@ def get_gateway_rule_manager() -> GatewayRuleManager:
     return _default_rule_manager
 
 
+_engine_managers: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def managers_for(engine) -> Tuple[GatewayRuleManager,
+                                  GatewayApiDefinitionManager]:
+    """Gateway managers scoped to ``engine``: the module defaults when it
+    IS the current default engine (so ops-plane pushes and
+    ``gateway_entry``'s default managers share state), else a per-engine
+    memoized pair — a command center bound to a non-default engine must
+    not silently load rules into the default one."""
+    if engine is st.get_engine():
+        return get_gateway_rule_manager(), _default_api_manager
+    pair = _engine_managers.get(engine)
+    if pair is None:
+        pair = (GatewayRuleManager(engine), GatewayApiDefinitionManager())
+        _engine_managers[engine] = pair
+    return pair
+
+
 def gateway_entry(request: GatewayRequest,
                   rule_manager: Optional[GatewayRuleManager] = None,
                   api_manager: Optional[GatewayApiDefinitionManager] = None):
@@ -268,3 +288,91 @@ def gateway_entry(request: GatewayRequest,
             e.exit()
         raise
     return entries
+
+
+# -- JSON wire schema (reference fastjson camelCase field names, so
+# dashboard payloads written for the reference parse unchanged) ------------
+
+
+def gateway_rule_from_dict(d: dict) -> GatewayFlowRule:
+    item = d.get("paramItem")
+    return GatewayFlowRule(
+        resource=d.get("resource", ""),
+        count=float(d.get("count", 0)),
+        resource_mode=int(d.get("resourceMode", RESOURCE_MODE_ROUTE_ID)),
+        grade=int(d.get("grade", C.PARAM_FLOW_GRADE_QPS)),
+        interval_sec=int(d.get("intervalSec", 1)),
+        control_behavior=int(d.get("controlBehavior",
+                                   C.CONTROL_BEHAVIOR_DEFAULT)),
+        burst=int(d.get("burst", 0)),
+        max_queueing_timeout_ms=int(d.get("maxQueueingTimeoutMs", 500)),
+        param_item=None if item is None else GatewayParamFlowItem(
+            parse_strategy=int(item.get("parseStrategy",
+                                        PARAM_PARSE_STRATEGY_CLIENT_IP)),
+            field_name=item.get("fieldName"),
+            pattern=item.get("pattern"),
+            match_strategy=int(item.get("matchStrategy",
+                                        PARAM_MATCH_STRATEGY_EXACT)),
+        ),
+    )
+
+
+def gateway_rule_to_dict(r: GatewayFlowRule) -> dict:
+    out = {
+        "resource": r.resource, "resourceMode": r.resource_mode,
+        "grade": r.grade, "count": r.count, "intervalSec": r.interval_sec,
+        "controlBehavior": r.control_behavior, "burst": r.burst,
+        "maxQueueingTimeoutMs": r.max_queueing_timeout_ms,
+    }
+    if r.param_item is not None:
+        out["paramItem"] = {
+            "parseStrategy": r.param_item.parse_strategy,
+            "fieldName": r.param_item.field_name,
+            "pattern": r.param_item.pattern,
+            "matchStrategy": r.param_item.match_strategy,
+        }
+    return out
+
+
+def gateway_rules_from_json(source) -> List[GatewayFlowRule]:
+    import json as _json
+
+    data = _json.loads(source) if isinstance(source, str) else (source or [])
+    return [gateway_rule_from_dict(d) for d in data]
+
+
+def gateway_rules_to_json(rules: Sequence[GatewayFlowRule]) -> str:
+    import json as _json
+
+    return _json.dumps([gateway_rule_to_dict(r) for r in rules])
+
+
+def api_definitions_from_json(source) -> List[ApiDefinition]:
+    import json as _json
+
+    data = _json.loads(source) if isinstance(source, str) else (source or [])
+    return [
+        ApiDefinition(
+            api_name=d.get("apiName", ""),
+            predicate_items=[
+                ApiPredicateItem(
+                    pattern=p.get("pattern", ""),
+                    match_strategy=int(p.get("matchStrategy",
+                                             PARAM_MATCH_STRATEGY_EXACT)))
+                for p in (d.get("predicateItems") or [])
+            ])
+        for d in data
+    ]
+
+
+def api_definition_to_dict(a: ApiDefinition) -> dict:
+    return {"apiName": a.api_name,
+            "predicateItems": [{"pattern": p.pattern,
+                                "matchStrategy": p.match_strategy}
+                               for p in a.predicate_items]}
+
+
+def api_definitions_to_json(defs: Sequence[ApiDefinition]) -> str:
+    import json as _json
+
+    return _json.dumps([api_definition_to_dict(a) for a in defs])

@@ -67,6 +67,26 @@ class SentinelApiClient:
         if out != "success":
             raise ApiError(f"setRules rejected: {out}")
 
+    def fetch_gateway_rules(self, ip: str, port: int) -> List[Dict]:
+        return json.loads(self.get(ip, port, "gateway/getRules"))
+
+    def set_gateway_rules(self, ip: str, port: int,
+                          rules: List[Dict]) -> None:
+        out = self.post(ip, port, "gateway/updateRules", {},
+                        body=f"data={urllib.parse.quote(json.dumps(rules))}")
+        if out != "success":
+            raise ApiError(f"gateway/updateRules rejected: {out}")
+
+    def fetch_api_definitions(self, ip: str, port: int) -> List[Dict]:
+        return json.loads(self.get(ip, port, "gateway/getApiDefinitions"))
+
+    def set_api_definitions(self, ip: str, port: int,
+                            defs: List[Dict]) -> None:
+        out = self.post(ip, port, "gateway/updateApiDefinitions", {},
+                        body=f"data={urllib.parse.quote(json.dumps(defs))}")
+        if out != "success":
+            raise ApiError(f"gateway/updateApiDefinitions rejected: {out}")
+
     def fetch_metric(self, ip: str, port: int, start_ms: int, end_ms: int,
                      max_lines: int = 6000) -> str:
         return self.get(ip, port, "metric", {

@@ -15,6 +15,10 @@ set as a small JSON API plus one static page:
     registered config-source provider/publisher pair
     (``FlowControllerV2`` + ``DynamicRuleProvider``/``Publisher``;
     see :meth:`DashboardServer.register_rule_source`)
+  * ``GET/POST /gateway/rules?app=``          gateway flow rules, V1 style
+  * ``GET/POST /gateway/apis?app=``           custom API groups
+    (``GatewayFlowRuleController`` / ``GatewayApiController`` via the
+    machines' ``gateway/*`` commands)
   * ``GET  /metric/queryTopResourceMetric.json?app=``    live QPS series
   * ``GET  /metric/queryByAppAndResource.json?app=&identity=``
     (``MetricController`` over ``InMemoryMetricsRepository``)
@@ -180,6 +184,29 @@ class DashboardServer:
             raise ApiError(f"no healthy machine for app {app!r}")
         return out
 
+    def get_gateway(self, app: str, kind: str):
+        m = self._first_healthy(app)
+        if kind == "apis":
+            return self.api.fetch_api_definitions(m.ip, m.port)
+        return self.api.fetch_gateway_rules(m.ip, m.port)
+
+    def set_gateway(self, app: str, kind: str, payload) -> Dict[str, bool]:
+        """Wholesale push to every healthy machine (V1 semantics), for
+        gateway rules (kind='rules') or custom API groups (kind='apis')."""
+        out = {}
+        for m in self.apps.healthy_machines(app):
+            try:
+                if kind == "apis":
+                    self.api.set_api_definitions(m.ip, m.port, payload)
+                else:
+                    self.api.set_gateway_rules(m.ip, m.port, payload)
+                out[m.key] = True
+            except ApiError:
+                out[m.key] = False
+        if not out:
+            raise ApiError(f"no healthy machine for app {app!r}")
+        return out
+
     def assign_token_server(self, app: str, ip: str, port: int,
                             token_port: int = 0) -> Dict:
         """Reference ``ClusterConfigController`` assign flow: flip the chosen
@@ -338,6 +365,16 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/app/machines.json":
                 return self._ok([m.to_dict()
                                  for m in d.apps.machines(q.get("app", ""))])
+            if path in ("/gateway/rules", "/gateway/apis"):
+                # reference: GatewayFlowRuleController / GatewayApiController
+                app = q.get("app", "")
+                kind = "apis" if path.endswith("apis") else "rules"
+                if self.command == "GET":
+                    return self._ok(d.get_gateway(app, kind))
+                payload = json.loads(body or "[]")
+                if not isinstance(payload, list):
+                    return self._fail("expected a JSON list")
+                return self._ok(d.set_gateway(app, kind, payload))
             if path in ("/v1/rules", "/v2/rules"):
                 app, rtype = q.get("app", ""), q.get("type", "flow")
                 if rtype not in RULE_TYPES:

@@ -24,7 +24,6 @@ from sentinel_tpu.datasource.base import (
     AutoRefreshDataSource,
     Converter,
     T,
-    _log_warn,
 )
 
 
@@ -43,6 +42,7 @@ class HttpRefreshableDataSource(AutoRefreshDataSource[str, T]):
         self.headers = dict(headers or {})
         self._etag: Optional[str] = None
         self._last_modified: Optional[str] = None
+        self._pending: Optional[tuple] = None
         self._not_modified = False
 
     def read_source(self) -> Optional[str]:
@@ -55,12 +55,13 @@ class HttpRefreshableDataSource(AutoRefreshDataSource[str, T]):
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 body = resp.read().decode(
                     resp.headers.get_content_charset() or "utf-8")
-                # Commit the validators only AFTER the body arrived: doing
-                # it first would turn a mid-body failure into a poisoned
-                # cache (every later poll 304s against a document that was
-                # never actually applied).
-                self._etag = resp.headers.get("ETag")
-                self._last_modified = resp.headers.get("Last-Modified")
+                # Stage the validators; load_config commits them only
+                # after the CONVERTER succeeds too — recording them any
+                # earlier turns a mid-body or bad-document failure into a
+                # poisoned cache (every later poll 304s against a document
+                # that was never actually applied).
+                self._pending = (resp.headers.get("ETag"),
+                                 resp.headers.get("Last-Modified"))
                 self._not_modified = False
                 return body
         except urllib.error.HTTPError as ex:
@@ -73,7 +74,11 @@ class HttpRefreshableDataSource(AutoRefreshDataSource[str, T]):
         raw = self.read_source()
         if raw is None and self._not_modified:
             return None
-        return self.converter(raw)
+        value = self.converter(raw)
+        if self._pending is not None:
+            self._etag, self._last_modified = self._pending
+            self._pending = None
+        return value
 
 
 class _ConfigHandler(BaseHTTPRequestHandler):

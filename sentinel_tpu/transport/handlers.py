@@ -369,3 +369,54 @@ def cmd_api(req: CommandRequest) -> CommandResponse:
         {"url": f"/{name}", "desc": desc}
         for name, desc in sorted(registered_commands().items())
     ])
+
+# -- gateway rules / API groups (reference: the sentinel-api-gateway
+# command handlers — gateway/getRules, gateway/updateRules,
+# gateway/getApiDefinitions, gateway/updateApiDefinitions) -----------------
+
+
+@command_mapping("gateway/getRules", "active gateway flow rules")
+def cmd_gateway_get_rules(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.adapters import gateway as GW
+
+    rules_mgr, _ = GW.managers_for(req.engine)
+    return CommandResponse.of_success(
+        [GW.gateway_rule_to_dict(r) for r in rules_mgr.get_rules()])
+
+
+@command_mapping("gateway/updateRules", "load gateway flow rules wholesale")
+def cmd_gateway_update_rules(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.adapters import gateway as GW
+
+    data = req.get_param("data") or req.body
+    try:
+        rules = GW.gateway_rules_from_json(data or "[]")
+    except (ValueError, KeyError, TypeError, AttributeError) as ex:
+        return CommandResponse.of_failure(f"parse error: {ex}")
+    rules_mgr, _ = GW.managers_for(req.engine)
+    rules_mgr.load_rules(rules)
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("gateway/getApiDefinitions", "custom API groups")
+def cmd_gateway_get_apis(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.adapters import gateway as GW
+
+    _, api_mgr = GW.managers_for(req.engine)
+    return CommandResponse.of_success(
+        [GW.api_definition_to_dict(a)
+         for a in api_mgr.get_api_definitions()])
+
+
+@command_mapping("gateway/updateApiDefinitions", "load custom API groups")
+def cmd_gateway_update_apis(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.adapters import gateway as GW
+
+    data = req.get_param("data") or req.body
+    try:
+        defs = GW.api_definitions_from_json(data or "[]")
+    except (ValueError, KeyError, TypeError, AttributeError) as ex:
+        return CommandResponse.of_failure(f"parse error: {ex}")
+    _, api_mgr = GW.managers_for(req.engine)
+    api_mgr.load_api_definitions(defs)
+    return CommandResponse.of_success("success")

@@ -392,3 +392,39 @@ def test_metric_history_series_shape(dash, engine, frozen_time, tmp_path,
                               "successQps", "exceptionQps", "rt"}
     finally:
         center.stop()
+
+
+def test_gateway_rules_through_dashboard(dash, engine):
+    """Gateway CRUD loop (reference: GatewayFlowRuleController /
+    GatewayApiController): dashboard -> machine gateway commands ->
+    adapter managers, and back."""
+    from sentinel_tpu.adapters.gateway import (
+        get_api_manager,
+        get_gateway_rule_manager,
+    )
+
+    center = CommandCenter(engine, port=0).start()
+    try:
+        HeartbeatSender(dashboards=[f"127.0.0.1:{dash.bound_port}"],
+                        api_port=center.bound_port).send_once()
+        app = _get(dash, "/app/names.json")[0]
+
+        rules = [{"resource": "route-x", "count": 9, "intervalSec": 1,
+                  "paramItem": {"parseStrategy": 0}}]
+        pushed = _post(dash, f"/gateway/rules?app={app}", json.dumps(rules))
+        assert all(pushed.values())
+        assert get_gateway_rule_manager().get_rules()[0].resource == "route-x"
+        got = _get(dash, f"/gateway/rules?app={app}")
+        assert got[0]["resource"] == "route-x" and got[0]["count"] == 9
+        assert got[0]["paramItem"]["parseStrategy"] == 0
+
+        apis = [{"apiName": "orders",
+                 "predicateItems": [{"pattern": "/orders",
+                                     "matchStrategy": 1}]}]
+        pushed = _post(dash, f"/gateway/apis?app={app}", json.dumps(apis))
+        assert all(pushed.values())
+        assert _get(dash, f"/gateway/apis?app={app}") == apis
+    finally:
+        center.stop()
+        get_gateway_rule_manager().load_rules([])
+        get_api_manager().load_api_definitions([])
