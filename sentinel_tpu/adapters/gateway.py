@@ -13,6 +13,7 @@ item — exactly the reference's conversion trick.
 
 from __future__ import annotations
 
+import json
 import re
 import threading
 import weakref
@@ -138,8 +139,9 @@ class GatewayRuleManager:
     batch's MAX_PARAMS); the parser emits the matching argument vector.
     """
 
-    def __init__(self, engine=None):
+    def __init__(self, engine=None, _weak_engine=None):
         self._engine = engine
+        self._engine_ref = _weak_engine  # weakref.ref (managers_for)
         self._lock = threading.Lock()
         self._rules: List[GatewayFlowRule] = []
         # resource -> [(gateway_rule, param_idx)]
@@ -147,7 +149,13 @@ class GatewayRuleManager:
 
     @property
     def engine(self):
-        return self._engine if self._engine is not None else st.get_engine()
+        if self._engine is not None:
+            return self._engine
+        if self._engine_ref is not None:
+            eng = self._engine_ref()
+            if eng is not None:
+                return eng
+        return st.get_engine()
 
     def load_rules(self, rules: Sequence[GatewayFlowRule]) -> None:
         by_resource: Dict[str, List[Tuple[GatewayFlowRule, int]]] = {}
@@ -245,6 +253,7 @@ def get_gateway_rule_manager() -> GatewayRuleManager:
 
 
 _engine_managers: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_engine_managers_lock = threading.Lock()
 
 
 def managers_for(engine) -> Tuple[GatewayRuleManager,
@@ -253,13 +262,21 @@ def managers_for(engine) -> Tuple[GatewayRuleManager,
     IS the current default engine (so ops-plane pushes and
     ``gateway_entry``'s default managers share state), else a per-engine
     memoized pair — a command center bound to a non-default engine must
-    not silently load rules into the default one."""
+    not silently load rules into the default one.
+
+    The pair holds only a WEAK reference to the engine (a strong one in
+    the value would pin the WeakKeyDictionary key forever, leaking every
+    short-lived engine), and the check-then-insert is locked so two
+    racing first-touch commands can't split enforcement and reporting
+    across different manager pairs."""
     if engine is st.get_engine():
         return get_gateway_rule_manager(), _default_api_manager
-    pair = _engine_managers.get(engine)
-    if pair is None:
-        pair = (GatewayRuleManager(engine), GatewayApiDefinitionManager())
-        _engine_managers[engine] = pair
+    with _engine_managers_lock:
+        pair = _engine_managers.get(engine)
+        if pair is None:
+            pair = (GatewayRuleManager(_weak_engine=weakref.ref(engine)),
+                    GatewayApiDefinitionManager())
+            _engine_managers[engine] = pair
     return pair
 
 
@@ -335,22 +352,16 @@ def gateway_rule_to_dict(r: GatewayFlowRule) -> dict:
 
 
 def gateway_rules_from_json(source) -> List[GatewayFlowRule]:
-    import json as _json
-
-    data = _json.loads(source) if isinstance(source, str) else (source or [])
+    data = json.loads(source) if isinstance(source, str) else (source or [])
     return [gateway_rule_from_dict(d) for d in data]
 
 
 def gateway_rules_to_json(rules: Sequence[GatewayFlowRule]) -> str:
-    import json as _json
-
-    return _json.dumps([gateway_rule_to_dict(r) for r in rules])
+    return json.dumps([gateway_rule_to_dict(r) for r in rules])
 
 
 def api_definitions_from_json(source) -> List[ApiDefinition]:
-    import json as _json
-
-    data = _json.loads(source) if isinstance(source, str) else (source or [])
+    data = json.loads(source) if isinstance(source, str) else (source or [])
     return [
         ApiDefinition(
             api_name=d.get("apiName", ""),
@@ -373,6 +384,4 @@ def api_definition_to_dict(a: ApiDefinition) -> dict:
 
 
 def api_definitions_to_json(defs: Sequence[ApiDefinition]) -> str:
-    import json as _json
-
-    return _json.dumps([api_definition_to_dict(a) for a in defs])
+    return json.dumps([api_definition_to_dict(a) for a in defs])

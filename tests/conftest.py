@@ -70,6 +70,7 @@ QUICK = (
     "test_cluster.py::test_codec_flow_round_trip",
     "test_transport.py::test_get_set_rules_round_trip",
     "test_dashboard.py::test_discovery_from_heartbeats",
+    "test_transport.py::test_gateway_rules_and_api_definitions_commands",
     "test_tlv_fixtures.py",     # whole file: 2.5s
     "test_redis_datasource.py",  # whole file: 2.5s
 )

@@ -479,3 +479,26 @@ def test_gateway_commands_scope_to_their_engine(center, engine):
     finally:
         c2.stop()
         other.close()
+
+
+def test_gateway_manager_pair_released_with_engine(center, engine):
+    """The per-engine manager memo must not pin dead engines (round-4
+    review: a strong engine ref in the WeakKeyDictionary VALUE defeated
+    the weak key)."""
+    import gc
+    import weakref as _wr
+
+    from sentinel_tpu.adapters.gateway import _engine_managers
+
+    other = st.SentinelEngine(capacity=256)
+    c2 = CommandCenter(other, port=0).start()
+    try:
+        _get(c2, "gateway/getRules")  # first touch memoizes the pair
+        assert any(k is other for k in _engine_managers.keys())
+    finally:
+        c2.stop()
+        other.close()
+    ref = _wr.ref(other)
+    del other, c2          # the center itself holds the engine strongly
+    gc.collect()
+    assert ref() is None, "engine leaked via the gateway manager memo"
