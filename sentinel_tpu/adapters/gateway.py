@@ -245,10 +245,17 @@ def get_api_manager() -> GatewayApiDefinitionManager:
     return _default_api_manager
 
 
+_default_rule_manager_lock = threading.Lock()
+
+
 def get_gateway_rule_manager() -> GatewayRuleManager:
     global _default_rule_manager
     if _default_rule_manager is None:
-        _default_rule_manager = GatewayRuleManager()
+        # locked: two racing first touches must not split enforcement
+        # and reporting across two manager instances
+        with _default_rule_manager_lock:
+            if _default_rule_manager is None:
+                _default_rule_manager = GatewayRuleManager()
     return _default_rule_manager
 
 

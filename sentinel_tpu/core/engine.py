@@ -152,6 +152,22 @@ class SentinelEngine:
         self.window_geometry_property.add_listener(SimplePropertyListener(
             lambda v: self.set_window_geometry(
                 v.get("intervalMs"), v.get("sampleCount"))))
+        # Prioritized-borrow wait cap (reference: OccupyTimeoutProperty —
+        # core:node/). Config-seeded, runtime-tunable; push form:
+        #   engine.occupy_timeout_property.update_value(250)
+        seed_occupy = _cfg.get_int(
+            "csp.sentinel.occupy.timeout.ms", C.DEFAULT_OCCUPY_TIMEOUT_MS)
+        if not 0 <= seed_occupy <= interval:
+            from sentinel_tpu.log.record_log import record_log
+
+            record_log.warn(
+                "invalid csp.sentinel.occupy.timeout.ms %s (window %sms); "
+                "using default", seed_occupy, interval)
+            seed_occupy = min(C.DEFAULT_OCCUPY_TIMEOUT_MS, interval)
+        self._occupy_timeout_ms = seed_occupy
+        self.occupy_timeout_property = DynamicSentinelProperty()
+        self.occupy_timeout_property.add_listener(SimplePropertyListener(
+            lambda v: self.set_occupy_timeout(int(v))))
         # Global kill switch (reference: Constants.ON via the setSwitch /
         # getSwitch command handlers). Off => every entry passes unguarded.
         self.enabled = True
@@ -357,8 +373,8 @@ class SentinelEngine:
         # stale checker set forever).
         self._spi_version = self._spi.device_version()
         checkers = self._spi.device_checkers()
-        step = functools.partial(S.entry_step, extra_checkers=checkers,
-                                 spec1=self._spec1)
+        step = functools.partial(
+            S.entry_step, extra_checkers=checkers, spec1=self._spec1)
         self._entry_jit = jax.jit(step, donate_argnums=(0,))
 
     # -- rule compilation --------------------------------------------------
@@ -480,6 +496,19 @@ class SentinelEngine:
             self._run_exit_batch(
                 ExitBatch(**{k: jnp.asarray(v) for k, v in xbuf.items()}))
 
+    def set_occupy_timeout(self, timeout_ms: int) -> None:
+        """Retune the prioritized-borrow wait cap at runtime (reference:
+        ``OccupyTimeoutProperty``). Capped at one instant window — a
+        borrow can never wait past the window it borrows from. A TRACED
+        step argument, so tuning is free (no recompile)."""
+        timeout_ms = int(timeout_ms)
+        with self._lock:
+            if timeout_ms < 0 or timeout_ms > self._spec1.interval_ms:
+                raise ValueError(
+                    f"occupy timeout {timeout_ms}ms must be within "
+                    f"[0, {self._spec1.interval_ms}] (one instant window)")
+            self._occupy_timeout_ms = timeout_ms
+
     def set_window_geometry(self, interval_ms: Optional[int] = None,
                             sample_count: Optional[int] = None) -> None:
         """Retune the instant window at runtime (reference:
@@ -512,6 +541,16 @@ class SentinelEngine:
             if new == cur:
                 return
             self._spec1 = new
+            # The borrow-wait cap must stay within one instant window; a
+            # shrink below the active cap clamps it (loudly), or borrows
+            # would credit buckets that expire before their wait elapses.
+            if self._occupy_timeout_ms > new.interval_ms:
+                from sentinel_tpu.log.record_log import record_log
+
+                record_log.warn(
+                    "occupy timeout %sms clamped to new %sms window",
+                    self._occupy_timeout_ms, new.interval_ms)
+                self._occupy_timeout_ms = new.interval_ms
             self._rebuild_w1_jits()
             self._rebuild_entry_jit()  # closes over the new spec
             # Reset the device window BEFORE rebuilding leases: the fresh
@@ -832,7 +871,8 @@ class SentinelEngine:
         self._refresh_signals(now)
         self._state, dec = timed_call(
             self.step_timer, "entry", batch.size, self._entry_jit,
-            self._state, self._rules, batch, now)
+            self._state, self._rules, batch, now,
+            occupy_timeout_ms=self._occupy_timeout_ms)
         return dec
 
     def _run_entry_batch(self, batch: EntryBatch) -> Decisions:
@@ -933,7 +973,9 @@ class SentinelEngine:
             self._ensure_compiled()
             now = now_ms if now_ms is not None else time_util.current_time_millis()
             self._refresh_signals(now)
-            self._state, dec = self._entry_jit(self._state, self._rules, batch, now)
+            self._state, dec = self._entry_jit(
+                self._state, self._rules, batch, now,
+                occupy_timeout_ms=self._occupy_timeout_ms)
             return dec
 
     def complete_batch(self, batch: ExitBatch, now_ms: Optional[int] = None) -> None:

@@ -109,13 +109,13 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
-def timed_call(timer: StepTimer, kind: str, batch_n: int, fn, *args):
-    """Run ``fn(*args)`` (a jitted dispatch returning a pytree), recording
-    enqueue wall always and blocking for a true step wall on sampled
-    dispatches."""
+def timed_call(timer: StepTimer, kind: str, batch_n: int, fn, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)`` (a jitted dispatch returning a pytree),
+    recording enqueue wall always and blocking for a true step wall on
+    sampled dispatches."""
     do_sync = timer.should_sync(kind)
     t0 = time.perf_counter()
-    out = fn(*args)
+    out = fn(*args, **kwargs)
     enqueue_ms = (time.perf_counter() - t0) * 1e3
     sync_ms = None
     if do_sync:

@@ -330,6 +330,7 @@ def check_flow(
     extra_pass_global: Optional[jax.Array] = None,  # int32[R] cross-POD passes
     extra_next_global: Optional[jax.Array] = None,  # int32[R] cross-POD next use
     spec: Optional[W.WindowSpec] = None,  # w1 geometry (engine may retune)
+    occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
 ) -> FlowVerdict:
     """Vectorized ``FlowRuleChecker.checkFlow`` over the micro-batch.
 
@@ -361,14 +362,14 @@ def check_flow(
         rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
         extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
-        spec=spec,
+        spec=spec, occupy_timeout_ms=occupy_timeout_ms,
     )
     blocked, wait_us, consumed, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
         survivors=candidate & (~blocked1), extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
         extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
-        spec=spec,
+        spec=spec, occupy_timeout_ms=occupy_timeout_ms,
     )
 
     # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
@@ -396,6 +397,7 @@ def _eval_flow_slots(
     extra_pass_global: Optional[jax.Array] = None,
     extra_next_global: Optional[jax.Array] = None,
     spec: Optional[W.WindowSpec] = None,
+    occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
 ):
     """One vectorized sweep over all rule slots.
 
@@ -592,7 +594,7 @@ def _eval_flow_slots(
                 next_used = next_used + jnp.where(
                     g(rt.cluster_mode, False), en, 0.0)
             grant = occ_cand & (next_used * qps_scale + acq <= thr) & (
-                occ_wait_us <= C.DEFAULT_OCCUPY_TIMEOUT_MS * 1000
+                occ_wait_us <= occupy_timeout_ms * 1000
             )
             occupied = occupied | grant
             wait_us = jnp.maximum(wait_us, jnp.where(grant, occ_wait_us, 0))

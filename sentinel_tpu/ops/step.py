@@ -222,6 +222,7 @@ def entry_step(
     extra_pass_global=None,
     extra_next_global=None,
     spec1: W.WindowSpec = SPEC_1S,
+    occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
 ) -> Tuple[SentinelState, Decisions]:
     """One admission step. ``extra_pass`` / ``extra_next`` (int32[R]) /
     ``extra_cms`` (f32[PR, D, W] param sketch), all optional, are the
@@ -299,7 +300,8 @@ def entry_step(
                       extra_pass=extra_pass, occupied_next=occupied_next,
                       extra_next=extra_next,
                       extra_pass_global=extra_pass_global,
-                      extra_next_global=extra_next_global, spec=spec1)
+                      extra_next_global=extra_next_global, spec=spec1,
+                      occupy_timeout_ms=occupy_timeout_ms)
     reason = jnp.where(valid & (~decided) & fv.blocked, C.BlockReason.FLOW, reason)
     blocked = blocked | fv.blocked
     decided = decided | blocked

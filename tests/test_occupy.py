@@ -166,3 +166,46 @@ def test_occupied_pass_reaches_minute_metrics(engine, frozen_time):
     # Minute staging records the grant's pass immediately (reference:
     # StatisticNode.addOccupiedPass hits the minute counter at grant time).
     assert _sec_count(engine, C.MetricEvent.PASS, row) == 11
+
+
+def test_occupy_timeout_runtime_tunable(engine, frozen_time):
+    """OccupyTimeoutProperty analog: shrinking the wait cap below the
+    time-to-next-bucket denies borrows the default cap granted; restoring
+    it grants again; out-of-range values are rejected."""
+    st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
+    _fill("occ", 10)
+    frozen_time.advance_time(700)  # next bucket is 300ms away
+
+    engine.set_occupy_timeout(100)  # 300ms wait no longer fits
+    with pytest.raises(st.FlowException):
+        st.entry("occ", prioritized=True)
+
+    engine.set_occupy_timeout(500)  # default again: borrow granted
+    e = st.entry("occ", prioritized=True)
+    e.exit()
+    assert _occ(engine, _row(engine, "occ")) == 1
+
+    with pytest.raises(ValueError):
+        engine.set_occupy_timeout(-1)
+    with pytest.raises(ValueError):
+        engine.set_occupy_timeout(engine._spec1.interval_ms + 1)
+    # push-property form
+    engine.occupy_timeout_property.update_value(250)
+    assert engine._occupy_timeout_ms == 250
+
+
+def test_occupy_timeout_tune_is_free_and_geometry_clamps(engine,
+                                                         frozen_time):
+    """The cap is a TRACED step argument (tuning must not re-jit), and a
+    geometry shrink below the active cap clamps it to one window."""
+    st.load_flow_rules([st.FlowRule(resource="occ", count=10)])
+    engine._ensure_compiled()
+    jit_before = engine._entry_jit
+    engine.set_occupy_timeout(123)
+    assert engine._entry_jit is jit_before       # no rebuild on tune
+    assert engine._occupy_timeout_ms == 123
+
+    engine.set_window_geometry(interval_ms=100, sample_count=2)
+    assert engine._occupy_timeout_ms == 100      # clamped to the window
+    with pytest.raises(ValueError):
+        engine.set_occupy_timeout(101)
