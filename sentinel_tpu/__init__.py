@@ -40,7 +40,8 @@ from sentinel_tpu.core.constants import (
 )
 from sentinel_tpu.core.context import enter as context_enter
 from sentinel_tpu.core.context import exit_context, get_context
-from sentinel_tpu.core.engine import EntryHandle, SentinelEngine
+from sentinel_tpu.core.engine import (DeviceDispatchError, EntryHandle,
+                                      SentinelEngine)
 from sentinel_tpu.core.exceptions import (
     AuthorityException,
     BlockException,
@@ -195,7 +196,8 @@ from sentinel_tpu.core.spi import (
 __all__ = [
     "AuthorityException", "AuthorityRule", "BlockException", "BlockReason",
     "CheckpointTimer", "restore_checkpoint", "save_checkpoint",
-    "DegradeException", "DegradeRule", "EntryHandle", "EntryInfo", "EntryType",
+    "DegradeException", "DegradeRule", "DeviceDispatchError", "EntryHandle",
+    "EntryInfo", "EntryType",
     "FlowException", "FlowRule", "MetricEvent", "ParamFlowException",
     "ParamFlowItem", "ParamFlowRule", "ProcessorSlot", "ResourceType",
     "SentinelEngine", "SystemBlockException", "SystemRule", "constants",

@@ -37,6 +37,14 @@ from sentinel_tpu.core.batch import (
     make_exit_batch_np,
 )
 from sentinel_tpu.core.exceptions import BlockException, exception_for_reason
+
+
+class DeviceDispatchError(RuntimeError):
+    """A device dispatch died (backend/tunnel failure) AFTER the input
+    state may have been donated. The raising site has already dropped the
+    engine to a cold state (reference restart stance: rules durable,
+    stats ephemeral); catchers decide their own degradation — the sync
+    entry path fails open, batch-API callers see the typed error."""
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
 from sentinel_tpu.metrics.profiling import StepTimer, timed_call
 
@@ -862,17 +870,25 @@ class SentinelEngine:
                         buf["param_present"][0, i] = True
                 else:
                     buf[k][0] = v
-            dec = self._run_entry_batch_locked(EntryBatch(**buf))
+            try:
+                dec = self._run_entry_batch_locked(EntryBatch(**buf))
+            except DeviceDispatchError as ex:  # backend/tunnel death only
+                self._note_fail_open(str(ex))
+                return 0, 0  # fail open, like fallbackToLocalOrPass
             return int(dec.reason[0]), int(dec.wait_us[0])
 
     def _run_entry_batch_locked(self, batch: EntryBatch) -> Decisions:
         self._ensure_compiled()
         now = time_util.current_time_millis()
         self._refresh_signals(now)
-        self._state, dec = timed_call(
-            self.step_timer, "entry", batch.size, self._entry_jit,
-            self._state, self._rules, batch, now,
-            occupy_timeout_ms=self._occupy_timeout_ms)
+        try:
+            self._state, dec = timed_call(
+                self.step_timer, "entry", batch.size, self._entry_jit,
+                self._state, self._rules, batch, now,
+                occupy_timeout_ms=self._occupy_timeout_ms)
+        except Exception as ex:  # noqa: BLE001 — dispatch only (donation)
+            self._state = None  # buffers possibly consumed: restart cold
+            raise DeviceDispatchError(f"entry dispatch failed: {ex!r:.200}") from ex
         return dec
 
     def _run_entry_batch(self, batch: EntryBatch) -> Decisions:
@@ -883,9 +899,14 @@ class SentinelEngine:
         with self._lock:
             self._ensure_compiled()
             now = time_util.current_time_millis()
-            self._state = timed_call(
-                self.step_timer, "exit", batch.size, self._exit_jit,
-                self._state, self._rules, batch, now)
+            try:
+                self._state = timed_call(
+                    self.step_timer, "exit", batch.size, self._exit_jit,
+                    self._state, self._rules, batch, now)
+            except Exception as ex:  # noqa: BLE001
+                self._state = None
+                raise DeviceDispatchError(
+                    f"exit dispatch failed: {ex!r:.200}") from ex
 
     # -- pipelined mode ----------------------------------------------------
 
@@ -963,7 +984,12 @@ class SentinelEngine:
                         buf["param_present"][0, i] = True
                 else:
                     buf[k][0] = v
-            self._run_exit_batch(ExitBatch(**buf))
+            try:
+                self._run_exit_batch(ExitBatch(**buf))
+            except DeviceDispatchError as ex:
+                # An exit commit is pure statistics; an infrastructure
+                # failure here must never break the caller's happy path.
+                self._note_fail_open(str(ex))
         ctx_mod.auto_exit_context()
 
     # -- batch API (bench / pipelined engine / cluster frontends) ---------
@@ -973,16 +999,26 @@ class SentinelEngine:
             self._ensure_compiled()
             now = now_ms if now_ms is not None else time_util.current_time_millis()
             self._refresh_signals(now)
-            self._state, dec = self._entry_jit(
-                self._state, self._rules, batch, now,
-                occupy_timeout_ms=self._occupy_timeout_ms)
+            try:
+                self._state, dec = self._entry_jit(
+                    self._state, self._rules, batch, now,
+                    occupy_timeout_ms=self._occupy_timeout_ms)
+            except Exception as ex:  # noqa: BLE001
+                self._state = None
+                raise DeviceDispatchError(
+                    f"entry dispatch failed: {ex!r:.200}") from ex
             return dec
 
     def complete_batch(self, batch: ExitBatch, now_ms: Optional[int] = None) -> None:
         with self._lock:
             self._ensure_compiled()
             now = now_ms if now_ms is not None else time_util.current_time_millis()
-            self._state = self._exit_jit(self._state, self._rules, batch, now)
+            try:
+                self._state = self._exit_jit(self._state, self._rules, batch, now)
+            except Exception as ex:  # noqa: BLE001
+                self._state = None
+                raise DeviceDispatchError(
+                    f"exit dispatch failed: {ex!r:.200}") from ex
 
     # -- metric log source (ops plane) ------------------------------------
 

@@ -395,7 +395,12 @@ def exit_step(
         jnp.minimum(w1.min_rt[idx1], mstage)))
     sec = sec._replace(min_rt=jnp.minimum(sec.min_rt, mstage))
 
-    cur_threads = state.cur_threads + extras[0].astype(jnp.int32)
+    # Clamp at zero: in a correct stream exits never outnumber entries,
+    # so a negative row only arises from unmatched exits after a cold
+    # state drop (DeviceDispatchError recovery) — without the clamp those
+    # stale handles would bias THREAD-grade admission permanently.
+    cur_threads = jnp.maximum(
+        state.cur_threads + extras[0].astype(jnp.int32), 0)
 
     degrade = D.feed_degrade(rules.degrade, state.degrade, batch, now_ms)
     param = P.feed_param_exit(rules.param, state.param, batch)

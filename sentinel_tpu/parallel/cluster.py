@@ -94,7 +94,9 @@ def global_next_window(w1: W.Window, occupied_next: jax.Array, now_ms: jax.Array
 
 def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
                now_ms: jax.Array, *, axis: str, cluster_param: bool,
-               extra_checkers: tuple = ()) -> Tuple[S.SentinelState, Decisions]:
+               extra_checkers: tuple = (),
+               occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
+               ) -> Tuple[S.SentinelState, Decisions]:
     local = _squeeze0(state)
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(local.w1, now_ms, S.SPEC_1S)
@@ -117,7 +119,8 @@ def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
     new_local, dec = S.entry_step(local._replace(w1=w1), rules, batch, now_ms,
                                   extra_pass=extra_pass, extra_next=extra_next,
                                   extra_cms=extra_cms,
-                                  extra_checkers=extra_checkers)
+                                  extra_checkers=extra_checkers,
+                                  occupy_timeout_ms=occupy_timeout_ms)
     return _expand0(new_local), dec
 
 
@@ -127,7 +130,8 @@ def _pod_exit(state: S.SentinelState, rules: S.RulePack, batch: ExitBatch,
     return _expand0(S.exit_step(_squeeze0(state), rules, batch, now_ms))
 
 
-def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True):
+def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True,
+                   occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS):
     """Build (entry_step, exit_step) shard_mapped over ``mesh[axis]``.
 
     State leaves carry a leading device axis (sharded); batches are sharded
@@ -138,6 +142,9 @@ def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True):
     ``cluster_param=False`` drops the param-sketch all-reduce (a
     [PR, 4, 2048] f32 psum per step) for deployments with no cluster-mode
     param rules — a static choice, like rule compilation itself.
+    ``occupy_timeout_ms`` is likewise build-static here (pod callers own
+    their jit lifecycle); the single-engine paths take it as a traced
+    runtime knob.
 
     SPI device checkers (core/spi.py) registered at BUILD time are spliced
     into the pod step like the single-device engine's; later registrations
@@ -148,7 +155,8 @@ def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True):
 
     entry = _shard_map(
         functools.partial(_pod_entry, axis=axis, cluster_param=cluster_param,
-                          extra_checkers=_spi.device_checkers()),
+                          extra_checkers=_spi.device_checkers(),
+                          occupy_timeout_ms=occupy_timeout_ms),
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P()),
         out_specs=(P(axis), P(axis)),

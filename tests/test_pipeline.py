@@ -109,3 +109,50 @@ def test_fail_open_is_counted_and_logged(piped, frozen_time, caplog):
         piped._run_entry_batch = orig
     assert piped.fail_open_count == 1
     assert any("UNGUARDED" in r.message for r in caplog.records)
+
+
+def test_sync_device_failure_fails_open_and_recovers(engine, frozen_time):
+    """Backend/tunnel death on the SYNC dispatch path (the round-4 outage
+    class): entry() must fail OPEN (counted + logged) like the
+    reference's fallbackToLocalOrPass — never surface an XLA error to the
+    caller — and the engine must recover with cold stats on the next
+    successful dispatch."""
+    st.load_flow_rules([st.FlowRule(resource="dead", count=1,
+                                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                                    max_queueing_time_ms=0)])  # device path
+    assert st.entry_ok("dead")          # healthy dispatch first
+    engine._flush_committer()
+
+    healthy_jit = engine._entry_jit
+
+    def dying_jit(*a, **kw):
+        raise RuntimeError("tunnel died mid-dispatch")
+
+    engine._entry_jit = dying_jit
+    before = engine.fail_open_count
+    h = st.entry_ok("dead")             # must NOT raise RuntimeError
+    assert h is not None                # failed open
+    assert engine.fail_open_count > before
+    assert engine._state is None        # poisoned state dropped
+    h.exit()                            # exit rebuilds cold + commits
+
+    # recovery: healthy jit again -> protection resumes on cold stats
+    engine._entry_jit = healthy_jit
+    assert st.entry_ok("dead") is not None
+    snap = engine.node_snapshot()["dead"]
+    assert snap["passQps"] >= 1         # stats flowing again
+
+
+def test_exit_device_failure_never_breaks_caller(engine, frozen_time):
+    st.load_flow_rules([st.FlowRule(resource="dx", count=5,
+                                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                                    max_queueing_time_ms=1000)])
+    h = st.entry_ok("dx")
+    assert h
+
+    def dying_jit(*a, **kw):
+        raise RuntimeError("tunnel died on exit")
+
+    engine._exit_jit = dying_jit
+    h.exit()                            # must not raise
+    assert engine.fail_open_count >= 1
