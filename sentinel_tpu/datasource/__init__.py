@@ -33,6 +33,16 @@ from sentinel_tpu.datasource.redis import (
     RedisDataSource,
     RedisWritableDataSource,
 )
+from sentinel_tpu.datasource.nacos import (
+    MiniNacosServer,
+    NacosDataSource,
+    NacosWritableDataSource,
+)
+from sentinel_tpu.datasource.consul import (
+    ConsulDataSource,
+    ConsulWritableDataSource,
+    MiniConsulServer,
+)
 from sentinel_tpu.datasource.converters import (
     authority_rules_from_json,
     authority_rules_to_json,
@@ -53,6 +63,8 @@ __all__ = [
     "FileRefreshableDataSource", "FileWritableDataSource",
     "HttpRefreshableDataSource", "MiniConfigHTTPServer",
     "MiniRedisServer", "RedisDataSource", "RedisWritableDataSource",
+    "MiniNacosServer", "NacosDataSource", "NacosWritableDataSource",
+    "ConsulDataSource", "ConsulWritableDataSource", "MiniConsulServer",
     "ReadableDataSource", "WritableDataSource", "bind",
     "authority_rules_from_json", "authority_rules_to_json",
     "degrade_rules_from_json", "degrade_rules_to_json",

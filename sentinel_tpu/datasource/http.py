@@ -17,9 +17,10 @@ import hashlib
 import threading
 import urllib.error
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
+from sentinel_tpu.datasource._mini_http import RestartableHTTPServer
 from sentinel_tpu.datasource.base import (
     AutoRefreshDataSource,
     Converter,
@@ -103,37 +104,24 @@ class _ConfigHandler(BaseHTTPRequestHandler):
         pass
 
 
-class MiniConfigHTTPServer(ThreadingHTTPServer):
-    """One-document config endpoint with real ETag/304 semantics."""
+class MiniConfigHTTPServer(RestartableHTTPServer):
+    """One-document config endpoint with real ETag/304 semantics (the
+    shared base adds stop()+start() same-port restartability)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        super().__init__((host, port), _ConfigHandler)
+        super().__init__(host, port, _ConfigHandler)
         self._lock = threading.Lock()
         self._body = b"[]"
         self._etag = '"empty"'
         self.request_count = 0
         self.not_modified_count = 0
-        self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.server_address[1]}/config"
+        return f"{self.addr}/config"
 
     def set_document(self, text: str) -> None:
         raw = text.encode("utf-8")
         with self._lock:
             self._body = raw
             self._etag = '"%s"' % hashlib.sha1(raw).hexdigest()[:16]
-
-    def start(self) -> "MiniConfigHTTPServer":
-        self._thread = threading.Thread(target=self.serve_forever,
-                                        name="mini-config-http", daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self.shutdown()
-        self.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None

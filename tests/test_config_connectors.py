@@ -1,0 +1,352 @@
+"""Nacos long-poll + Consul blocking-query connector tests (SURVEY.md
+§2.2: ``sentinel-datasource-nacos`` / ``sentinel-datasource-consul``):
+real wire protocols over real sockets — initial load, pushed updates via
+the watch mechanism, writable publish, reconnect across a server
+restart, and bad-payload resilience.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import bind
+from sentinel_tpu.datasource.consul import (
+    ConsulDataSource,
+    ConsulWritableDataSource,
+    MiniConsulServer,
+    _parse_wait,
+)
+from sentinel_tpu.datasource.converters import (
+    flow_rules_from_json,
+    flow_rules_to_json,
+)
+from sentinel_tpu.datasource.nacos import (
+    MiniNacosServer,
+    NacosDataSource,
+    NacosWritableDataSource,
+    _md5_hex,
+)
+
+
+def _wait_for(pred, timeout_s: float = 5.0) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _rules_json(*resources, count=5.0) -> str:
+    return json.dumps([{"resource": r, "count": count} for r in resources])
+
+
+def _resources(prop):
+    return {r.resource for r in (prop.value or [])}
+
+
+# -- Nacos --------------------------------------------------------------------
+
+
+@pytest.fixture()
+def nacos():
+    s = MiniNacosServer(max_hold_ms=400).start()
+    yield s
+    s.stop()
+
+
+def _nacos_source(server, **kw) -> NacosDataSource:
+    kw.setdefault("poll_timeout_ms", 300)
+    kw.setdefault("reconnect_backoff_ms", (20, 100))
+    return NacosDataSource(server.addr, "sentinel-flow", "DEFAULT_GROUP",
+                           flow_rules_from_json, **kw)
+
+
+def test_nacos_initial_load_and_push(nacos):
+    nacos.publish("sentinel-flow", "DEFAULT_GROUP", _rules_json("api:a"))
+    src = _nacos_source(nacos).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"api:a"})
+        # A publish lands through the long-poll listener, no restart.
+        nacos.publish("sentinel-flow", "DEFAULT_GROUP",
+                      _rules_json("api:a", "api:b"))
+        assert _wait_for(
+            lambda: _resources(src.property) == {"api:a", "api:b"})
+    finally:
+        src.close()
+
+
+def test_nacos_absent_config_then_first_publish(nacos):
+    src = _nacos_source(nacos).start()
+    try:
+        assert src.property.value is None  # 404 → nothing pushed
+        nacos.publish("sentinel-flow", "DEFAULT_GROUP", _rules_json("late"))
+        assert _wait_for(lambda: _resources(src.property) == {"late"})
+    finally:
+        src.close()
+
+
+def test_nacos_writable_publish_roundtrip(nacos):
+    writer = NacosWritableDataSource(nacos.addr, "sentinel-flow",
+                                     "DEFAULT_GROUP", flow_rules_to_json)
+    src = _nacos_source(nacos).start()
+    try:
+        writer.write([st.FlowRule(resource="via-writer", count=9.0)])
+        assert _wait_for(lambda: _resources(src.property) == {"via-writer"})
+    finally:
+        src.close()
+
+
+def test_nacos_bad_payload_keeps_last_good_without_spinning(nacos):
+    nacos.publish("sentinel-flow", "DEFAULT_GROUP", _rules_json("good"))
+    src = _nacos_source(nacos).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"good"})
+        nacos.publish("sentinel-flow", "DEFAULT_GROUP", "{not json]")
+        # Receipt advances the listener md5 even though conversion failed,
+        # so the long-poll PARKS again instead of busy-looping drift.
+        assert _wait_for(lambda: src._md5 == _md5_hex("{not json]"))
+        rounds_after_bad = nacos.poll_rounds
+        time.sleep(0.7)
+        assert _resources(src.property) == {"good"}
+        # 0.7s / 300ms poll timeout ≈ 2-3 parked rounds; a busy loop would
+        # rack up hundreds.
+        assert nacos.poll_rounds - rounds_after_bad <= 6
+        # And a later good payload still lands.
+        nacos.publish("sentinel-flow", "DEFAULT_GROUP",
+                      _rules_json("recovered"))
+        assert _wait_for(lambda: _resources(src.property) == {"recovered"})
+    finally:
+        src.close()
+
+
+def test_nacos_deleted_config_keeps_rules_without_spinning(nacos):
+    nacos.publish("sentinel-flow", "DEFAULT_GROUP", _rules_json("good"))
+    src = _nacos_source(nacos).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"good"})
+        nacos.delete("sentinel-flow", "DEFAULT_GROUP")
+        # Deletion is recorded as md5 "" so the long-poll parks again.
+        assert _wait_for(lambda: src._md5 == "")
+        rounds_after_delete = nacos.poll_rounds
+        time.sleep(0.7)
+        assert _resources(src.property) == {"good"}  # last good kept
+        assert nacos.poll_rounds - rounds_after_delete <= 6  # no busy loop
+        nacos.publish("sentinel-flow", "DEFAULT_GROUP",
+                      _rules_json("republished"))
+        assert _wait_for(
+            lambda: _resources(src.property) == {"republished"})
+    finally:
+        src.close()
+
+
+def test_normalize_base_schemes():
+    from sentinel_tpu.datasource._mini_http import normalize_base
+
+    assert normalize_base("1.2.3.4:8848") == "http://1.2.3.4:8848"
+    assert normalize_base("http://h:1/") == "http://h:1"
+    assert normalize_base("https://h:1") == "https://h:1"
+    # A bare hostname merely STARTING with "http" still gets a scheme.
+    assert normalize_base("httpd-gw:8848") == "http://httpd-gw:8848"
+
+
+def test_nacos_reconnect_after_server_restart(nacos):
+    nacos.publish("sentinel-flow", "DEFAULT_GROUP", _rules_json("v1"))
+    src = _nacos_source(nacos).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"v1"})
+        nacos.stop()
+        assert _wait_for(lambda: src.reconnect_count > 0)
+        # Publish while the connector is down, then restart on the SAME
+        # port: the md5 drift is caught on the first listener round.
+        nacos.publish("sentinel-flow", "DEFAULT_GROUP", _rules_json("v2"))
+        nacos.start()
+        assert _wait_for(lambda: _resources(src.property) == {"v2"})
+    finally:
+        src.close()
+
+
+def test_nacos_tenant_isolation(nacos):
+    nacos.publish("sentinel-flow", "DEFAULT_GROUP", _rules_json("t-a"),
+                  tenant="a")
+    src_a = _nacos_source(nacos, tenant="a").start()
+    src_default = _nacos_source(nacos).start()
+    try:
+        assert _wait_for(lambda: _resources(src_a.property) == {"t-a"})
+        assert src_default.property.value is None
+    finally:
+        src_a.close()
+        src_default.close()
+
+
+def test_nacos_bind_to_engine(nacos):
+    eng = st.reset(capacity=64)
+    try:
+        src = _nacos_source(nacos).start()
+        bind(src, st.load_flow_rules)
+        nacos.publish("sentinel-flow", "DEFAULT_GROUP",
+                      _rules_json("bound", count=0.0))
+        try:
+            def blocked():
+                try:
+                    with st.entry("bound"):
+                        pass
+                    return False
+                except st.BlockException:
+                    return True
+
+            assert _wait_for(blocked)
+        finally:
+            src.close()
+    finally:
+        eng.close()
+
+
+# -- Consul -------------------------------------------------------------------
+
+
+@pytest.fixture()
+def consul():
+    s = MiniConsulServer(max_hold_ms=400).start()
+    yield s
+    s.stop()
+
+
+def _consul_source(server, **kw) -> ConsulDataSource:
+    kw.setdefault("wait", "300ms")
+    kw.setdefault("reconnect_backoff_ms", (20, 100))
+    return ConsulDataSource(server.addr, "config/sentinel/flow-rules",
+                            flow_rules_from_json, **kw)
+
+
+def test_parse_wait_durations():
+    assert _parse_wait("10s") == 10.0
+    assert _parse_wait("1m") == 60.0
+    assert _parse_wait("250ms") == 0.25
+    assert _parse_wait("5") == 5.0
+    with pytest.raises(ValueError):
+        _parse_wait("soon")
+
+
+def test_consul_bad_wait_fails_at_construction():
+    # Must raise HERE — inside the watch loop it would be swallowed as an
+    # endless silent reconnect.
+    with pytest.raises(ValueError):
+        ConsulDataSource("127.0.0.1:1", "k", flow_rules_from_json,
+                         wait="5 minutes")
+
+
+def test_consul_initial_load_and_watch(consul):
+    consul.put("config/sentinel/flow-rules", _rules_json("api:a"))
+    src = _consul_source(consul).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"api:a"})
+        consul.put("config/sentinel/flow-rules",
+                   _rules_json("api:a", "api:b"))
+        assert _wait_for(
+            lambda: _resources(src.property) == {"api:a", "api:b"})
+    finally:
+        src.close()
+
+
+def test_consul_absent_key_then_first_put(consul):
+    src = _consul_source(consul).start()
+    try:
+        assert src.property.value is None
+        consul.put("config/sentinel/flow-rules", _rules_json("late"))
+        assert _wait_for(lambda: _resources(src.property) == {"late"})
+    finally:
+        src.close()
+
+
+def test_consul_writable_put_roundtrip(consul):
+    writer = ConsulWritableDataSource(consul.addr,
+                                      "config/sentinel/flow-rules",
+                                      flow_rules_to_json)
+    src = _consul_source(consul).start()
+    try:
+        writer.write([st.FlowRule(resource="via-writer", count=9.0)])
+        assert _wait_for(lambda: _resources(src.property) == {"via-writer"})
+    finally:
+        src.close()
+
+
+def test_consul_bad_payload_keeps_last_good(consul):
+    consul.put("config/sentinel/flow-rules", _rules_json("good"))
+    src = _consul_source(consul).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"good"})
+        consul.put("config/sentinel/flow-rules", "{not json]")
+        time.sleep(0.3)
+        assert _resources(src.property) == {"good"}
+        consul.put("config/sentinel/flow-rules", _rules_json("recovered"))
+        assert _wait_for(lambda: _resources(src.property) == {"recovered"})
+    finally:
+        src.close()
+
+
+def test_consul_reconnect_after_server_restart(consul):
+    consul.put("config/sentinel/flow-rules", _rules_json("v1"))
+    src = _consul_source(consul).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"v1"})
+        consul.stop()
+        assert _wait_for(lambda: src.reconnect_count > 0)
+        # State-based recovery: whatever was put while down is simply the
+        # current state after reconnect.
+        consul.put("config/sentinel/flow-rules", _rules_json("v2"))
+        consul.start()
+        assert _wait_for(lambda: _resources(src.property) == {"v2"})
+    finally:
+        src.close()
+
+
+def test_consul_blocking_query_parks_when_idle(consul):
+    """An idle blocking query must PARK (no busy spin): with a 300ms wait
+    and no writes, a handful of rounds should elapse per second, not
+    hundreds."""
+    consul.put("config/sentinel/flow-rules", _rules_json("idle"))
+    src = _consul_source(consul).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"idle"})
+        before_idx = consul._index
+        before_rounds = consul.poll_rounds
+        time.sleep(0.7)
+        assert consul._index == before_idx  # no phantom writes
+        assert src.reconnect_count == 0  # idle != error
+        # 0.7s / 300ms wait ≈ 2-3 parked rounds; a busy-spinning watch
+        # would rack up hundreds.
+        assert consul.poll_rounds - before_rounds <= 6
+    finally:
+        src.close()
+
+
+def test_consul_index_reset_restarts_watch(consul):
+    consul.put("config/sentinel/flow-rules", _rules_json("v1"))
+    src = _consul_source(consul).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"v1"})
+        # Simulate a leader change resetting the index space backwards.
+        with consul._cond:
+            consul._index = 0
+            consul._kv["config/sentinel/flow-rules"] = (
+                _rules_json("reset").encode("utf-8"), 1)
+            consul._cond.notify_all()
+        assert _wait_for(lambda: _resources(src.property) == {"reset"})
+    finally:
+        src.close()
+
+
+def test_consul_raw_http_shape(consul):
+    """The fake speaks recognizable Consul: base64 values + index header."""
+    consul.put("k", "hello")
+    with urllib.request.urlopen(f"{consul.addr}/v1/kv/k") as resp:
+        assert resp.headers["X-Consul-Index"] == "1"
+        (entry,) = json.loads(resp.read())
+    assert entry["Key"] == "k"
+    import base64
+
+    assert base64.b64decode(entry["Value"]) == b"hello"
