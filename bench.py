@@ -444,6 +444,49 @@ def main() -> None:
             _reexec_cpu(
                 f"tunnel unreachable for {wait_budget_s / 60:.0f} min")
 
+    # A tunnel stall can hang a dispatch FOREVER (observed: the latency
+    # section parked 45+ min with zero CPU, all threads sleeping — no
+    # exception to catch). The watchdog guarantees a JSON line within a
+    # bounded compute budget: if the throughput number exists, print it
+    # (with the sections that completed) and hard-exit; if even the
+    # throughput section is stuck, re-exec on CPU like any other backend
+    # death. Hung tunnel threads cannot be joined, hence os._exit/execve.
+    state = {"out": None, "emitted": False}
+    sections_done = threading.Event()
+    emit_lock = threading.Lock()  # exactly ONE JSON line, main or watchdog
+
+    def _watchdog() -> None:
+        try:
+            budget_s = float(os.environ.get("BENCH_COMPUTE_BUDGET_S",
+                                            "1500"))
+        except ValueError:
+            budget_s = 1500.0
+        if sections_done.wait(budget_s):
+            return
+        with emit_lock:
+            if sections_done.is_set() or state["emitted"]:
+                return  # lost the race with a just-finished run
+            out = state.get("out")
+            if out is None:
+                if platform != "cpu-fallback":
+                    _reexec_cpu(f"dispatch hang > {budget_s:.0f}s "
+                                "(tunnel stalled mid-throughput)")
+                os._exit(1)  # CPU hang: no honest number exists
+            state["emitted"] = True
+            out["latency_section_error"] = (
+                f"watchdog: section hang > {budget_s:.0f}s (tunnel stall)")
+            try:
+                with open("bench_partial.json", "w") as f:
+                    json.dump(out, f)
+            except OSError:
+                pass
+            print(json.dumps(out))
+            sys.stdout.flush()
+        os._exit(0)
+
+    threading.Thread(target=_watchdog, name="bench-watchdog",
+                     daemon=True).start()
+
     # The CPU fallback must also catch a tunnel that dies DURING the
     # throughput section — otherwise these retries end in a raise with no
     # JSON line at all.
@@ -475,6 +518,7 @@ def main() -> None:
         "vs_baseline": round(checks_per_sec / target, 4),
         "platform": platform,
     }
+    state["out"] = out  # the watchdog may now emit this on a later hang
 
     def persist(partial: dict) -> None:
         """Crash-safe partial record: if the tunnel (or the driver's
@@ -500,7 +544,11 @@ def main() -> None:
     except Exception as ex:  # noqa: BLE001 — any late failure keeps §1
         out["latency_section_error"] = f"{ex!r:.160}"
         persist(out)
-    print(json.dumps(out))
+    with emit_lock:
+        sections_done.set()
+        if not state["emitted"]:
+            state["emitted"] = True
+            print(json.dumps(out))
 
 
 if __name__ == "__main__":

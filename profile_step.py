@@ -1,0 +1,140 @@
+"""Break down the fused entry_step's on-chip cost at bench shapes.
+
+Times jitted sub-stages in isolation (same shapes as bench_throughput:
+capacity 32768, batch 8192) so optimization targets the measured hot
+spot, not a guess. Run on the real chip; scratch tool, not a test.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, iters=10, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def main():
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+    from sentinel_tpu.core.registry import NodeRegistry
+    from sentinel_tpu.models import authority as A
+    from sentinel_tpu.models import degrade as D
+    from sentinel_tpu.models import flow as F
+    from sentinel_tpu.models import param_flow as P
+    from sentinel_tpu.models import system as Y
+    from sentinel_tpu.ops import segment as seg
+    from sentinel_tpu.ops import step as S
+    from sentinel_tpu.ops import window as W
+
+    n_resources, capacity, batch_n = 10_000, 32_768, 8192
+    now0 = 1_700_000_000_000
+    reg = NodeRegistry(capacity)
+    rules = [F.FlowRule(resource=f"res{i}", count=1e9, control_behavior=0)
+             for i in range(0, n_resources, 10)]
+    degrade_rules = [D.DegradeRule(resource=f"res{i}", count=100,
+                                   grade=i % 3, time_window=10)
+                     for i in range(0, n_resources, 20)]
+    param_rules = [P.ParamFlowRule(f"res{i}", param_idx=0, count=1e9)
+                   for i in range(0, n_resources, 40)]
+    ctx = "sentinel_default_context"
+    ent_row = reg.entrance_row(ctx)
+    c_rows = np.asarray([reg.cluster_row(f"res{i}")
+                         for i in range(n_resources)])
+    d_rows = np.asarray([reg.default_row(ctx, f"res{i}", ent_row)
+                         for i in range(n_resources)])
+    ft, _ = F.compile_flow_rules(rules, reg, capacity)
+    dt, di = D.compile_degrade_rules(degrade_rules, reg, capacity)
+    pt = P.compile_param_rules(param_rules, reg, capacity)
+    pack = S.RulePack(flow=ft, degrade=dt,
+                      authority=A.compile_authority_rules([], reg, capacity),
+                      system=Y.compile_system_rules([Y.SystemRule(qps=1e12)]),
+                      param=pt)
+    state = S.make_state(capacity, ft.num_rules, now0,
+                         degrade=D.make_degrade_state(dt, di),
+                         param=P.make_param_state(pt.num_rules))
+
+    rng = np.random.default_rng(0)
+    buf = make_entry_batch_np(batch_n)
+    pick = rng.integers(0, n_resources, size=batch_n)
+    buf["cluster_row"][:] = c_rows[pick]
+    buf["dn_row"][:] = d_rows[pick]
+    buf["count"][:] = 1
+    buf["param_hash"][:, 0] = rng.integers(1, 1 << 31, size=batch_n)
+    buf["param_present"][:, 0] = True
+    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+    now = jnp.asarray(now0, jnp.int64)
+
+    print(f"platform: {jax.devices()[0].platform}")
+
+    # Full step (no donation, so reusable).
+    full = jax.jit(lambda st_, b, t: S.entry_step(st_, pack, b, t))
+    print(f"full entry_step:        {timeit(full, state, batch, now):8.3f} ms")
+
+    # Stage: window rotate only.
+    rot = jax.jit(lambda w, t: W.rotate(w, t, S.SPEC_1S))
+    print(f"  w1 rotate:            {timeit(rot, state.w1, now):8.3f} ms")
+
+    # Stage: the 4-row commit bincount.
+    rows4 = jnp.stack([batch.dn_row, batch.cluster_row, batch.origin_row,
+                       jnp.full_like(batch.cluster_row, -1)], axis=1)
+
+    def commit(r4):
+        pass4 = jnp.ones(r4.shape, jnp.int32)
+        return seg.bincount_matmul(r4.reshape(-1),
+                                   jnp.stack([pass4.reshape(-1)] * 3, axis=1),
+                                   capacity)
+
+    cj = jax.jit(commit)
+    print(f"  bincount commit:      {timeit(cj, rows4):8.3f} ms")
+
+    # Stage: flow check only.
+    fj = jax.jit(lambda st_, b, t: F.check_flow(
+        ft, st_.flow, st_.w1, st_.cur_threads, b, t,
+        jnp.zeros((batch_n,), bool), occupied_next=st_.occupied_next))
+    print(f"  flow check:           {timeit(fj, state, batch, now):8.3f} ms")
+
+    # Stage: degrade check.
+    dj = jax.jit(lambda st_, b, t: D.check_degrade(
+        dt, st_.degrade, b, t, jnp.ones((batch_n,), bool)))
+    print(f"  degrade check:        {timeit(dj, state, batch, now):8.3f} ms")
+
+    # Stage: param check.
+    pj = jax.jit(lambda st_, b, t: P.check_param_flow(
+        pt, st_.param, b, t, jnp.ones((batch_n,), bool)))
+    print(f"  param check:          {timeit(pj, state, batch, now):8.3f} ms")
+
+    # Stage: system check.
+    yj = jax.jit(lambda st_, b, t: Y.check_system(
+        pack.system, st_.sys_signals, st_.w1, st_.w60, st_.sec.counts,
+        st_.cur_threads, b, jnp.ones((batch_n,), bool), t))
+    print(f"  system check:         {timeit(yj, state, batch, now):8.3f} ms")
+
+    # Dense prefix at batch width (inside flow for ruled rows).
+    ids = batch.cluster_row
+    vals = jnp.ones((batch_n,), jnp.float32)
+    sj = jax.jit(lambda i, v: seg.segmented_prefix_dense(i, v))
+    print(f"  segmented prefix:     {timeit(sj, ids, vals):8.3f} ms")
+
+    # Cost analysis of the full step.
+    lowered = jax.jit(
+        lambda st_, b, t: S.entry_step(st_, pack, b, t)
+    ).lower(state, batch, now).compile()
+    ca = lowered.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    if ca:
+        print("cost_analysis: flops=%.3g bytes=%.3g" % (
+            ca.get("flops", -1), ca.get("bytes accessed", -1)))
+
+
+if __name__ == "__main__":
+    main()

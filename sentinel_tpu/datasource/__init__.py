@@ -5,6 +5,23 @@ SURVEY.md §2.2): pull/push rule configuration into the property system.
 ``Converter``, and pushes the result into its ``SentinelProperty`` — to which
 a rule manager listens. ``WritableDataSource`` persists rules pushed from the
 ops plane (``setRules`` command handler).
+
+Coverage vs the reference's concrete connectors (every one follows one of
+four wire shapes, each implemented here against a real protocol with an
+in-repo fake server):
+
+- **file mtime poll** (`FileRefreshableDataSource`) → ``base.py`` (exact).
+- **HTTP poll / conditional GET** (Eureka, Spring-Cloud-Config) →
+  ``http.py``.
+- **HTTP long-poll push** (Nacos; Apollo's notifications/v2 is the same
+  shape) → ``nacos.py`` (real Nacos 1.x open-api), ``consul.py`` (real
+  Consul KV blocking queries).
+- **socket push-subscription** (Redis pub/sub; ZooKeeper watches follow
+  the same subscribe+catch-up discipline over their own framing) →
+  ``redis.py`` (real RESP2), ``etcd.py`` (real etcd3 gRPC Watch).
+
+``push.py`` additionally proves the bare push/poll property shapes against
+an in-process broker for tests that want no sockets at all.
 """
 
 from sentinel_tpu.datasource.base import (
@@ -43,6 +60,17 @@ from sentinel_tpu.datasource.consul import (
     ConsulWritableDataSource,
     MiniConsulServer,
 )
+try:
+    # The etcd connector needs the protobuf runtime (its etcd3 messages
+    # are descriptor-built at import); every other datasource is stdlib-
+    # only and must stay importable without it.
+    from sentinel_tpu.datasource.etcd import (
+        EtcdDataSource,
+        EtcdWritableDataSource,
+        MiniEtcdServer,
+    )
+except ImportError:  # pragma: no cover — protobuf-less host
+    EtcdDataSource = EtcdWritableDataSource = MiniEtcdServer = None
 from sentinel_tpu.datasource.converters import (
     authority_rules_from_json,
     authority_rules_to_json,
@@ -65,6 +93,7 @@ __all__ = [
     "MiniRedisServer", "RedisDataSource", "RedisWritableDataSource",
     "MiniNacosServer", "NacosDataSource", "NacosWritableDataSource",
     "ConsulDataSource", "ConsulWritableDataSource", "MiniConsulServer",
+    "EtcdDataSource", "EtcdWritableDataSource", "MiniEtcdServer",
     "ReadableDataSource", "WritableDataSource", "bind",
     "authority_rules_from_json", "authority_rules_to_json",
     "degrade_rules_from_json", "degrade_rules_to_json",

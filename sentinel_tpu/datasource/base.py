@@ -183,6 +183,75 @@ class FileWritableDataSource(WritableDataSource[T]):
             os.replace(tmp, self.file_path)
 
 
+class ReconnectingWatchMixin:
+    """Scaffolding shared by the push connectors (Redis / Nacos / Consul /
+    etcd): a daemon watch thread that runs ``_watch_round()`` forever,
+    turning any exception in ``_watch_exceptions`` into an exponential-
+    backoff reconnect. One implementation so the stop-guard/backoff
+    discipline can't drift between connectors.
+
+    Contract for subclasses:
+      - call ``_init_watch(reconnect_backoff_ms)`` in ``__init__``,
+        ``_start_watching()`` in ``start()``, ``_join_watch()`` in
+        ``close()``;
+      - implement ``_watch_round()``: ONE connect/park/read cycle; raise
+        one of ``_watch_exceptions`` on any failure; call ``_healthy()``
+        once the round proves the server is back (resets the backoff);
+        return normally when ``self._stop`` is set;
+      - override ``_interrupt_watch()`` if a parked round needs an
+        explicit kick (e.g. socket shutdown) to notice ``close()``.
+    """
+
+    _watch_exceptions: tuple = (OSError, ConnectionError, ValueError)
+    _watch_thread_name = "sentinel-datasource-watch"
+
+    def _init_watch(self, reconnect_backoff_ms) -> None:
+        self.backoff_min_ms, self.backoff_max_ms = reconnect_backoff_ms
+        self._backoff_ms = self.backoff_min_ms
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.reconnect_count = 0  # ops visibility + test hook
+
+    def _start_watching(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch_forever, name=self._watch_thread_name,
+            daemon=True)
+        self._thread.start()
+
+    def _healthy(self) -> None:
+        self._backoff_ms = self.backoff_min_ms
+
+    def _watch_round(self) -> None:
+        raise NotImplementedError
+
+    def _interrupt_watch(self) -> None:
+        pass
+
+    def _watch_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._watch_round()
+            except self._watch_exceptions as ex:
+                if self._stop.is_set():
+                    break
+                self.reconnect_count += 1
+                _log_warn("%s lost (%r); retry in %dms",
+                          self._watch_thread_name, ex, self._backoff_ms)
+                self._stop.wait(self._backoff_ms / 1000.0)
+                self._backoff_ms = min(self._backoff_ms * 2,
+                                       self.backoff_max_ms)
+
+    def _join_watch(self, timeout_s: float = 2.0) -> None:
+        self._stop.set()
+        self._interrupt_watch()
+        if self._thread is not None:
+            # The thread may be parked inside a long poll; it is a daemon
+            # and the stop guards discard any post-close push, so an
+            # impatient join is safe.
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+
 def bind(source: ReadableDataSource, load_rules: Callable) -> None:
     """Attach a datasource to a rule loader (``register2Property`` analog).
 

@@ -28,7 +28,6 @@ from __future__ import annotations
 import base64
 import json
 import re
-import threading
 import time
 import urllib.error
 import urllib.parse
@@ -43,6 +42,7 @@ from sentinel_tpu.datasource._mini_http import (
 from sentinel_tpu.datasource.base import (
     AbstractDataSource,
     Converter,
+    ReconnectingWatchMixin,
     T,
     WritableDataSource,
     _log_warn,
@@ -59,13 +59,16 @@ def _parse_wait(raw: str) -> float:
     return float(m.group(1)) * scale
 
 
-class ConsulDataSource(AbstractDataSource[str, T]):
+class ConsulDataSource(ReconnectingWatchMixin, AbstractDataSource[str, T]):
     """Initial get + index-keyed blocking-query watch loop.
 
     ``wait`` is the blocking-query duration advertised to the server
     (Consul default 5m; tests shrink it). The HTTP read timeout stretches
     past it so only a dead agent — not a quiet key — trips reconnect.
     """
+
+    _watch_exceptions = (OSError, urllib.error.URLError, ValueError)
+    _watch_thread_name = "sentinel-consul-watch"
 
     def __init__(self, agent_addr: str, key: str, converter: Converter,
                  wait: str = "30s", token: Optional[str] = None,
@@ -79,12 +82,9 @@ class ConsulDataSource(AbstractDataSource[str, T]):
         # reconnect and silently never deliver updates).
         self._wait_s = _parse_wait(wait)
         self.token = token
-        self.backoff_min_ms, self.backoff_max_ms = reconnect_backoff_ms
         self._index = 0          # last X-Consul-Index seen
         self._applied = None     # raw content of the last APPLIED value
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.reconnect_count = 0  # ops visibility + test hook
+        self._init_watch(reconnect_backoff_ms)
 
     # -- ReadableDataSource ------------------------------------------------
 
@@ -123,19 +123,11 @@ class ConsulDataSource(AbstractDataSource[str, T]):
             self._apply(entry)
         except (OSError, urllib.error.URLError, ValueError) as ex:
             _log_warn("consul datasource initial load failed: %r", ex)
-        self._thread = threading.Thread(
-            target=self._watch_loop, name="sentinel-consul-watch",
-            daemon=True)
-        self._thread.start()
+        self._start_watching()
         return self
 
     def close(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            # May be parked in a blocking query; it is a daemon and its
-            # stop guard discards any post-close push.
-            self._thread.join(timeout=2.0)
-            self._thread = None
+        self._join_watch()
 
     # -- internals ---------------------------------------------------------
 
@@ -162,24 +154,13 @@ class ConsulDataSource(AbstractDataSource[str, T]):
             self._property.update_value(value)
             self._applied = content
 
-    def _watch_loop(self) -> None:
-        backoff_ms = self.backoff_min_ms
-        while not self._stop.is_set():
-            try:
-                entry, idx = self._get(blocking=True)
-                # Consul contract: a reset index (e.g. leader change /
-                # restarted fake) must restart the watch from scratch.
-                self._index = idx if idx >= self._index else 0
-                self._apply(entry)
-                backoff_ms = self.backoff_min_ms  # healthy round
-            except (OSError, urllib.error.URLError, ValueError) as ex:
-                if self._stop.is_set():
-                    break
-                self.reconnect_count += 1
-                _log_warn("consul watch lost (%r); retry in %dms",
-                          ex, backoff_ms)
-                self._stop.wait(backoff_ms / 1000.0)
-                backoff_ms = min(backoff_ms * 2, self.backoff_max_ms)
+    def _watch_round(self) -> None:
+        entry, idx = self._get(blocking=True)
+        # Consul contract: a reset index (e.g. leader change / restarted
+        # fake) must restart the watch from scratch.
+        self._index = idx if idx >= self._index else 0
+        self._apply(entry)
+        self._healthy()
 
 
 class ConsulWritableDataSource(WritableDataSource[T]):

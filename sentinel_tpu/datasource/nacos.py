@@ -30,7 +30,6 @@ line of the connector changes.
 from __future__ import annotations
 
 import hashlib
-import threading
 import time
 import urllib.error
 import urllib.parse
@@ -45,6 +44,7 @@ from sentinel_tpu.datasource._mini_http import (
 from sentinel_tpu.datasource.base import (
     AbstractDataSource,
     Converter,
+    ReconnectingWatchMixin,
     T,
     WritableDataSource,
     _log_warn,
@@ -58,7 +58,7 @@ def _md5_hex(content: str) -> str:
     return hashlib.md5(content.encode("utf-8")).hexdigest()
 
 
-class NacosDataSource(AbstractDataSource[str, T]):
+class NacosDataSource(ReconnectingWatchMixin, AbstractDataSource[str, T]):
     """Initial GET + md5 long-poll listener, with reconnect/backoff.
 
     ``poll_timeout_ms`` is the ``Long-Pulling-Timeout`` the listener
@@ -66,6 +66,9 @@ class NacosDataSource(AbstractDataSource[str, T]):
     timeout stretches past it so only a dead server — not a quiet one —
     trips the reconnect path.
     """
+
+    _watch_exceptions = (OSError, urllib.error.URLError, ValueError)
+    _watch_thread_name = "sentinel-nacos-listener"
 
     def __init__(self, server_addr: str, data_id: str, group: str,
                  converter: Converter, tenant: str = "",
@@ -75,11 +78,8 @@ class NacosDataSource(AbstractDataSource[str, T]):
         self.base = normalize_base(server_addr)
         self.data_id, self.group, self.tenant = data_id, group, tenant
         self.poll_timeout_ms = poll_timeout_ms
-        self.backoff_min_ms, self.backoff_max_ms = reconnect_backoff_ms
         self._md5 = ""          # md5 of the last RECEIVED content ("" = none)
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.reconnect_count = 0  # ops visibility + test hook
+        self._init_watch(reconnect_backoff_ms)
 
     # -- ReadableDataSource ------------------------------------------------
 
@@ -102,21 +102,11 @@ class NacosDataSource(AbstractDataSource[str, T]):
             self._apply(self.read_source())
         except (OSError, urllib.error.URLError) as ex:
             _log_warn("nacos datasource initial load failed: %r", ex)
-        self._thread = threading.Thread(
-            target=self._listen_loop, name="sentinel-nacos-listener",
-            daemon=True)
-        self._thread.start()
+        self._start_watching()
         return self
 
     def close(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            # The thread may be parked inside a long-poll whose server-side
-            # timeout exceeds the join budget; it is a daemon and its stop
-            # guard discards any post-close push, so an impatient join is
-            # safe.
-            self._thread.join(timeout=2.0)
-            self._thread = None
+        self._join_watch()
 
     # -- internals ---------------------------------------------------------
 
@@ -144,7 +134,7 @@ class NacosDataSource(AbstractDataSource[str, T]):
             fields.append(self.tenant)
         return WORD_SEP.join(fields) + LINE_SEP
 
-    def _poll_once(self) -> None:
+    def _watch_round(self) -> None:
         """One listener round: park until change/timeout, GET on change."""
         body = urllib.parse.urlencode(
             {"Listening-Configs": self._listening_entry()})
@@ -167,21 +157,7 @@ class NacosDataSource(AbstractDataSource[str, T]):
                 self._md5 = ""
             else:
                 self._apply(content)
-
-    def _listen_loop(self) -> None:
-        backoff_ms = self.backoff_min_ms
-        while not self._stop.is_set():
-            try:
-                self._poll_once()
-                backoff_ms = self.backoff_min_ms  # healthy round
-            except (OSError, urllib.error.URLError, ValueError) as ex:
-                if self._stop.is_set():
-                    break
-                self.reconnect_count += 1
-                _log_warn("nacos listener lost (%r); retry in %dms",
-                          ex, backoff_ms)
-                self._stop.wait(backoff_ms / 1000.0)
-                backoff_ms = min(backoff_ms * 2, self.backoff_max_ms)
+        self._healthy()  # a completed round proves the server is up
 
 
 class NacosWritableDataSource(WritableDataSource[T]):

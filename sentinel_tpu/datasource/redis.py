@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from sentinel_tpu.datasource.base import (
     AbstractDataSource,
     Converter,
+    ReconnectingWatchMixin,
     T,
     WritableDataSource,
     _log_warn,
@@ -128,7 +129,7 @@ class RespConnection:
             pass
 
 
-class RedisDataSource(AbstractDataSource[bytes, T]):
+class RedisDataSource(ReconnectingWatchMixin, AbstractDataSource[bytes, T]):
     """Initial GET + SUBSCRIBE pushes, with reconnect and catch-up.
 
     The subscriber connection GETs the rule key immediately before
@@ -138,6 +139,13 @@ class RedisDataSource(AbstractDataSource[bytes, T]):
     free. Bad payloads keep the last good rules (converter errors are
     logged, never pushed)."""
 
+    # ValueError/IndexError/UnicodeDecodeError: a corrupt or desynced
+    # RESP frame from the parser — the connection is unusable but the
+    # CONNECTOR must survive and reconnect.
+    _watch_exceptions = (OSError, ConnectionError, RespError, ValueError,
+                         IndexError, UnicodeDecodeError)
+    _watch_thread_name = "sentinel-redis-subscriber"
+
     def __init__(self, host: str, port: int, rule_key: str, channel: str,
                  converter: Converter, password: Optional[str] = None,
                  reconnect_backoff_ms: Tuple[int, int] = (50, 2000)):
@@ -145,11 +153,8 @@ class RedisDataSource(AbstractDataSource[bytes, T]):
         self.host, self.port = host, port
         self.rule_key, self.channel = rule_key, channel
         self.password = password
-        self.backoff_min_ms, self.backoff_max_ms = reconnect_backoff_ms
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._active: Optional[RespConnection] = None
-        self.reconnect_count = 0  # ops visibility + test hook
+        self._init_watch(reconnect_backoff_ms)
 
     # -- ReadableDataSource ------------------------------------------------
 
@@ -165,14 +170,13 @@ class RedisDataSource(AbstractDataSource[bytes, T]):
             self._push_raw(self.read_source())
         except (OSError, RespError) as ex:
             _log_warn("redis datasource initial load failed: %r", ex)
-        self._thread = threading.Thread(
-            target=self._subscribe_loop, name="sentinel-redis-subscriber",
-            daemon=True)
-        self._thread.start()
+        self._start_watching()
         return self
 
     def close(self) -> None:
-        self._stop.set()
+        self._join_watch()
+
+    def _interrupt_watch(self) -> None:
         active = self._active
         if active is not None:
             # shutdown() wakes the subscriber thread out of its blocking
@@ -181,9 +185,6 @@ class RedisDataSource(AbstractDataSource[bytes, T]):
                 active.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None
 
     # -- internals ---------------------------------------------------------
 
@@ -202,46 +203,33 @@ class RedisDataSource(AbstractDataSource[bytes, T]):
         if value is not None:
             self._property.update_value(value)
 
-    def _subscribe_loop(self) -> None:
-        backoff_ms = self.backoff_min_ms
-        while not self._stop.is_set():
-            conn = None
-            try:
-                conn = RespConnection(self.host, self.port, self.password,
-                                      timeout_s=None)
-                self._active = conn
-                sub = conn.command("SUBSCRIBE", self.channel)
-                if not (isinstance(sub, list) and sub
-                        and sub[0] == b"subscribe"):
-                    raise RespError(f"unexpected SUBSCRIBE reply {sub!r}")
-                # catch-up AFTER subscribe (on a command connection — a
-                # subscribed conn can't GET): an update missed while down
-                # is recovered here, and one racing this instant arrives
-                # as a message too. GET-then-subscribe would have a lossy
-                # gap between the two; this order has none.
-                self._push_raw(self.read_source())
-                backoff_ms = self.backoff_min_ms  # healthy again
-                while not self._stop.is_set():
-                    msg = conn.reader.read_reply()
-                    if (isinstance(msg, list) and len(msg) == 3
-                            and msg[0] == b"message"):
-                        self._push_raw(msg[2])
-            except (OSError, ConnectionError, RespError, ValueError,
-                    IndexError, UnicodeDecodeError) as ex:
-                # ValueError/IndexError/UnicodeDecodeError: a corrupt or
-                # desynced RESP frame from the parser — the connection is
-                # unusable but the CONNECTOR must survive and reconnect
-                if self._stop.is_set():
-                    break
-                self.reconnect_count += 1
-                _log_warn("redis subscriber lost (%r); reconnect in %dms",
-                          ex, backoff_ms)
-                self._stop.wait(backoff_ms / 1000.0)
-                backoff_ms = min(backoff_ms * 2, self.backoff_max_ms)
-            finally:
-                self._active = None
-                if conn is not None:
-                    conn.close()
+    def _watch_round(self) -> None:
+        """One connect → subscribe → catch-up → read-until-error cycle."""
+        conn = None
+        try:
+            conn = RespConnection(self.host, self.port, self.password,
+                                  timeout_s=None)
+            self._active = conn
+            sub = conn.command("SUBSCRIBE", self.channel)
+            if not (isinstance(sub, list) and sub
+                    and sub[0] == b"subscribe"):
+                raise RespError(f"unexpected SUBSCRIBE reply {sub!r}")
+            # catch-up AFTER subscribe (on a command connection — a
+            # subscribed conn can't GET): an update missed while down
+            # is recovered here, and one racing this instant arrives
+            # as a message too. GET-then-subscribe would have a lossy
+            # gap between the two; this order has none.
+            self._push_raw(self.read_source())
+            self._healthy()
+            while not self._stop.is_set():
+                msg = conn.reader.read_reply()
+                if (isinstance(msg, list) and len(msg) == 3
+                        and msg[0] == b"message"):
+                    self._push_raw(msg[2])
+        finally:
+            self._active = None
+            if conn is not None:
+                conn.close()
 
 
 class RedisWritableDataSource(WritableDataSource[T]):

@@ -198,7 +198,10 @@ def test_nacos_bind_to_engine(nacos):
                 except st.BlockException:
                     return True
 
-            assert _wait_for(blocked)
+            # Generous bound: the fresh engine's first entry() compiles
+            # (tens of seconds on a contended 1-core box); _wait_for
+            # returns the moment the push is enforced.
+            assert _wait_for(blocked, timeout_s=90.0)
         finally:
             src.close()
     finally:

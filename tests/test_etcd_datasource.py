@@ -1,0 +1,189 @@
+"""etcd v3 gRPC datasource tests (SURVEY.md §2.2:
+``sentinel-datasource-etcd``): the real etcd3 wire protocol (runtime-
+built ``etcdserverpb``/``mvccpb`` messages over grpcio) — initial Range,
+Watch-stream pushes, revision-replay recovery across a server restart,
+writable Put, and bad-payload resilience.
+"""
+
+import json
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import bind
+from sentinel_tpu.datasource.converters import (
+    flow_rules_from_json,
+    flow_rules_to_json,
+)
+from sentinel_tpu.datasource.etcd import (
+    EtcdDataSource,
+    EtcdWritableDataSource,
+    MiniEtcdServer,
+)
+
+
+def _wait_for(pred, timeout_s: float = 5.0) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _rules_json(*resources, count=5.0) -> str:
+    return json.dumps([{"resource": r, "count": count} for r in resources])
+
+
+def _resources(prop):
+    return {r.resource for r in (prop.value or [])}
+
+
+@pytest.fixture()
+def etcd():
+    s = MiniEtcdServer().start()
+    yield s
+    s.stop()
+
+
+def _source(server, **kw) -> EtcdDataSource:
+    kw.setdefault("reconnect_backoff_ms", (20, 100))
+    return EtcdDataSource(server.endpoint, "/sentinel/flow-rules",
+                          flow_rules_from_json, **kw)
+
+
+def test_etcd_initial_load_and_watch_push(etcd):
+    etcd.put("/sentinel/flow-rules", _rules_json("api:a"))
+    src = _source(etcd).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"api:a"})
+        etcd.put("/sentinel/flow-rules", _rules_json("api:a", "api:b"))
+        assert _wait_for(
+            lambda: _resources(src.property) == {"api:a", "api:b"})
+    finally:
+        src.close()
+
+
+def test_etcd_absent_key_then_first_put(etcd):
+    src = _source(etcd).start()
+    try:
+        assert src.property.value is None
+        etcd.put("/sentinel/flow-rules", _rules_json("late"))
+        assert _wait_for(lambda: _resources(src.property) == {"late"})
+    finally:
+        src.close()
+
+
+def test_etcd_writable_put_roundtrip(etcd):
+    writer = EtcdWritableDataSource(etcd.endpoint, "/sentinel/flow-rules",
+                                    flow_rules_to_json)
+    src = _source(etcd).start()
+    try:
+        writer.write([st.FlowRule(resource="via-writer", count=9.0)])
+        assert _wait_for(lambda: _resources(src.property) == {"via-writer"})
+    finally:
+        src.close()
+
+
+def test_etcd_bad_payload_keeps_last_good(etcd):
+    etcd.put("/sentinel/flow-rules", _rules_json("good"))
+    src = _source(etcd).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"good"})
+        etcd.put("/sentinel/flow-rules", "{not json]")
+        time.sleep(0.3)
+        assert _resources(src.property) == {"good"}
+        etcd.put("/sentinel/flow-rules", _rules_json("recovered"))
+        assert _wait_for(lambda: _resources(src.property) == {"recovered"})
+    finally:
+        src.close()
+
+
+def test_etcd_reconnect_replays_update_missed_during_outage(etcd):
+    etcd.put("/sentinel/flow-rules", _rules_json("v1"))
+    src = _source(etcd).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"v1"})
+        etcd.stop()
+        assert _wait_for(lambda: src.reconnect_count > 0)
+        # Put lands in the (surviving) store while the server is down...
+        with etcd._lock:
+            etcd._revision += 1
+            etcd._kv[b"/sentinel/flow-rules"] = (
+                _rules_json("v2").encode("utf-8"), 1, etcd._revision, 2)
+        etcd.start()
+        # ...and the reconnected watch's start_revision triggers replay.
+        assert _wait_for(lambda: _resources(src.property) == {"v2"},
+                         timeout_s=8.0)
+    finally:
+        src.close()
+
+
+def test_etcd_watch_is_event_driven_not_polled(etcd):
+    etcd.put("/sentinel/flow-rules", _rules_json("idle"))
+    src = _source(etcd).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"idle"})
+        assert _wait_for(lambda: etcd.watch_count >= 1)
+        watches_before = etcd.watch_count
+        time.sleep(0.5)
+        # One long-lived stream, not a reconnect-per-poll loop.
+        assert etcd.watch_count == watches_before
+        assert src.reconnect_count == 0
+    finally:
+        src.close()
+
+
+def test_etcd_bind_to_engine(etcd):
+    eng = st.reset(capacity=64)
+    try:
+        src = _source(etcd).start()
+        bind(src, st.load_flow_rules)
+        etcd.put("/sentinel/flow-rules", _rules_json("bound", count=0.0))
+        try:
+            def blocked():
+                try:
+                    with st.entry("bound"):
+                        pass
+                    return False
+                except st.BlockException:
+                    return True
+
+            # Generous bound: the fresh engine's first entry() compiles
+            # (tens of seconds on a contended 1-core box); _wait_for
+            # returns the moment the push is enforced.
+            assert _wait_for(blocked, timeout_s=90.0)
+        finally:
+            src.close()
+    finally:
+        eng.close()
+
+
+def test_etcd_wire_messages_roundtrip():
+    """The runtime-built messages serialize/parse like real etcd3 ones."""
+    from sentinel_tpu.datasource.etcd import (
+        KeyValue, PutRequest, RangeResponse, WatchRequest, WatchResponse)
+
+    kv = KeyValue(key=b"k", value=b"v", mod_revision=7, version=2)
+    data = kv.SerializeToString()
+    back = KeyValue.FromString(data)
+    assert back.key == b"k" and back.mod_revision == 7
+
+    wr = WatchRequest()
+    wr.create_request.key = b"/sentinel/flow-rules"
+    wr.create_request.start_revision = 42
+    parsed = WatchRequest.FromString(wr.SerializeToString())
+    assert parsed.HasField("create_request")
+    assert parsed.create_request.start_revision == 42
+
+    resp = WatchResponse()
+    resp.header.revision = 9
+    ev = resp.events.add()
+    ev.kv.key = b"k"
+    ev.kv.value = b"v2"
+    parsed2 = WatchResponse.FromString(resp.SerializeToString())
+    assert parsed2.events[0].kv.value == b"v2"
+
+    assert PutRequest(key=b"a", value=b"b").SerializeToString()
+    assert RangeResponse.FromString(b"") is not None
