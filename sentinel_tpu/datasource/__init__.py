@@ -16,9 +16,9 @@ in-repo fake server):
 - **HTTP long-poll push** (Nacos; Apollo's notifications/v2 is the same
   shape) → ``nacos.py`` (real Nacos 1.x open-api), ``consul.py`` (real
   Consul KV blocking queries).
-- **socket push-subscription** (Redis pub/sub; ZooKeeper watches follow
-  the same subscribe+catch-up discipline over their own framing) →
-  ``redis.py`` (real RESP2), ``etcd.py`` (real etcd3 gRPC Watch).
+- **socket push-subscription** (Redis pub/sub, ZooKeeper watches) →
+  ``redis.py`` (real RESP2), ``etcd.py`` (real etcd3 gRPC Watch),
+  ``zookeeper.py`` (real jute frames with one-shot watch re-arm).
 
 ``push.py`` additionally proves the bare push/poll property shapes against
 an in-process broker for tests that want no sockets at all.
@@ -60,6 +60,11 @@ from sentinel_tpu.datasource.consul import (
     ConsulWritableDataSource,
     MiniConsulServer,
 )
+from sentinel_tpu.datasource.zookeeper import (
+    MiniZooKeeperServer,
+    ZookeeperDataSource,
+    ZookeeperWritableDataSource,
+)
 try:
     # The etcd connector needs the protobuf runtime (its etcd3 messages
     # are descriptor-built at import); every other datasource is stdlib-
@@ -93,6 +98,8 @@ __all__ = [
     "MiniRedisServer", "RedisDataSource", "RedisWritableDataSource",
     "MiniNacosServer", "NacosDataSource", "NacosWritableDataSource",
     "ConsulDataSource", "ConsulWritableDataSource", "MiniConsulServer",
+    "MiniZooKeeperServer", "ZookeeperDataSource",
+    "ZookeeperWritableDataSource",
     "EtcdDataSource", "EtcdWritableDataSource", "MiniEtcdServer",
     "ReadableDataSource", "WritableDataSource", "bind",
     "authority_rules_from_json", "authority_rules_to_json",
