@@ -221,6 +221,16 @@ class SentinelEngine:
         self._committer = None
         self._closed = False
         self._lock = threading.RLock()
+        # Config-plane lock: serializes rule pushes / geometry retunes /
+        # close against EACH OTHER without making them wait on the device
+        # dispatch path, which holds ``_lock`` for the full XLA call —
+        # including first-dispatch compiles (seconds on CPU, 20-40s on
+        # TPU). Before the split, a rule push racing a cold compile
+        # appeared to "not take": the manager had the new rules while the
+        # lease table served the old thresholds until the compile
+        # finished. Lock ORDER is config -> engine; never acquire
+        # ``_config_lock`` while holding ``_lock``.
+        self._config_lock = threading.RLock()
         self._state: Optional[S.SentinelState] = None
         self._rules: Optional[S.RulePack] = None
         self._named_origins: Dict[str, set] = {}
@@ -265,6 +275,10 @@ class SentinelEngine:
         their usage)."""
         from sentinel_tpu.core.lease import build_lease_table
 
+        if self._closed:
+            # close() swapped in the empty fast path; a straggler push
+            # must not resurrect lease admission on a closed engine.
+            return
         old = self._leases
         if self.lease_enabled:
             new, guarded, unruled_ok = build_lease_table(self)
@@ -388,7 +402,11 @@ class SentinelEngine:
     # -- rule compilation --------------------------------------------------
 
     def _mark_dirty(self, family: str):
-        with self._lock:
+        # Config lock, NOT the engine lock: the dirty flag hand-off is a
+        # GIL-atomic dict write (_ensure_compiled reads it under the
+        # engine lock on next dispatch), and the lease rebuild must not
+        # queue behind an in-flight dispatch's compile (see _config_lock).
+        with self._config_lock:
             self._dirty[family] = True
             self._rebuild_leases()
 
@@ -396,7 +414,7 @@ class SentinelEngine:
         """Flow/param loads also rebuild the host-side cluster-rule maps
         eagerly (cheap scans), so the entry() fast path can consult them
         lock-free: the dicts are replaced wholesale, never mutated."""
-        with self._lock:
+        with self._config_lock:
             self._dirty[family] = True
             self._rebuild_leases()
             if family == "flow":
@@ -420,7 +438,16 @@ class SentinelEngine:
         """
         if self._spi_version != self._spi.device_version():
             self._rebuild_entry_jit()  # SPI device checker set changed
+        # Dirty flags are cleared BEFORE the corresponding get_rules()
+        # read, and the dict object is never rebound: rule pushes set the
+        # flag on the config plane WITHOUT the engine lock (_mark_dirty),
+        # so clear-after-read would lose a push landing mid-compile (the
+        # dispatcher would clear a flag it never compiled for, and the
+        # device tensors would enforce stale rules until an unrelated
+        # later push). Clear-first at worst costs one redundant recompile.
         if self._state is None:
+            for k in self._dirty:
+                self._dirty[k] = False
             now = time_util.current_time_millis()
             ft, named = F.compile_flow_rules(
                 self.flow_rules.get_rules(), self.registry, self.capacity)
@@ -440,41 +467,40 @@ class SentinelEngine:
                                        degrade=D.make_degrade_state(dt, di),
                                        param=P.make_param_state(pt.num_rules),
                                        spec1=self._spec1)
-            self._dirty = {k: False for k in self._dirty}
             self._maybe_start_system_listener()
             return
         if not any(self._dirty.values()):
             return
         now = time_util.current_time_millis()
         if self._dirty["flow"]:
+            self._dirty["flow"] = False
             ft, named = F.compile_flow_rules(
                 self.flow_rules.get_rules(), self.registry, self.capacity)
             self._named_origins = {r: set(o) for r, o in named.items()}
             self._rules = self._rules._replace(flow=ft)
             self._state = self._state._replace(flow=F.make_flow_state(ft.num_rules, now))
-            self._dirty["flow"] = False
         if self._dirty["degrade"]:
+            self._dirty["degrade"] = False
             dt, di = D.compile_degrade_rules(
                 self.degrade_rules.get_rules(), self.registry, self.capacity)
             self._rules = self._rules._replace(degrade=dt)
             self._state = self._state._replace(degrade=D.make_degrade_state(dt, di))
-            self._dirty["degrade"] = False
         if self._dirty["authority"]:
+            self._dirty["authority"] = False
             self._rules = self._rules._replace(
                 authority=A.compile_authority_rules(
                     self.authority_rules.get_rules(), self.registry, self.capacity))
-            self._dirty["authority"] = False
         if self._dirty["system"]:
+            self._dirty["system"] = False
             self._rules = self._rules._replace(
                 system=Y.compile_system_rules(self.system_rules.get_rules()))
-            self._dirty["system"] = False
             self._maybe_start_system_listener()
         if self._dirty["param"]:
+            self._dirty["param"] = False
             pt = P.compile_param_rules(
                 self.param_rules.get_rules(), self.registry, self.capacity)
             self._rules = self._rules._replace(param=pt)
             self._state = self._state._replace(param=P.make_param_state(pt.num_rules))
-            self._dirty["param"] = False
 
     def _maybe_start_system_listener(self):
         def is_set(v):
@@ -492,10 +518,12 @@ class SentinelEngine:
 
         XLA specializes per (batch width, rule-tensor shape); the first
         dispatch of each pair pays a compile (seconds on CPU, 20-40s on
-        TPU) while holding the engine lock — so first traffic, AND any
-        rule push racing it, stalls behind the compiler. Production boot
-        sequence: load initial rules, then ``warmup()``, then serve.
-        No-op batches (all rows -1) commit nothing."""
+        TPU) while holding the engine lock — so first DEVICE-PATH traffic
+        stalls behind the compiler. (Rule pushes do not: they run on the
+        config lock and only wait when seeding a newly-eligible resource
+        from the device window.) Production boot sequence: load initial
+        rules, then ``warmup()``, then serve. No-op batches (all rows -1)
+        commit nothing."""
         for width in (widths if widths is not None else BATCH_WIDTHS):
             ebuf = make_entry_batch_np(int(width))  # all rows -1: no-op
             self._run_entry_batch(
@@ -536,7 +564,7 @@ class SentinelEngine:
         # the fresh lease mirrors inherit pre-retune usage. (Must happen
         # outside self._lock — the flush dispatch takes it.)
         self._flush_committer()
-        with self._lock:
+        with self._config_lock, self._lock:
             cur = self._spec1
             interval_ms = cur.interval_ms if interval_ms is None else int(interval_ms)
             sample_count = cur.buckets if sample_count is None else int(sample_count)
@@ -584,7 +612,7 @@ class SentinelEngine:
         # stop that one below) or sees _closed and commits inline; stop()
         # runs OUTSIDE the lock — the background flush takes the engine
         # lock, and joining it while holding that lock would deadlock.
-        with self._lock:
+        with self._config_lock, self._lock:
             self._closed = True
             self._fastpath = _FastPathState({}, frozenset(), False)
             committer, self._committer = self._committer, None
@@ -650,20 +678,19 @@ class SentinelEngine:
                                entry_type == C.EntryType.IN, count, ())
 
         reg = self.registry
-        cluster_row = reg.cluster_row(resource, int(entry_type))
         if ctx.entrance_row < 0:
             ctx.entrance_row = reg.entrance_row(ctx.name)
         parent = ctx.cur_entry.dn_row if ctx.cur_entry else ctx.entrance_row
-        dn_row = reg.default_row(ctx.name, resource, parent)
-        origin_row = reg.origin_row(resource, ctx.origin)
-        origin_id = reg.origin_id(ctx.origin)
+        cluster_row, dn_row, origin_row, origin_id = reg.resolve_entry(
+            resource, ctx.name, ctx.origin, parent, int(entry_type))
         entry_in = entry_type == C.EntryType.IN
 
         if cluster_row < 0:
             # Registry full: pass-through, like the reference's chain cap.
             return EntryHandle(self, resource, ctx, -1, -1, -1, entry_in, count, ())
 
-        params = tuple(_hash_param(a) for a in args[:MAX_PARAMS])
+        params = tuple(_hash_param(a) for a in args[:MAX_PARAMS]) \
+            if args else ()
 
         # SPI host slots (core/spi.py): a slot raising a BlockException
         # rejects the entry; the block is committed to statistics first

@@ -13,9 +13,9 @@ in-repo fake server):
 - **file mtime poll** (`FileRefreshableDataSource`) → ``base.py`` (exact).
 - **HTTP poll / conditional GET** (Eureka, Spring-Cloud-Config) →
   ``http.py``.
-- **HTTP long-poll push** (Nacos; Apollo's notifications/v2 is the same
-  shape) → ``nacos.py`` (real Nacos 1.x open-api), ``consul.py`` (real
-  Consul KV blocking queries).
+- **HTTP long-poll push** → ``nacos.py`` (real Nacos 1.x open-api),
+  ``consul.py`` (real Consul KV blocking queries), ``apollo.py`` (real
+  notifications/v2 + releaseKey echo + open-api item/release publisher).
 - **socket push-subscription** (Redis pub/sub, ZooKeeper watches) →
   ``redis.py`` (real RESP2), ``etcd.py`` (real etcd3 gRPC Watch),
   ``zookeeper.py`` (real jute frames with one-shot watch re-arm).
@@ -60,6 +60,11 @@ from sentinel_tpu.datasource.consul import (
     ConsulWritableDataSource,
     MiniConsulServer,
 )
+from sentinel_tpu.datasource.apollo import (
+    ApolloDataSource,
+    ApolloWritableDataSource,
+    MiniApolloServer,
+)
 from sentinel_tpu.datasource.zookeeper import (
     MiniZooKeeperServer,
     ZookeeperDataSource,
@@ -100,6 +105,7 @@ __all__ = [
     "ConsulDataSource", "ConsulWritableDataSource", "MiniConsulServer",
     "MiniZooKeeperServer", "ZookeeperDataSource",
     "ZookeeperWritableDataSource",
+    "ApolloDataSource", "ApolloWritableDataSource", "MiniApolloServer",
     "EtcdDataSource", "EtcdWritableDataSource", "MiniEtcdServer",
     "ReadableDataSource", "WritableDataSource", "bind",
     "authority_rules_from_json", "authority_rules_to_json",

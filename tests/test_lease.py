@@ -351,3 +351,44 @@ def test_warmup_precompiles_ladder_widths(engine, frozen_time):
     push_s = _time.perf_counter() - t0
     assert engine._leases["wu"].thresholds == [20.0]
     assert push_s < 2.0, f"rule push stalled {push_s:.1f}s behind a compile"
+
+
+def test_rule_push_does_not_wait_on_device_dispatch(engine, frozen_time):
+    """Config-plane/device-plane lock split: a rule push must retune the
+    lease table even while the engine lock is held for a long device
+    dispatch (first-dispatch XLA compiles hold it for seconds on CPU,
+    20-40s on TPU; before the split, pushes stalled behind them and the
+    old thresholds kept being enforced)."""
+    import threading
+    import time as _time
+
+    st.load_flow_rules([st.FlowRule(resource="r", count=3)])
+    assert engine._leases["r"].thresholds == [3.0]
+
+    hold = threading.Event()
+    release = threading.Event()
+
+    def dispatcher():
+        with engine._lock:  # stands in for a compile-length dispatch
+            hold.set()
+            release.wait(timeout=10.0)
+
+    t = threading.Thread(target=dispatcher, daemon=True)
+    t.start()
+    assert hold.wait(timeout=5.0)
+    try:
+        done = threading.Event()
+
+        def pusher():
+            st.load_flow_rules([st.FlowRule(resource="r", count=1000)])
+            done.set()
+
+        threading.Thread(target=pusher, daemon=True).start()
+        # The push completes while the device lock is STILL held...
+        assert done.wait(timeout=2.0), \
+            "rule push blocked behind the device dispatch lock"
+        # ...and the lease table already serves the new threshold.
+        assert engine._leases["r"].thresholds == [1000.0]
+    finally:
+        release.set()
+        t.join(timeout=5.0)
