@@ -11,8 +11,10 @@ four wire shapes, each implemented here against a real protocol with an
 in-repo fake server):
 
 - **file mtime poll** (`FileRefreshableDataSource`) → ``base.py`` (exact).
-- **HTTP poll / conditional GET** (Eureka, Spring-Cloud-Config) →
-  ``http.py``.
+- **HTTP poll / conditional GET** → ``http.py`` (generic endpoint),
+  ``eureka.py`` (real Eureka instance-metadata REST with sticky URL
+  failover), ``spring_config.py`` (real Config-Server environment
+  endpoint with Spring source precedence).
 - **HTTP long-poll push** → ``nacos.py`` (real Nacos 1.x open-api),
   ``consul.py`` (real Consul KV blocking queries), ``apollo.py`` (real
   notifications/v2 + releaseKey echo + open-api item/release publisher).
@@ -44,6 +46,15 @@ from sentinel_tpu.datasource.push import (
 from sentinel_tpu.datasource.http import (
     HttpRefreshableDataSource,
     MiniConfigHTTPServer,
+)
+from sentinel_tpu.datasource.eureka import (
+    EurekaDataSource,
+    EurekaWritableDataSource,
+    MiniEurekaServer,
+)
+from sentinel_tpu.datasource.spring_config import (
+    MiniSpringConfigServer,
+    SpringCloudConfigDataSource,
 )
 from sentinel_tpu.datasource.redis import (
     MiniRedisServer,
@@ -100,6 +111,8 @@ __all__ = [
     "PollingKVDataSource", "PushDataSource",
     "FileRefreshableDataSource", "FileWritableDataSource",
     "HttpRefreshableDataSource", "MiniConfigHTTPServer",
+    "EurekaDataSource", "EurekaWritableDataSource", "MiniEurekaServer",
+    "MiniSpringConfigServer", "SpringCloudConfigDataSource",
     "MiniRedisServer", "RedisDataSource", "RedisWritableDataSource",
     "MiniNacosServer", "NacosDataSource", "NacosWritableDataSource",
     "ConsulDataSource", "ConsulWritableDataSource", "MiniConsulServer",

@@ -1,0 +1,320 @@
+"""Eureka + Spring Cloud Config connector tests (SURVEY.md §2.2:
+``sentinel-datasource-eureka`` / ``sentinel-datasource-spring-cloud-config``):
+real REST payloads over real sockets — initial load, metadata/property
+update pushes, sticky URL failover (Eureka), Spring source precedence,
+basic auth, bad-payload resilience, and reconnect across a server
+restart.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sentinel_tpu.datasource.converters import (
+    flow_rules_from_json,
+    flow_rules_to_json,
+)
+from sentinel_tpu.datasource.eureka import (
+    EurekaDataSource,
+    EurekaWritableDataSource,
+    MiniEurekaServer,
+)
+from sentinel_tpu.datasource.spring_config import (
+    MiniSpringConfigServer,
+    SpringCloudConfigDataSource,
+)
+
+
+def _wait_for(pred, timeout_s: float = 5.0) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _rules_json(*resources, count=5.0) -> str:
+    return json.dumps([{"resource": r, "count": count} for r in resources])
+
+
+def _resources(prop):
+    return {r.resource for r in (prop.value or [])}
+
+
+RULE_KEY = "sentinel.flow.rules"
+
+
+# -- Eureka -------------------------------------------------------------------
+
+
+@pytest.fixture()
+def eureka():
+    s = MiniEurekaServer().start()
+    s.register("demo-app", "i-1", {RULE_KEY: _rules_json("resA")})
+    yield s
+    s.stop()
+
+
+def _eureka_source(server, **kw) -> EurekaDataSource:
+    kw.setdefault("recommend_refresh_ms", 40)
+    return EurekaDataSource([server.service_url], "demo-app", "i-1",
+                            RULE_KEY, flow_rules_from_json, **kw)
+
+
+def test_eureka_initial_load_and_poll_push(eureka):
+    src = _eureka_source(eureka).start()
+    try:
+        assert _resources(src.property) == {"resA"}
+        eureka.set_metadata("demo-app", "i-1", RULE_KEY,
+                            _rules_json("resA", "resB"))
+        assert _wait_for(lambda: _resources(src.property) == {"resA", "resB"})
+    finally:
+        src.close()
+
+
+def test_eureka_unregistered_instance_then_first_registration(eureka):
+    src = EurekaDataSource([eureka.service_url], "demo-app", "i-ghost",
+                           RULE_KEY, flow_rules_from_json,
+                           recommend_refresh_ms=40).start()
+    try:
+        assert src.property.value is None
+        eureka.register("demo-app", "i-ghost",
+                        {RULE_KEY: _rules_json("late")})
+        assert _wait_for(lambda: _resources(src.property) == {"late"})
+    finally:
+        src.close()
+
+
+def test_eureka_missing_key_and_bad_payload_keep_last_good(eureka):
+    src = _eureka_source(eureka).start()
+    try:
+        assert _resources(src.property) == {"resA"}
+        # Key removed entirely → keep last good rules.
+        eureka.register("demo-app", "i-1", {"other": "x"})
+        time.sleep(0.2)
+        assert _resources(src.property) == {"resA"}
+        # Corrupt document → keep last good rules.
+        eureka.set_metadata("demo-app", "i-1", RULE_KEY, "{nope")
+        time.sleep(0.2)
+        assert _resources(src.property) == {"resA"}
+        # Recovery.
+        eureka.set_metadata("demo-app", "i-1", RULE_KEY, _rules_json("resC"))
+        assert _wait_for(lambda: _resources(src.property) == {"resC"})
+    finally:
+        src.close()
+
+
+def test_eureka_unchanged_metadata_pushes_nothing(eureka):
+    src = _eureka_source(eureka).start()
+    try:
+        before = src.property.value
+        polls_before = eureka.request_count
+        time.sleep(0.3)  # many polls, same content
+        assert eureka.request_count > polls_before  # the loop IS polling
+        assert src.property.value is before         # …but pushed nothing
+    finally:
+        src.close()
+
+
+def test_eureka_sticky_failover_between_replicas():
+    dead = MiniEurekaServer().start()
+    live = MiniEurekaServer().start()
+    live.register("demo-app", "i-1", {RULE_KEY: _rules_json("resF")})
+    dead_url = dead.service_url
+    dead.stop()  # replica 1 is down from the start
+    src = EurekaDataSource([dead_url, live.service_url], "demo-app", "i-1",
+                           RULE_KEY, flow_rules_from_json,
+                           recommend_refresh_ms=40, timeout_s=1.0).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"resF"})
+        assert src.failover_count >= 1
+        time.sleep(0.2)
+        # Sticky: once failed over, later polls stay on the live replica.
+        assert src._url_idx == 1
+    finally:
+        src.close()
+        live.stop()
+
+
+def test_eureka_reconnect_after_server_restart(eureka):
+    src = _eureka_source(eureka).start()
+    try:
+        assert _resources(src.property) == {"resA"}
+        eureka.stop()
+        time.sleep(0.15)  # polls fail; loop must survive
+        eureka.set_metadata("demo-app", "i-1", RULE_KEY, _rules_json("resR"))
+        eureka.start()
+        assert _wait_for(lambda: _resources(src.property) == {"resR"})
+    finally:
+        src.close()
+
+
+def test_eureka_writable_publish_roundtrip(eureka):
+    from sentinel_tpu.models.flow import FlowRule
+
+    writer = EurekaWritableDataSource(eureka.service_url, "demo-app", "i-1",
+                                      RULE_KEY, flow_rules_to_json)
+    writer.write([FlowRule(resource="pushed", count=7.0)])
+    assert "pushed" in eureka.metadata("demo-app", "i-1")[RULE_KEY]
+
+    src = _eureka_source(eureka).start()
+    try:
+        assert _wait_for(lambda: _resources(src.property) == {"pushed"})
+    finally:
+        src.close()
+
+
+def test_eureka_raw_http_shape(eureka):
+    req = urllib.request.Request(
+        eureka.service_url + "/apps/DEMO-APP/i-1",
+        headers={"Accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=2.0) as resp:
+        doc = json.loads(resp.read().decode("utf-8"))
+    inst = doc["instance"]
+    assert inst["app"] == "DEMO-APP" and inst["status"] == "UP"
+    assert RULE_KEY in inst["metadata"]
+
+
+# -- Spring Cloud Config ------------------------------------------------------
+
+
+@pytest.fixture()
+def config_server():
+    s = MiniSpringConfigServer().start()
+    s.set_property("demo-app", RULE_KEY, _rules_json("resA"))
+    yield s
+    s.stop()
+
+
+def _scc_source(server, **kw) -> SpringCloudConfigDataSource:
+    kw.setdefault("recommend_refresh_ms", 40)
+    return SpringCloudConfigDataSource(server.addr, "demo-app", RULE_KEY,
+                                       flow_rules_from_json, **kw)
+
+
+def test_scc_initial_load_and_poll_push(config_server):
+    src = _scc_source(config_server).start()
+    try:
+        assert _resources(src.property) == {"resA"}
+        config_server.set_property("demo-app", RULE_KEY,
+                                   _rules_json("resA", "resB"))
+        assert _wait_for(lambda: _resources(src.property) == {"resA", "resB"})
+        assert src._version == config_server.version
+    finally:
+        src.close()
+
+
+def test_scc_profile_source_beats_default(config_server):
+    config_server.set_property("demo-app", RULE_KEY, _rules_json("prod-only"),
+                               profile="prod")
+    src = SpringCloudConfigDataSource(
+        config_server.addr, "demo-app", RULE_KEY, flow_rules_from_json,
+        profile="prod", recommend_refresh_ms=40).start()
+    try:
+        # app-prod.yml wins over app.yml for the prod profile...
+        assert _resources(src.property) == {"prod-only"}
+    finally:
+        src.close()
+    # ...while other profiles still see the default source.
+    src2 = _scc_source(config_server, profile="dev").start()
+    try:
+        assert _resources(src2.property) == {"resA"}
+    finally:
+        src2.close()
+
+
+def test_scc_deleting_profile_override_falls_back(config_server):
+    config_server.set_property("demo-app", RULE_KEY, _rules_json("override"),
+                               profile="prod")
+    src = SpringCloudConfigDataSource(
+        config_server.addr, "demo-app", RULE_KEY, flow_rules_from_json,
+        profile="prod", recommend_refresh_ms=40).start()
+    try:
+        assert _resources(src.property) == {"override"}
+        config_server.delete_property("demo-app", RULE_KEY, profile="prod")
+        assert _wait_for(lambda: _resources(src.property) == {"resA"})
+    finally:
+        src.close()
+
+
+def test_scc_basic_auth(config_server):
+    auth_server = MiniSpringConfigServer(auth=("cfg", "secret")).start()
+    auth_server.set_property("demo-app", RULE_KEY, _rules_json("authd"))
+    try:
+        # Wrong/missing credentials → 401 at the wire.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(auth_server.addr + "/demo-app/default",
+                                   timeout=2.0)
+        assert ei.value.code == 401
+        src = SpringCloudConfigDataSource(
+            auth_server.addr, "demo-app", RULE_KEY, flow_rules_from_json,
+            auth=("cfg", "secret"), recommend_refresh_ms=40).start()
+        try:
+            assert _resources(src.property) == {"authd"}
+        finally:
+            src.close()
+    finally:
+        auth_server.stop()
+
+
+def test_scc_bad_payload_keeps_last_good(config_server):
+    src = _scc_source(config_server).start()
+    try:
+        assert _resources(src.property) == {"resA"}
+        config_server.set_property("demo-app", RULE_KEY, "not json at all")
+        time.sleep(0.2)
+        assert _resources(src.property) == {"resA"}
+        config_server.set_property("demo-app", RULE_KEY, _rules_json("resC"))
+        assert _wait_for(lambda: _resources(src.property) == {"resC"})
+    finally:
+        src.close()
+
+
+def test_scc_reconnect_after_server_restart(config_server):
+    src = _scc_source(config_server).start()
+    try:
+        assert _resources(src.property) == {"resA"}
+        config_server.stop()
+        time.sleep(0.15)
+        config_server.set_property("demo-app", RULE_KEY, _rules_json("resR"))
+        config_server.start()
+        assert _wait_for(lambda: _resources(src.property) == {"resR"})
+    finally:
+        src.close()
+
+
+def test_scc_label_in_path(config_server):
+    config_server.set_property("demo-app", RULE_KEY,
+                               _rules_json("feature"), label="feature-x")
+    src = _scc_source(config_server, label="feature-x").start()
+    try:
+        assert _resources(src.property) == {"feature"}
+    finally:
+        src.close()
+
+
+def test_scc_slashed_label_uses_spring_encoding(config_server):
+    config_server.set_property("demo-app", RULE_KEY,
+                               _rules_json("branch"), label="release/1.2")
+    src = _scc_source(config_server, label="release/1.2").start()
+    try:
+        assert "(_)" in src._endpoint()  # wire form, not a path segment
+        assert _resources(src.property) == {"branch"}
+    finally:
+        src.close()
+
+
+def test_scc_raw_environment_shape(config_server):
+    req = urllib.request.Request(
+        config_server.addr + "/demo-app/default",
+        headers={"Accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=2.0) as resp:
+        doc = json.loads(resp.read().decode("utf-8"))
+    assert doc["name"] == "demo-app"
+    assert doc["profiles"] == ["default"]
+    assert doc["version"].startswith("rev-")
+    assert any(RULE_KEY in ps["source"] for ps in doc["propertySources"])
