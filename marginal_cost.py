@@ -1,0 +1,101 @@
+"""Marginal on-chip cost of each rule family inside the scanned bench step.
+
+Times the bench_throughput configuration (capacity 32768, batch 8192,
+16-step scan) with one family removed at a time; the delta vs full is the
+family's true fused cost. Scratch tool, not a test.
+"""
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def build(n_resources=10_000, capacity=32_768, batch_n=8192,
+          with_flow=True, with_degrade=True, with_param=True,
+          with_system=True):
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+    from sentinel_tpu.core.registry import NodeRegistry
+    from sentinel_tpu.models import authority as A
+    from sentinel_tpu.models import degrade as D
+    from sentinel_tpu.models import flow as F
+    from sentinel_tpu.models import param_flow as P
+    from sentinel_tpu.models import system as Y
+    from sentinel_tpu.ops import step as S
+
+    now0 = 1_700_000_000_000
+    reg = NodeRegistry(capacity)
+    rules = ([F.FlowRule(resource=f"res{i}", count=1e9, control_behavior=0)
+              for i in range(0, n_resources, 10)] if with_flow else [])
+    degrade_rules = ([D.DegradeRule(resource=f"res{i}", count=100,
+                                    grade=i % 3, time_window=10)
+                      for i in range(0, n_resources, 20)]
+                     if with_degrade else [])
+    param_rules = ([P.ParamFlowRule(f"res{i}", param_idx=0, count=1e9)
+                    for i in range(0, n_resources, 40)] if with_param else [])
+    sys_rules = [Y.SystemRule(qps=1e12)] if with_system else []
+    ctx = "sentinel_default_context"
+    ent_row = reg.entrance_row(ctx)
+    c_rows = np.asarray([reg.cluster_row(f"res{i}")
+                         for i in range(n_resources)])
+    d_rows = np.asarray([reg.default_row(ctx, f"res{i}", ent_row)
+                         for i in range(n_resources)])
+    ft, _ = F.compile_flow_rules(rules, reg, capacity)
+    dt, di = D.compile_degrade_rules(degrade_rules, reg, capacity)
+    pt = P.compile_param_rules(param_rules, reg, capacity)
+    pack = S.RulePack(flow=ft, degrade=dt,
+                      authority=A.compile_authority_rules([], reg, capacity),
+                      system=Y.compile_system_rules(sys_rules),
+                      param=pt)
+    state = S.make_state(capacity, ft.num_rules, now0,
+                         degrade=D.make_degrade_state(dt, di),
+                         param=P.make_param_state(pt.num_rules))
+    rng = np.random.default_rng(0)
+    buf = make_entry_batch_np(batch_n)
+    pick = rng.integers(0, n_resources, size=batch_n)
+    buf["cluster_row"][:] = c_rows[pick]
+    buf["dn_row"][:] = d_rows[pick]
+    buf["count"][:] = 1
+    buf["param_hash"][:, 0] = rng.integers(1, 1 << 31, size=batch_n)
+    buf["param_present"][:, 0] = True
+    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+    return S, pack, state, batch, now0
+
+
+def time_config(scan_steps=16, iters=10, **kw):
+    S, pack, state, batch, now0 = build(**kw)
+
+    def multi(state, now_start):
+        def body(st_, i):
+            st_, dec = S.entry_step(st_, pack, batch, now_start + i)
+            return st_, dec.reason[0]
+        return jax.lax.scan(body, state,
+                            jnp.arange(scan_steps, dtype=jnp.int64))
+
+    step = jax.jit(multi, donate_argnums=(0,))
+    state, _ = step(state, jnp.asarray(now0, jnp.int64))
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(1, iters + 1):
+        state, last = step(state, jnp.asarray(now0 + i * scan_steps,
+                                              jnp.int64))
+    jax.block_until_ready(last)
+    dt_ = time.perf_counter() - t0
+    per_step_ms = dt_ / (iters * scan_steps) * 1e3
+    return per_step_ms
+
+
+if __name__ == "__main__":
+    print(f"platform: {jax.devices()[0].platform}")
+    full = time_config()
+    print(f"full:        {full:7.3f} ms/step")
+    for name, kw in [("no_param", dict(with_param=False)),
+                     ("no_degrade", dict(with_degrade=False)),
+                     ("no_system", dict(with_system=False)),
+                     ("no_flow", dict(with_flow=False)),
+                     ("flow_only", dict(with_param=False,
+                                        with_degrade=False,
+                                        with_system=False))]:
+        ms = time_config(**kw)
+        print(f"{name:12s} {ms:7.3f} ms/step   (marginal {full - ms:+6.3f})")

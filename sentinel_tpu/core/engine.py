@@ -236,6 +236,18 @@ class SentinelEngine:
         self._named_origins: Dict[str, set] = {}
         self._dirty = {"flow": True, "degrade": True, "authority": True,
                        "system": True, "param": True}
+        # Slot-count ratchet per family: empty families compile to ZERO
+        # slots (their per-slot loops vanish — a no-rules step is ~4x
+        # cheaper), but 0 -> 1 slots is a tensor-SHAPE change that would
+        # retrace the fused step on a rule push. Flooring each compile at
+        # the widest slot count ever seen keeps the round-4 guarantee
+        # "rule pushes don't recompile" for every push after a family's
+        # first use (the first-use retrace is one-time and unavoidable).
+        # Flow starts at 1 (compile_flow_rules' historical floor) and
+        # ratchets up the same way: a second rule on one resource widens
+        # the shape once and it never shrinks back.
+        self._slot_floor = {"flow": 1, "degrade": 0, "authority": 0,
+                            "param": 0}
         self._rebuild_w1_jits()
         self._flush_jit = jax.jit(S.flush_seconds, donate_argnums=(0,))
         self._w60_read_jit = jax.jit(lambda st_, now, idx: jnp.transpose(
@@ -450,16 +462,21 @@ class SentinelEngine:
                 self._dirty[k] = False
             now = time_util.current_time_millis()
             ft, named = F.compile_flow_rules(
-                self.flow_rules.get_rules(), self.registry, self.capacity)
+                self.flow_rules.get_rules(), self.registry, self.capacity,
+                min_slots=self._slot_floor["flow"])
             dt, di = D.compile_degrade_rules(
-                self.degrade_rules.get_rules(), self.registry, self.capacity)
+                self.degrade_rules.get_rules(), self.registry, self.capacity,
+                min_slots=self._slot_floor["degrade"])
             pt = P.compile_param_rules(
-                self.param_rules.get_rules(), self.registry, self.capacity)
+                self.param_rules.get_rules(), self.registry, self.capacity,
+                min_slots=self._slot_floor["param"])
+            at = A.compile_authority_rules(
+                self.authority_rules.get_rules(), self.registry,
+                self.capacity, min_slots=self._slot_floor["authority"])
+            self._ratchet_slots(flow=ft, degrade=dt, param=pt, authority=at)
             self._named_origins = {r: set(o) for r, o in named.items()}
             self._rules = S.RulePack(
-                flow=ft, degrade=dt,
-                authority=A.compile_authority_rules(
-                    self.authority_rules.get_rules(), self.registry, self.capacity),
+                flow=ft, degrade=dt, authority=at,
                 system=Y.compile_system_rules(self.system_rules.get_rules()),
                 param=pt,
             )
@@ -475,21 +492,27 @@ class SentinelEngine:
         if self._dirty["flow"]:
             self._dirty["flow"] = False
             ft, named = F.compile_flow_rules(
-                self.flow_rules.get_rules(), self.registry, self.capacity)
+                self.flow_rules.get_rules(), self.registry, self.capacity,
+                min_slots=self._slot_floor["flow"])
+            self._ratchet_slots(flow=ft)
             self._named_origins = {r: set(o) for r, o in named.items()}
             self._rules = self._rules._replace(flow=ft)
             self._state = self._state._replace(flow=F.make_flow_state(ft.num_rules, now))
         if self._dirty["degrade"]:
             self._dirty["degrade"] = False
             dt, di = D.compile_degrade_rules(
-                self.degrade_rules.get_rules(), self.registry, self.capacity)
+                self.degrade_rules.get_rules(), self.registry, self.capacity,
+                min_slots=self._slot_floor["degrade"])
+            self._ratchet_slots(degrade=dt)
             self._rules = self._rules._replace(degrade=dt)
             self._state = self._state._replace(degrade=D.make_degrade_state(dt, di))
         if self._dirty["authority"]:
             self._dirty["authority"] = False
-            self._rules = self._rules._replace(
-                authority=A.compile_authority_rules(
-                    self.authority_rules.get_rules(), self.registry, self.capacity))
+            at = A.compile_authority_rules(
+                self.authority_rules.get_rules(), self.registry,
+                self.capacity, min_slots=self._slot_floor["authority"])
+            self._ratchet_slots(authority=at)
+            self._rules = self._rules._replace(authority=at)
         if self._dirty["system"]:
             self._dirty["system"] = False
             self._rules = self._rules._replace(
@@ -498,9 +521,18 @@ class SentinelEngine:
         if self._dirty["param"]:
             self._dirty["param"] = False
             pt = P.compile_param_rules(
-                self.param_rules.get_rules(), self.registry, self.capacity)
+                self.param_rules.get_rules(), self.registry, self.capacity,
+                min_slots=self._slot_floor["param"])
+            self._ratchet_slots(param=pt)
             self._rules = self._rules._replace(param=pt)
             self._state = self._state._replace(param=P.make_param_state(pt.num_rules))
+
+    def _ratchet_slots(self, **tensors) -> None:
+        """Raise each family's slot floor to what was just compiled, so
+        later pushes (even back to zero rules) keep the same tensor
+        shapes and never retrace the fused step."""
+        for family, rt in tensors.items():
+            self._slot_floor[family] = max(self._slot_floor[family], rt.slots)
 
     def _maybe_start_system_listener(self):
         def is_set(v):

@@ -12,6 +12,21 @@ from http.server import ThreadingHTTPServer
 from typing import Optional
 
 
+class JsonResponderMixin:
+    """``_send_json`` for fake-server handlers that speak plain JSON
+    (mix in ahead of ``BaseHTTPRequestHandler``)."""
+
+    def _send_json(self, code: int, doc) -> None:
+        import json
+
+        body = json.dumps(doc).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
 def normalize_base(addr: str) -> str:
     """``host:port`` or URL → scheme-ful base with no trailing slash."""
     base = addr.rstrip("/")

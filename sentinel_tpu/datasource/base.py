@@ -183,6 +183,27 @@ class FileWritableDataSource(WritableDataSource[T]):
             os.replace(tmp, self.file_path)
 
 
+class ContentDedupPollMixin:
+    """``load_config`` for poll connectors whose only change signal is
+    the document bytes (Eureka metadata, Spring Cloud Config — neither
+    API has a usable change index): ``read_source() -> None`` (absent
+    key/instance) or unchanged content pushes nothing and keeps the last
+    good rules; ``_applied`` commits only after the converter succeeds,
+    so a bad payload can't poison the dedup cache.
+    """
+
+    _applied: Optional[str] = None
+
+    def load_config(self):
+        raw = self.read_source()
+        if raw is None or raw == self._applied:
+            return None
+        value = self.converter(raw)
+        if value is not None:
+            self._applied = raw
+        return value
+
+
 class ReconnectingWatchMixin:
     """Scaffolding shared by the push connectors (Redis / Nacos / Consul /
     etcd): a daemon watch thread that runs ``_watch_round()`` forever,

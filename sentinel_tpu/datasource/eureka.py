@@ -33,18 +33,20 @@ from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional, Sequence
 
 from sentinel_tpu.datasource._mini_http import (
+    JsonResponderMixin,
     RestartableHTTPServer,
     normalize_base,
 )
 from sentinel_tpu.datasource.base import (
     AutoRefreshDataSource,
+    ContentDedupPollMixin,
     Converter,
     T,
     WritableDataSource,
 )
 
 
-class EurekaDataSource(AutoRefreshDataSource[str, T]):
+class EurekaDataSource(ContentDedupPollMixin, AutoRefreshDataSource[str, T]):
     """Poll instance metadata across a failover list of service URLs.
 
     ``service_urls`` mirrors the reference constructor's ``serviceUrls``
@@ -66,7 +68,6 @@ class EurekaDataSource(AutoRefreshDataSource[str, T]):
         self.rule_key = rule_key
         self.timeout_s = timeout_s
         self._url_idx = 0
-        self._applied: Optional[str] = None
         self.failover_count = 0  # ops visibility + test hook
 
     # -- ReadableDataSource ------------------------------------------------
@@ -109,17 +110,9 @@ class EurekaDataSource(AutoRefreshDataSource[str, T]):
                 self.failover_count += 1
         raise last_err if last_err is not None else OSError("no replicas")
 
-    def load_config(self):
-        raw = self.read_source()
-        # Dedup on content: Eureka has no ModifyIndex/releaseKey, so the
-        # bytes are the only change signal; an absent instance/key keeps
-        # the last good rules rather than clearing them.
-        if raw is None or raw == self._applied:
-            return None
-        value = self.converter(raw)
-        if value is not None:
-            self._applied = raw
-        return value
+    # load_config: ContentDedupPollMixin — Eureka has no ModifyIndex/
+    # releaseKey, so the bytes are the only change signal; an absent
+    # instance/key keeps the last good rules rather than clearing them.
 
 
 class EurekaWritableDataSource(WritableDataSource[T]):
@@ -152,15 +145,7 @@ class EurekaWritableDataSource(WritableDataSource[T]):
 # -- in-repo fake server ------------------------------------------------------
 
 
-class _EurekaHandler(BaseHTTPRequestHandler):
-    def _send_json(self, code: int, doc) -> None:
-        body = json.dumps(doc).encode("utf-8")
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
+class _EurekaHandler(JsonResponderMixin, BaseHTTPRequestHandler):
     def _parse_instance_path(self, path: str):
         # /<context…>/apps/<APP>/<instanceId>[/metadata] — real service
         # URLs carry a context base ("/eureka" or "/eureka/v2"); anything

@@ -37,17 +37,20 @@ from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional, Tuple
 
 from sentinel_tpu.datasource._mini_http import (
+    JsonResponderMixin,
     RestartableHTTPServer,
     normalize_base,
 )
 from sentinel_tpu.datasource.base import (
     AutoRefreshDataSource,
+    ContentDedupPollMixin,
     Converter,
     T,
 )
 
 
-class SpringCloudConfigDataSource(AutoRefreshDataSource[str, T]):
+class SpringCloudConfigDataSource(ContentDedupPollMixin,
+                                  AutoRefreshDataSource[str, T]):
     """Environment-endpoint poller with Spring source precedence."""
 
     def __init__(self, server_addr: str, application: str, rule_key: str,
@@ -66,8 +69,8 @@ class SpringCloudConfigDataSource(AutoRefreshDataSource[str, T]):
         if auth is not None:
             raw = ("%s:%s" % auth).encode("utf-8")
             self._auth_header = "Basic " + base64.b64encode(raw).decode()
-        self._version: Optional[str] = None
-        self._applied: Optional[str] = None
+        self._version: Optional[str] = None  # ops visibility (no
+        # conditional form exists on this API, so it can't gate a fetch)
 
     # -- ReadableDataSource ------------------------------------------------
 
@@ -105,30 +108,15 @@ class SpringCloudConfigDataSource(AutoRefreshDataSource[str, T]):
         self._version = env.get("version")
         return self._extract(env, self.rule_key)
 
-    def load_config(self):
-        # The environment endpoint has no conditional-request form, so
-        # every poll refetches; unchanged bytes push nothing.
-        raw = self.read_source()
-        if raw is None or raw == self._applied:
-            return None
-        value = self.converter(raw)
-        if value is not None:
-            self._applied = raw
-        return value
+    # load_config: ContentDedupPollMixin — the environment endpoint has
+    # no conditional-request form, so every poll refetches; unchanged
+    # bytes push nothing.
 
 
 # -- in-repo fake server ------------------------------------------------------
 
 
-class _SpringConfigHandler(BaseHTTPRequestHandler):
-    def _send_json(self, code: int, doc) -> None:
-        body = json.dumps(doc).encode("utf-8")
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
+class _SpringConfigHandler(JsonResponderMixin, BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — http.server API
         server: "MiniSpringConfigServer" = self.server  # type: ignore
         if server.auth is not None:

@@ -73,6 +73,7 @@ def compile_authority_rules(
     rules: List[AuthorityRule],
     registry: NodeRegistry,
     num_rows: int,
+    min_slots: int = 0,
 ) -> AuthorityRuleTensors:
     valid = [r for r in rules if r.is_valid()]
     ar = _round_up(len(valid), 8)
@@ -94,7 +95,15 @@ def compile_authority_rules(
         if row >= 0:
             by_row.setdefault(row, []).append(i)
 
-    s = max(1, max((len(v) for v in by_row.values()), default=1))
+    # 0 when no rules: the per-slot loop then vanishes at trace time,
+    # so rule-free deployments pay nothing for this family (the
+    # dropped-index scatters of an empty table still cost ~0.1ms/step
+    # per scatter at batch 8192 on TPU). ``min_slots`` is the engine's
+    # ratchet: crossing 0 -> 1 slots is a SHAPE change that retraces the
+    # fused step, so the engine floors this at the widest slot count it
+    # has ever compiled — one retrace when a family is first used, none
+    # on later pushes (including dropping back to zero rules).
+    s = max(min_slots, max((len(v) for v in by_row.values()), default=0))
     rules_by_row = np.full((num_rows, s), -1, np.int32)
     for row, ids in by_row.items():
         rules_by_row[row, : len(ids)] = ids

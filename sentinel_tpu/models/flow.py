@@ -42,7 +42,10 @@ from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
 from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.ops import window as W
-from sentinel_tpu.ops.segment import segmented_prefix_dense
+from sentinel_tpu.ops.segment import (
+    segmented_prefix_dense,
+    segmented_prefix_dense_multi,
+)
 from sentinel_tpu.utils.shapes import round_up as _round_up
 
 
@@ -418,8 +421,9 @@ def _eval_flow_slots(
     # sharing one mask matmul for the token (QPS) and entry (THREAD) value
     # columns (``ops/segment.py`` — the MXU path; sorts blew scoped VMEM).
     vals2 = jnp.stack([token_count, entry_count], axis=1).astype(jnp.float32)
-    cols = [segmented_prefix_dense(rows, vals2)[0]
-            for rows in (batch.cluster_row, batch.dn_row, batch.origin_row)]
+    cols = [p for p, _ in segmented_prefix_dense_multi(
+        [(rows, vals2)
+         for rows in (batch.cluster_row, batch.dn_row, batch.origin_row)])]
     tok3 = jnp.stack([c[:, 0] for c in cols], axis=1)  # [:, (cluster, dn, origin)]
     ent3 = jnp.stack([c[:, 1] for c in cols], axis=1)
 

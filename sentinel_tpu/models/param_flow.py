@@ -65,6 +65,11 @@ MAX_ITEMS = 8         # per-rule exact-value exception slots
 # Count-min sketch geometry (cold tier). With W=2048 and D=4, the classic
 # bound gives over-estimate ≤ ~e·N/W per row (N = window acquires) with
 # probability 1 − e^−D; one-sided error only.
+# Both sketches (admission + promotion) share this depth. Measured dead
+# end (r4, real chip): a shallower promotion sketch (depth 2) halves its
+# gather/scatter cost but fattens the min-estimate's low tail enough
+# that one of ~100k storm challengers out-scores a hot owner
+# (test_hot_key_exact_and_survives_cold_storm) — don't re-try it.
 CMS_DEPTH = 4
 CMS_WIDTH = 2048
 # Odd multiplicative-hash constants (Knuth/xxhash-style); row d's position
@@ -156,7 +161,10 @@ class ParamFlowState(NamedTuple):
                           # (admission tier; hard-reset each window)
     cms_hot: jax.Array    # float32[PR, D, W] decayed hotness sketch
                           # (promotion gate only; halves each window so a
-                          # hot owner's history survives the boundary)
+                          # hot owner's history survives the boundary.
+                          # Both gate sides — challenger AND owner — read
+                          # THIS sketch, so collision inflation cancels
+                          # instead of biasing the comparison.)
     cms_start: jax.Array  # int64[PR] sketch window start (per-rule duration)
 
 
@@ -179,6 +187,7 @@ def compile_param_rules(
     registry: NodeRegistry,
     num_rows: int,
     hash_fn=None,
+    min_slots: int = 0,
 ) -> ParamRuleTensors:
     from sentinel_tpu.utils.param_hash import hash_param
 
@@ -218,7 +227,15 @@ def compile_param_rules(
         if row >= 0:
             by_row.setdefault(row, []).append(i)
 
-    k = max(1, max((len(v) for v in by_row.values()), default=1))
+    # 0 when no rules: the per-slot loop then vanishes at trace time,
+    # so rule-free deployments pay nothing for this family (the
+    # dropped-index scatters of an empty table still cost ~0.1ms/step
+    # per scatter at batch 8192 on TPU). ``min_slots`` is the engine's
+    # ratchet: crossing 0 -> 1 slots is a SHAPE change that retraces the
+    # fused step, so the engine floors this at the widest slot count it
+    # has ever compiled — one retrace when a family is first used, none
+    # on later pushes (including dropping back to zero rules).
+    k = max(min_slots, max((len(v) for v in by_row.values()), default=0))
     rules_by_row = np.full((num_rows, k), -1, np.int32)
     for row, ids in by_row.items():
         rules_by_row[row, : len(ids)] = ids
@@ -283,11 +300,14 @@ def _gather2(arr, r, s, fill):
 def _cms_min(cms: jax.Array, srule: jax.Array, pos: jax.Array) -> jax.Array:
     """min over depth of ``cms[rule, d, pos[:, d]]`` — the CMS estimate.
 
-    ``srule`` < 0 (no applicable rule) reads row 0 and is masked to 0.
+    Depth comes from the table (the admission sketch is deeper than the
+    promotion sketch); ``pos`` columns beyond it are ignored. ``srule`` <
+    0 (no applicable rule) reads row 0 and is masked to 0.
     """
+    d = cms.shape[1]
     ok = (srule >= 0) & (srule < cms.shape[0])
     r = jnp.where(ok, srule, 0)
-    vals = cms[r[:, None], jnp.arange(CMS_DEPTH)[None, :], pos]  # [N, D]
+    vals = cms[r[:, None], jnp.arange(d)[None, :], pos[:, :d]]  # [N, d]
     return jnp.where(ok, vals.min(axis=1), 0.0)
 
 
@@ -467,7 +487,11 @@ def _eval_param(
             # Promotion gate (space-saving top-k): an admitted cold key
             # takes the slot only when its window count has caught up with
             # the owner's — a cold-key storm can't evict a hot key's exact
-            # bucket. Empty slots (key 0) are claimed directly.
+            # bucket. Empty slots (key 0) are claimed directly. BOTH gate
+            # sides probe the same promotion sketch: symmetric
+            # collision inflation cancels in the comparison, which is what
+            # keeps a 100k-key cold storm from out-scoring a hot owner
+            # whose bar would otherwise stay at its small exact count.
             hot_est = _cms_min(ps.cms_hot, srule, pos)
             owner_est = _cms_min(ps.cms_hot, srule, _cms_positions(stored_key))
             promoted = (admitted & dflt & fresh
@@ -481,14 +505,19 @@ def _eval_param(
                 key=ps.key.at[ridx, slot].set(pv_hash, mode="drop"),
             )
             need_stamp = dflt & (~fresh) & (windows >= 1)
-            tidx = W.oob(jnp.where(
-                need_stamp | promoted | (claim_other & fresh), srule, -1
-            ), ps.key.shape[0])
-            ps = ps._replace(
-                filled_ms=ps.filled_ms.at[tidx, slot].set(
-                    now_ms.astype(jnp.int64), mode="drop"
-                )
-            )
+            stamp = need_stamp | promoted | (claim_other & fresh)
+
+            # int64 scatter (emulated as hi/lo-u32 pairs on TPU — one of
+            # the measured top-3 step costs); only window-boundary
+            # crossings, promotions, and evictions stamp, so steady-state
+            # batches skip it entirely via the cond.
+            def _stamp_filled(filled_prev):
+                tidx = W.oob(jnp.where(stamp, srule, -1), ps.key.shape[0])
+                return filled_prev.at[tidx, slot].set(
+                    now_ms.astype(jnp.int64), mode="drop")
+
+            ps = ps._replace(filled_ms=jax.lax.cond(
+                jnp.any(stamp), _stamp_filled, lambda f: f, ps.filled_ms))
             # Default-mode token accounting: owners (and freshly promoted
             # keys, seeded from the CMS-discounted level) get their bucket
             # set, then every admitted acquire is subtracted. Non-promoted
@@ -523,26 +552,48 @@ def _eval_param(
                 cidx[:, None], darange, pos].add(hot_inc, mode="drop"))
             # Throttle-mode head advance: head' = latest + consumed · cost.
             # Evicted slots first drop their stale head so .max starts fresh.
-            fresh_rl = W.oob(
-                jnp.where(applicable & is_rl & fresh, srule, -1), ps.key.shape[0]
-            )
-            passed = ps.passed_us.at[fresh_rl, slot].set(0, mode="drop")
-            rlidx = W.oob(jnp.where(admitted & is_rl, srule, -1), ps.key.shape[0])
-            consumed_after, _ = segmented_prefix_dense(
-                gid, jnp.where(admitted & is_rl, batch.count, 0).astype(jnp.float32)
-            )
-            last_total = consumed_after + jnp.where(admitted & is_rl, batch.count, 0)
-            new_head = latest + last_total.astype(jnp.int64) * cost_us
-            ps = ps._replace(
-                passed_us=passed.at[rlidx, slot].max(new_head, mode="drop")
-            )
+            # The whole block rides a lax.cond: the head table is int64
+            # (epoch µs), whose scatter-set/-max lower to ~0.55ms/step of
+            # emulated hi/lo-u32 scatter fusions on TPU EACH — measured as
+            # the 3 hottest ops of the fused step — plus a dense-prefix
+            # scan. With no rate-limiter param traffic in the batch (the
+            # common case: QPS-reject param rules), every index is dropped
+            # and the state provably unchanged, so the cond skips it all.
+            def _advance_rl_heads(passed_prev):
+                fresh_rl = W.oob(
+                    jnp.where(applicable & is_rl & fresh, srule, -1),
+                    ps.key.shape[0])
+                passed = passed_prev.at[fresh_rl, slot].set(0, mode="drop")
+                rlidx = W.oob(jnp.where(admitted & is_rl, srule, -1),
+                              ps.key.shape[0])
+                consumed_after, _ = segmented_prefix_dense(
+                    gid,
+                    jnp.where(admitted & is_rl, batch.count, 0)
+                    .astype(jnp.float32))
+                last_total = consumed_after + jnp.where(
+                    admitted & is_rl, batch.count, 0)
+                new_head = latest + last_total.astype(jnp.int64) * cost_us
+                return passed.at[rlidx, slot].max(new_head, mode="drop")
+
+            ps = ps._replace(passed_us=jax.lax.cond(
+                jnp.any(applicable & is_rl), _advance_rl_heads,
+                lambda p: p, ps.passed_us))
+
             # Thread gauge: reset evicted buckets, then increment admits.
-            thidx = W.oob(jnp.where(applicable & fresh & is_thread, srule, -1), ps.key.shape[0])
-            threads = ps.threads.at[thidx, slot].set(0, mode="drop")
-            threads = threads.at[
-                W.oob(jnp.where(admitted & is_thread, srule, -1), ps.key.shape[0]), slot
-            ].add(1, mode="drop")
-            ps = ps._replace(threads=threads)
+            # Same skip for batches with no thread-grade param traffic.
+            def _advance_threads(threads_prev):
+                thidx = W.oob(
+                    jnp.where(applicable & fresh & is_thread, srule, -1),
+                    ps.key.shape[0])
+                threads = threads_prev.at[thidx, slot].set(0, mode="drop")
+                return threads.at[
+                    W.oob(jnp.where(admitted & is_thread, srule, -1),
+                          ps.key.shape[0]), slot
+                ].add(1, mode="drop")
+
+            ps = ps._replace(threads=jax.lax.cond(
+                jnp.any(applicable & is_thread), _advance_threads,
+                lambda t: t, ps.threads))
 
     return ParamVerdict(blocked=blocked, wait_us=wait_us, state=ps)
 

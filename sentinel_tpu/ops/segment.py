@@ -36,6 +36,13 @@ Exactness: the mask is {0,1} and values are cast to bfloat16 with float32
 accumulation, so results are exact for per-request counts ≤ 256 (bf16
 integer range) — counts are 1 in every reference code path (`SphU.entry`
 acquires batch=1; larger acquireCount stays far below 256).
+
+Measured dead end (r4, real v5e chip): a two-level "bounded" variant —
+per-block bincounts + cross-block cumsum + block-local triangular mask,
+O(N·block) mask work instead of O(N²) — benched 0.60ms vs 0.53ms for
+the dense form at N=8192/block=512 inside a 16-step scan: the per-block
+bincount scan overhead eats the mask savings at these sizes. Don't
+re-derive it below N≈32k.
 """
 
 from __future__ import annotations
@@ -87,42 +94,77 @@ def segmented_prefix_dense(
     one shared mask. Returns ``(prefix, is_first)`` with ``prefix`` shaped
     like ``values`` (float32) and ``is_first`` bool[N].
     """
-    squeeze = values.ndim == 1
-    if squeeze:
-        values = values[:, None]
-    n, m = values.shape
+    (prefix, is_first), = segmented_prefix_dense_multi([(ids, values)],
+                                                       block=block)
+    return prefix, is_first
+
+
+def segmented_prefix_dense_multi(pairs, block: int = 512):
+    """K independent dense segmented prefixes fused into ONE scan loop.
+
+    ``pairs``: list of ``(ids, values)`` as in ``segmented_prefix_dense``,
+    all with the same leading length N. Every separate prefix call is its
+    own ``lax.scan`` over mask/matmul blocks, and XLA does not CSE across
+    scans — so callers that need several segmentations of the SAME batch
+    (the flow sweep's cluster/dn/origin row spaces) fuse them here: one
+    loop, K masks + K matmuls per block, one pass over the batch's VMEM
+    working set. Returns a list of ``(prefix, is_first)``.
+    """
+    n = pairs[0][0].shape[0]
+    for ids_k, values_k in pairs:
+        if ids_k.shape[0] != n or values_k.shape[0] != n:
+            raise ValueError(
+                "segmented_prefix_dense_multi: all pairs must share the "
+                f"same leading length (got {ids_k.shape[0]} / "
+                f"{values_k.shape[0]}, expected {n})")
     nb = -(-n // block)
     npad = nb * block
-    ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n), constant_values=_ID_SENTINEL)
-    # One extra ones-column yields the count of earlier same-id requests,
-    # from which is_first falls out for free.
-    vals_p = jnp.pad(
-        jnp.concatenate([values.astype(jnp.float32), jnp.ones((n, 1), jnp.float32)], axis=1),
-        ((0, npad - n), (0, 0)),
-    )
-    v16 = vals_p.astype(jnp.bfloat16)  # exact for integer counts ≤ 256
-    idsb = ids_p.reshape(nb, block)
     pos = jnp.arange(npad, dtype=jnp.int32)
     off = jnp.arange(block, dtype=jnp.int32)
 
-    def body(_, b):
-        my_ids = idsb[b]                                   # [B]
-        my_pos = b * block + off                           # [B]
-        mask = (my_ids[:, None] == ids_p[None, :]) & (pos[None, :] < my_pos[:, None])
-        out = jax.lax.dot_general(
-            mask.astype(jnp.bfloat16), v16,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                                  # [B, M+1]
-        return _, out
+    prepped = []
+    for ids, values in pairs:
+        squeeze = values.ndim == 1
+        if squeeze:
+            values = values[:, None]
+        m = values.shape[1]
+        ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n),
+                        constant_values=_ID_SENTINEL)
+        # One extra ones-column yields the count of earlier same-id
+        # requests, from which is_first falls out for free.
+        vals_p = jnp.pad(
+            jnp.concatenate(
+                [values.astype(jnp.float32), jnp.ones((n, 1), jnp.float32)],
+                axis=1),
+            ((0, npad - n), (0, 0)),
+        )
+        v16 = vals_p.astype(jnp.bfloat16)  # exact for integer counts ≤ 256
+        prepped.append((squeeze, m, ids_p, ids_p.reshape(nb, block), v16))
 
-    _, outs = jax.lax.scan(body, None, jnp.arange(nb, dtype=jnp.int32))
-    outs = outs.reshape(npad, m + 1)[:n]
-    prefix, earlier_count = outs[:, :m], outs[:, m]
-    is_first = earlier_count == 0
-    if squeeze:
-        prefix = prefix[:, 0]
-    return prefix, is_first
+    def body(_, b):
+        my_pos = b * block + off                           # [B]
+        outs = []
+        for _sq, _m, ids_p, idsb, v16 in prepped:
+            my_ids = idsb[b]                               # [B]
+            mask = (my_ids[:, None] == ids_p[None, :]) & (
+                pos[None, :] < my_pos[:, None])
+            outs.append(jax.lax.dot_general(
+                mask.astype(jnp.bfloat16), v16,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))                                             # [B, M_k+1]
+        return _, tuple(outs)
+
+    _, outs_all = jax.lax.scan(body, None, jnp.arange(nb, dtype=jnp.int32))
+    results = []
+    for (squeeze, m, _ids_p, _idsb, _v16), outs in zip(prepped, outs_all):
+        outs = outs.reshape(npad, m + 1)[:n]
+        prefix, earlier_count = outs[:, :m], outs[:, m]
+        is_first = earlier_count == 0
+        if squeeze:
+            prefix = prefix[:, 0]
+        results.append((prefix, is_first))
+    return results
 
 
 def bincount_matmul(

@@ -159,3 +159,41 @@ def test_negative_burst_rule_is_dropped(engine):
         h = st.entry_ok("hot", args=("k",))
         assert h is not None
         h.exit()
+
+
+def test_empty_family_compiles_zero_slots_with_ratchet_floor():
+    """Rule-free families compile to ZERO slots (their per-slot loop
+    vanishes at trace time — a no-rules step measured ~4x cheaper), and
+    ``min_slots`` restores the wider shape so the engine's ratchet can
+    keep rule pushes retrace-free after a family's first use."""
+    from sentinel_tpu.core.registry import NodeRegistry
+    from sentinel_tpu.models import param_flow as P
+
+    reg = NodeRegistry(64)
+    assert P.compile_param_rules([], reg, 64).slots == 0
+    pt = P.compile_param_rules(
+        [st.ParamFlowRule("r", param_idx=0, count=5)], reg, 64)
+    assert pt.slots == 1
+    # The ratchet case: rules dropped back to zero keeps the shape.
+    assert P.compile_param_rules([], reg, 64, min_slots=1).slots == 1
+
+
+def test_engine_slot_floor_ratchets_across_pushes(engine, frozen_time):
+    """Pushing a family's first rule widens its slot floor permanently:
+    clearing the rules later compiles the SAME tensor shape, so the
+    fused step is not retraced by the push cycle (the round-4
+    'rule pushes don't recompile' guarantee, kept under zero-slot
+    compiles of empty families)."""
+    assert engine._slot_floor["param"] == 0
+    st.load_param_flow_rules([st.ParamFlowRule("hot", param_idx=0, count=2)])
+    h = st.entry_ok("hot", args=("k",))  # forces compile + dispatch
+    if h:
+        h.exit()
+    assert engine._slot_floor["param"] == 1
+    shape_with_rules = tuple(engine._rules.param.rules_by_row.shape)
+    st.load_param_flow_rules([])  # clear the family
+    h = st.entry_ok("hot", args=("k",))
+    if h:
+        h.exit()
+    assert engine._slot_floor["param"] == 1
+    assert tuple(engine._rules.param.rules_by_row.shape) == shape_with_rules
