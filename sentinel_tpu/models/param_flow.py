@@ -66,10 +66,16 @@ MAX_ITEMS = 8         # per-rule exact-value exception slots
 # bound gives over-estimate ≤ ~e·N/W per row (N = window acquires) with
 # probability 1 − e^−D; one-sided error only.
 # Both sketches (admission + promotion) share this depth. Measured dead
-# end (r4, real chip): a shallower promotion sketch (depth 2) halves its
-# gather/scatter cost but fattens the min-estimate's low tail enough
-# that one of ~100k storm challengers out-scores a hot owner
-# (test_hot_key_exact_and_survives_cold_storm) — don't re-try it.
+# ends (r4, real chip) — don't re-try:
+# - a shallower promotion sketch (depth 2) halves its gather/scatter
+#   cost but fattens the min-estimate's low tail enough that one of
+#   ~100k storm challengers out-scores a hot owner
+#   (test_hot_key_exact_and_survives_cold_storm);
+# - probing via blocked one-hot matmuls instead of scalar gathers
+#   (rowvals = onehot_rule @ table, sampled at pos) benched 1.27ms vs
+#   0.84ms per 8192-probe step: the [block, D, W] rowvals
+#   materialization costs more than the ~13ns/elem DynamicGather it
+#   replaces.
 CMS_DEPTH = 4
 CMS_WIDTH = 2048
 # Odd multiplicative-hash constants (Knuth/xxhash-style); row d's position

@@ -56,6 +56,30 @@ import jax.numpy as jnp
 _ID_SENTINEL = jnp.int32(-(2**31))
 
 
+def prep_prefix_pair(ids: jnp.ndarray, values: jnp.ndarray, npad: int):
+    """Shared prep for the dense-prefix implementations (XLA scan and the
+    Pallas kernel): squeeze 1-D values, pad ids with the sentinel (padded
+    rows match only each other and carry zero values), and append the
+    ones column whose prefix is the earlier-same-id count that yields
+    ``is_first`` for free. Returns ``(squeeze, m, ids_p, vals_p)`` with
+    ``vals_p`` float32 [npad, m+1].
+    """
+    n = ids.shape[0]
+    squeeze = values.ndim == 1
+    if squeeze:
+        values = values[:, None]
+    m = values.shape[1]
+    ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n),
+                    constant_values=_ID_SENTINEL)
+    vals_p = jnp.pad(
+        jnp.concatenate(
+            [values.astype(jnp.float32), jnp.ones((n, 1), jnp.float32)],
+            axis=1),
+        ((0, npad - n), (0, 0)),
+    )
+    return squeeze, m, ids_p, vals_p
+
+
 def segmented_prefix(ids: jnp.ndarray, values: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Exclusive prefix sum of ``values`` within equal ``ids``, arrival order.
 
@@ -99,6 +123,26 @@ def segmented_prefix_dense(
     return prefix, is_first
 
 
+def _use_pallas() -> bool:
+    """Opt-in routing of the dense prefix through the Pallas kernel
+    (``SENTINEL_TPU_PALLAS=1`` on a real TPU). Standalone the kernel
+    measured 1.71x the XLA scan (ops/pallas_prefix.py), but embedded in
+    the donated 16-step fused-step scan it crashed this image's backend
+    with a non-unwinding runtime panic (r4; the tunnel needed recovery) —
+    so the XLA path stays the default until the in-step embedding is
+    proven on hardware. The kernel itself is correctness-tested in
+    interpret mode on CPU (test_pallas_prefix.py)."""
+    import os
+
+    if os.environ.get("SENTINEL_TPU_PALLAS", "").lower() not in (
+            "1", "true", "yes", "on"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover — uninitialized backend
+        return False
+
+
 def segmented_prefix_dense_multi(pairs, block: int = 512):
     """K independent dense segmented prefixes fused into ONE scan loop.
 
@@ -109,6 +153,10 @@ def segmented_prefix_dense_multi(pairs, block: int = 512):
     (the flow sweep's cluster/dn/origin row spaces) fuse them here: one
     loop, K masks + K matmuls per block, one pass over the batch's VMEM
     working set. Returns a list of ``(prefix, is_first)``.
+
+    With ``SENTINEL_TPU_PALLAS=1`` on a real TPU the work routes through
+    the Pallas kernel instead (same contract, measured 1.71x standalone;
+    opt-in pending an in-step backend-panic fix — see ``_use_pallas``).
     """
     n = pairs[0][0].shape[0]
     for ids_k, values_k in pairs:
@@ -117,6 +165,10 @@ def segmented_prefix_dense_multi(pairs, block: int = 512):
                 "segmented_prefix_dense_multi: all pairs must share the "
                 f"same leading length (got {ids_k.shape[0]} / "
                 f"{values_k.shape[0]}, expected {n})")
+    if _use_pallas():
+        from sentinel_tpu.ops.pallas_prefix import prefix_pallas_multi
+
+        return prefix_pallas_multi(pairs)
     nb = -(-n // block)
     npad = nb * block
     pos = jnp.arange(npad, dtype=jnp.int32)
@@ -124,20 +176,7 @@ def segmented_prefix_dense_multi(pairs, block: int = 512):
 
     prepped = []
     for ids, values in pairs:
-        squeeze = values.ndim == 1
-        if squeeze:
-            values = values[:, None]
-        m = values.shape[1]
-        ids_p = jnp.pad(ids.astype(jnp.int32), (0, npad - n),
-                        constant_values=_ID_SENTINEL)
-        # One extra ones-column yields the count of earlier same-id
-        # requests, from which is_first falls out for free.
-        vals_p = jnp.pad(
-            jnp.concatenate(
-                [values.astype(jnp.float32), jnp.ones((n, 1), jnp.float32)],
-                axis=1),
-            ((0, npad - n), (0, 0)),
-        )
+        squeeze, m, ids_p, vals_p = prep_prefix_pair(ids, values, npad)
         v16 = vals_p.astype(jnp.bfloat16)  # exact for integer counts ≤ 256
         prepped.append((squeeze, m, ids_p, ids_p.reshape(nb, block), v16))
 

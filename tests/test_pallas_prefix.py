@@ -1,0 +1,86 @@
+"""Pallas dense-prefix kernel (ops/pallas_prefix.py) — correctness in
+interpret mode against the sort-based oracle and the XLA dense path,
+plus the dispatch gate's default-off contract.
+
+The kernel's on-chip speedup (1.71x the XLA scan, standalone) is
+documented in ops/pallas_prefix.py; embedding it in the fused step is
+gated behind SENTINEL_TPU_PALLAS=1 pending a backend-panic fix (see
+segment._use_pallas).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sentinel_tpu.ops.pallas_prefix import prefix_pallas, prefix_pallas_multi
+from sentinel_tpu.ops.segment import (
+    _use_pallas,
+    segmented_prefix,
+    segmented_prefix_dense,
+)
+
+
+def _case(n, bins, seed, m=2, invalid_frac=0.1):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, bins, size=n).astype(np.int32)
+    ids[rng.random(n) < invalid_frac] = -1
+    vals = rng.integers(1, 4, size=(n, m)).astype(np.float32)
+    vals[ids < 0] = 0
+    return jnp.asarray(ids), jnp.asarray(vals)
+
+
+@pytest.mark.parametrize("n,bins", [(512, 8), (1024, 32768), (1000, 64)])
+def test_interpret_matches_oracle_and_dense(n, bins):
+    ids, vals = _case(n, bins, seed=n)
+    got, got_first = prefix_pallas(ids, vals, interpret=True)
+    want, want_first = segmented_prefix_dense(ids, vals)
+    assert np.allclose(np.asarray(got), np.asarray(want))
+    assert np.array_equal(np.asarray(got_first), np.asarray(want_first))
+    oracle, _ = segmented_prefix(ids, vals[:, 0])
+    assert np.allclose(np.asarray(got[:, 0]), np.asarray(oracle))
+
+
+def test_interpret_1d_values_and_unpadded_n():
+    # 1000 is not a multiple of the 512-row block: exercises padding.
+    ids, vals = _case(1000, 16, seed=7, m=1)
+    got, got_first = prefix_pallas(ids, vals[:, 0], interpret=True)
+    want, want_first = segmented_prefix_dense(ids, vals[:, 0])
+    assert got.shape == (1000,)
+    assert np.allclose(np.asarray(got), np.asarray(want))
+    assert np.array_equal(np.asarray(got_first), np.asarray(want_first))
+
+
+def test_interpret_multi_matches_per_pair():
+    ids1, vals1 = _case(512, 16, seed=1)
+    ids2, vals2 = _case(512, 4, seed=2, m=3)
+    (p1, f1), (p2, f2) = prefix_pallas_multi(
+        [(ids1, vals1), (ids2, vals2)], interpret=True)
+    w1, wf1 = segmented_prefix_dense(ids1, vals1)
+    w2, wf2 = segmented_prefix_dense(ids2, vals2)
+    assert np.allclose(np.asarray(p1), np.asarray(w1))
+    assert np.allclose(np.asarray(p2), np.asarray(w2))
+    assert np.array_equal(np.asarray(f1), np.asarray(wf1))
+    assert np.array_equal(np.asarray(f2), np.asarray(wf2))
+
+
+def test_wide_counts_exact_beyond_bf16():
+    """The f32 kernel is exact for counts far beyond the XLA path's
+    bf16 envelope (<= 256) — pin it against the sort oracle."""
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 4, size=512).astype(np.int32))
+    vals = jnp.asarray(rng.integers(1, 100_000, size=512).astype(np.float32))
+    got, _ = prefix_pallas(ids, vals, interpret=True)
+    want, _ = segmented_prefix(ids, vals)
+    assert np.allclose(np.asarray(got), np.asarray(want))
+
+
+def test_dispatch_gate_defaults_off(monkeypatch):
+    monkeypatch.delenv("SENTINEL_TPU_PALLAS", raising=False)
+    assert _use_pallas() is False
+
+
+def test_dispatch_gate_explicit_zero_is_off(monkeypatch):
+    for off in ("0", "false", "no", ""):
+        monkeypatch.setenv("SENTINEL_TPU_PALLAS", off)
+        assert _use_pallas() is False, off
