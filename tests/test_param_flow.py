@@ -197,3 +197,39 @@ def test_engine_slot_floor_ratchets_across_pushes(engine, frozen_time):
         h.exit()
     assert engine._slot_floor["param"] == 1
     assert tuple(engine._rules.param.rules_by_row.shape) == shape_with_rules
+
+
+def _jit_cache_size(jitted):
+    """jax-private trace-cache probe; skip rather than fail if a jax
+    bump renames it (the ratchet behavior itself is version-agnostic)."""
+    probe = getattr(jitted, "_cache_size", None)
+    if probe is None:
+        pytest.skip("jax _cache_size API unavailable in this version")
+    return probe()
+
+
+def test_rule_push_cycle_never_retraces_after_first_use(engine, frozen_time):
+    """The compile-count guarantee behind the ratchet: after a family's
+    first use is compiled, pushing new rule VALUES, clearing the family,
+    and re-pushing must all hit the same jit specialization — the
+    entry jit's trace-cache size stays at 1."""
+    st.load_flow_rules([st.FlowRule(resource="api", count=100)])
+    st.load_param_flow_rules([st.ParamFlowRule("api", param_idx=0, count=50)])
+    h = st.entry_ok("api", args=("k",))
+    if h:
+        h.exit()
+    assert _jit_cache_size(engine._entry_jit) == 1
+    # Value-only push, family clear, and re-push: no new specialization.
+    st.load_param_flow_rules([st.ParamFlowRule("api", param_idx=0, count=9)])
+    h = st.entry_ok("api", args=("k",))
+    if h:
+        h.exit()
+    st.load_param_flow_rules([])
+    h = st.entry_ok("api", args=("k",))
+    if h:
+        h.exit()
+    st.load_param_flow_rules([st.ParamFlowRule("api", param_idx=0, count=2)])
+    h = st.entry_ok("api", args=("k",))
+    if h:
+        h.exit()
+    assert _jit_cache_size(engine._entry_jit) == 1
