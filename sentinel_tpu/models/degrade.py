@@ -251,35 +251,58 @@ def feed_degrade(
     valid = batch.cluster_row >= 0
     err = valid & batch.error
 
-    half_bad = jnp.zeros((rt.num_rules,), bool)
-    half_good = jnp.zeros((rt.num_rules,), bool)
+    # Varying-typed seeds so the lax.cond below type-checks under
+    # shard_map (W.varying_zeros carries the rationale).
+    half_bad = W.varying_zeros(batch.count, (rt.num_rules,), bool)
+    half_good = W.varying_zeros(batch.count, (rt.num_rules,), bool)
 
     for k in range(rt.slots):
         rule_id = rt.rules_by_row.at[
             W.oob(batch.cluster_row, rt.rules_by_row.shape[0]), jnp.full((n,), k)
         ].get(mode="fill", fill_value=-1)
         has_rule = (rule_id >= 0) & valid
-        rid = jnp.where(has_rule, rule_id, -1)
 
-        thr = rt.threshold.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=0.0)
-        grade = rt.grade.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=0)
-        slow = has_rule & (grade == C.DEGRADE_GRADE_RT) & (
-            batch.rt_ms.astype(jnp.float32) > thr
-        )
-        bad = jnp.where(grade == C.DEGRADE_GRADE_RT, slow, err & has_rule)
+        # Exit batches with no breaker-ruled completions (degrade rules
+        # are sparse in mixed deployments; small pipeline batches miss
+        # them routinely) leave the window and probe votes provably
+        # unchanged — skip the three window scatters via the cond.
+        def _feed(args, rule_id=rule_id, has_rule=has_rule):
+            win_, half_bad_, half_good_ = args
+            rid = jnp.where(has_rule, rule_id, -1)
+            thr = rt.threshold.at[W.oob(rule_id, rt.num_rules)].get(
+                mode="fill", fill_value=0.0)
+            grade = rt.grade.at[W.oob(rule_id, rt.num_rules)].get(
+                mode="fill", fill_value=0)
+            slow = has_rule & (grade == C.DEGRADE_GRADE_RT) & (
+                batch.rt_ms.astype(jnp.float32) > thr
+            )
+            bad = jnp.where(grade == C.DEGRADE_GRADE_RT, slow, err & has_rule)
 
-        cnt = jnp.where(has_rule, batch.count, 0)
-        win = W.row_window_add(win, now_ms, rid, jnp.full((n,), CH_TOTAL), cnt)
-        win = W.row_window_add(win, now_ms, rid, jnp.full((n,), CH_ERROR),
-                               jnp.where(err & has_rule, batch.count, 0))
-        win = W.row_window_add(win, now_ms, rid, jnp.full((n,), CH_SLOW),
-                               jnp.where(slow, batch.count, 0))
+            cnt = jnp.where(has_rule, batch.count, 0)
+            win_ = W.row_window_add(win_, now_ms, rid,
+                                    jnp.full((n,), CH_TOTAL), cnt)
+            win_ = W.row_window_add(win_, now_ms, rid,
+                                    jnp.full((n,), CH_ERROR),
+                                    jnp.where(err & has_rule, batch.count, 0))
+            win_ = W.row_window_add(win_, now_ms, rid,
+                                    jnp.full((n,), CH_SLOW),
+                                    jnp.where(slow, batch.count, 0))
 
-        # HALF_OPEN probe verdicts: any completion of the rule votes.
-        st = state.at[W.oob(rule_id, rt.num_rules)].get(mode="fill", fill_value=-1)
-        on_half = has_rule & (st == C.BREAKER_HALF_OPEN)
-        half_bad = half_bad.at[W.oob(jnp.where(on_half & bad, rule_id, -1), rt.num_rules)].set(True, mode="drop")
-        half_good = half_good.at[W.oob(jnp.where(on_half & ~bad, rule_id, -1), rt.num_rules)].set(True, mode="drop")
+            # HALF_OPEN probe verdicts: any completion of the rule votes.
+            st = state.at[W.oob(rule_id, rt.num_rules)].get(
+                mode="fill", fill_value=-1)
+            on_half = has_rule & (st == C.BREAKER_HALF_OPEN)
+            half_bad_ = half_bad_.at[W.oob(
+                jnp.where(on_half & bad, rule_id, -1), rt.num_rules)].set(
+                True, mode="drop")
+            half_good_ = half_good_.at[W.oob(
+                jnp.where(on_half & ~bad, rule_id, -1), rt.num_rules)].set(
+                True, mode="drop")
+            return win_, half_bad_, half_good_
+
+        win, half_bad, half_good = jax.lax.cond(
+            jnp.any(has_rule), _feed, lambda args: args,
+            (win, half_bad, half_good))
 
     # --- rule-axis transitions -------------------------------------------
     totals = W.row_window_totals(win, jnp.arange(rt.num_rules))  # [DR, 3]

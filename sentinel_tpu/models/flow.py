@@ -428,19 +428,14 @@ def _eval_flow_slots(
     ent3 = jnp.stack([c[:, 1] for c in cols], axis=1)
 
     blocked = jnp.zeros((n,), bool)
-    # The accumulators below flow through lax.cond gates whose taken
-    # branch derives from the (device-sharded) batch. Under shard_map,
-    # cond requires both branches to agree on varying-axes typing, so
-    # they are built FROM batch data (all-zero by construction) rather
-    # than as literal constants — free outside shard_map, and inside it
-    # marks them varying like the true-branch outputs.
-    zero_n = batch.count * 0
-    wait_us = zero_n.astype(jnp.int64)
-    occupied = zero_n < 0
-    occ_add = (jnp.zeros((w1.num_rows,), jnp.int32)
-               + zero_n[0].astype(jnp.int32))  # granted borrows per row
-    consumed = (jnp.zeros((rt.num_rules,), jnp.int64)
-                + zero_n[0].astype(jnp.int64))  # rate-limiter tokens
+    # Cond-gated accumulators: varying-typed seeds (W.varying_zeros) so
+    # the no-traffic branches type-check under shard_map.
+    wait_us = W.varying_zeros(batch.count, (n,), jnp.int64)
+    occupied = W.varying_zeros(batch.count, (n,), bool)
+    occ_add = W.varying_zeros(batch.count, (w1.num_rows,),
+                              jnp.int32)  # granted borrows per row
+    consumed = W.varying_zeros(batch.count, (rt.num_rules,),
+                               jnp.int64)  # rate-limiter tokens
 
     # Occupy-next-window geometry (DefaultController.tryOccupyNext): at the
     # next bucket boundary the OLDEST bucket's counts leave the window, so
@@ -564,7 +559,7 @@ def _eval_flow_slots(
 
         rl_prefix = jax.lax.cond(
             any_rl, _rl_prefix,
-            lambda _: zero_n.astype(jnp.float32), 0)
+            lambda _: W.varying_zeros(batch.count, (n,), jnp.float32), 0)
         now_us = now_ms.astype(jnp.int64) * 1000
         # Clamp the bucket head the same way the state advance does: the
         # reference sets latestPassedTime = NOW for the first pass after an

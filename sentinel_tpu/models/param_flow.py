@@ -631,9 +631,15 @@ def feed_param_exit(
             valid & has_rule & pv_present
             & (grade == C.PARAM_FLOW_GRADE_THREAD) & (stored_key == pv_hash)
         )
-        ridx = W.oob(jnp.where(dec, rule_id, -1), ps.key.shape[0])
-        threads = ps.threads.at[ridx, slot].add(
-            jnp.where(dec, -1, 0), mode="drop"
-        )
-        ps = ps._replace(threads=jnp.maximum(threads, 0))
+        # No THREAD-grade param traffic in this exit batch (the dominant
+        # QPS-rules case) → the gauge is provably untouched; skip the
+        # scatter (same no-traffic gating as the entry commit).
+        def _dec_gauges(threads_prev):
+            ridx = W.oob(jnp.where(dec, rule_id, -1), ps.key.shape[0])
+            threads = threads_prev.at[ridx, slot].add(
+                jnp.where(dec, -1, 0), mode="drop")
+            return jnp.maximum(threads, 0)
+
+        ps = ps._replace(threads=jax.lax.cond(
+            jnp.any(dec), _dec_gauges, lambda t: t, ps.threads))
     return ps

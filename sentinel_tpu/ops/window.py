@@ -54,6 +54,23 @@ def oob(rows: jax.Array, n: int) -> jax.Array:
     return jnp.where(rows < 0, n, rows)
 
 
+def varying_zeros(like: jax.Array, shape, dtype) -> jax.Array:
+    """All-zero array DERIVED from ``like``, not a literal constant.
+
+    Accumulators that flow through ``lax.cond`` gates whose taken branch
+    depends on (device-sharded) batch data must type as "varying" under
+    shard_map's varying-axes rules — a literal ``jnp.zeros`` is
+    unvarying and makes the cond branches disagree. Deriving the zeros
+    from batch data is free elementwise algebra outside shard_map and
+    carries the varying marking inside it. Use this for every
+    cond-gated accumulator seed (flow sweep, degrade feed, ...).
+    """
+    z = like.ravel()[0] * 0
+    if dtype in (jnp.bool_, bool):
+        return jnp.zeros(shape, bool) | (z != 0)
+    return jnp.zeros(shape, dtype) + z.astype(dtype)
+
+
 class WindowSpec(NamedTuple):
     """Static geometry of a shared-clock window."""
 

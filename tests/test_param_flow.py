@@ -218,7 +218,8 @@ def test_rule_push_cycle_never_retraces_after_first_use(engine, frozen_time):
     h = st.entry_ok("api", args=("k",))
     if h:
         h.exit()
-    assert _jit_cache_size(engine._entry_jit) == 1
+    jit0 = engine._entry_jit  # identity-pin: a rebuilt jit would reset
+    assert _jit_cache_size(jit0) == 1
     # Value-only push, family clear, and re-push: no new specialization.
     st.load_param_flow_rules([st.ParamFlowRule("api", param_idx=0, count=9)])
     h = st.entry_ok("api", args=("k",))
@@ -232,4 +233,5 @@ def test_rule_push_cycle_never_retraces_after_first_use(engine, frozen_time):
     h = st.entry_ok("api", args=("k",))
     if h:
         h.exit()
-    assert _jit_cache_size(engine._entry_jit) == 1
+    assert engine._entry_jit is jit0  # not silently rebuilt per push
+    assert _jit_cache_size(jit0) == 1
