@@ -165,6 +165,18 @@ def segmented_prefix_dense_multi(pairs, block: int = 512):
                 "segmented_prefix_dense_multi: all pairs must share the "
                 f"same leading length (got {ids_k.shape[0]} / "
                 f"{values_k.shape[0]}, expected {n})")
+    if n == 0:
+        # Zero-width batches (empty pipeline flushes) must trace: the
+        # blocked scan below still traces its body once, and indexing a
+        # (0, block) array raises. Outputs derived from the inputs (not
+        # literal zeros) keep shard_map varying-axes typing.
+        out0 = []
+        for ids, values in pairs:
+            squeeze = values.ndim == 1
+            v = values if not squeeze else values[:, None]
+            p = v.astype(jnp.float32) * 0
+            out0.append((p[:, 0] if squeeze else p, ids < jnp.int32(0)))
+        return out0
     if _use_pallas():
         from sentinel_tpu.ops.pallas_prefix import prefix_pallas_multi
 

@@ -65,7 +65,10 @@ def varying_zeros(like: jax.Array, shape, dtype) -> jax.Array:
     carries the varying marking inside it. Use this for every
     cond-gated accumulator seed (flow sweep, degrade feed, ...).
     """
-    z = like.ravel()[0] * 0
+    # [:1].sum(), not [0]: a width-0 batch (empty pipeline flush) must
+    # trace — indexing would raise at trace time and the engine's
+    # dispatch-error handler would then drop the whole device state.
+    z = like.ravel()[:1].sum() * 0
     if dtype in (jnp.bool_, bool):
         return jnp.zeros(shape, bool) | (z != 0)
     return jnp.zeros(shape, dtype) + z.astype(dtype)

@@ -73,6 +73,7 @@ QUICK = (
     "test_transport.py::test_gateway_rules_and_api_definitions_commands",
     "test_tlv_fixtures.py",     # whole file: 2.5s
     "test_redis_datasource.py",  # whole file: 2.5s
+    "test_step_fuzz.py",  # differential fuzz vs serial oracle: ~32s
 )
 
 

@@ -1,0 +1,283 @@
+"""Randomized differential fuzz: fused step vs a serial oracle.
+
+SURVEY.md §4/§5 (the race-detector analog): the device step must agree
+with a sequential pure-Python re-implementation of the reference
+semantics on randomized mixed workloads. Scope is the serially-exact
+regime (unit counts, one rule per family per resource, distinct
+non-colliding param values), where the two-pass prefix scheme is
+documented to equal serial execution — so any divergence is a bug, not
+an approximation. The mix includes QPS and THREAD grades for BOTH flow
+and param rules with randomized exits, so the THREAD-gauge cond gates
+(entry commit + exit decrement) run in taken and skipped states across
+random batches; the RL/occupy gates run skipped-only here — their
+taken-state semantics are pinned by test_flow/test_occupy.
+
+One fixed batch width (padding with invalid rows) keeps this at two jit
+specializations total.
+"""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import (
+    ExitBatch,
+    make_entry_batch_np,
+    make_exit_batch_np,
+)
+from sentinel_tpu.core.batch import EntryBatch
+from sentinel_tpu.utils.param_hash import hash_param
+
+WIDTH = 32
+NOW0 = 1_700_000_000_000
+
+
+class OracleWindow:
+    """1s/2-bucket pass window (lazy reset), matching SPEC_1S."""
+
+    def __init__(self):
+        self.starts = [-1, -1]
+        self.counts = [0, 0]
+
+    def total(self, now):
+        idx = (now // 500) % 2
+        ws = now - now % 500
+        t = 0
+        for b in range(2):
+            expect = ws if b == idx else ws - 500
+            if self.starts[b] == expect:
+                t += self.counts[b]
+        return t
+
+    def add(self, now):
+        idx = (now // 500) % 2
+        ws = now - now % 500
+        if self.starts[idx] != ws:
+            self.starts[idx] = ws
+            self.counts[idx] = 0
+        self.counts[idx] += 1
+
+
+class Oracle:
+    """Sequential reference semantics over the fuzz rule set."""
+
+    def __init__(self, spec):
+        self.spec = spec          # per-resource dict of rules
+        self.win = {r: OracleWindow() for r in spec}
+        self.gauge = {r: 0 for r in spec}
+        self.param = {}           # (resource, value) -> [tokens, filled]
+        self.pgauge = {}          # (resource, value) -> concurrency
+
+    def admit(self, res, origin, value, now):
+        s = self.spec[res]
+        # Chain order: authority -> param -> flow (system off).
+        auth = s.get("authority")
+        if auth is not None:
+            allow, white = auth
+            inside = origin in allow
+            if (white and not inside) or ((not white) and inside):
+                return C.BlockReason.AUTHORITY
+        prule = s.get("param")
+        if prule is not None and value is not None:
+            pgrade, pcount = prule
+            key = (res, value)
+            if pgrade == "thread":
+                # Per-value concurrency gauge; exits release.
+                if self.pgauge.get(key, 0) + 1 > pcount:
+                    return C.BlockReason.PARAM_FLOW
+                self.pgauge[key] = self.pgauge.get(key, 0) + 1
+            else:
+                # Reference token bucket: elapsed-based refill against
+                # the LAST fill stamp (not calendar windows); an owner
+                # touch writes the refreshed level back even when
+                # blocked.
+                state = self.param.get(key)
+                if state is None:
+                    if pcount < 1:
+                        return C.BlockReason.PARAM_FLOW
+                    self.param[key] = [pcount - 1, now]
+                else:
+                    tokens, filled = state
+                    windows = (now - filled) // 1000
+                    avail = min(tokens + windows * pcount, pcount)
+                    if windows >= 1:
+                        state[1] = now
+                    state[0] = avail
+                    if avail < 1:
+                        return C.BlockReason.PARAM_FLOW
+                    state[0] = avail - 1
+        frule = s.get("flow")
+        if frule is not None:
+            grade, count = frule
+            if grade == C.FLOW_GRADE_QPS:
+                if self.win[res].total(now) + 1 > count:
+                    # A param admit above already consumed a token; the
+                    # serial reference does the same (rate-limiter heads
+                    # and param buckets move before later slots reject).
+                    return C.BlockReason.FLOW
+            else:  # THREAD
+                if self.gauge[res] + 1 > count:
+                    return C.BlockReason.FLOW
+        self.win[res].add(now)
+        self.gauge[res] += 1
+        return C.BlockReason.PASS
+
+    def exit(self, res, value):
+        self.gauge[res] -= 1
+        prule = self.spec[res].get("param")
+        if (prule is not None and prule[0] == "thread"
+                and value is not None):
+            self.pgauge[(res, value)] -= 1
+
+
+def _pick_param_values(rng):
+    """Distinct values whose table slots don't collide (the fuzz scope
+    is the exact-ownership regime)."""
+    vals, slots = [], set()
+    while len(vals) < 4:
+        v = f"v{int(rng.integers(1, 10_000))}"
+        slot = int(np.uint32(hash_param(v)) % 2048)
+        if slot not in slots:
+            slots.add(slot)
+            vals.append(v)
+    return vals
+
+
+@pytest.mark.parametrize("seed", [11, 23, 37, 59])
+def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
+    rng = np.random.default_rng(seed)
+    resources = [f"res{i}" for i in range(12)]
+    origins = ["appA", "appB", "appC"]
+
+    spec = {}
+    flow_rules, auth_rules, param_rules = [], [], []
+    for r in resources:
+        s = {}
+        roll = rng.random()
+        if roll < 0.4:
+            count = int(rng.integers(0, 8))
+            s["flow"] = (C.FLOW_GRADE_QPS, count)
+            flow_rules.append(st.FlowRule(resource=r, count=count))
+        elif roll < 0.6:
+            count = int(rng.integers(1, 4))
+            s["flow"] = (C.FLOW_GRADE_THREAD, count)
+            flow_rules.append(st.FlowRule(resource=r, count=count,
+                                          grade=C.FLOW_GRADE_THREAD))
+        if rng.random() < 0.3:
+            allow = set(rng.choice(origins,
+                                   size=int(rng.integers(1, 3)),
+                                   replace=False).tolist())
+            white = bool(rng.random() < 0.5)
+            s["authority"] = (allow, white)
+            auth_rules.append(st.AuthorityRule(
+                r, ",".join(sorted(allow)),
+                C.AUTHORITY_WHITE if white else C.AUTHORITY_BLACK))
+        if rng.random() < 0.4:
+            pcount = int(rng.integers(1, 5))
+            if rng.random() < 0.35:
+                s["param"] = ("thread", pcount)
+                param_rules.append(st.ParamFlowRule(
+                    r, param_idx=0, count=pcount,
+                    grade=C.PARAM_FLOW_GRADE_THREAD))
+            else:
+                s["param"] = ("qps", pcount)
+                param_rules.append(st.ParamFlowRule(r, param_idx=0,
+                                                    count=pcount))
+        spec[r] = s
+
+    st.load_flow_rules(flow_rules)
+    st.load_authority_rules(auth_rules)
+    st.load_param_flow_rules(param_rules)
+    engine._ensure_compiled()
+
+    reg = engine.registry
+    values = {r: _pick_param_values(rng) for r in resources
+              if spec[r].get("param") is not None}
+    oracle = Oracle(spec)
+    now = NOW0
+    open_handles = []   # (resource,) admitted, not yet exited
+
+    for step in range(40):
+        now += int(rng.integers(0, 800))
+        frozen_time.freeze_time(now)
+        n = int(rng.integers(4, WIDTH + 1))
+        buf = make_entry_batch_np(WIDTH)
+        buf["cluster_row"][:] = -1  # padding rows: invalid
+        meta = []
+        for i in range(n):
+            r = resources[int(rng.integers(0, len(resources)))]
+            origin = origins[int(rng.integers(0, len(origins)))]
+            v = None
+            if spec[r].get("param") is not None and rng.random() < 0.8:
+                v = values[r][int(rng.integers(0, 4))]
+            buf["cluster_row"][i] = reg.cluster_row(r)
+            buf["origin_row"][i] = reg.origin_row(r, origin)
+            buf["origin_id"][i] = reg.origin_id(origin)
+            buf["origin_named"][i] = True
+            buf["dn_row"][i] = -1
+            buf["count"][i] = 1
+            if v is not None:
+                buf["param_hash"][i, 0] = np.uint32(hash_param(v))
+                buf["param_present"][i, 0] = True
+            meta.append((r, origin, v))
+
+        dec = engine.check_batch(
+            EntryBatch(**{k: np.asarray(a) for k, a in buf.items()}),
+            now_ms=now)
+        reasons = np.asarray(dec.reason)[:n]
+
+        want = np.asarray([oracle.admit(r, o, v, now) for r, o, v in meta])
+        assert (reasons == want).all(), (
+            f"seed {seed} step {step}: device {reasons.tolist()} "
+            f"!= oracle {want.tolist()} for {meta}")
+
+        open_handles += [(m[0], m[2]) for m, rr in zip(meta, reasons)
+                         if rr == C.BlockReason.PASS]
+
+        # Exit a random subset of open handles (releases THREAD gauges).
+        rng.shuffle(open_handles)
+        n_exit = int(rng.integers(0, len(open_handles) + 1))
+        if n_exit:
+            closing, open_handles = (open_handles[:n_exit],
+                                     open_handles[n_exit:])
+            xbuf = make_exit_batch_np(WIDTH)
+            xbuf["cluster_row"][:] = -1
+            for i, (r, v) in enumerate(closing[:WIDTH]):
+                xbuf["cluster_row"][i] = reg.cluster_row(r)
+                xbuf["dn_row"][i] = -1
+                xbuf["count"][i] = 1
+                xbuf["rt_ms"][i] = int(rng.integers(1, 50))
+                xbuf["success"][i] = True
+                if v is not None:
+                    xbuf["param_hash"][i, 0] = np.uint32(hash_param(v))
+                    xbuf["param_present"][i, 0] = True
+                oracle.exit(r, v)
+            open_handles += closing[WIDTH:]
+            engine.complete_batch(
+                ExitBatch(**{k: np.asarray(a) for k, a in xbuf.items()}),
+                now_ms=now)
+
+
+def test_width_zero_batches_trace_and_preserve_state(engine, frozen_time):
+    """A zero-width entry/exit flush (empty pipeline buffer) must trace
+    and be a no-op — W.varying_zeros indexes like.ravel()[:1], because a
+    [0]-index would raise at trace time and the dispatch-error handler
+    would then drop the whole device state."""
+    st.load_flow_rules([st.FlowRule(resource="api", count=5)])
+    st.load_degrade_rules([st.DegradeRule(resource="api", grade=2, count=3,
+                                          time_window=1)])
+    h = st.entry_ok("api")
+    assert h is not None
+    h.exit()
+    before = engine._state
+    assert before is not None
+    ebuf = make_entry_batch_np(0)
+    dec = engine.check_batch(
+        EntryBatch(**{k: np.asarray(a) for k, a in ebuf.items()}))
+    assert np.asarray(dec.reason).shape == (0,)
+    xbuf = make_exit_batch_np(0)
+    engine.complete_batch(
+        ExitBatch(**{k: np.asarray(a) for k, a in xbuf.items()}))
+    assert engine._state is not None  # no dispatch error, state kept
+    assert st.entry_ok("api") is not None
