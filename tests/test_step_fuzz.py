@@ -68,6 +68,13 @@ class Oracle:
         self.gauge = {r: 0 for r in spec}
         self.param = {}           # (resource, value) -> [tokens, filled]
         self.pgauge = {}          # (resource, value) -> concurrency
+        # Breaker state per degrade-ruled resource. The stat window is a
+        # single calendar-aligned tumbling bucket (BREAKER_BUCKETS=1):
+        # totals zero lazily whenever now crosses a stat-interval
+        # boundary, mirrored here via win_start.
+        self.brk = {r: {"state": "CLOSED", "retry": 0, "total": 0,
+                        "err": 0, "win_start": None}
+                    for r, s in spec.items() if s.get("degrade")}
 
     def admit(self, res, origin, value, now):
         s = self.spec[res]
@@ -119,16 +126,59 @@ class Oracle:
             else:  # THREAD
                 if self.gauge[res] + 1 > count:
                     return C.BlockReason.FLOW
+        if s.get("degrade"):
+            b = self.brk[res]
+            if b["state"] == "OPEN":
+                if now >= b["retry"]:
+                    b["state"] = "HALF_OPEN"  # probe admitted
+                else:
+                    return C.BlockReason.DEGRADE
+            elif b["state"] == "HALF_OPEN":
+                return C.BlockReason.DEGRADE
         self.win[res].add(now)
         self.gauge[res] += 1
         return C.BlockReason.PASS
 
-    def exit(self, res, value):
-        self.gauge[res] -= 1
-        prule = self.spec[res].get("param")
-        if (prule is not None and prule[0] == "thread"
-                and value is not None):
-            self.pgauge[(res, value)] -= 1
+    def exit_batch(self, completions, now):
+        """Device exit-batch semantics: feed all windows, then apply
+        HALF_OPEN votes (bad wins within a batch) and trip checks once
+        on the post-batch totals."""
+        votes = {}
+        for res, value, error in completions:
+            self.gauge[res] -= 1
+            prule = self.spec[res].get("param")
+            if (prule is not None and prule[0] == "thread"
+                    and value is not None):
+                self.pgauge[(res, value)] -= 1
+            d = self.spec[res].get("degrade")
+            if d:
+                b = self.brk[res]
+                stat_ms = d[3]
+                ws = now - now % stat_ms
+                if b["win_start"] != ws:  # lazy calendar roll
+                    b["win_start"] = ws
+                    b["total"] = b["err"] = 0
+                b["total"] += 1
+                b["err"] += 1 if error else 0
+                if b["state"] == "HALF_OPEN":
+                    votes.setdefault(res, []).append(error)
+        for res, s in self.spec.items():
+            d = s.get("degrade")
+            if not d:
+                continue
+            thr, min_req, window_ms, _stat_ms = d
+            b = self.brk[res]
+            if b["state"] == "HALF_OPEN" and res in votes:
+                if any(votes[res]):          # bad wins
+                    b["state"] = "OPEN"
+                    b["retry"] = now + window_ms
+                else:
+                    b["state"] = "CLOSED"
+                    b["total"] = b["err"] = 0  # resetStat on close
+            elif b["state"] == "CLOSED":
+                if b["total"] >= min_req and b["err"] > thr:
+                    b["state"] = "OPEN"
+                    b["retry"] = now + window_ms
 
 
 def _pick_param_values(rng):
@@ -144,14 +194,14 @@ def _pick_param_values(rng):
     return vals
 
 
-@pytest.mark.parametrize("seed", [11, 23, 37, 59])
+@pytest.mark.parametrize("seed", [11, 23, 37, 59, 101, 137])
 def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
     rng = np.random.default_rng(seed)
     resources = [f"res{i}" for i in range(12)]
     origins = ["appA", "appB", "appC"]
 
     spec = {}
-    flow_rules, auth_rules, param_rules = [], [], []
+    flow_rules, auth_rules, param_rules, degrade_rules = [], [], [], []
     for r in resources:
         s = {}
         roll = rng.random()
@@ -184,11 +234,28 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
                 s["param"] = ("qps", pcount)
                 param_rules.append(st.ParamFlowRule(r, param_idx=0,
                                                     count=pcount))
+        if "flow" not in s and rng.random() < 0.4:
+            # Exception-count breaker; the oracle mirrors the device's
+            # single calendar-aligned tumbling stat bucket (lazy roll at
+            # now - now % stat_interval). Degrade-ruled resources carry
+            # no flow rule here: within one batch, flow's prefix counts
+            # entries the (later) degrade slot blocks — the documented
+            # bounded micro-batch delta (SEMANTICS.md), outside this
+            # fuzz's serial-exact scope.
+            dthr = int(rng.integers(1, 4))
+            dmin = int(rng.integers(1, 3))
+            dstat = int(rng.choice([2000, 5000, 30000]))
+            s["degrade"] = (dthr, dmin, 1000, dstat)
+            degrade_rules.append(st.DegradeRule(
+                resource=r, grade=C.DEGRADE_GRADE_EXCEPTION_COUNT,
+                count=dthr, time_window=1, min_request_amount=dmin,
+                stat_interval_ms=dstat))
         spec[r] = s
 
     st.load_flow_rules(flow_rules)
     st.load_authority_rules(auth_rules)
     st.load_param_flow_rules(param_rules)
+    st.load_degrade_rules(degrade_rules)
     engine._ensure_compiled()
 
     reg = engine.registry
@@ -243,16 +310,20 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
                                      open_handles[n_exit:])
             xbuf = make_exit_batch_np(WIDTH)
             xbuf["cluster_row"][:] = -1
+            completions = []
             for i, (r, v) in enumerate(closing[:WIDTH]):
+                err = bool(rng.random() < 0.3)
                 xbuf["cluster_row"][i] = reg.cluster_row(r)
                 xbuf["dn_row"][i] = -1
                 xbuf["count"][i] = 1
                 xbuf["rt_ms"][i] = int(rng.integers(1, 50))
-                xbuf["success"][i] = True
+                xbuf["success"][i] = not err
+                xbuf["error"][i] = err
                 if v is not None:
                     xbuf["param_hash"][i, 0] = np.uint32(hash_param(v))
                     xbuf["param_present"][i, 0] = True
-                oracle.exit(r, v)
+                completions.append((r, v, err))
+            oracle.exit_batch(completions, now)
             open_handles += closing[WIDTH:]
             engine.complete_batch(
                 ExitBatch(**{k: np.asarray(a) for k, a in xbuf.items()}),
