@@ -208,3 +208,49 @@ def test_pre_blocked_wins_over_pre_passed(engine, frozen_time):
     ]))
     assert np.asarray(dec.reason)[0] == C.BlockReason.FLOW
     assert engine.node_snapshot()["pb"]["blockQps"] == 1
+
+
+def test_flow_plus_breaker_bound_within_one_batch(engine, frozen_time):
+    """SEMANTICS.md bounded delta #1 (cross-family clause): flow's
+    within-batch prefix counts entries the later degrade slot blocks, so
+    on a flow+breaker resource the device may attribute some blocks to
+    FLOW that the serial reference attributes to DEGRADE — but it NEVER
+    admits more than serial, never admits fewer than serial minus the
+    breaker-blocked count, and commits PASS only for actual admits."""
+    st.load_flow_rules([st.FlowRule(resource="fb", count=2)])
+    st.load_degrade_rules([st.DegradeRule(
+        resource="fb", grade=C.DEGRADE_GRADE_EXCEPTION_COUNT, count=1,
+        time_window=1, min_request_amount=1, stat_interval_ms=30_000)])
+    reg = engine.registry
+    cl = reg.cluster_row("fb")
+    engine._ensure_compiled()
+
+    # Trip the breaker: admit, fail, let the exception count trip it.
+    h = st.entry("fb")
+    h.trace(ValueError("boom"))
+    h.exit()
+    h = st.entry_ok("fb")
+    if h is not None:
+        h.trace(ValueError("boom"))
+        h.exit()
+    assert st.entry_ok("fb") is None  # OPEN
+
+    # Retry due -> next batch carries exactly one probe.
+    frozen_time.advance_time(1100)
+    dec = engine.check_batch(_batch(engine, [
+        {"cluster_row": cl, "dn_row": -1, "count": 1} for _ in range(5)
+    ]))
+    reasons = np.asarray(dec.reason)
+    # Serial reference: entry 1 probes (PASS), entries 2-5 DEGRADE.
+    # Device: one PASS; the rest blocked — some as FLOW (the documented
+    # conservative attribution), none over-admitted.
+    admitted = int((reasons == C.BlockReason.PASS).sum())
+    assert admitted == 1
+    assert set(np.unique(reasons)) <= {C.BlockReason.PASS,
+                                       C.BlockReason.FLOW,
+                                       C.BlockReason.DEGRADE}
+    # State exactness: exactly the one admit committed PASS — the
+    # instant window for "fb" carries 1 pass this second.
+    row_pass = int(np.asarray(
+        engine._state.w1.counts[:, C.MetricEvent.PASS, cl]).sum())
+    assert row_pass == 1

@@ -9,8 +9,9 @@ documented to equal serial execution — so any divergence is a bug, not
 an approximation. The mix includes QPS and THREAD grades for BOTH flow
 and param rules with randomized exits, so the THREAD-gauge cond gates
 (entry commit + exit decrement) run in taken and skipped states across
-random batches; the RL/occupy gates run skipped-only here — their
-taken-state semantics are pinned by test_flow/test_occupy.
+random batches; rate-limiter rules pace with exact (reason,
+wait_us) agreement so the RL cond gates run both states too; only the
+occupy gates stay skipped-only (pinned by test_occupy).
 
 One fixed batch width (padding with invalid rows) keeps this at two jit
 specializations total.
@@ -28,6 +29,7 @@ from sentinel_tpu.core.batch import (
 )
 from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.utils.param_hash import hash_param
+from tests.oracle import OracleRateLimiter
 
 WIDTH = 32
 NOW0 = 1_700_000_000_000
@@ -68,6 +70,9 @@ class Oracle:
         self.gauge = {r: 0 for r in spec}
         self.param = {}           # (resource, value) -> [tokens, filled]
         self.pgauge = {}          # (resource, value) -> concurrency
+        self.rl = {r: OracleRateLimiter(s["flow"][1], s["flow"][2])
+                   for r, s in spec.items()
+                   if s.get("flow") and s["flow"][0] == "rl"}
         # Breaker state per degrade-ruled resource. The stat window is a
         # single calendar-aligned tumbling bucket (BREAKER_BUCKETS=1):
         # totals zero lazily whenever now crosses a stat-interval
@@ -84,7 +89,7 @@ class Oracle:
             allow, white = auth
             inside = origin in allow
             if (white and not inside) or ((not white) and inside):
-                return C.BlockReason.AUTHORITY
+                return C.BlockReason.AUTHORITY, 0
         prule = s.get("param")
         if prule is not None and value is not None:
             pgrade, pcount = prule
@@ -92,7 +97,7 @@ class Oracle:
             if pgrade == "thread":
                 # Per-value concurrency gauge; exits release.
                 if self.pgauge.get(key, 0) + 1 > pcount:
-                    return C.BlockReason.PARAM_FLOW
+                    return C.BlockReason.PARAM_FLOW, 0
                 self.pgauge[key] = self.pgauge.get(key, 0) + 1
             else:
                 # Reference token bucket: elapsed-based refill against
@@ -102,7 +107,7 @@ class Oracle:
                 state = self.param.get(key)
                 if state is None:
                     if pcount < 1:
-                        return C.BlockReason.PARAM_FLOW
+                        return C.BlockReason.PARAM_FLOW, 0
                     self.param[key] = [pcount - 1, now]
                 else:
                     tokens, filled = state
@@ -112,32 +117,36 @@ class Oracle:
                         state[1] = now
                     state[0] = avail
                     if avail < 1:
-                        return C.BlockReason.PARAM_FLOW
+                        return C.BlockReason.PARAM_FLOW, 0
                     state[0] = avail - 1
+        wait_us = 0
         frule = s.get("flow")
         if frule is not None:
-            grade, count = frule
-            if grade == C.FLOW_GRADE_QPS:
-                if self.win[res].total(now) + 1 > count:
+            if frule[0] == "rl":
+                ok, wait_us = self.rl[res].try_pass(now)
+                if not ok:
+                    return C.BlockReason.FLOW, 0
+            elif frule[0] == C.FLOW_GRADE_QPS:
+                if self.win[res].total(now) + 1 > frule[1]:
                     # A param admit above already consumed a token; the
                     # serial reference does the same (rate-limiter heads
                     # and param buckets move before later slots reject).
-                    return C.BlockReason.FLOW
+                    return C.BlockReason.FLOW, 0
             else:  # THREAD
-                if self.gauge[res] + 1 > count:
-                    return C.BlockReason.FLOW
+                if self.gauge[res] + 1 > frule[1]:
+                    return C.BlockReason.FLOW, 0
         if s.get("degrade"):
             b = self.brk[res]
             if b["state"] == "OPEN":
                 if now >= b["retry"]:
                     b["state"] = "HALF_OPEN"  # probe admitted
                 else:
-                    return C.BlockReason.DEGRADE
+                    return C.BlockReason.DEGRADE, 0
             elif b["state"] == "HALF_OPEN":
-                return C.BlockReason.DEGRADE
+                return C.BlockReason.DEGRADE, 0
         self.win[res].add(now)
         self.gauge[res] += 1
-        return C.BlockReason.PASS
+        return C.BlockReason.PASS, wait_us
 
     def exit_batch(self, completions, now):
         """Device exit-batch semantics: feed all windows, then apply
@@ -214,6 +223,14 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
             s["flow"] = (C.FLOW_GRADE_THREAD, count)
             flow_rules.append(st.FlowRule(resource=r, count=count,
                                           grade=C.FLOW_GRADE_THREAD))
+        elif roll < 0.75:
+            count = int(rng.integers(2, 30))
+            mq = int(rng.integers(0, 800))
+            s["flow"] = ("rl", count, mq)
+            flow_rules.append(st.FlowRule(
+                resource=r, count=count,
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=mq))
         if rng.random() < 0.3:
             allow = set(rng.choice(origins,
                                    size=int(rng.integers(1, 3)),
@@ -294,10 +311,16 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
             now_ms=now)
         reasons = np.asarray(dec.reason)[:n]
 
-        want = np.asarray([oracle.admit(r, o, v, now) for r, o, v in meta])
+        waits = np.asarray(dec.wait_us)[:n]
+        oracle_out = [oracle.admit(r, o, v, now) for r, o, v in meta]
+        want = np.asarray([w[0] for w in oracle_out])
+        want_wait = np.asarray([w[1] for w in oracle_out], np.int64)
         assert (reasons == want).all(), (
             f"seed {seed} step {step}: device {reasons.tolist()} "
             f"!= oracle {want.tolist()} for {meta}")
+        assert (waits == want_wait).all(), (
+            f"seed {seed} step {step}: device waits {waits.tolist()} "
+            f"!= oracle {want_wait.tolist()} for {meta}")
 
         open_handles += [(m[0], m[2]) for m, rr in zip(meta, reasons)
                          if rr == C.BlockReason.PASS]
