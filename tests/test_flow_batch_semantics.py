@@ -229,11 +229,13 @@ def test_flow_plus_breaker_bound_within_one_batch(engine, frozen_time):
     h = st.entry("fb")
     h.trace(ValueError("boom"))
     h.exit()
-    h = st.entry_ok("fb")
-    if h is not None:
-        h.trace(ValueError("boom"))
-        h.exit()
-    assert st.entry_ok("fb") is None  # OPEN
+    h2 = st.entry_ok("fb")
+    assert h2 is not None  # second admit within count=2
+    h2.trace(ValueError("boom"))
+    h2.exit()
+    # Verify OPEN via breaker state directly — a probe entry here would
+    # be flow-blocked (window already at count) and prove nothing.
+    assert int(np.asarray(engine._state.degrade.state)[0]) == C.BREAKER_OPEN
 
     # Retry due -> next batch carries exactly one probe.
     frozen_time.advance_time(1100)

@@ -203,8 +203,13 @@ def _pick_param_values(rng):
     return vals
 
 
-@pytest.mark.parametrize("seed", [11, 23, 37, 59, 101, 137])
-def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
+@pytest.mark.parametrize("seed,steps", [
+    (11, 40), (23, 40), (37, 40), (59, 40), (101, 40), (137, 40),
+    # One long soak: many breaker retry cycles, stat-window rolls, and
+    # QPS-window turnovers against a single compile.
+    (7, 150),
+])
+def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed, steps):
     rng = np.random.default_rng(seed)
     resources = [f"res{i}" for i in range(12)]
     origins = ["appA", "appB", "appC"]
@@ -282,7 +287,7 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed):
     now = NOW0
     open_handles = []   # (resource,) admitted, not yet exited
 
-    for step in range(40):
+    for step in range(steps):
         now += int(rng.integers(0, 800))
         frozen_time.freeze_time(now)
         n = int(rng.integers(4, WIDTH + 1))
