@@ -361,13 +361,13 @@ def check_flow(
     rule_prev_pass = _gather(prev_pass_all, rt.sync_row, 0).astype(jnp.float32)
     fs = _sync_warmup(rt, fs, rule_prev_pass, now_ms)
 
-    blocked1, _, _, _, _ = _eval_flow_slots(
+    blocked1, _, _, _, _, _ = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
         extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
         spec=spec, occupy_timeout_ms=occupy_timeout_ms,
     )
-    blocked, wait_us, consumed, occupied, occ_add = _eval_flow_slots(
+    blocked, wait_us, consumed, rl_cmax, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
         survivors=candidate & (~blocked1), extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
@@ -375,9 +375,15 @@ def check_flow(
         spec=spec, occupy_timeout_ms=occupy_timeout_ms,
     )
 
-    # Advance leaky buckets: latest' = max(latest, now - cost) + consumed*cost
+    # Advance leaky buckets: latest' = max(latest, now - acquire·cost) +
+    # consumed·cost — the idle clamp scales with the acquire size (the
+    # reference's whole-acquire-free-after-idle; see the verdict-side
+    # comment). rl_cmax is the per-rule admitted acquire count (uniform
+    # within a batch in the serially-exact regime).
     now_us = now_ms.astype(jnp.int64) * 1000
-    new_latest = jnp.maximum(fs.latest_passed_us, now_us - rt.cost_us) + consumed * rt.cost_us
+    new_latest = (jnp.maximum(fs.latest_passed_us,
+                              now_us - rt.cost_us * jnp.maximum(rl_cmax, 1))
+                  + consumed * rt.cost_us)
     fs = fs._replace(
         latest_passed_us=jnp.where(consumed > 0, new_latest, fs.latest_passed_us)
     )
@@ -436,6 +442,9 @@ def _eval_flow_slots(
                               jnp.int32)  # granted borrows per row
     consumed = W.varying_zeros(batch.count, (rt.num_rules,),
                                jnp.int64)  # rate-limiter tokens
+    # Per-rule max admitted acquire count: the state advance clamps the
+    # idle bucket head by acquire·cost (see the verdict-side comment).
+    rl_cmax = W.varying_zeros(batch.count, (rt.num_rules,), jnp.int64)
 
     # Occupy-next-window geometry (DefaultController.tryOccupyNext): at the
     # next bucket boundary the OLDEST bucket's counts leave the window, so
@@ -562,11 +571,15 @@ def _eval_flow_slots(
             lambda _: W.varying_zeros(batch.count, (n,), jnp.float32), 0)
         now_us = now_ms.astype(jnp.int64) * 1000
         # Clamp the bucket head the same way the state advance does: the
-        # reference sets latestPassedTime = NOW for the first pass after an
-        # idle period (not latest + cost), i.e. the effective base is
-        # max(latest, now - cost). Using the raw stale head here would let
-        # a whole micro-batch through unpaced after any idle gap.
-        latest = jnp.maximum(g(fs.latest_passed_us, 0), now_us - cost)
+        # reference sets latestPassedTime = NOW for the first pass after
+        # an idle period (not latest + cost), i.e. the effective base is
+        # max(latest, now - acquire·cost) — the WHOLE multi-token acquire
+        # is free after idle (RateLimiterController: expected ≤ now →
+        # latest = now), not just one token; found by the differential
+        # fuzz at count>1. Using the raw stale head here would let a
+        # whole micro-batch through unpaced after any idle gap.
+        latest = jnp.maximum(g(fs.latest_passed_us, 0),
+                             now_us - cost * batch.count)
         expected = latest + (rl_prefix + batch.count).astype(jnp.int64) * cost
         rl_wait = jnp.maximum(expected - now_us, 0)
         rl_ok = rl_wait <= g(rt.max_queue_us, 0)
@@ -648,11 +661,17 @@ def _eval_flow_slots(
         # cond as the prefix above.
         admitted_rl = applicable & is_rl & ok & survivors
         wait_us = jnp.maximum(wait_us, jnp.where(admitted_rl, rl_wait, 0))
-        consumed = jax.lax.cond(
-            any_rl,
-            lambda c: c.at[W.oob(rule_id, rt.num_rules)].add(
-                jnp.where(admitted_rl, batch.count, 0).astype(jnp.int64),
-                mode="drop"),
-            lambda c: c, consumed)
 
-    return blocked, wait_us, consumed, occupied, occ_add
+        def _consume(args):
+            c_, cmax_ = args
+            ridx = W.oob(rule_id, rt.num_rules)
+            admitted_counts = jnp.where(admitted_rl, batch.count,
+                                        0).astype(jnp.int64)
+            c_ = c_.at[ridx].add(admitted_counts, mode="drop")
+            cmax_ = cmax_.at[ridx].max(admitted_counts, mode="drop")
+            return c_, cmax_
+
+        consumed, rl_cmax = jax.lax.cond(
+            any_rl, _consume, lambda args: args, (consumed, rl_cmax))
+
+    return blocked, wait_us, consumed, rl_cmax, occupied, occ_add

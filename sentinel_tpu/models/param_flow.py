@@ -474,7 +474,10 @@ def _eval_param(
         ).astype(jnp.int64)
         head0 = _gather2(ps.passed_us, srule, slot, 0)
         head0 = jnp.where(fresh, 0, head0)
-        latest = jnp.maximum(head0, now_us - cost_us)
+        # Idle clamp scales with the acquire (whole multi-token acquire
+        # free after idle, like the reference — see flow.py's RL note).
+        latest = jnp.maximum(head0,
+                             now_us - cost_us * batch.count.astype(jnp.int64))
         expected = latest + (tok_prefix + batch.count).astype(jnp.int64) * cost_us
         rl_wait = jnp.maximum(expected - now_us, 0)
         rl_ok = (thr > 0) & (rl_wait <= g(rt.max_queue_us, 0))
