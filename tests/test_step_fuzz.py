@@ -67,6 +67,7 @@ class Oracle:
     def __init__(self, spec):
         self.spec = spec          # per-resource dict of rules
         self.win = {r: OracleWindow() for r in spec}
+        self.owin = {}            # (resource, origin) -> OracleWindow
         self.gauge = {r: 0 for r in spec}
         self.param = {}           # (resource, value) -> [tokens, filled]
         self.pgauge = {}          # (resource, value) -> concurrency
@@ -134,6 +135,15 @@ class Oracle:
                     # serial reference does the same (rate-limiter heads
                     # and param buckets move before later slots reject).
                     return C.BlockReason.FLOW, 0
+            elif frule[0] == "qps_origin":
+                # Applies only to the named origin, admitting against
+                # that origin's own statistics node.
+                _, count, lim = frule
+                if origin == lim:
+                    ow = self.owin.setdefault(
+                        (res, origin), OracleWindow())
+                    if ow.total(now) + c > count:
+                        return C.BlockReason.FLOW, 0
             else:  # THREAD
                 if self.gauge[res] + 1 > frule[1]:
                     return C.BlockReason.FLOW, 0
@@ -147,6 +157,8 @@ class Oracle:
             elif b["state"] == "HALF_OPEN":
                 return C.BlockReason.DEGRADE, 0
         self.win[res].add(now, c)
+        if frule is not None and frule[0] == "qps_origin" and origin == frule[2]:
+            self.owin.setdefault((res, origin), OracleWindow()).add(now, c)
         self.gauge[res] += 1
         return C.BlockReason.PASS, wait_us
 
@@ -238,6 +250,12 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed, steps):
                 resource=r, count=count,
                 control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
                 max_queueing_time_ms=mq))
+        elif roll < 0.85:
+            count = int(rng.integers(0, 6))
+            lim = origins[int(rng.integers(0, len(origins)))]
+            s["flow"] = ("qps_origin", count, lim)
+            flow_rules.append(st.FlowRule(resource=r, count=count,
+                                          limit_app=lim))
         if rng.random() < 0.3:
             allow = set(rng.choice(origins,
                                    size=int(rng.integers(1, 3)),
