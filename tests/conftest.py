@@ -74,6 +74,7 @@ QUICK = (
     "test_tlv_fixtures.py",     # whole file: 2.5s
     "test_redis_datasource.py",  # whole file: 2.5s
     "test_step_fuzz.py",  # differential fuzz vs serial oracle: ~32s
+    "test_token_service_fuzz.py",  # token-service fuzz vs oracle: ~2s
 )
 
 

@@ -1,0 +1,109 @@
+"""Randomized differential fuzz: cluster token service vs serial oracle.
+
+`DefaultTokenService.request_tokens` claims serial-exact arrival-order
+admission (the reference's per-request CAS semantics folded into one
+`lax.scan`). This fuzz replays randomized batches — mixed flow ids,
+counts, prioritized occupy requests, unknown ids, random time advances
+across bucket and window boundaries — against a sequential pure-Python
+oracle mirroring the ring geometry (shared bucket count, per-rule
+bucket_ms, lazy expected-start reset) and requires identical
+(status, extra) for every request: extra is `remaining` for OK and the
+time-to-next-bucket for SHOULD_WAIT.
+
+One fixed batch width (padded with an unknown flowId) keeps this at a
+single jit specialization.
+"""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import constants as CC
+from tests.oracle import OracleLeapArray
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+WIDTH = 32
+NOW0 = 1_700_000_000_000
+BUCKETS = CC.DEFAULT_SAMPLE_COUNT
+P_CH, W_CH = 0, 1  # OracleLeapArray channels: pass, waiting
+
+
+@pytest.mark.parametrize("seed", [5, 17, 41])
+def test_token_service_matches_serial_oracle(seed):
+    rng = np.random.default_rng(seed)
+    flows = {}
+    rules = []
+    for i in range(16):
+        fid = 1000 + i
+        thr = float(rng.integers(0, 20))
+        interval = int(rng.choice([500, 1000, 2000]))
+        ttype = int(rng.choice([CC.THRESHOLD_GLOBAL, CC.THRESHOLD_AVG_LOCAL]))
+        flows[fid] = {"thr": thr, "interval": interval, "ttype": ttype,
+                      "ring": OracleLeapArray(interval, BUCKETS, 2)}
+        rules.append(st.FlowRule(
+            resource=f"clus{i}", count=thr, cluster_mode=True,
+            cluster_config={"flowId": fid, "thresholdType": ttype,
+                            "windowIntervalMs": interval}))
+    mgr = ClusterFlowRuleManager()
+    mgr.load_rules("default", rules)
+    svc = DefaultTokenService(mgr)
+    # Live connections make AVG_LOCAL a real branch: effective threshold
+    # = count x max(connected, 1) for AVG_LOCAL rules only.
+    n_conns = int(rng.integers(1, 4))
+    for _ in range(n_conns):
+        svc.connections.connect("default")
+    for f in flows.values():
+        if f["ttype"] == CC.THRESHOLD_AVG_LOCAL:
+            f["thr"] = f["thr"] * max(n_conns, 1)
+    fids = sorted(flows)
+
+    now = NOW0
+    for step in range(40):
+        now += int(rng.integers(0, 300))
+        n = int(rng.integers(4, WIDTH + 1))
+        batch = []
+        for _ in range(n):
+            batch.append((fids[int(rng.integers(0, len(fids)))],
+                          int(rng.integers(1, 4)),
+                          bool(rng.random() < 0.25)))
+        batch += [(999, 1, False)] * (WIDTH - n)  # unknown-id padding
+
+        results = svc.request_tokens(batch, now_ms=now)
+
+        # Sequential oracle over the same batch (AVG_LOCAL thresholds
+        # already scaled by the registered connection count above).
+        for i, (fid, c, prio) in enumerate(batch[:n]):
+            f = flows[fid]
+            p = f["ring"].total(now, P_CH)
+            w = f["ring"].total(now, W_CH)
+            scale = 1000.0 / f["interval"]
+            used = (p + w) * scale
+            bm = f["interval"] // BUCKETS
+            if used + c <= f["thr"]:
+                want = CC.TokenResultStatus.OK
+                want_extra = int(max(f["thr"] - used - c, 0))
+                f["ring"].current(now)  # lazy reset
+                f["ring"].add(now, P_CH, c)
+            elif prio and w + c <= 1.0 * f["thr"]:  # maxOccupyRatio 1.0
+                want = CC.TokenResultStatus.SHOULD_WAIT
+                want_extra = int(bm - now % bm)
+                f["ring"].current(now)
+                f["ring"].add(now, W_CH, c)
+            else:
+                want = CC.TokenResultStatus.BLOCKED  # no quota consumed
+                want_extra = 0
+            got = results[i]
+            assert got.status == want, (
+                f"seed {seed} step {step} req {i} ({fid},{c},{prio}): "
+                f"device {got.status} != oracle {want}")
+            if want == CC.TokenResultStatus.OK:
+                assert got.remaining == want_extra, (
+                    f"seed {seed} step {step} req {i}: remaining "
+                    f"{got.remaining} != {want_extra}")
+            elif want == CC.TokenResultStatus.SHOULD_WAIT:
+                assert got.wait_ms == want_extra, (
+                    f"seed {seed} step {step} req {i}: wait "
+                    f"{got.wait_ms} != {want_extra}")
+        for r in results[n:]:
+            assert r.status == CC.TokenResultStatus.NO_RULE_EXISTS
