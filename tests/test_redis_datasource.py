@@ -31,7 +31,9 @@ def server():
     s.stop()
 
 
-def _wait_for(pred, timeout_s: float = 5.0) -> bool:
+def _wait_for(pred, timeout_s: float = 20.0) -> bool:
+    # Generous: under a fully contended suite run (dozens of parallel
+    # XLA compiles) a 5s margin starved once; slack is free when fast.
     deadline = time.time() + timeout_s
     while time.time() < deadline:
         if pred():
