@@ -3,18 +3,24 @@
 SURVEY.md §4/§5 (the race-detector analog): the device step must agree
 with a sequential pure-Python re-implementation of the reference
 semantics on randomized mixed workloads. Scope is the serially-exact
-regime (unit counts, one rule per family per resource, distinct
-non-colliding param values), where the two-pass prefix scheme is
-documented to equal serial execution — so any divergence is a bug, not
-an approximation. The mix includes QPS and THREAD grades for BOTH flow
-and param rules with randomized exits, so the THREAD-gauge cond gates
-(entry commit + exit decrement) run in taken and skipped states across
-random batches; rate-limiter rules pace with exact (reason,
-wait_us) agreement so the RL cond gates run both states too; only the
-occupy gates stay skipped-only (pinned by test_occupy).
+regime — uniform acquire counts (1-3), one rule per family per
+resource, flow and degrade on disjoint resources (their cross-family
+prefix interplay is the documented bounded delta), distinct
+non-colliding param values — where the two-pass prefix scheme is
+documented to equal serial execution, so any divergence is a bug, not
+an approximation.
+
+The rule mix: flow QPS / THREAD / rate-limiter (exact (reason, wait_us)
+agreement) / origin-limited QPS; authority white+black lists; param
+QPS / THREAD; exception-count circuit breakers (probe-at-entry,
+feed-at-exit with bad-wins batch votes, calendar-tumbling stat
+windows); randomized exits carrying error flags and acquire counts.
+Already caught in round 4: the multi-token rate-limiter idle-grace
+fidelity bug, the zero-width batch trace crash, and the undocumented
+flow→degrade prefix delta.
 
 One fixed batch width (padding with invalid rows) keeps this at two jit
-specializations total.
+specializations.
 """
 
 import numpy as np
