@@ -70,6 +70,13 @@ from sentinel_tpu.ops import step as S
 from sentinel_tpu.utils import time_util
 from sentinel_tpu.utils.param_hash import hash_param as _hash_param
 
+# Per-family slot-count floors at engine construction (and after a
+# reset_slot_floor): flow starts at 1 (compile_flow_rules' historical
+# floor); the rest compile to zero slots until first use. One definition
+# shared by __init__ and reset_slot_floor so the two can't drift.
+INITIAL_SLOT_FLOOR = {"flow": 1, "degrade": 0, "authority": 0, "param": 0}
+
+
 class EntryHandle:
     """A live entry (reference: ``CtEntry``). Use as a context manager."""
 
@@ -246,8 +253,7 @@ class SentinelEngine:
         # Flow starts at 1 (compile_flow_rules' historical floor) and
         # ratchets up the same way: a second rule on one resource widens
         # the shape once and it never shrinks back.
-        self._slot_floor = {"flow": 1, "degrade": 0, "authority": 0,
-                            "param": 0}
+        self._slot_floor = dict(INITIAL_SLOT_FLOOR)
         self._rebuild_w1_jits()
         self._flush_jit = jax.jit(S.flush_seconds, donate_argnums=(0,))
         self._w60_read_jit = jax.jit(lambda st_, now, idx: jnp.transpose(
@@ -530,9 +536,33 @@ class SentinelEngine:
     def _ratchet_slots(self, **tensors) -> None:
         """Raise each family's slot floor to what was just compiled, so
         later pushes (even back to zero rules) keep the same tensor
-        shapes and never retrace the fused step."""
+        shapes and never retrace the fused step.
+
+        The ratchet is monotonic for the process lifetime BY DESIGN: a
+        one-time burst of K rules on one resource widens that family's
+        per-slot device loop to K forever, trading steady-state step cost
+        for the no-retrace guarantee. After a known-transient burst, ops
+        can reclaim the cost with ``reset_slot_floor()`` (one retrace) —
+        see OPERATIONS.md "retune"."""
         for family, rt in tensors.items():
             self._slot_floor[family] = max(self._slot_floor[family], rt.slots)
+
+    def reset_slot_floor(self) -> Dict[str, int]:
+        """Drop every family's slot floor back to its initial value and
+        force a recompile, shrinking the per-slot device loops to what
+        the CURRENT rules actually need.
+
+        Costs one fused-step retrace on the next dispatch (the exact
+        thing the ratchet exists to avoid) — call it deliberately after
+        a transient rule burst, not on a schedule. Returns the floor that
+        was in effect before the reset (ops visibility)."""
+        with self._config_lock:
+            old = dict(self._slot_floor)
+            self._slot_floor = dict(INITIAL_SLOT_FLOOR)
+            for family in INITIAL_SLOT_FLOOR:
+                self._dirty[family] = True
+            self._rebuild_leases()
+        return old
 
     def _maybe_start_system_listener(self):
         def is_set(v):

@@ -118,22 +118,39 @@ class EurekaDataSource(ContentDedupPollMixin, AutoRefreshDataSource[str, T]):
 class EurekaWritableDataSource(WritableDataSource[T]):
     """Publish via ``PUT /apps/<APP>/<id>/metadata?<ruleKey>=<encoded>``
     (Eureka's real metadata-update endpoint — the value rides a query
-    parameter, so it is URL-encoded)."""
+    parameter, so it is URL-encoded).
+
+    Size limitation (inherent to the endpoint, not this client): the
+    whole encoded rule document travels in the request URL, and common
+    servers/proxies cap URLs around 8KB — a few hundred JSON rules.
+    Writes whose URL exceeds ``max_url_bytes`` (default 7KB, leaving
+    headroom under the usual 8KB cap) raise ``ValueError`` up front
+    rather than failing opaquely server-side; raise the limit only if
+    every hop to your Eureka server is known to accept more."""
 
     def __init__(self, service_url: str, app_id: str, instance_id: str,
-                 rule_key: str, encoder: Converter, timeout_s: float = 5.0):
+                 rule_key: str, encoder: Converter, timeout_s: float = 5.0,
+                 max_url_bytes: int = 7168):
         self.base = normalize_base(service_url)
         self.app_id = app_id
         self.instance_id = instance_id
         self.rule_key = rule_key
         self.encoder = encoder
         self.timeout_s = timeout_s
+        self.max_url_bytes = max_url_bytes
 
     def write(self, value: T) -> None:
         qs = urllib.parse.urlencode({self.rule_key: self.encoder(value)})
         url = "%s/apps/%s/%s/metadata?%s" % (
             self.base, urllib.parse.quote(self.app_id),
             urllib.parse.quote(self.instance_id), qs)
+        if len(url.encode("utf-8")) > self.max_url_bytes:
+            raise ValueError(
+                "eureka metadata write: encoded URL is "
+                f"{len(url.encode('utf-8'))} bytes > max_url_bytes="
+                f"{self.max_url_bytes}; Eureka's metadata endpoint rides "
+                "the query string and servers/proxies commonly cap URLs "
+                "~8KB — shrink the rule set or use another datasource")
         req = urllib.request.Request(url, method="PUT")
         # urlopen raises on >=400; any 2xx (200 or a proxy's 204) is a
         # successful write.

@@ -123,19 +123,31 @@ def segmented_prefix_dense(
     return prefix, is_first
 
 
-def _use_pallas() -> bool:
-    """Opt-in routing of the dense prefix through the Pallas kernel
-    (``SENTINEL_TPU_PALLAS=1`` on a real TPU). Standalone the kernel
-    measured 1.71x the XLA scan (ops/pallas_prefix.py), but embedded in
-    the donated 16-step fused-step scan it crashed this image's backend
-    with a non-unwinding runtime panic (r4; the tunnel needed recovery) —
-    so the XLA path stays the default until the in-step embedding is
-    proven on hardware. The kernel itself is correctness-tested in
-    interpret mode on CPU (test_pallas_prefix.py)."""
+def _read_pallas_flag() -> bool:
     import os
 
-    if os.environ.get("SENTINEL_TPU_PALLAS", "").lower() not in (
-            "1", "true", "yes", "on"):
+    return os.environ.get("SENTINEL_TPU_PALLAS", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+# Captured ONCE at import: jit caches traces, and a trace bakes in the
+# routing decision — re-reading the env var per trace would let one
+# process mix both prefix implementations across already-compiled vs
+# freshly-traced batch widths (r4 advisory). Set SENTINEL_TPU_PALLAS
+# before importing sentinel_tpu; later changes are intentionally inert.
+_PALLAS_OPTED_IN = _read_pallas_flag()
+
+
+def _use_pallas() -> bool:
+    """Opt-in routing of the dense prefix through the Pallas kernel
+    (``SENTINEL_TPU_PALLAS=1`` at import time, on a real TPU). Standalone
+    the kernel measured 1.71x the XLA scan (ops/pallas_prefix.py), but
+    embedded in the donated 16-step fused-step scan it crashed this
+    image's backend with a non-unwinding runtime panic (r4; the tunnel
+    needed recovery) — so the XLA path stays the default until the
+    in-step embedding is proven on hardware. The kernel itself is
+    correctness-tested in interpret mode on CPU (test_pallas_prefix.py)."""
+    if not _PALLAS_OPTED_IN:
         return False
     try:
         return jax.default_backend() == "tpu"

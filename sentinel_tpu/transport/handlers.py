@@ -222,6 +222,24 @@ def cmd_leases(req: CommandRequest) -> CommandResponse:
     })
 
 
+@command_mapping("resetSlotFloor", "shrink ratcheted per-slot device loops")
+def cmd_reset_slot_floor(req: CommandRequest) -> CommandResponse:
+    """Reclaim step cost after a transient rule burst: the engine's
+    slot-count ratchet (engine._ratchet_slots) widens per-family device
+    loops monotonically to keep rule pushes retrace-free, so a one-time
+    K-rule push costs K loop iterations per step forever. This command
+    drops the floor to what current rules need, at the price of ONE
+    fused-step retrace on the next dispatch (no reference twin — the
+    upstream's per-resource object graph has no compiled shapes)."""
+    eng = req.engine
+    old = eng.reset_slot_floor()
+    return CommandResponse.of_success({
+        "previousFloor": old,
+        "floor": dict(eng._slot_floor),
+        "note": "next dispatch pays one retrace per affected batch width",
+    })
+
+
 @command_mapping("getSwitch", "global protection switch state")
 def cmd_get_switch(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success(

@@ -168,6 +168,22 @@ def test_eureka_writable_publish_roundtrip(eureka):
         src.close()
 
 
+def test_eureka_writable_url_size_guard(eureka):
+    """The metadata endpoint rides the query string; an oversized rule
+    document must fail fast with a clear error, not opaquely at a proxy
+    (r4 advisory — common URL caps sit ~8KB)."""
+    from sentinel_tpu.models.flow import FlowRule
+
+    writer = EurekaWritableDataSource(eureka.service_url, "demo-app", "i-1",
+                                      RULE_KEY, flow_rules_to_json)
+    big = [FlowRule(resource=f"res-{i:06d}", count=float(i))
+           for i in range(2000)]
+    with pytest.raises(ValueError, match="max_url_bytes"):
+        writer.write(big)
+    # nothing reached the server
+    assert "res-000000" not in eureka.metadata("demo-app", "i-1")[RULE_KEY]
+
+
 def test_eureka_raw_http_shape(eureka):
     req = urllib.request.Request(
         eureka.service_url + "/apps/DEMO-APP/i-1",

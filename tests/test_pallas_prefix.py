@@ -14,6 +14,7 @@ import pytest
 import jax.numpy as jnp
 
 from sentinel_tpu.ops.pallas_prefix import prefix_pallas, prefix_pallas_multi
+from sentinel_tpu.ops import segment
 from sentinel_tpu.ops.segment import (
     _use_pallas,
     segmented_prefix,
@@ -77,10 +78,26 @@ def test_wide_counts_exact_beyond_bf16():
 
 def test_dispatch_gate_defaults_off(monkeypatch):
     monkeypatch.delenv("SENTINEL_TPU_PALLAS", raising=False)
+    assert segment._read_pallas_flag() is False
     assert _use_pallas() is False
 
 
 def test_dispatch_gate_explicit_zero_is_off(monkeypatch):
     for off in ("0", "false", "no", ""):
         monkeypatch.setenv("SENTINEL_TPU_PALLAS", off)
-        assert _use_pallas() is False, off
+        assert segment._read_pallas_flag() is False, off
+
+
+def test_dispatch_gate_frozen_at_import(monkeypatch):
+    """The flag is captured ONCE at import so one process can never mix
+    prefix implementations across cached vs fresh traces (r4 advisory):
+    flipping the env var afterwards must be inert. Runs under any
+    ambient SENTINEL_TPU_PALLAS (the suite may legitimately be launched
+    with it set to exercise the kernel) by asserting the FLIP is inert,
+    not a particular captured value."""
+    captured = segment._PALLAS_OPTED_IN
+    before = _use_pallas()
+    monkeypatch.setenv("SENTINEL_TPU_PALLAS", "1" if not captured else "0")
+    assert segment._read_pallas_flag() is (not captured)  # env parse works
+    assert segment._PALLAS_OPTED_IN is captured           # capture held
+    assert _use_pallas() is before                        # routing inert

@@ -199,6 +199,68 @@ def test_engine_slot_floor_ratchets_across_pushes(engine, frozen_time):
     assert tuple(engine._rules.param.rules_by_row.shape) == shape_with_rules
 
 
+def test_reset_slot_floor_shrinks_after_transient_burst(engine, frozen_time):
+    """The ratchet's escape hatch (r4 advisory): after a transient burst
+    widens a family's loop, ``reset_slot_floor()`` (the ``resetSlotFloor``
+    ops command) shrinks the compiled shapes back to what current rules
+    need, at the documented cost of one retrace."""
+    st.load_param_flow_rules([
+        st.ParamFlowRule("hot", param_idx=0, count=2, duration_in_sec=i + 1)
+        for i in range(4)  # 4 rules on ONE resource -> 4 slots
+    ])
+    h = st.entry_ok("hot", args=("k",))
+    if h:
+        h.exit()
+    assert engine._slot_floor["param"] == 4
+    st.load_param_flow_rules(
+        [st.ParamFlowRule("hot", param_idx=0, count=2)])  # burst over
+    h = st.entry_ok("hot", args=("k",))
+    if h:
+        h.exit()
+    assert engine._slot_floor["param"] == 4  # ratchet held the wide shape
+    wide = tuple(engine._rules.param.rules_by_row.shape)
+
+    old = engine.reset_slot_floor()
+    assert old["param"] == 4
+    h = st.entry_ok("hot", args=("k",))  # forces the shrink recompile
+    if h:
+        h.exit()
+    assert engine._slot_floor["param"] == 1
+    narrow = tuple(engine._rules.param.rules_by_row.shape)
+    assert narrow != wide and narrow[-1] == 1
+
+    # still admits correctly after the shrink
+    blocked = 0
+    for _ in range(6):
+        h = st.entry_ok("hot", args=("k",))
+        if h:
+            h.exit()
+        else:
+            blocked += 1
+    assert blocked > 0  # count=2 rule still enforced post-reset
+
+
+def test_reset_slot_floor_command(engine, frozen_time):
+    import json
+
+    from sentinel_tpu.transport.command_center import CommandRequest
+    from sentinel_tpu.transport.handlers import cmd_reset_slot_floor
+
+    st.load_param_flow_rules([
+        st.ParamFlowRule("hot", param_idx=0, count=2, duration_in_sec=i + 1)
+        for i in range(3)
+    ])
+    h = st.entry_ok("hot", args=("k",))
+    if h:
+        h.exit()
+    st.load_param_flow_rules([])
+    resp = cmd_reset_slot_floor(CommandRequest(engine=engine))
+    assert resp.success
+    body = json.loads(resp.result)
+    assert body["previousFloor"]["param"] == 3
+    assert body["floor"]["param"] == 0
+
+
 def _jit_cache_size(jitted):
     """jax-private trace-cache probe; skip rather than fail if a jax
     bump renames it (the ratchet behavior itself is version-agnostic)."""
