@@ -175,3 +175,72 @@ def decode_param_flow_request(entity: bytes) -> Tuple[int, int, list]:
     flow_id, count = struct.unpack_from(">qi", entity)
     params, _ = decode_params(entity, 12)
     return flow_id, count, params
+
+
+# -- MSG_ENTRY / MSG_EXIT (TPU extension — the M4 slot-chain bridge) ----------
+#
+# ENTRY request:  u8 rlen | resource utf-8 | u8 olen | origin utf-8 |
+#                 count:i32 | entry_type:u8 | prioritized:u8 | params
+#                 (params as in PARAM_FLOW: u16 n, then tagged values).
+# ENTRY response: entry_id:i64 | reason:u8 — status carries OK/BLOCKED;
+#                 entry_id is 0 when blocked, reason is a BlockReason code
+#                 (core/constants.py: 1=flow 2=degrade 3=system 4=authority
+#                 5=param 7=custom) and 0 when passed.
+# EXIT request:   entry_id:i64 | error:u8 | count:i32 (count -1 = the
+#                 count given at entry).
+# EXIT response:  empty; status OK, or BAD_REQUEST for an unknown id.
+
+
+def _pack_str8(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    if len(raw) > 255:
+        # Truncate on a CHARACTER boundary: a blind byte slice can split
+        # a multibyte sequence, and the receiver's strict UTF-8 decode
+        # would then kill the whole bridge connection (and force-exit
+        # every live remote entry on it) over one long resource name.
+        raw = raw[:255].decode("utf-8", errors="ignore").encode("utf-8")
+    return bytes([len(raw)]) + raw
+
+
+def _unpack_str8(entity: bytes, offset: int) -> Tuple[str, int]:
+    n = entity[offset]
+    # Tolerant receive (strict send): a peer that DID split a multibyte
+    # char must cost itself one mangled name, not the connection — which
+    # carries other threads' live entries.
+    return (entity[offset + 1:offset + 1 + n].decode("utf-8", "replace"),
+            offset + 1 + n)
+
+
+def encode_entry_request(resource: str, origin: str, count: int,
+                         entry_type: int, prioritized: bool,
+                         params: Sequence = ()) -> bytes:
+    return (_pack_str8(resource) + _pack_str8(origin)
+            + struct.pack(">iBB", count, entry_type, 1 if prioritized else 0)
+            + encode_params(params))
+
+
+def decode_entry_request(entity: bytes) -> Tuple[str, str, int, int, bool, list]:
+    resource, off = _unpack_str8(entity, 0)
+    origin, off = _unpack_str8(entity, off)
+    count, entry_type, prio = struct.unpack_from(">iBB", entity, off)
+    params, _ = decode_params(entity, off + 6)
+    return resource, origin, count, entry_type, bool(prio), params
+
+
+def encode_entry_response(entry_id: int, reason: int) -> bytes:
+    return struct.pack(">qB", entry_id, reason)
+
+
+def decode_entry_response(entity: bytes) -> Tuple[int, int]:
+    if len(entity) < 9:
+        return 0, 0
+    return struct.unpack_from(">qB", entity)
+
+
+def encode_exit_request(entry_id: int, error: bool, count: int = -1) -> bytes:
+    return struct.pack(">qBi", entry_id, 1 if error else 0, count)
+
+
+def decode_exit_request(entity: bytes) -> Tuple[int, bool, int]:
+    entry_id, error, count = struct.unpack_from(">qBi", entity)
+    return entry_id, bool(error), count

@@ -11,6 +11,14 @@ MSG_PING = 0
 MSG_FLOW = 1
 MSG_PARAM_FLOW = 2
 
+# TPU-extension message types (no reference twin — SURVEY.md §7 M4's
+# "forward StatisticSlot/rule checks" bridge). Values start at 10 to
+# stay clear of any future reference assignments in the 0..9 range:
+# a stock reference server receiving one replies BAD_REQUEST, which the
+# bridge maps to its fail-open path.
+MSG_ENTRY = 10  # full slot-chain check + stats commit on the backend
+MSG_EXIT = 11   # exit/commit (RT, success, thread-count release)
+
 # ClusterFlowConfig.thresholdType (reference: ClusterRuleConstant).
 THRESHOLD_AVG_LOCAL = 0  # effective threshold = count × connected clients
 THRESHOLD_GLOBAL = 1     # effective threshold = count

@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 
 from sentinel_tpu.cluster import codec
 from sentinel_tpu.cluster.constants import (
+    MSG_ENTRY,
+    MSG_EXIT,
     MSG_FLOW,
     MSG_PARAM_FLOW,
     MSG_PING,
@@ -91,6 +93,11 @@ class _Handler(socketserver.BaseRequestHandler):
         server: "ClusterTokenServer" = self.server.token_server
         reader = codec.FrameReader()
         namespace: Optional[str] = None
+        # Live remote entries on THIS connection (the M4 slot-chain
+        # bridge): id -> EntryHandle. Ids are per-connection, so a
+        # client reconnect can never exit another client's entry.
+        self._remote_entries = {}
+        self._next_entry_id = 0
         self.request.settimeout(300)
         try:
             while True:
@@ -105,6 +112,16 @@ class _Handler(socketserver.BaseRequestHandler):
         finally:
             if namespace is not None:
                 server.service.connections.disconnect(namespace)
+            # A dead JVM must not leak thread counts: exit whatever its
+            # connection still holds (reference analog: CtEntry cleanup;
+            # the error flag stays False — a dropped link is not a biz
+            # exception, and RT for these is honest wall time to now).
+            for handle in self._remote_entries.values():
+                try:
+                    handle.exit()
+                except Exception:  # noqa: BLE001 — best-effort drain
+                    pass
+            self._remote_entries.clear()
 
     def _process(self, server, req: codec.Request, namespace):
         if req.msg_type == MSG_PING:
@@ -131,6 +148,37 @@ class _Handler(socketserver.BaseRequestHandler):
             result = server.service.request_param_token(flow_id, count, params)
             self.request.sendall(codec.encode_response(
                 req.xid, MSG_PARAM_FLOW, result.status))
+        elif req.msg_type == MSG_ENTRY:
+            resource, origin, count, etype, prio, params = \
+                codec.decode_entry_request(req.entity)
+            handle, reason = server.remote_entry(
+                resource, origin, count, etype, prio, params)
+            if handle is not None:
+                self._next_entry_id += 1
+                self._remote_entries[self._next_entry_id] = handle
+                self.request.sendall(codec.encode_response(
+                    req.xid, MSG_ENTRY, TokenResultStatus.OK,
+                    codec.encode_entry_response(self._next_entry_id, 0)))
+            elif reason < 0:  # engine unavailable, fail-open on the JVM
+                self.request.sendall(codec.encode_response(
+                    req.xid, MSG_ENTRY, TokenResultStatus.FAIL,
+                    codec.encode_entry_response(0, 0)))
+            else:
+                self.request.sendall(codec.encode_response(
+                    req.xid, MSG_ENTRY, TokenResultStatus.BLOCKED,
+                    codec.encode_entry_response(0, reason)))
+        elif req.msg_type == MSG_EXIT:
+            entry_id, error, count = codec.decode_exit_request(req.entity)
+            handle = self._remote_entries.pop(entry_id, None)
+            if handle is None:
+                self.request.sendall(codec.encode_response(
+                    req.xid, MSG_EXIT, TokenResultStatus.BAD_REQUEST))
+            else:
+                if error:
+                    handle.trace(None)  # biz exception on the JVM side
+                handle.exit(count if count >= 0 else None)
+                self.request.sendall(codec.encode_response(
+                    req.xid, MSG_EXIT, TokenResultStatus.OK))
         else:
             self.request.sendall(codec.encode_response(
                 req.xid, req.msg_type, TokenResultStatus.BAD_REQUEST))
@@ -147,13 +195,60 @@ class ClusterTokenServer:
 
     def __init__(self, service: Optional[DefaultTokenService] = None,
                  host: str = "0.0.0.0", port: int = 0,
-                 batch_linger_s: float = 0.0005, max_batch: int = 256):
+                 batch_linger_s: float = 0.0005, max_batch: int = 256,
+                 engine=None):
         self.service = service or DefaultTokenService()
         self.host = host
         self.port = port
         self.batcher = _Batcher(self.service, batch_linger_s, max_batch)
         self._server: Optional[_ThreadingTCP] = None
         self._thread: Optional[threading.Thread] = None
+        # Engine serving MSG_ENTRY/MSG_EXIT (the M4 slot-chain bridge).
+        # None -> the process default engine, resolved lazily so merely
+        # constructing a token server never boots the engine singleton.
+        self._engine = engine
+
+    @property
+    def engine(self):
+        if self._engine is None:
+            import sentinel_tpu
+
+            self._engine = sentinel_tpu.get_engine()
+        return self._engine
+
+    def remote_entry(self, resource: str, origin: str, count: int,
+                     entry_type: int, prioritized: bool, params):
+        """Run the FULL local slot chain for a remote (JVM) caller.
+
+        Returns ``(handle, 0)`` on pass, ``(None, reason>0)`` on block,
+        ``(None, -1)`` when the engine is unusable (the bridge's wire
+        FAIL -> the JVM falls open, mirroring fallbackToLocalOrPass).
+
+        Each remote entry runs in its OWN context object (name
+        ``sentinel_remote_context``, the caller's origin): connection
+        threads interleave entries from many JVM threads, so borrowing
+        the connection thread's context would corrupt parent/child
+        chains. The handle keeps its context alive; exit may happen on
+        any thread (engine._do_exit tolerates out-of-order pops)."""
+        from sentinel_tpu.core import context as ctx_mod
+        from sentinel_tpu.core.exceptions import (
+            BlockException,
+            reason_for_exception,
+        )
+
+        prev = ctx_mod.get_context()
+        ctx_mod.replace_context(None)
+        try:
+            ctx_mod.enter("sentinel_remote_context", origin)
+            handle = self.engine.entry(
+                resource, entry_type, count, tuple(params), prioritized)
+            return handle, 0
+        except BlockException as ex:
+            return None, reason_for_exception(ex)
+        except Exception:  # noqa: BLE001 — engine death must fail open
+            return None, -1
+        finally:
+            ctx_mod.replace_context(prev)
 
     @property
     def bound_port(self) -> int:

@@ -66,3 +66,13 @@ def exception_for_reason(reason: int, resource: str, rule=None) -> BlockExceptio
     if cls is SystemBlockException:
         return SystemBlockException(resource, rule=rule)
     return cls(resource, rule=rule)
+
+
+def reason_for_exception(ex: BlockException) -> int:
+    """Inverse of ``exception_for_reason`` — the wire code the M4 bridge
+    sends so a JVM can re-raise the matching BlockException subclass.
+    Unmapped subclasses (e.g. an SPI slot's custom type) report CUSTOM."""
+    for reason, cls in _REASON_TO_EXC.items():
+        if type(ex) is cls:
+            return int(reason)
+    return int(BlockReason.CUSTOM)

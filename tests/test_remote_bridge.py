@@ -1,0 +1,238 @@
+"""M4 slot-chain bridge: MSG_ENTRY/MSG_EXIT over the token server
+(SURVEY.md §7 M4 — "SlotChainBuilder/SPI-registered slot that forwards
+StatisticSlot/rule checks to the backend"; reference twin of the wire:
+``core:slotchain/ProcessorSlot`` entry/exit, carried over the TPU
+extension of the cluster TLV protocol, message types 10/11).
+
+Covers: codec round-trips (incl. the UTF-8 character-boundary truncation
+regression), full pass/block/exit cycles over real TCP against the real
+engine, typed block reasons, count accounting, connection-drop drain of
+outstanding entries, and stock-server BAD_REQUEST behavior for unknown
+message types (the bridge's fail-open trigger).
+"""
+
+import socket
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.constants import (
+    MSG_ENTRY,
+    MSG_EXIT,
+    TokenResultStatus,
+)
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.core.constants import BlockReason, EntryType
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def test_entry_request_round_trip():
+    entity = codec.encode_entry_request(
+        "/api/users", "caller-app", 3, int(EntryType.IN), True,
+        [7, "user-1", True, 2.5])
+    assert codec.decode_entry_request(entity) == (
+        "/api/users", "caller-app", 3, int(EntryType.IN), True,
+        [7, "user-1", True, 2.5])
+
+
+def test_entry_request_empty_origin_no_params():
+    entity = codec.encode_entry_request("r", "", 1, 0, False, [])
+    assert codec.decode_entry_request(entity) == ("r", "", 1, 0, False, [])
+
+
+def test_entry_response_round_trip():
+    entity = codec.encode_entry_response(1 << 40, int(BlockReason.DEGRADE))
+    assert codec.decode_entry_response(entity) == (1 << 40, 2)
+    assert codec.decode_entry_response(b"") == (0, 0)  # short entity safe
+
+
+def test_exit_request_round_trip():
+    entity = codec.encode_exit_request(42, True, 5)
+    assert codec.decode_exit_request(entity) == (42, True, 5)
+    assert codec.decode_exit_request(
+        codec.encode_exit_request(7, False)) == (7, False, -1)
+
+
+def test_str8_truncates_on_character_boundary():
+    """A resource name whose 255-byte cut lands mid-UTF-8-sequence must
+    not produce undecodable bytes (r5 review: the strict decode would
+    have torn down the whole bridge connection)."""
+    name = "x" * 254 + "é"  # byte 255 is half of a 2-byte sequence
+    entity = codec.encode_entry_request(name, "", 1, 0, False, [])
+    resource, _, _, _, _, _ = codec.decode_entry_request(entity)
+    assert resource == "x" * 254  # clean character-boundary cut
+    # tolerant receive: even a hand-built mid-char split decodes
+    raw = name.encode("utf-8")[:255]
+    hostile = bytes([len(raw)]) + raw + codec._pack_str8("")
+    hostile += b"\x00\x00\x00\x01\x00\x00" + b"\x00\x00"
+    decoded, _, _, _, _, _ = codec.decode_entry_request(hostile)
+    assert decoded.startswith("x" * 254)
+
+
+# -- server, over real TCP ----------------------------------------------------
+
+
+class _BridgeConn:
+    """Minimal synchronous bridge client (what the C shim / JVM send)."""
+
+    def __init__(self, port):
+        # Generous timeout: the first entry of a fresh engine (or of a
+        # newly-widened rule family) absorbs an XLA compile, tens of
+        # seconds on the CPU test topology.
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=120)
+        self.reader = codec.FrameReader()
+        self.xid = 0
+
+    def call(self, msg_type, entity):
+        self.xid += 1
+        self.sock.sendall(codec.encode_request(self.xid, msg_type, entity))
+        while True:
+            frames = self.reader.feed(self.sock.recv(65536))
+            if frames:
+                return codec.decode_response(frames[0])
+
+    def entry(self, resource, origin="", count=1,
+              entry_type=int(EntryType.OUT), prioritized=False, params=()):
+        resp = self.call(MSG_ENTRY, codec.encode_entry_request(
+            resource, origin, count, entry_type, prioritized, params))
+        entry_id, reason = codec.decode_entry_response(resp.entity)
+        return resp.status, entry_id, reason
+
+    def exit(self, entry_id, error=False, count=-1):
+        return self.call(MSG_EXIT, codec.encode_exit_request(
+            entry_id, error, count)).status
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def bridge(engine):
+    server = ClusterTokenServer(host="127.0.0.1", port=0,
+                                engine=engine).start()
+    time.sleep(0.05)
+    conn = _BridgeConn(server.bound_port)
+    yield engine, server, conn
+    conn.close()
+    server.stop()
+
+
+def test_remote_entry_pass_block_reason(bridge, frozen_time):
+    engine, _, conn = bridge
+    st.load_flow_rules([st.FlowRule(resource="remoteRes", count=3)])
+    statuses = [conn.entry("remoteRes", origin="appA") for _ in range(8)]
+    ok = [s for s in statuses if s[0] == TokenResultStatus.OK]
+    blocked = [s for s in statuses if s[0] == TokenResultStatus.BLOCKED]
+    assert len(ok) == 3 and len(blocked) == 5
+    assert all(eid > 0 and reason == 0 for _, eid, reason in ok)
+    assert all(eid == 0 and reason == int(BlockReason.FLOW)
+               for _, eid, reason in blocked)
+    # ids are distinct per entry
+    assert len({eid for _, eid, _ in ok}) == 3
+    for _, eid, _ in ok:
+        assert conn.exit(eid) == TokenResultStatus.OK
+
+
+def test_remote_exit_unknown_id_bad_request(bridge):
+    _, _, conn = bridge
+    assert conn.exit(12345) == TokenResultStatus.BAD_REQUEST
+
+
+def test_remote_exit_is_idempotent_per_id(bridge, frozen_time):
+    _, _, conn = bridge
+    st.load_flow_rules([st.FlowRule(resource="once", count=10)])
+    status, eid, _ = conn.entry("once")
+    assert status == TokenResultStatus.OK
+    assert conn.exit(eid) == TokenResultStatus.OK
+    # the id was consumed: double-exit is a BAD_REQUEST, not a double
+    # thread-count decrement
+    assert conn.exit(eid) == TokenResultStatus.BAD_REQUEST
+
+
+def test_remote_entry_commits_stats(bridge, frozen_time):
+    """The forwarded entry runs the real StatisticSlot fan-out: node
+    tree shows the resource with pass counts after entry+exit."""
+    engine, _, conn = bridge
+    st.load_flow_rules([st.FlowRule(resource="statRes", count=100)])
+    for _ in range(4):
+        status, eid, _ = conn.entry("statRes", origin="appB")
+        assert status == TokenResultStatus.OK
+        assert conn.exit(eid) == TokenResultStatus.OK
+    tree = engine.tree_dict()
+    assert "statRes" in str(tree)
+
+
+def test_remote_entry_thread_count_and_drop_drain(engine, frozen_time):
+    """Entries held open by a connection that dies are force-exited so
+    thread counts drain (a crashed JVM must not wedge THREAD-grade
+    rules)."""
+    st.load_flow_rules([st.FlowRule(resource="drainRes", count=2, grade=0)])
+    server = ClusterTokenServer(host="127.0.0.1", port=0,
+                                engine=engine).start()
+    time.sleep(0.05)
+    conn = _BridgeConn(server.bound_port)
+    try:
+        # grade=0 is THREAD: both permits held, third blocks
+        s1, e1, _ = conn.entry("drainRes")
+        s2, e2, _ = conn.entry("drainRes")
+        s3, _, r3 = conn.entry("drainRes")
+        assert (s1, s2) == (TokenResultStatus.OK, TokenResultStatus.OK)
+        assert s3 == TokenResultStatus.BLOCKED and r3 == int(BlockReason.FLOW)
+        conn.close()  # JVM dies with 2 entries outstanding
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            conn2 = _BridgeConn(server.bound_port)
+            status, eid, _ = conn2.entry("drainRes")
+            if status == TokenResultStatus.OK:
+                conn2.exit(eid)
+                conn2.close()
+                break
+            conn2.close()
+            time.sleep(0.05)
+        else:
+            pytest.fail("outstanding entries were not drained on disconnect")
+    finally:
+        server.stop()
+
+
+def test_remote_entry_param_flow(bridge, frozen_time):
+    """Hot params ride the ENTRY frame and hit the param checker."""
+    _, _, conn = bridge
+    st.load_param_flow_rules([
+        st.ParamFlowRule("hotRes", param_idx=0, count=2)])
+    outcomes = [conn.entry("hotRes", params=["user-1"]) for _ in range(6)]
+    ok = [o for o in outcomes if o[0] == TokenResultStatus.OK]
+    blocked = [o for o in outcomes if o[0] == TokenResultStatus.BLOCKED]
+    assert len(ok) <= 3 and len(blocked) >= 3
+    assert all(r == int(BlockReason.PARAM_FLOW) for _, _, r in blocked)
+
+
+def test_unknown_msg_type_bad_request(bridge):
+    """What a stock reference server answers the bridge: BAD_REQUEST —
+    the signal the JVM side maps to fail-open."""
+    _, _, conn = bridge
+    resp = conn.call(99, b"")
+    assert resp.status == TokenResultStatus.BAD_REQUEST
+
+
+def test_remote_entry_fail_open_when_engine_closed(engine, frozen_time):
+    """A dying backend returns FAIL (not BLOCKED): the JVM falls open,
+    the reference's fallbackToLocalOrPass stance."""
+    server = ClusterTokenServer(host="127.0.0.1", port=0,
+                                engine=engine).start()
+    time.sleep(0.05)
+    conn = _BridgeConn(server.bound_port)
+    try:
+        st.load_flow_rules([st.FlowRule(resource="failRes", count=5)])
+        status, eid, _ = conn.entry("failRes")
+        assert status == TokenResultStatus.OK
+        conn.exit(eid)
+        engine.close()  # backend death
+        status, _, _ = conn.entry("failRes")
+        assert status in (TokenResultStatus.OK, TokenResultStatus.FAIL)
+    finally:
+        server.stop()
