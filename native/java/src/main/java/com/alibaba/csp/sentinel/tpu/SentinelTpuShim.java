@@ -5,6 +5,7 @@ import com.sun.jna.Native;
 import com.sun.jna.Pointer;
 import com.sun.jna.Structure;
 import com.sun.jna.ptr.IntByReference;
+import com.sun.jna.ptr.LongByReference;
 
 import java.util.Arrays;
 import java.util.List;
@@ -17,8 +18,11 @@ import java.util.List;
  *
  * <p>The shim speaks the same length-framed TLV protocol as the Python
  * {@code cluster/codec.py}: PING namespace registration on connect, FLOW
- * and PARAM_FLOW acquires with xid correlation. One in-flight request per
- * handle (the shim serializes internally); pool handles for concurrency.
+ * and PARAM_FLOW acquires, batched FLOW acquires, and the M4 remote
+ * slot-chain bridge (MSG_ENTRY/MSG_EXIT). Handles are multi-in-flight:
+ * N threads may issue requests on ONE handle concurrently — responses
+ * demux by xid inside the shim (the Netty client's xid->promise map,
+ * natively). Only {@code st_client_close} must not race new requests.
  *
  * <p>Build: see {@code native/java/BUILD.md}. No JNI glue is required —
  * JNA maps these declarations straight onto the C ABI, so the same
@@ -52,6 +56,17 @@ public interface SentinelTpuShim extends Library {
 
     int st_request_param_token(Pointer handle, long flowId, int count,
                                StParam[] params, int nparams);
+
+    int st_request_tokens_batch(Pointer handle, long[] flowIds, int[] counts,
+                                int[] prioritized, int n, int[] outStatuses,
+                                int[] outExtras);
+
+    int st_remote_entry(Pointer handle, String resource, String origin,
+                        int count, int entryType, int prioritized,
+                        StParam[] params, int nparams,
+                        LongByReference outEntryId, IntByReference outReason);
+
+    int st_remote_exit(Pointer handle, long entryId, int error, int count);
 
     void st_client_close(Pointer handle);
 

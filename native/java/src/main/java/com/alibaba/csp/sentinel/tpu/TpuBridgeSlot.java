@@ -1,0 +1,216 @@
+package com.alibaba.csp.sentinel.tpu;
+
+import com.alibaba.csp.sentinel.EntryType;
+import com.alibaba.csp.sentinel.cluster.ClusterConstants;
+import com.alibaba.csp.sentinel.cluster.client.config.ClusterClientConfigManager;
+import com.alibaba.csp.sentinel.context.Context;
+import com.alibaba.csp.sentinel.log.RecordLog;
+import com.alibaba.csp.sentinel.node.DefaultNode;
+import com.alibaba.csp.sentinel.slotchain.AbstractLinkedProcessorSlot;
+import com.alibaba.csp.sentinel.slotchain.ResourceWrapper;
+import com.alibaba.csp.sentinel.slots.block.BlockException;
+import com.alibaba.csp.sentinel.slots.block.authority.AuthorityException;
+import com.alibaba.csp.sentinel.slots.block.degrade.DegradeException;
+import com.alibaba.csp.sentinel.slots.block.flow.FlowException;
+import com.alibaba.csp.sentinel.slots.block.flow.param.ParamFlowException;
+import com.alibaba.csp.sentinel.slots.block.system.SystemBlockException;
+import com.sun.jna.Pointer;
+import com.sun.jna.ptr.IntByReference;
+import com.sun.jna.ptr.LongByReference;
+
+import java.util.ArrayDeque;
+import java.util.Deque;
+
+/**
+ * The M4 rule-check forwarding slot (SURVEY.md §7 M4: "SPI-registered
+ * slot that forwards StatisticSlot/rule checks to the backend"):
+ * replaces the local FlowSlot/DegradeSlot/SystemSlot/AuthoritySlot/
+ * ParamFlowSlot tail of the chain with ONE remote MSG_ENTRY check
+ * against the sentinel-tpu backend, which runs its full fused slot
+ * chain AND commits the StatisticSlot 4-row fan-out there. Exit
+ * forwards the RT/success/thread-count release via MSG_EXIT.
+ *
+ * <p>Reference twins: {@code core:slotchain/ProcessorSlot.java} (the
+ * SPI this implements), {@code core:slots/statistic/StatisticSlot.java}
+ * (whose commit-inversion the backend performs),
+ * {@code core:slots/block/*} (the exception mapping below).
+ *
+ * <p>Failure semantics: transport failure or a backend FAIL status
+ * fails OPEN (fireEntry proceeds locally) — the stance of the
+ * reference's {@code fallbackToLocalOrPass} and of the backend's own
+ * DeviceDispatchError fail-open (core/engine.py). A BLOCKED status
+ * re-raises the exact BlockException subclass the backend's BlockReason
+ * code names, so blockHandler/fallback dispatch in user code is
+ * unchanged.
+ *
+ * <p>Entry ids ride a per-thread stack: the sync entry model nests
+ * strictly per thread (CtEntry enforces it), so exit order matches.
+ * Async entries ({@code context.isAsync()}) are NOT forwarded — they
+ * fire through locally (documented limitation; the async context
+ * detaches from the thread).
+ *
+ * <p>NOTE (sandbox provenance): written against the vendored 1.8 SPI
+ * surface in {@code native/java/vendored}; re-check against the fork
+ * before first compile (BUILD.md).
+ */
+public class TpuBridgeSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
+
+    /** BlockReason codes (backend core/constants.py BlockReason). */
+    static final int REASON_FLOW = 1;
+    static final int REASON_DEGRADE = 2;
+    static final int REASON_SYSTEM = 3;
+    static final int REASON_AUTHORITY = 4;
+    static final int REASON_PARAM_FLOW = 5;
+
+    private static final long RECONNECT_BACKOFF_MS = 2000;
+
+    // Shared multi-in-flight handle (the shim demuxes by xid); guarded
+    // by the class monitor for connect/drop only — requests race freely.
+    private static volatile Pointer handle;
+    private static long lastConnectFailMs;
+
+    private static final ThreadLocal<Deque<Long>> ENTRY_IDS =
+        ThreadLocal.withInitial(ArrayDeque::new);
+
+    private static synchronized Pointer connectedHandle() {
+        if (handle != null) {
+            return handle;
+        }
+        if (System.currentTimeMillis() - lastConnectFailMs < RECONNECT_BACKOFF_MS) {
+            return null;
+        }
+        String host = System.getProperty("csp.sentinel.tpu.host",
+            ClusterClientConfigManager.getServerHost());
+        int port = Integer.getInteger("csp.sentinel.tpu.port",
+            ClusterClientConfigManager.getServerPort());
+        if (host == null || port <= 0) {
+            return null;
+        }
+        Pointer fresh = SentinelTpuShim.INSTANCE.st_client_connect(
+            host, port, ClusterConstants.DEFAULT_CLUSTER_NAMESPACE,
+            ClusterClientConfigManager.getRequestTimeout());
+        if (fresh == null) {
+            lastConnectFailMs = System.currentTimeMillis();
+            return null;
+        }
+        handle = fresh;
+        RecordLog.info("[TpuBridgeSlot] connected to {}:{}", host, port);
+        return handle;
+    }
+
+    private static synchronized void dropConnection() {
+        if (handle != null) {
+            SentinelTpuShim.INSTANCE.st_client_close(handle);
+            handle = null;
+            lastConnectFailMs = System.currentTimeMillis();
+        }
+    }
+
+    @Override
+    public void entry(Context context, ResourceWrapper resourceWrapper,
+                      DefaultNode node, int count, boolean prioritized,
+                      Object... args) throws Throwable {
+        Pointer h = context.isAsync() ? null : connectedHandle();
+        if (h == null) {
+            // fail open: no backend -> behave like an unruled resource
+            ENTRY_IDS.get().push(0L);
+            fireEntry(context, resourceWrapper, node, count, prioritized, args);
+            return;
+        }
+        SentinelTpuShim.StParam[] arr = marshalParams(args);
+        LongByReference outId = new LongByReference();
+        IntByReference outReason = new IntByReference();
+        // Wire entry_type matches the backend's EntryType enum: IN=0,
+        // OUT=1 (core/constants.py — note the inversion vs. a naive
+        // boolean encoding).
+        int status = SentinelTpuShim.INSTANCE.st_remote_entry(
+            h, resourceWrapper.getName(),
+            context.getOrigin() == null ? "" : context.getOrigin(), count,
+            resourceWrapper.getEntryType() == EntryType.IN ? 0 : 1,
+            prioritized ? 1 : 0, arr, args == null ? 0 : args.length,
+            outId, outReason);
+        if (status == -1) {
+            dropConnection();  // transport death: reconnect next entry
+            ENTRY_IDS.get().push(0L);
+            fireEntry(context, resourceWrapper, node, count, prioritized, args);
+            return;
+        }
+        if (status == 1) {  // BLOCKED: re-raise the typed exception
+            // Push a sentinel FIRST: the framework still runs the chain's
+            // exit for a blocked entry (CtSph catches the BlockException
+            // and calls e.exit()), and that exit must pop THIS entry's
+            // slot — not the enclosing entry's live id.
+            ENTRY_IDS.get().push(0L);
+            throw exceptionFor(outReason.getValue(), resourceWrapper.getName(),
+                               context.getOrigin());
+        }
+        ENTRY_IDS.get().push(outId.getValue());
+        fireEntry(context, resourceWrapper, node, count, prioritized, args);
+    }
+
+    @Override
+    public void exit(Context context, ResourceWrapper resourceWrapper,
+                     int count, Object... args) {
+        Deque<Long> stack = ENTRY_IDS.get();
+        Long entryId = stack.isEmpty() ? null : stack.pop();
+        if (entryId != null && entryId != 0L) {
+            Pointer h = handle;  // volatile read; no connect on exit path
+            if (h != null) {
+                boolean error = context.getCurEntry() != null
+                    && context.getCurEntry().getError() != null;
+                int rc = SentinelTpuShim.INSTANCE.st_remote_exit(
+                    h, entryId, error ? 1 : 0, count);
+                if (rc == -1) {
+                    dropConnection();
+                }
+            }
+            // else: connection already died; the backend's disconnect
+            // drain released this entry server-side.
+        }
+        fireExit(context, resourceWrapper, count, args);
+    }
+
+    static BlockException exceptionFor(int reason, String resource,
+                                       String origin) {
+        String app = origin == null ? "" : origin;
+        switch (reason) {
+            case REASON_DEGRADE:
+                return new DegradeException(app, resource);
+            case REASON_SYSTEM:
+                return new SystemBlockException(resource, "tpu-backend");
+            case REASON_AUTHORITY:
+                return new AuthorityException(app, resource);
+            case REASON_PARAM_FLOW:
+                return new ParamFlowException(resource, "tpu-backend");
+            case REASON_FLOW:
+            default:
+                return new FlowException(app, resource);
+        }
+    }
+
+    static SentinelTpuShim.StParam[] marshalParams(Object[] args) {
+        int n = args == null ? 0 : args.length;
+        SentinelTpuShim.StParam[] arr =
+            (SentinelTpuShim.StParam[]) new SentinelTpuShim.StParam()
+                .toArray(Math.max(n, 1));
+        for (int k = 0; k < n; ++k) {
+            Object p = args[k];
+            SentinelTpuShim.StParam sp = arr[k];
+            if (p instanceof Boolean) {
+                sp.tag = 2;
+                sp.i = ((Boolean) p) ? 1 : 0;
+            } else if (p instanceof Integer || p instanceof Long
+                       || p instanceof Short || p instanceof Byte) {
+                sp.tag = 0;
+                sp.i = ((Number) p).longValue();
+            } else if (p instanceof Double || p instanceof Float) {
+                sp.tag = 3;
+                sp.d = ((Number) p).doubleValue();
+            } else {
+                sp.tag = 1;
+                sp.s = String.valueOf(p);
+            }
+        }
+        return arr;
+    }
+}

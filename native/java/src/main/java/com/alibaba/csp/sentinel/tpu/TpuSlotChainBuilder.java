@@ -1,0 +1,46 @@
+package com.alibaba.csp.sentinel.tpu;
+
+import com.alibaba.csp.sentinel.slotchain.DefaultProcessorSlotChain;
+import com.alibaba.csp.sentinel.slotchain.ProcessorSlotChain;
+import com.alibaba.csp.sentinel.slotchain.SlotChainBuilder;
+import com.alibaba.csp.sentinel.slots.clusterbuilder.ClusterBuilderSlot;
+import com.alibaba.csp.sentinel.slots.logger.LogSlot;
+import com.alibaba.csp.sentinel.slots.nodeselector.NodeSelectorSlot;
+import com.alibaba.csp.sentinel.slots.statistic.StatisticSlot;
+import com.alibaba.csp.sentinel.spi.Spi;
+
+/**
+ * {@link SlotChainBuilder} SPI that completes SURVEY.md §7 M4: drop the
+ * bridge jar on the classpath of an app running the stock framework and
+ * {@code SlotChainProvider} picks THIS builder (highest @Spi order), so
+ * every {@code SphU.entry} routes its rule checks + stats commits to the
+ * sentinel-tpu backend via {@link TpuBridgeSlot}.
+ *
+ * <p>Chain shape (reference: {@code core:slotchain/DefaultSlotChainBuilder}):
+ * NodeSelector → ClusterBuilder → Log → Statistic → TpuBridge. The
+ * node-building and statistic slots stay so in-JVM consumers (dashboards
+ * reading curNode, adapters inspecting the tree) keep local visibility;
+ * the backend's verdicts are authoritative and its stats are the ones
+ * the sentinel-tpu dashboard serves. The local FlowSlot/DegradeSlot/
+ * SystemSlot/AuthoritySlot/ParamFlowSlot are intentionally ABSENT —
+ * their checks happen inside the backend's fused device step.
+ *
+ * <p>Configure the backend address via {@code -Dcsp.sentinel.tpu.host} /
+ * {@code -Dcsp.sentinel.tpu.port} (or the standard cluster-client
+ * config). With no address configured every entry fails open locally,
+ * so adding the jar before configuring it is harmless.
+ */
+@Spi(isDefault = false, order = -2000)
+public class TpuSlotChainBuilder implements SlotChainBuilder {
+
+    @Override
+    public ProcessorSlotChain build() {
+        ProcessorSlotChain chain = new DefaultProcessorSlotChain();
+        chain.addLast(new NodeSelectorSlot());
+        chain.addLast(new ClusterBuilderSlot());
+        chain.addLast(new LogSlot());
+        chain.addLast(new StatisticSlot());
+        chain.addLast(new TpuBridgeSlot());
+        return chain;
+    }
+}

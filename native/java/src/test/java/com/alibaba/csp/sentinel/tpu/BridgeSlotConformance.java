@@ -1,0 +1,234 @@
+package com.alibaba.csp.sentinel.tpu;
+
+import com.alibaba.csp.sentinel.EntryType;
+import com.alibaba.csp.sentinel.context.Context;
+import com.alibaba.csp.sentinel.slotchain.ProcessorSlotChain;
+import com.alibaba.csp.sentinel.slotchain.StringResourceWrapper;
+import com.alibaba.csp.sentinel.slots.block.degrade.DegradeException;
+
+import java.io.ByteArrayOutputStream;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.net.ServerSocket;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.nio.file.Files;
+import java.nio.file.Paths;
+import java.util.ArrayList;
+import java.util.HashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.regex.Matcher;
+import java.util.regex.Pattern;
+
+/**
+ * M4 bridge-slot conformance (the Java twin of
+ * {@code tests/test_remote_bridge.py} + the ENTRY/EXIT golden-frame
+ * assertions of {@code tests/test_tlv_fixtures.py}): drives the FULL
+ * {@link TpuSlotChainBuilder} chain against a scripted capture server
+ * and asserts
+ *
+ * <ol>
+ *   <li>the emitted MSG_ENTRY / MSG_EXIT frames equal the golden bytes
+ *       ({@code entry_request_basic}, {@code exit_request_basic});</li>
+ *   <li>a BLOCKED(reason=2) response re-raises {@link DegradeException}
+ *       out of {@code chain.entry};</li>
+ *   <li>exit forwards the held entry id.</li>
+ * </ol>
+ *
+ * Runnable against the vendored stubs alone (plus JNA + the shim):
+ *
+ * <pre>
+ *   javac -cp native/java/vendored:jna-5.x.jar -d out \
+ *         $(find native/java/src native/java/vendored -name '*.java')
+ *   java -cp out:jna-5.x.jar -Djna.library.path=native \
+ *        com.alibaba.csp.sentinel.tpu.BridgeSlotConformance \
+ *        tests/fixtures/tlv/fixtures.json
+ * </pre>
+ *
+ * <p>PROVENANCE: written without a JVM in the build sandbox — never
+ * compiled here; the Python suite pins the same frames + behaviors
+ * through the C shim path.
+ */
+public final class BridgeSlotConformance {
+
+    public static void main(String[] args) throws Exception {
+        String path = args.length > 0 ? args[0]
+                : "tests/fixtures/tlv/fixtures.json";
+        Map<String, byte[]> fx = loadFixtures(path);
+
+        CaptureServer server = new CaptureServer(new byte[][] {
+                fx.get("ping_response_ok"),
+                fx.get("entry_response_pass"),
+                withXid(fx.get("exit_response_ok"), 3),
+                withXid(fx.get("entry_response_blocked_degrade"), 4),
+        });
+
+        System.setProperty("csp.sentinel.tpu.host", "127.0.0.1");
+        System.setProperty("csp.sentinel.tpu.port",
+                String.valueOf(server.port()));
+
+        ProcessorSlotChain chain = new TpuSlotChainBuilder().build();
+        Context ctx = new Context(null, "sentinel_default_context")
+                .setOrigin("appA");
+        StringResourceWrapper resource =
+                new StringResourceWrapper("getUser", EntryType.IN);
+
+        chain.entry(ctx, resource, null, 1, false);
+        chain.exit(ctx, resource, 1);
+
+        boolean degradeRaised = false;
+        try {
+            chain.entry(ctx, resource, null, 1, false);
+        } catch (DegradeException ex) {
+            degradeRaised = true;
+        }
+        expect(degradeRaised, "BLOCKED reason=2 must raise DegradeException");
+        server.join();
+
+        List<byte[]> got = server.frames();
+        expect(got.size() == 4, "expected 4 frames, got " + got.size());
+        expectBytes(got.get(0), body(fx.get("ping_request_default")),
+                "PING-on-connect frame");
+        expectBytes(got.get(1), body(fx.get("entry_request_basic")),
+                "MSG_ENTRY frame");
+        expectBytes(got.get(2), body(fx.get("exit_request_count1")),
+                "MSG_EXIT frame");
+        byte[] goldenEntry2 = body(fx.get("entry_request_basic"));
+        goldenEntry2[3] = 4; // xid 2 -> 4: fourth request
+        expectBytes(got.get(3), goldenEntry2, "second MSG_ENTRY frame");
+
+        System.out.println("Bridge-slot conformance OK: 4 frames "
+                + "byte-identical, DegradeException re-raised, exit id held");
+    }
+
+    // -- fixture plumbing (same shape as TlvGoldenFramesConformance) --------
+
+    private static Map<String, byte[]> loadFixtures(String path)
+            throws Exception {
+        String json = new String(Files.readAllBytes(Paths.get(path)),
+                StandardCharsets.UTF_8);
+        Map<String, byte[]> out = new HashMap<>();
+        Pattern p = Pattern.compile(
+                "\"name\":\\s*\"([^\"]+)\"[^}]*?\"hex\":\\s*\"([0-9a-f]+)\"",
+                Pattern.DOTALL);
+        Matcher m = p.matcher(json);
+        while (m.find()) {
+            out.put(m.group(1), unhex(m.group(2)));
+        }
+        if (out.isEmpty()) {
+            throw new IllegalStateException("no fixtures parsed from " + path);
+        }
+        return out;
+    }
+
+    private static byte[] unhex(String hex) {
+        byte[] out = new byte[hex.length() / 2];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = (byte) Integer.parseInt(
+                    hex.substring(2 * i, 2 * i + 2), 16);
+        }
+        return out;
+    }
+
+    private static byte[] body(byte[] frame) {
+        byte[] out = new byte[frame.length - 2];
+        System.arraycopy(frame, 2, out, 0, out.length);
+        return out;
+    }
+
+    private static byte[] withXid(byte[] frame, int xid) {
+        byte[] out = frame.clone();
+        out[5] = (byte) xid;
+        return out;
+    }
+
+    private static void expect(boolean ok, String what) {
+        if (!ok) {
+            throw new AssertionError("conformance failure: " + what);
+        }
+    }
+
+    private static void expectBytes(byte[] got, byte[] want, String what) {
+        if (!java.util.Arrays.equals(got, want)) {
+            throw new AssertionError("conformance failure: " + what
+                    + "\n  got  " + hex(got) + "\n  want " + hex(want));
+        }
+    }
+
+    private static String hex(byte[] b) {
+        StringBuilder sb = new StringBuilder();
+        for (byte x : b) {
+            sb.append(String.format("%02x", x));
+        }
+        return sb.toString();
+    }
+
+    private static final class CaptureServer {
+        private final ServerSocket listener;
+        private final byte[][] script;
+        private final List<byte[]> frames = new ArrayList<>();
+        private final Thread thread;
+
+        CaptureServer(byte[][] script) throws Exception {
+            this.script = script;
+            this.listener = new ServerSocket(0);
+            this.thread = new Thread(this::run, "bridge-capture");
+            this.thread.setDaemon(true);
+            this.thread.start();
+        }
+
+        int port() {
+            return listener.getLocalPort();
+        }
+
+        List<byte[]> frames() {
+            return frames;
+        }
+
+        void join() throws InterruptedException {
+            thread.join(5000);
+        }
+
+        private void run() {
+            try (Socket conn = listener.accept()) {
+                InputStream in = conn.getInputStream();
+                OutputStream os = conn.getOutputStream();
+                ByteArrayOutputStream buf = new ByteArrayOutputStream();
+                int served = 0;
+                byte[] chunk = new byte[4096];
+                while (served < script.length) {
+                    int n = in.read(chunk);
+                    if (n < 0) {
+                        return;
+                    }
+                    buf.write(chunk, 0, n);
+                    byte[] all = buf.toByteArray();
+                    int off = 0;
+                    while (all.length - off >= 2 && served < script.length) {
+                        int len = ((all[off] & 0xff) << 8)
+                                | (all[off + 1] & 0xff);
+                        if (all.length - off - 2 < len) {
+                            break;
+                        }
+                        byte[] body = new byte[len];
+                        System.arraycopy(all, off + 2, body, 0, len);
+                        frames.add(body);
+                        os.write(script[served++]);
+                        os.flush();
+                        off += 2 + len;
+                    }
+                    buf.reset();
+                    buf.write(all, off, all.length - off);
+                }
+            } catch (Exception ex) {
+                throw new RuntimeException(ex);
+            } finally {
+                try {
+                    listener.close();
+                } catch (Exception ignored) {
+                }
+            }
+        }
+    }
+}

@@ -1,0 +1,16 @@
+package com.alibaba.csp.sentinel;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:Entry.java — only the members the bridge touches. */
+public abstract class Entry {
+
+    private Throwable error;
+
+    public Throwable getError() {
+        return error;
+    }
+
+    public void setError(Throwable error) {
+        this.error = error;
+    }
+}

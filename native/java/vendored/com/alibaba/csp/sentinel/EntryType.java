@@ -1,0 +1,8 @@
+package com.alibaba.csp.sentinel;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:EntryType.java. */
+public enum EntryType {
+    IN,
+    OUT
+}

@@ -1,0 +1,15 @@
+package com.alibaba.csp.sentinel.cluster;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:cluster/ClusterConstants.java. */
+public final class ClusterConstants {
+
+    public static final String DEFAULT_CLUSTER_NAMESPACE = "default";
+
+    public static final int CLIENT_STATUS_OFF = 0;
+    public static final int CLIENT_STATUS_PENDING = 1;
+    public static final int CLIENT_STATUS_STARTED = 2;
+
+    private ClusterConstants() {
+    }
+}

@@ -1,0 +1,44 @@
+package com.alibaba.csp.sentinel.cluster;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:cluster/TokenResult.java. */
+public class TokenResult {
+
+    private Integer status;
+    private int remaining;
+    private int waitInMs;
+
+    public TokenResult() {
+    }
+
+    public TokenResult(Integer status) {
+        this.status = status;
+    }
+
+    public Integer getStatus() {
+        return status;
+    }
+
+    public TokenResult setStatus(Integer status) {
+        this.status = status;
+        return this;
+    }
+
+    public int getRemaining() {
+        return remaining;
+    }
+
+    public TokenResult setRemaining(int remaining) {
+        this.remaining = remaining;
+        return this;
+    }
+
+    public int getWaitInMs() {
+        return waitInMs;
+    }
+
+    public TokenResult setWaitInMs(int waitInMs) {
+        this.waitInMs = waitInMs;
+        return this;
+    }
+}

@@ -1,0 +1,22 @@
+package com.alibaba.csp.sentinel.cluster;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:cluster/TokenServerDescriptor.java. */
+public class TokenServerDescriptor {
+
+    private final String host;
+    private final int port;
+
+    public TokenServerDescriptor(String host, int port) {
+        this.host = host;
+        this.port = port;
+    }
+
+    public String getHost() {
+        return host;
+    }
+
+    public int getPort() {
+        return port;
+    }
+}

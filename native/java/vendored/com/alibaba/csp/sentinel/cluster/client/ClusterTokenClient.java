@@ -1,0 +1,25 @@
+package com.alibaba.csp.sentinel.cluster.client;
+
+import java.util.Collection;
+
+import com.alibaba.csp.sentinel.cluster.TokenResult;
+import com.alibaba.csp.sentinel.cluster.TokenServerDescriptor;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:cluster/client/ClusterTokenClient.java — the SPI
+ * FlowRuleChecker/ParamFlowChecker resolve for cluster acquires. */
+public interface ClusterTokenClient {
+
+    TokenServerDescriptor currentServer();
+
+    void start();
+
+    void stop();
+
+    int getState();
+
+    TokenResult requestToken(Long flowId, int acquireCount, boolean prioritized);
+
+    TokenResult requestParamToken(Long flowId, int acquireCount,
+                                  Collection<Object> params);
+}

@@ -1,0 +1,22 @@
+package com.alibaba.csp.sentinel.cluster.client.config;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:cluster/client/config/ClusterClientConfigManager.java — only
+ * the static getters the bridge reads. */
+public final class ClusterClientConfigManager {
+
+    public static String getServerHost() {
+        return null;
+    }
+
+    public static int getServerPort() {
+        return -1;
+    }
+
+    public static int getRequestTimeout() {
+        return 3000;
+    }
+
+    private ClusterClientConfigManager() {
+    }
+}

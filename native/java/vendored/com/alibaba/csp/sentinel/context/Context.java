@@ -1,0 +1,51 @@
+package com.alibaba.csp.sentinel.context;
+
+import com.alibaba.csp.sentinel.Entry;
+import com.alibaba.csp.sentinel.node.DefaultNode;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:context/Context.java — only the members the bridge touches. */
+public class Context {
+
+    private final String name;
+    private DefaultNode entranceNode;
+    private Entry curEntry;
+    private String origin = "";
+    private final boolean async;
+
+    public Context(DefaultNode entranceNode, String name) {
+        this.name = name;
+        this.entranceNode = entranceNode;
+        this.async = false;
+    }
+
+    public String getName() {
+        return name;
+    }
+
+    public String getOrigin() {
+        return origin;
+    }
+
+    public Context setOrigin(String origin) {
+        this.origin = origin;
+        return this;
+    }
+
+    public Entry getCurEntry() {
+        return curEntry;
+    }
+
+    public Context setCurEntry(Entry curEntry) {
+        this.curEntry = curEntry;
+        return this;
+    }
+
+    public DefaultNode getEntranceNode() {
+        return entranceNode;
+    }
+
+    public boolean isAsync() {
+        return async;
+    }
+}

@@ -1,0 +1,12 @@
+package com.alibaba.csp.sentinel.log;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:log/RecordLog.java. */
+public class RecordLog {
+
+    public static void info(String format, Object... args) {
+    }
+
+    public static void warn(String format, Object... args) {
+    }
+}

@@ -1,0 +1,7 @@
+package com.alibaba.csp.sentinel.node;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:node/DefaultNode.java — opaque to the bridge (it forwards stats
+ * to the backend instead of mutating local nodes). */
+public class DefaultNode {
+}

@@ -1,0 +1,44 @@
+package com.alibaba.csp.sentinel.slotchain;
+
+import com.alibaba.csp.sentinel.context.Context;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slotchain/AbstractLinkedProcessorSlot.java. */
+public abstract class AbstractLinkedProcessorSlot<T> implements ProcessorSlot<T> {
+
+    private AbstractLinkedProcessorSlot<?> next = null;
+
+    @Override
+    public void fireEntry(Context context, ResourceWrapper resourceWrapper,
+                          Object obj, int count, boolean prioritized,
+                          Object... args) throws Throwable {
+        if (next != null) {
+            next.transformEntry(context, resourceWrapper, obj, count,
+                                prioritized, args);
+        }
+    }
+
+    @SuppressWarnings("unchecked")
+    void transformEntry(Context context, ResourceWrapper resourceWrapper,
+                        Object o, int count, boolean prioritized,
+                        Object... args) throws Throwable {
+        T t = (T) o;
+        entry(context, resourceWrapper, t, count, prioritized, args);
+    }
+
+    @Override
+    public void fireExit(Context context, ResourceWrapper resourceWrapper,
+                         int count, Object... args) {
+        if (next != null) {
+            next.exit(context, resourceWrapper, count, args);
+        }
+    }
+
+    public AbstractLinkedProcessorSlot<?> getNext() {
+        return next;
+    }
+
+    public void setNext(AbstractLinkedProcessorSlot<?> next) {
+        this.next = next;
+    }
+}

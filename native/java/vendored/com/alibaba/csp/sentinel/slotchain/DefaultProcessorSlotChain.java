@@ -1,0 +1,65 @@
+package com.alibaba.csp.sentinel.slotchain;
+
+import com.alibaba.csp.sentinel.context.Context;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slotchain/DefaultProcessorSlotChain.java. Minimal but
+ * functional linking so the conformance harness can exercise a real
+ * chain without the fork. */
+public class DefaultProcessorSlotChain extends ProcessorSlotChain {
+
+    AbstractLinkedProcessorSlot<?> first = new AbstractLinkedProcessorSlot<Object>() {
+        @Override
+        public void entry(Context context, ResourceWrapper resourceWrapper,
+                          Object t, int count, boolean prioritized,
+                          Object... args) throws Throwable {
+            fireEntry(context, resourceWrapper, t, count, prioritized, args);
+        }
+
+        @Override
+        public void exit(Context context, ResourceWrapper resourceWrapper,
+                         int count, Object... args) {
+            fireExit(context, resourceWrapper, count, args);
+        }
+    };
+    AbstractLinkedProcessorSlot<?> end = first;
+
+    @Override
+    public void addFirst(AbstractLinkedProcessorSlot<?> protocolProcessor) {
+        protocolProcessor.setNext(first.getNext());
+        first.setNext(protocolProcessor);
+        if (end == first) {
+            end = protocolProcessor;
+        }
+    }
+
+    @Override
+    public void addLast(AbstractLinkedProcessorSlot<?> protocolProcessor) {
+        end.setNext(protocolProcessor);
+        end = protocolProcessor;
+    }
+
+    @Override
+    public void setNext(AbstractLinkedProcessorSlot<?> next) {
+        addLast(next);
+    }
+
+    @Override
+    public AbstractLinkedProcessorSlot<?> getNext() {
+        return first.getNext();
+    }
+
+    @Override
+    public void entry(Context context, ResourceWrapper resourceWrapper,
+                      Object t, int count, boolean prioritized,
+                      Object... args) throws Throwable {
+        first.transformEntry(context, resourceWrapper, t, count, prioritized,
+                             args);
+    }
+
+    @Override
+    public void exit(Context context, ResourceWrapper resourceWrapper,
+                     int count, Object... args) {
+        first.exit(context, resourceWrapper, count, args);
+    }
+}

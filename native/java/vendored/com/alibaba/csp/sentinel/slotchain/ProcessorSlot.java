@@ -1,0 +1,22 @@
+package com.alibaba.csp.sentinel.slotchain;
+
+import com.alibaba.csp.sentinel.context.Context;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slotchain/ProcessorSlot.java — the slot-chain SPI every
+ * chain element implements. */
+public interface ProcessorSlot<T> {
+
+    void entry(Context context, ResourceWrapper resourceWrapper, T param,
+               int count, boolean prioritized, Object... args) throws Throwable;
+
+    void fireEntry(Context context, ResourceWrapper resourceWrapper,
+                   Object obj, int count, boolean prioritized,
+                   Object... args) throws Throwable;
+
+    void exit(Context context, ResourceWrapper resourceWrapper, int count,
+              Object... args);
+
+    void fireExit(Context context, ResourceWrapper resourceWrapper, int count,
+                  Object... args);
+}

@@ -1,0 +1,10 @@
+package com.alibaba.csp.sentinel.slotchain;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slotchain/ProcessorSlotChain.java. */
+public abstract class ProcessorSlotChain extends AbstractLinkedProcessorSlot<Object> {
+
+    public abstract void addFirst(AbstractLinkedProcessorSlot<?> protocolProcessor);
+
+    public abstract void addLast(AbstractLinkedProcessorSlot<?> protocolProcessor);
+}

@@ -1,0 +1,32 @@
+package com.alibaba.csp.sentinel.slotchain;
+
+import com.alibaba.csp.sentinel.EntryType;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slotchain/ResourceWrapper.java. */
+public abstract class ResourceWrapper {
+
+    protected final String name;
+    protected final EntryType entryType;
+    protected final int resourceType;
+
+    public ResourceWrapper(String name, EntryType entryType, int resourceType) {
+        this.name = name;
+        this.entryType = entryType;
+        this.resourceType = resourceType;
+    }
+
+    public String getName() {
+        return name;
+    }
+
+    public abstract String getShowName();
+
+    public EntryType getEntryType() {
+        return entryType;
+    }
+
+    public int getResourceType() {
+        return resourceType;
+    }
+}

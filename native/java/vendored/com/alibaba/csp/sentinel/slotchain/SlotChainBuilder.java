@@ -1,0 +1,9 @@
+package com.alibaba.csp.sentinel.slotchain;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slotchain/SlotChainBuilder.java — the SPI SlotChainProvider
+ * resolves to assemble the chain (§7 M4's splice point). */
+public interface SlotChainBuilder {
+
+    ProcessorSlotChain build();
+}

@@ -1,0 +1,17 @@
+package com.alibaba.csp.sentinel.slotchain;
+
+import com.alibaba.csp.sentinel.EntryType;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slotchain/StringResourceWrapper.java. */
+public class StringResourceWrapper extends ResourceWrapper {
+
+    public StringResourceWrapper(String name, EntryType e) {
+        super(name, e, 0);
+    }
+
+    @Override
+    public String getShowName() {
+        return name;
+    }
+}

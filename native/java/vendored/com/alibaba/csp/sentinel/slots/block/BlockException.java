@@ -1,0 +1,36 @@
+package com.alibaba.csp.sentinel.slots.block;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/block/BlockException.java. */
+public abstract class BlockException extends Exception {
+
+    protected final String ruleLimitApp;
+
+    public BlockException(String ruleLimitApp) {
+        this.ruleLimitApp = ruleLimitApp;
+    }
+
+    public BlockException(String ruleLimitApp, String message) {
+        super(message);
+        this.ruleLimitApp = ruleLimitApp;
+    }
+
+    public String getRuleLimitApp() {
+        return ruleLimitApp;
+    }
+
+    public static boolean isBlockException(Throwable t) {
+        if (null == t) {
+            return false;
+        }
+        int counter = 0;
+        Throwable cause = t;
+        while (cause != null && counter++ < 50) {
+            if (cause instanceof BlockException) {
+                return true;
+            }
+            cause = cause.getCause();
+        }
+        return false;
+    }
+}

@@ -1,0 +1,16 @@
+package com.alibaba.csp.sentinel.slots.block.authority;
+
+import com.alibaba.csp.sentinel.slots.block.BlockException;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/block/authority/AuthorityException.java. */
+public class AuthorityException extends BlockException {
+
+    public AuthorityException(String ruleLimitApp) {
+        super(ruleLimitApp);
+    }
+
+    public AuthorityException(String ruleLimitApp, String message) {
+        super(ruleLimitApp, message);
+    }
+}

@@ -1,0 +1,16 @@
+package com.alibaba.csp.sentinel.slots.block.degrade;
+
+import com.alibaba.csp.sentinel.slots.block.BlockException;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/block/degrade/DegradeException.java. */
+public class DegradeException extends BlockException {
+
+    public DegradeException(String ruleLimitApp) {
+        super(ruleLimitApp);
+    }
+
+    public DegradeException(String ruleLimitApp, String message) {
+        super(ruleLimitApp, message);
+    }
+}

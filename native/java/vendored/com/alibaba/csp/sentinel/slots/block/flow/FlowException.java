@@ -1,0 +1,16 @@
+package com.alibaba.csp.sentinel.slots.block.flow;
+
+import com.alibaba.csp.sentinel.slots.block.BlockException;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/block/flow/FlowException.java. */
+public class FlowException extends BlockException {
+
+    public FlowException(String ruleLimitApp) {
+        super(ruleLimitApp);
+    }
+
+    public FlowException(String ruleLimitApp, String message) {
+        super(ruleLimitApp, message);
+    }
+}

@@ -1,0 +1,12 @@
+package com.alibaba.csp.sentinel.slots.block.flow.param;
+
+import com.alibaba.csp.sentinel.slots.block.BlockException;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/block/flow/param/ParamFlowException.java. */
+public class ParamFlowException extends BlockException {
+
+    public ParamFlowException(String resourceName, String message) {
+        super(resourceName, message);
+    }
+}

@@ -1,0 +1,19 @@
+package com.alibaba.csp.sentinel.slots.block.system;
+
+import com.alibaba.csp.sentinel.slots.block.BlockException;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/block/system/SystemBlockException.java. */
+public class SystemBlockException extends BlockException {
+
+    private final String resourceName;
+
+    public SystemBlockException(String resourceName, String limitType) {
+        super(resourceName, limitType);
+        this.resourceName = resourceName;
+    }
+
+    public String getResourceName() {
+        return resourceName;
+    }
+}

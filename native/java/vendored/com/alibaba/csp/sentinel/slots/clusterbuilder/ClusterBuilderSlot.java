@@ -1,0 +1,24 @@
+package com.alibaba.csp.sentinel.slots.clusterbuilder;
+
+import com.alibaba.csp.sentinel.context.Context;
+import com.alibaba.csp.sentinel.node.DefaultNode;
+import com.alibaba.csp.sentinel.slotchain.AbstractLinkedProcessorSlot;
+import com.alibaba.csp.sentinel.slotchain.ResourceWrapper;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/clusterbuilder/ClusterBuilderSlot.java. */
+public class ClusterBuilderSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
+
+    @Override
+    public void entry(Context context, ResourceWrapper resourceWrapper,
+                      DefaultNode node, int count, boolean prioritized,
+                      Object... args) throws Throwable {
+        fireEntry(context, resourceWrapper, node, count, prioritized, args);
+    }
+
+    @Override
+    public void exit(Context context, ResourceWrapper resourceWrapper,
+                     int count, Object... args) {
+        fireExit(context, resourceWrapper, count, args);
+    }
+}

@@ -1,0 +1,27 @@
+package com.alibaba.csp.sentinel.slots.nodeselector;
+
+import com.alibaba.csp.sentinel.context.Context;
+import com.alibaba.csp.sentinel.node.DefaultNode;
+import com.alibaba.csp.sentinel.slotchain.AbstractLinkedProcessorSlot;
+import com.alibaba.csp.sentinel.slotchain.ResourceWrapper;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:slots/nodeselector/NodeSelectorSlot.java — the real class keeps
+ * the context node tree; this stub only fires through so the chain
+ * shape compiles and the conformance harness can run it. */
+public class NodeSelectorSlot extends AbstractLinkedProcessorSlot<Object> {
+
+    @Override
+    public void entry(Context context, ResourceWrapper resourceWrapper,
+                      Object obj, int count, boolean prioritized,
+                      Object... args) throws Throwable {
+        fireEntry(context, resourceWrapper, new DefaultNode(), count,
+                  prioritized, args);
+    }
+
+    @Override
+    public void exit(Context context, ResourceWrapper resourceWrapper,
+                     int count, Object... args) {
+        fireExit(context, resourceWrapper, count, args);
+    }
+}

@@ -1,0 +1,23 @@
+package com.alibaba.csp.sentinel.spi;
+
+import java.lang.annotation.Documented;
+import java.lang.annotation.ElementType;
+import java.lang.annotation.Retention;
+import java.lang.annotation.RetentionPolicy;
+import java.lang.annotation.Target;
+
+/** Vendored signature stub (see vendored/README.md). Reference:
+ * core:spi/Spi.java. */
+@Documented
+@Retention(RetentionPolicy.RUNTIME)
+@Target(ElementType.TYPE)
+public @interface Spi {
+
+    String value() default "";
+
+    boolean isSingleton() default true;
+
+    int order() default 0;
+
+    boolean isDefault() default false;
+}

@@ -6,9 +6,11 @@
  * of cluster/codec.py (the reference's cluster-common Netty protocol
  * re-specified; message types PING=0, FLOW=1, PARAM_FLOW=2).
  *
- * Thread-safety: one in-flight request per client handle (an internal
- * mutex serializes callers, matching the blocking-client design); create
- * one handle per worker for parallelism.
+ * Thread-safety: handles are multi-in-flight — N threads may issue
+ * requests on ONE handle concurrently; responses are demuxed by xid
+ * (the reference Netty client's xid -> promise map, shared-receiver
+ * style, no background thread). Only st_client_close must not race new
+ * requests on the same handle.
  */
 
 #ifndef SENTINEL_SHIM_H_
@@ -50,6 +52,29 @@ typedef struct st_param {
  * status (PARAM_FLOW responses carry no entity). */
 int st_request_param_token(void* handle, long long flow_id, int count,
                            const st_param* params, int nparams);
+
+/* Pipelined batch acquire: all `n` FLOW requests hit the wire before any
+ * response is awaited (one RTT per batch; the server micro-batches them
+ * into one device step). out_statuses[i] = TokenResultStatus or -1;
+ * out_extras[i] (optional) = remaining / wait-ms. Returns 0 when every
+ * response arrived, -1 on transport failure. */
+int st_request_tokens_batch(void* handle, const long long* flow_ids,
+                            const int* counts, const int* prioritized, int n,
+                            int* out_statuses, int* out_extras);
+
+/* Remote slot-chain entry (M4 bridge, MSG_ENTRY): run the backend's full
+ * rule chain + stats commit. Returns OK(0) with *out_entry_id set,
+ * BLOCKED(1) with *out_reason = BlockReason code (1=flow 2=degrade
+ * 3=system 4=authority 5=param 7=custom), or -1 (fail open). */
+int st_remote_entry(void* handle, const char* resource, const char* origin,
+                    int count, int entry_type, int prioritized,
+                    const st_param* params, int nparams,
+                    long long* out_entry_id, int* out_reason);
+
+/* Remote exit (MSG_EXIT): commit RT/success, release the entry. `error`
+ * non-zero records a business exception; `count` < 0 keeps the entry's
+ * count. Returns OK, BAD_REQUEST (unknown id), or -1. */
+int st_remote_exit(void* handle, long long entry_id, int error, int count);
 
 void st_client_close(void* handle);
 

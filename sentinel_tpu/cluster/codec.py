@@ -19,7 +19,13 @@ from __future__ import annotations
 import struct
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
-from sentinel_tpu.cluster.constants import MSG_FLOW, MSG_PARAM_FLOW, MSG_PING
+from sentinel_tpu.cluster.constants import (
+    MSG_ENTRY,
+    MSG_EXIT,
+    MSG_FLOW,
+    MSG_PARAM_FLOW,
+    MSG_PING,
+)
 
 _LEN = struct.Struct(">H")
 _REQ_HEAD = struct.Struct(">iB")

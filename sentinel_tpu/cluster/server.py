@@ -94,19 +94,45 @@ class _Handler(socketserver.BaseRequestHandler):
         reader = codec.FrameReader()
         namespace: Optional[str] = None
         # Live remote entries on THIS connection (the M4 slot-chain
-        # bridge): id -> EntryHandle. Ids are per-connection, so a
-        # client reconnect can never exit another client's entry.
+        # bridge): id -> EntryHandle. Ids come from a SERVER-wide
+        # counter: a reconnecting bridge keeps stale ids in its
+        # thread-local stacks, and per-connection numbering restarting
+        # at 1 would let those stale ids alias (and exit) a fresh
+        # entry's id on the new connection (r5 review). Globally-unique
+        # ids make a stale exit a harmless BAD_REQUEST instead. The map
+        # stays per-connection so one peer can never exit another's.
         self._remote_entries = {}
-        self._next_entry_id = 0
         self.request.settimeout(300)
         try:
             while True:
                 data = self.request.recv(65536)
                 if not data:
                     break
-                for body in reader.feed(data):
-                    req = codec.decode_request(body)
-                    namespace = self._process(server, req, namespace)
+                reqs = [codec.decode_request(b) for b in reader.feed(data)]
+                i = 0
+                while i < len(reqs):
+                    if reqs[i].msg_type == MSG_FLOW:
+                        # Pipelined FLOW runs are submitted to the
+                        # batcher AS A GROUP before any reply is awaited
+                        # — otherwise a client's batched burst of N
+                        # degrades to N sequential linger+device-step
+                        # cycles and the batch API's one-step promise is
+                        # false exactly for the caller it was built for.
+                        j = i
+                        pending = []
+                        while j < len(reqs) and reqs[j].msg_type == MSG_FLOW:
+                            fid, cnt, prio = codec.decode_flow_request(
+                                reqs[j].entity)
+                            pending.append(
+                                (reqs[j].xid,
+                                 server.batcher.submit(fid, cnt, prio)))
+                            j += 1
+                        for xid, (done, box) in pending:
+                            self._reply_flow(xid, done, box)
+                        i = j
+                    else:
+                        namespace = self._process(server, reqs[i], namespace)
+                        i += 1
         except OSError:
             pass
         finally:
@@ -123,6 +149,17 @@ class _Handler(socketserver.BaseRequestHandler):
                     pass
             self._remote_entries.clear()
 
+    def _reply_flow(self, xid: int, done, box) -> None:
+        done.wait(timeout=5)
+        result = box.get("result")
+        if result is None:
+            self.request.sendall(codec.encode_response(
+                xid, MSG_FLOW, TokenResultStatus.FAIL))
+        else:
+            self.request.sendall(codec.encode_response(
+                xid, MSG_FLOW, result.status,
+                codec.encode_flow_response(result.remaining, result.wait_ms)))
+
     def _process(self, server, req: codec.Request, namespace):
         if req.msg_type == MSG_PING:
             ns = codec.decode_ping(req.entity)
@@ -132,17 +169,9 @@ class _Handler(socketserver.BaseRequestHandler):
             self.request.sendall(codec.encode_response(
                 req.xid, MSG_PING, TokenResultStatus.OK))
         elif req.msg_type == MSG_FLOW:
-            flow_id, count, prio = codec.decode_flow_request(req.entity)
-            done, box = server.batcher.submit(flow_id, count, prio)
-            done.wait(timeout=5)
-            result = box.get("result")
-            if result is None:
-                self.request.sendall(codec.encode_response(
-                    req.xid, MSG_FLOW, TokenResultStatus.FAIL))
-            else:
-                self.request.sendall(codec.encode_response(
-                    req.xid, MSG_FLOW, result.status,
-                    codec.encode_flow_response(result.remaining, result.wait_ms)))
+            # Lone FLOW frames (not part of a pipelined run) land here.
+            self._reply_flow(req.xid, *server.batcher.submit(
+                *codec.decode_flow_request(req.entity)))
         elif req.msg_type == MSG_PARAM_FLOW:
             flow_id, count, params = codec.decode_param_flow_request(req.entity)
             result = server.service.request_param_token(flow_id, count, params)
@@ -154,11 +183,11 @@ class _Handler(socketserver.BaseRequestHandler):
             handle, reason = server.remote_entry(
                 resource, origin, count, etype, prio, params)
             if handle is not None:
-                self._next_entry_id += 1
-                self._remote_entries[self._next_entry_id] = handle
+                entry_id = server.next_entry_id()
+                self._remote_entries[entry_id] = handle
                 self.request.sendall(codec.encode_response(
                     req.xid, MSG_ENTRY, TokenResultStatus.OK,
-                    codec.encode_entry_response(self._next_entry_id, 0)))
+                    codec.encode_entry_response(entry_id, 0)))
             elif reason < 0:  # engine unavailable, fail-open on the JVM
                 self.request.sendall(codec.encode_response(
                     req.xid, MSG_ENTRY, TokenResultStatus.FAIL,
@@ -207,6 +236,15 @@ class ClusterTokenServer:
         # None -> the process default engine, resolved lazily so merely
         # constructing a token server never boots the engine singleton.
         self._engine = engine
+        self._entry_id_lock = threading.Lock()
+        self._entry_id = 0
+
+    def next_entry_id(self) -> int:
+        """Server-unique remote-entry id (never reused across
+        connections — see _Handler.handle's aliasing note)."""
+        with self._entry_id_lock:
+            self._entry_id += 1
+            return self._entry_id
 
     @property
     def engine(self):

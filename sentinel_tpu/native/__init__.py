@@ -75,6 +75,20 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.st_request_param_token.argtypes = [
         ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
         ctypes.POINTER(StParam), ctypes.c_int]
+    lib.st_request_tokens_batch.restype = ctypes.c_int
+    lib.st_request_tokens_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.st_remote_entry.restype = ctypes.c_int
+    lib.st_remote_entry.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(StParam), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int)]
+    lib.st_remote_exit.restype = ctypes.c_int
+    lib.st_remote_exit.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_int]
     lib.st_client_close.argtypes = [ctypes.c_void_p]
     lib.st_now_ms.restype = ctypes.c_longlong
 
@@ -104,8 +118,10 @@ def _pack_params(params):
 
 
 class NativeTokenClient:
-    """Blocking token client backed by the C++ shim (wire-compatible with
-    the Python ``ClusterTokenClient``; one in-flight request at a time)."""
+    """Token client backed by the C++ shim (wire-compatible with the
+    Python ``ClusterTokenClient``). Multi-in-flight: N threads may call
+    concurrently on one instance — responses demux by xid inside the
+    shim. ``close`` must not race new requests (shim close contract)."""
 
     def __init__(self, host: str, port: int, namespace: str = "default",
                  timeout_ms: int = 3000):
@@ -140,6 +156,52 @@ class NativeTokenClient:
             self._handle, flow_id, count, arr, len(arr))
         del keepalive
         return TokenResult(status)
+
+    def request_tokens_batch(self, requests):
+        """Pipelined batch acquire: ``requests`` is a sequence of
+        ``(flow_id, count, prioritized)``; all frames are sent before any
+        response is awaited — one RTT per batch, and the server's
+        micro-batcher folds them into one device step. Returns a list of
+        TokenResult (status -1 entries mark transport loss)."""
+        from sentinel_tpu.cluster.token_service import TokenResult
+
+        n = len(requests)
+        if n == 0:
+            return []
+        flow_ids = (ctypes.c_longlong * n)(*[int(r[0]) for r in requests])
+        counts = (ctypes.c_int * n)(*[int(r[1]) for r in requests])
+        prios = (ctypes.c_int * n)(*[1 if r[2] else 0 for r in requests])
+        statuses = (ctypes.c_int * n)()
+        extras = (ctypes.c_int * n)()
+        self._lib.st_request_tokens_batch(
+            self._handle, flow_ids, counts, prios, n, statuses, extras)
+        out = []
+        for k in range(n):
+            if statuses[k] == 2:  # SHOULD_WAIT
+                out.append(TokenResult(statuses[k], wait_ms=extras[k]))
+            else:
+                out.append(TokenResult(statuses[k], remaining=extras[k]))
+        return out
+
+    def remote_entry(self, resource: str, origin: str = "", count: int = 1,
+                     entry_type: int = 0, prioritized: bool = False,
+                     params=()):
+        """M4 bridge: full backend slot-chain check + stats commit.
+        Returns ``(status, entry_id, reason)``."""
+        arr, keepalive = _pack_params(list(params))
+        entry_id = ctypes.c_longlong(0)
+        reason = ctypes.c_int(0)
+        status = self._lib.st_remote_entry(
+            self._handle, resource.encode(), origin.encode(), count,
+            entry_type, 1 if prioritized else 0, arr, len(arr),
+            ctypes.byref(entry_id), ctypes.byref(reason))
+        del keepalive
+        return status, entry_id.value, reason.value
+
+    def remote_exit(self, entry_id: int, error: bool = False,
+                    count: int = -1) -> int:
+        return self._lib.st_remote_exit(
+            self._handle, entry_id, 1 if error else 0, count)
 
     def close(self) -> None:
         if self._handle:

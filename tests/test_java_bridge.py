@@ -1,0 +1,186 @@
+"""Java M4 bridge: signature conformance without a JVM.
+
+The bridge sources (``native/java/src``) must fit the SPI surface they
+compile against. No JDK exists in this sandbox, so the fit is checked
+structurally: the vendored 1.8 signatures (``native/java/vendored`` —
+hand-written, behavior-free stubs of the documented API) are parsed with
+a small regex extractor, and every SPI method the bridge must implement
+is asserted present with a matching parameter list. Cross-language
+constants (BlockReason codes, TokenResultStatus values, MSG types) are
+pinned against the Python definitions so the wire can't drift by edit.
+
+The byte-level wire conformance lives in test_tlv_fixtures.py (C shim)
+and native/java/src/test (JVM harnesses, runnable the day a JDK is
+available — BUILD.md).
+"""
+
+import re
+from pathlib import Path
+
+JAVA_ROOT = Path(__file__).parent.parent / "native" / "java"
+SRC = JAVA_ROOT / "src" / "main" / "java" / "com" / "alibaba" / "csp" / \
+    "sentinel" / "tpu"
+VENDORED = JAVA_ROOT / "vendored" / "com" / "alibaba" / "csp" / "sentinel"
+
+_METHOD_RE = re.compile(
+    r"(?:public|protected)?\s*(?:abstract\s+)?(?:static\s+)?"
+    r"(?:synchronized\s+)?[\w<>\[\],.\s]+?\s+(\w+)\s*\(([^)]*)\)",
+    re.DOTALL)
+
+
+def _strip_comments(src: str) -> str:
+    src = re.sub(r"/\*.*?\*/", "", src, flags=re.DOTALL)
+    return re.sub(r"//[^\n]*", "", src)
+
+
+def _param_types(arglist: str):
+    """'Context context, int count, Object... args' -> normalized type
+    names (generics erased, varargs kept)."""
+    out = []
+    depth = 0
+    current = []
+    parts = []
+    for ch in arglist:
+        if ch == "<":
+            depth += 1
+        elif ch == ">":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+    if current and "".join(current).strip():
+        parts.append("".join(current))
+    for p in parts:
+        p = re.sub(r"<[^<>]*>", "", p).strip()
+        if not p:
+            continue
+        toks = p.split()
+        typ = " ".join(toks[:-1]) if len(toks) > 1 else toks[0]
+        varargs = typ.endswith("...")
+        typ = typ[:-3] if varargs else typ
+        typ = typ.split(".")[-1]  # strip package qualifier
+        out.append(typ + "..." if varargs else typ)
+    return out
+
+
+def _methods(path: Path):
+    src = _strip_comments(path.read_text())
+    found = {}
+    for m in _METHOD_RE.finditer(src):
+        name, args = m.group(1), m.group(2)
+        if name[0].isupper():  # constructor or type mention, not a method
+            continue
+        found.setdefault(name, []).append(_param_types(args))
+    return found
+
+
+def _has(methods, name, types):
+    return any(sig == types for sig in methods.get(name, []))
+
+
+# -- ProcessorSlot fit --------------------------------------------------------
+
+
+def test_bridge_slot_implements_processor_slot():
+    spi = _methods(VENDORED / "slotchain" / "ProcessorSlot.java")
+    impl = _methods(SRC / "TpuBridgeSlot.java")
+    # the SPI's entry/exit pair, with the slot's concrete T = DefaultNode
+    want_entry = ["Context", "ResourceWrapper", "DefaultNode", "int",
+                  "boolean", "Object..."]
+    want_exit = ["Context", "ResourceWrapper", "int", "Object..."]
+    assert _has(spi, "entry",
+                ["Context", "ResourceWrapper", "T", "int", "boolean",
+                 "Object..."])
+    assert _has(impl, "entry", want_entry), impl.get("entry")
+    assert _has(impl, "exit", want_exit), impl.get("exit")
+
+
+def test_chain_builder_implements_spi():
+    spi = _methods(VENDORED / "slotchain" / "SlotChainBuilder.java")
+    impl = _methods(SRC / "TpuSlotChainBuilder.java")
+    assert _has(spi, "build", [])
+    assert _has(impl, "build", [])
+    src = (SRC / "TpuSlotChainBuilder.java").read_text()
+    assert "implements SlotChainBuilder" in src
+    assert "@Spi" in src
+
+
+def test_token_client_implements_spi():
+    spi = _methods(VENDORED / "cluster" / "client" / "ClusterTokenClient.java")
+    impl = _methods(SRC / "TpuClusterTokenClient.java")
+    for name, sig in [("start", []), ("stop", []), ("getState", []),
+                      ("currentServer", []),
+                      ("requestToken", ["Long", "int", "boolean"]),
+                      ("requestParamToken", ["Long", "int", "Collection"])]:
+        assert _has(spi, name, sig), (name, spi.get(name))
+        assert _has(impl, name, sig), (name, impl.get(name))
+
+
+def test_service_registrations():
+    services = JAVA_ROOT / "src" / "main" / "resources" / "META-INF" / \
+        "services"
+    builder = (services /
+               "com.alibaba.csp.sentinel.slotchain.SlotChainBuilder")
+    client = (services /
+              "com.alibaba.csp.sentinel.cluster.client.ClusterTokenClient")
+    assert builder.read_text().strip() == \
+        "com.alibaba.csp.sentinel.tpu.TpuSlotChainBuilder"
+    assert client.read_text().strip() == \
+        "com.alibaba.csp.sentinel.tpu.TpuClusterTokenClient"
+
+
+# -- cross-language constant pinning -----------------------------------------
+
+
+def test_reason_codes_match_python():
+    from sentinel_tpu.core.constants import BlockReason
+
+    src = (SRC / "TpuBridgeSlot.java").read_text()
+    for name, member in [("REASON_FLOW", BlockReason.FLOW),
+                         ("REASON_DEGRADE", BlockReason.DEGRADE),
+                         ("REASON_SYSTEM", BlockReason.SYSTEM),
+                         ("REASON_AUTHORITY", BlockReason.AUTHORITY),
+                         ("REASON_PARAM_FLOW", BlockReason.PARAM_FLOW)]:
+        m = re.search(rf"{name}\s*=\s*(\d+)", src)
+        assert m, name
+        assert int(m.group(1)) == int(member), name
+
+
+def test_token_status_values_match_python():
+    from sentinel_tpu.cluster.constants import TokenResultStatus
+
+    src = (VENDORED / "TokenResultStatus.java").read_text() \
+        if (VENDORED / "TokenResultStatus.java").exists() else \
+        (VENDORED / "cluster" / "TokenResultStatus.java").read_text()
+    for member in TokenResultStatus:
+        m = re.search(rf"{member.name}\s*=\s*(-?\d+)", src)
+        assert m, member.name
+        assert int(m.group(1)) == int(member), member.name
+
+
+def test_entry_type_wire_mapping_pinned():
+    """Backend EntryType is IN=0/OUT=1 (core/constants.py) — the Java
+    side must encode the same values, not a naive IN->1 boolean."""
+    from sentinel_tpu.core.constants import EntryType
+
+    assert int(EntryType.IN) == 0 and int(EntryType.OUT) == 1
+    src = (SRC / "TpuBridgeSlot.java").read_text()
+    assert re.search(r"EntryType\.IN\s*\?\s*0\s*:\s*1", src), \
+        "TpuBridgeSlot must map IN->0, OUT->1 on the wire"
+
+
+def test_conformance_harnesses_reference_real_fixture_names():
+    import json
+
+    fixtures = json.loads(
+        (Path(__file__).parent / "fixtures" / "tlv" / "fixtures.json")
+        .read_text())["fixtures"]
+    names = {f["name"] for f in fixtures}
+    for harness in ["TlvGoldenFramesConformance.java",
+                    "BridgeSlotConformance.java"]:
+        src = (JAVA_ROOT / "src" / "test" / "java" / "com" / "alibaba" /
+               "csp" / "sentinel" / "tpu" / harness).read_text()
+        for ref in re.findall(r'fx\.get\("(\w+)"\)', src):
+            assert ref in names, (harness, ref)

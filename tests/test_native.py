@@ -89,6 +89,150 @@ def test_native_param_token_acquire(param_server):
             == TokenResultStatus.NO_RULE_EXISTS
 
 
+def test_native_concurrent_acquires_one_handle(token_server):
+    """Multi-in-flight pipelining (r5): 8 threads share ONE handle; xid
+    demux must route every response to its caller — the reference Netty
+    client's xid->promise behavior, now in the C shim."""
+    import threading
+
+    with NativeTokenClient("127.0.0.1", token_server.bound_port) as client:
+        results = [None] * 8
+        barrier = threading.Barrier(8)
+
+        def worker(tid):
+            barrier.wait()
+            # mix known and unknown flow ids so a mis-routed response is
+            # detectable by status, not just by count
+            if tid % 2 == 0:
+                results[tid] = client.request_token(4242).status
+            else:
+                results[tid] = client.request_token(999).status
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    evens = [results[i] for i in range(0, 8, 2)]
+    odds = [results[i] for i in range(1, 8, 2)]
+    # odd threads asked for an unknown flow: every one must see
+    # NO_RULE_EXISTS (a swapped xid would hand them OK/BLOCKED)
+    assert all(s == TokenResultStatus.NO_RULE_EXISTS for s in odds)
+    assert evens.count(TokenResultStatus.OK) == 3
+    assert evens.count(TokenResultStatus.BLOCKED) == 1
+
+
+def test_native_batch_acquire(token_server):
+    """st_request_tokens_batch: one pipelined wire burst, per-request
+    statuses in order."""
+    with NativeTokenClient("127.0.0.1", token_server.bound_port) as client:
+        results = client.request_tokens_batch(
+            [(4242, 1, False)] * 5 + [(999, 1, False)])
+    statuses = [r.status for r in results]
+    assert statuses[:5].count(TokenResultStatus.OK) == 3
+    assert statuses[:5].count(TokenResultStatus.BLOCKED) == 2
+    assert statuses[5] == TokenResultStatus.NO_RULE_EXISTS
+
+
+def test_native_slow_response_does_not_brick_handle():
+    """A clean per-call timeout (e.g. the server absorbing an XLA
+    compile) fails THAT call only: the connection stays usable and the
+    late response is discarded by xid (r5 review — previously one
+    timeout marked the shared handle dead forever)."""
+    import socket
+    import threading
+
+    from sentinel_tpu.cluster import codec as cc
+
+    sock = socket.socket()
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+
+    def serve():
+        conn, _ = sock.accept()
+        reader = cc.FrameReader()
+        try:
+            while True:
+                data = conn.recv(4096)
+                if not data:
+                    return
+                for body in reader.feed(data):
+                    req = cc.decode_request(body)
+                    if req.msg_type == 0:  # PING
+                        conn.sendall(cc.encode_response(req.xid, 0, 0))
+                    elif req.xid == 2:  # first acquire: reply LATE
+                        def late(xid=req.xid):
+                            time.sleep(1.2)
+                            try:
+                                conn.sendall(cc.encode_response(
+                                    xid, 1, 0, cc.encode_flow_response(9, 0)))
+                            except OSError:
+                                pass
+                        threading.Thread(target=late, daemon=True).start()
+                    else:  # later acquires: reply promptly
+                        conn.sendall(cc.encode_response(
+                            req.xid, 1, 0, cc.encode_flow_response(5, 0)))
+        except OSError:
+            pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    with NativeTokenClient("127.0.0.1", port, timeout_ms=400) as client:
+        first = client.request_token(1)
+        assert first.status == -1  # timed out, honestly failed
+        time.sleep(1.0)  # let the stale xid-2 reply arrive and be dropped
+        second = client.request_token(1)
+        assert second.status == TokenResultStatus.OK
+        assert second.remaining == 5  # xid-matched: NOT the stale reply
+    sock.close()
+
+
+@pytest.fixture()
+def bridge_server(engine, frozen_time):
+    server = ClusterTokenServer(host="127.0.0.1", port=0,
+                                engine=engine).start()
+    yield server
+    server.stop()
+
+
+def test_native_remote_entry_exit(bridge_server, frozen_time):
+    """The M4 bridge through the C shim: pass with id, typed block
+    reason, exit commit."""
+    st.load_flow_rules([st.FlowRule(resource="shimRes", count=2)])
+    with NativeTokenClient("127.0.0.1", bridge_server.bound_port,
+                           timeout_ms=120_000) as client:
+        outcomes = [client.remote_entry("shimRes", origin="jvm-app")
+                    for _ in range(5)]
+        ok = [(s, e, r) for s, e, r in outcomes
+              if s == TokenResultStatus.OK]
+        blocked = [(s, e, r) for s, e, r in outcomes
+                   if s == TokenResultStatus.BLOCKED]
+        assert len(ok) == 2 and len(blocked) == 3
+        assert all(e > 0 for _, e, _ in ok)
+        assert all(r == 1 for _, _, r in blocked)  # BlockReason.FLOW
+        for _, eid, _ in ok:
+            assert client.remote_exit(eid) == TokenResultStatus.OK
+        # consumed ids answer BAD_REQUEST
+        assert client.remote_exit(ok[0][1]) == TokenResultStatus.BAD_REQUEST
+
+
+def test_native_remote_entry_params(bridge_server, frozen_time):
+    """Hot params ride the shim's ENTRY frame into the param checker."""
+    st.load_param_flow_rules(
+        [st.ParamFlowRule("shimHot", param_idx=0, count=1)])
+    # generous timeout: the first param-family entry absorbs an XLA
+    # compile (tens of seconds on the CPU test topology)
+    with NativeTokenClient("127.0.0.1", bridge_server.bound_port,
+                           timeout_ms=120_000) as client:
+        outcomes = [client.remote_entry("shimHot", params=["k1"])
+                    for _ in range(3)]
+        blocked = [r for s, _, r in outcomes
+                   if s == TokenResultStatus.BLOCKED]
+        assert len(blocked) >= 1
+        assert all(r == 5 for r in blocked)  # BlockReason.PARAM_FLOW
+
+
 def test_native_param_buckets_shared_with_python_client(param_server):
     """Typed wire params hash identically from C and Python, so both
     clients drain the SAME (flowId, value) bucket — incl. int vs str

@@ -39,6 +39,13 @@ def _encode(f: dict) -> bytes:
         elif f["msg_type"] == codec.MSG_FLOW:
             entity = codec.encode_flow_request(
                 f["flow_id"], f["count"], f["prioritized"])
+        elif f["msg_type"] == codec.MSG_ENTRY:
+            entity = codec.encode_entry_request(
+                f["resource"], f["origin"], f["count"], f["entry_type"],
+                f["prioritized"], f["params"])
+        elif f["msg_type"] == codec.MSG_EXIT:
+            entity = codec.encode_exit_request(
+                f["entry_id"], f["error"], f["count"])
         else:
             entity = codec.encode_param_flow_request(
                 f["flow_id"], f["count"], f["params"])
@@ -46,6 +53,8 @@ def _encode(f: dict) -> bytes:
     entity = b""
     if f["msg_type"] == 1:
         entity = codec.encode_flow_response(f["remaining"], f["wait_ms"])
+    elif f["msg_type"] == codec.MSG_ENTRY:
+        entity = codec.encode_entry_response(f["entry_id"], f["reason"])
     return codec.encode_response(f["xid"], f["msg_type"], f["status"], entity)
 
 
@@ -66,6 +75,13 @@ def test_python_codec_decodes_golden_bytes(f):
         elif f["msg_type"] == 1:
             assert codec.decode_flow_request(req.entity) == (
                 f["flow_id"], f["count"], f["prioritized"])
+        elif f["msg_type"] == codec.MSG_ENTRY:
+            assert codec.decode_entry_request(req.entity) == (
+                f["resource"], f["origin"], f["count"], f["entry_type"],
+                f["prioritized"], f["params"])
+        elif f["msg_type"] == codec.MSG_EXIT:
+            assert codec.decode_exit_request(req.entity) == (
+                f["entry_id"], f["error"], f["count"])
         else:
             assert codec.decode_param_flow_request(req.entity) == (
                 f["flow_id"], f["count"], f["params"])
@@ -76,6 +92,9 @@ def test_python_codec_decodes_golden_bytes(f):
         if f["msg_type"] == 1:
             assert codec.decode_flow_response(resp.entity) == (
                 f["remaining"], f["wait_ms"])
+        elif f["msg_type"] == codec.MSG_ENTRY:
+            assert codec.decode_entry_response(resp.entity) == (
+                f["entry_id"], f["reason"])
 
 
 def test_frame_reader_reassembles_fixture_stream():
@@ -161,3 +180,37 @@ def test_c_shim_speaks_golden_bytes():
         bytes.fromhex(_fx("param_request_every_type")["hex"])[2:])
     golden_param[3] = 3  # xid 2 -> 3 (third request on this connection)
     assert param == bytes(golden_param)
+
+
+@pytest.mark.skipif(
+    pytest.importorskip("sentinel_tpu.native").load_shim() is None,
+    reason="native toolchain unavailable")
+def test_c_shim_entry_exit_golden_bytes():
+    """The M4 bridge frames from the C side (st_remote_entry /
+    st_remote_exit) are pinned byte-for-byte against the fixtures, both
+    encode and decode."""
+    from sentinel_tpu.cluster.constants import TokenResultStatus
+    from sentinel_tpu.native import NativeTokenClient
+
+    exit_reply = bytearray(bytes.fromhex(_fx("exit_response_ok")["hex"]))
+    exit_reply[5] = 3  # xid 4 -> 3: the shim's third request here
+    server = _CaptureServer(script=[
+        bytes.fromhex(_fx("ping_response_ok")["hex"]),
+        bytes.fromhex(_fx("entry_response_pass")["hex"]),
+        bytes(exit_reply),
+    ])
+    with NativeTokenClient("127.0.0.1", server.port, "default") as client:
+        status, entry_id, reason = client.remote_entry(
+            "getUser", origin="appA", count=1, entry_type=0)
+        assert status == TokenResultStatus.OK
+        assert (entry_id, reason) == (1, 0)
+        assert client.remote_exit(1) == TokenResultStatus.OK
+    assert server.done.wait(3.0)
+
+    ping, entry, exit_ = server.frames
+    assert ping == bytes.fromhex(_fx("ping_request_default")["hex"])[2:]
+    assert entry == bytes.fromhex(_fx("entry_request_basic")["hex"])[2:]
+    golden_exit = bytearray(
+        bytes.fromhex(_fx("exit_request_basic")["hex"])[2:])
+    golden_exit[3] = 3  # xid 4 -> 3 (third request on this connection)
+    assert exit_ == bytes(golden_exit)
