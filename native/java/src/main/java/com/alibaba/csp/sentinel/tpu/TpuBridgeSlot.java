@@ -64,45 +64,95 @@ public class TpuBridgeSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
 
     private static final long RECONNECT_BACKOFF_MS = 2000;
 
-    // Shared multi-in-flight handle (the shim demuxes by xid); guarded
-    // by the class monitor for connect/drop only — requests race freely.
-    private static volatile Pointer handle;
+    /**
+     * Refcounted wrapper around a shim handle. The shim's close contract
+     * forbids st_client_close racing NEW requests on the same handle, and
+     * the window between reading a shared Pointer and entering the native
+     * call can't be covered by a monitor without serializing every entry
+     * — so the native close runs only when the LAST borrower releases
+     * (native memory is freed exactly once, never under a live caller).
+     */
+    static final class Conn {
+        final Pointer ptr;
+        // starts at 1: the static `current` table reference
+        private final java.util.concurrent.atomic.AtomicInteger refs =
+            new java.util.concurrent.atomic.AtomicInteger(1);
+
+        Conn(Pointer ptr) {
+            this.ptr = ptr;
+        }
+
+        boolean acquire() {
+            for (;;) {
+                int r = refs.get();
+                if (r <= 0) {
+                    return false;  // already fully closed
+                }
+                if (refs.compareAndSet(r, r + 1)) {
+                    return true;
+                }
+            }
+        }
+
+        void release() {
+            if (refs.decrementAndGet() == 0) {
+                SentinelTpuShim.INSTANCE.st_client_close(ptr);
+            }
+        }
+    }
+
+    private static volatile Conn current;
     private static long lastConnectFailMs;
 
     private static final ThreadLocal<Deque<Long>> ENTRY_IDS =
         ThreadLocal.withInitial(ArrayDeque::new);
 
-    private static synchronized Pointer connectedHandle() {
-        if (handle != null) {
-            return handle;
+    /** Borrow the live connection (caller MUST release()); null when
+     * unconfigured/backing off — the caller fails open. */
+    private static Conn borrowConnection() {
+        Conn c = current;
+        if (c != null && c.acquire()) {
+            return c;
         }
-        if (System.currentTimeMillis() - lastConnectFailMs < RECONNECT_BACKOFF_MS) {
-            return null;
+        synchronized (TpuBridgeSlot.class) {
+            c = current;
+            if (c != null && c.acquire()) {
+                return c;
+            }
+            if (System.currentTimeMillis() - lastConnectFailMs
+                    < RECONNECT_BACKOFF_MS) {
+                return null;
+            }
+            String host = System.getProperty("csp.sentinel.tpu.host",
+                ClusterClientConfigManager.getServerHost());
+            int port = Integer.getInteger("csp.sentinel.tpu.port",
+                ClusterClientConfigManager.getServerPort());
+            if (host == null || port <= 0) {
+                return null;
+            }
+            Pointer fresh = SentinelTpuShim.INSTANCE.st_client_connect(
+                host, port, ClusterConstants.DEFAULT_CLUSTER_NAMESPACE,
+                ClusterClientConfigManager.getRequestTimeout());
+            if (fresh == null) {
+                lastConnectFailMs = System.currentTimeMillis();
+                return null;
+            }
+            Conn made = new Conn(fresh);
+            made.acquire();  // the caller's borrow
+            current = made;
+            RecordLog.info("[TpuBridgeSlot] connected to {}:{}", host, port);
+            return made;
         }
-        String host = System.getProperty("csp.sentinel.tpu.host",
-            ClusterClientConfigManager.getServerHost());
-        int port = Integer.getInteger("csp.sentinel.tpu.port",
-            ClusterClientConfigManager.getServerPort());
-        if (host == null || port <= 0) {
-            return null;
-        }
-        Pointer fresh = SentinelTpuShim.INSTANCE.st_client_connect(
-            host, port, ClusterConstants.DEFAULT_CLUSTER_NAMESPACE,
-            ClusterClientConfigManager.getRequestTimeout());
-        if (fresh == null) {
-            lastConnectFailMs = System.currentTimeMillis();
-            return null;
-        }
-        handle = fresh;
-        RecordLog.info("[TpuBridgeSlot] connected to {}:{}", host, port);
-        return handle;
     }
 
-    private static synchronized void dropConnection() {
-        if (handle != null) {
-            SentinelTpuShim.INSTANCE.st_client_close(handle);
-            handle = null;
+    /** Retire `failed` (transport death observed on it): drop the table
+     * reference so the native handle closes once in-flight borrowers
+     * release. Other connections installed since are untouched. */
+    private static synchronized void retireConnection(Conn failed) {
+        if (current == failed) {
+            current = null;
             lastConnectFailMs = System.currentTimeMillis();
+            failed.release();  // the table's own reference
         }
     }
 
@@ -110,27 +160,43 @@ public class TpuBridgeSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
     public void entry(Context context, ResourceWrapper resourceWrapper,
                       DefaultNode node, int count, boolean prioritized,
                       Object... args) throws Throwable {
-        Pointer h = context.isAsync() ? null : connectedHandle();
-        if (h == null) {
+        if (context.isAsync()) {
+            // Async entries exit on another thread, so the per-thread id
+            // stack cannot pair them (exit() has the mirror guard): they
+            // fire through locally, nothing pushed.
+            fireEntry(context, resourceWrapper, node, count, prioritized, args);
+            return;
+        }
+        Conn conn = borrowConnection();
+        if (conn == null) {
             // fail open: no backend -> behave like an unruled resource
             ENTRY_IDS.get().push(0L);
             fireEntry(context, resourceWrapper, node, count, prioritized, args);
             return;
         }
-        SentinelTpuShim.StParam[] arr = marshalParams(args);
+        int status = -1;
         LongByReference outId = new LongByReference();
         IntByReference outReason = new IntByReference();
-        // Wire entry_type matches the backend's EntryType enum: IN=0,
-        // OUT=1 (core/constants.py — note the inversion vs. a naive
-        // boolean encoding).
-        int status = SentinelTpuShim.INSTANCE.st_remote_entry(
-            h, resourceWrapper.getName(),
-            context.getOrigin() == null ? "" : context.getOrigin(), count,
-            resourceWrapper.getEntryType() == EntryType.IN ? 0 : 1,
-            prioritized ? 1 : 0, arr, args == null ? 0 : args.length,
-            outId, outReason);
+        try {
+            SentinelTpuShim.StParam[] arr = marshalParams(args);
+            // Wire entry_type matches the backend's EntryType enum: IN=0,
+            // OUT=1 (core/constants.py — note the inversion vs. a naive
+            // boolean encoding).
+            status = SentinelTpuShim.INSTANCE.st_remote_entry(
+                conn.ptr, resourceWrapper.getName(),
+                context.getOrigin() == null ? "" : context.getOrigin(), count,
+                resourceWrapper.getEntryType() == EntryType.IN ? 0 : 1,
+                prioritized ? 1 : 0, arr, args == null ? 0 : args.length,
+                outId, outReason);
+        } finally {
+            if (status == -1) {
+                // transport death (or a thrown marshalling error):
+                // reconnect on the next entry
+                retireConnection(conn);
+            }
+            conn.release();
+        }
         if (status == -1) {
-            dropConnection();  // transport death: reconnect next entry
             ENTRY_IDS.get().push(0L);
             fireEntry(context, resourceWrapper, node, count, prioritized, args);
             return;
@@ -151,21 +217,34 @@ public class TpuBridgeSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
     @Override
     public void exit(Context context, ResourceWrapper resourceWrapper,
                      int count, Object... args) {
+        if (context.isAsync()) {
+            // Mirror of entry()'s async guard: nothing was pushed for
+            // this entry (and this thread's stack may hold OTHER live
+            // entries' ids — popping here would exit one of those).
+            fireExit(context, resourceWrapper, count, args);
+            return;
+        }
         Deque<Long> stack = ENTRY_IDS.get();
         Long entryId = stack.isEmpty() ? null : stack.pop();
         if (entryId != null && entryId != 0L) {
-            Pointer h = handle;  // volatile read; no connect on exit path
-            if (h != null) {
-                boolean error = context.getCurEntry() != null
-                    && context.getCurEntry().getError() != null;
-                int rc = SentinelTpuShim.INSTANCE.st_remote_exit(
-                    h, entryId, error ? 1 : 0, count);
-                if (rc == -1) {
-                    dropConnection();
+            Conn conn = borrowConnection();
+            if (conn != null) {
+                try {
+                    boolean error = context.getCurEntry() != null
+                        && context.getCurEntry().getError() != null;
+                    int rc = SentinelTpuShim.INSTANCE.st_remote_exit(
+                        conn.ptr, entryId, error ? 1 : 0, count);
+                    if (rc == -1) {
+                        retireConnection(conn);
+                    }
+                } finally {
+                    conn.release();
                 }
             }
             // else: connection already died; the backend's disconnect
-            // drain released this entry server-side.
+            // drain released this entry server-side. (If the connection
+            // CHANGED since this entry, the stale id gets a harmless
+            // BAD_REQUEST — ids are server-unique across connections.)
         }
         fireExit(context, resourceWrapper, count, args);
     }

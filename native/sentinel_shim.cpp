@@ -530,6 +530,12 @@ void st_client_close(void* handle) {
   // live thread — close is rare and the fd is already closed.
 }
 
+// NOTE: the token-lease admission ring is NOT part of this shim. It
+// lives in native/lease_ext.c as a CPython extension — a ctypes route
+// through here was measured (r5) and its ~2-4µs trampoline erased the
+// win, and a third copy of the admission math would be drift waiting to
+// happen.
+
 // -- cached-tick clock (reference: core:util/TimeUtil.java) ------------------
 
 namespace {

@@ -87,7 +87,8 @@ class EntryHandle:
     )
 
     def __init__(self, engine, resource, context, cluster_row, dn_row,
-                 origin_row, entry_in, count, params, leased=False):
+                 origin_row, entry_in, count, params, leased=False,
+                 now_ms=None):
         self.engine = engine
         self.resource = resource
         self.context = context
@@ -96,7 +97,10 @@ class EntryHandle:
         self.origin_row = origin_row
         self.entry_in = entry_in
         self.count = count
-        self.created_ms = time_util.current_time_millis()
+        # Callers on the µs-scale fast path pass the clock they already
+        # read; everyone else pays the (cached-tick) read here.
+        self.created_ms = (time_util.current_time_millis()
+                           if now_ms is None else now_ms)
         self.error = False
         self.exited = False
         self.params = params
@@ -729,8 +733,7 @@ class SentinelEngine:
                 f"count={count} exceeds MAX_ACQUIRE_COUNT={C.MAX_ACQUIRE_COUNT}")
         ctx = ctx_mod.get_context()
         if ctx is None:
-            ctx = ctx_mod.enter(C.CONTEXT_DEFAULT_NAME)
-            ctx.auto_created = True
+            ctx = ctx_mod.enter_auto()  # pooled per-thread default context
         if ctx.is_null:
             return EntryHandle(self, resource, ctx, -1, -1, -1,
                                entry_type == C.EntryType.IN, count, ())
@@ -811,7 +814,7 @@ class SentinelEngine:
                 raise ex
             handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
                                  origin_row, entry_in, count, params,
-                                 leased=True)
+                                 leased=True, now_ms=now)
             ctx.entry_stack.append(handle)
             return handle
         if lease is None and fast_ok and fp.unruled \

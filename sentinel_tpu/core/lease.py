@@ -43,6 +43,14 @@ from sentinel_tpu.core.batch import (
     make_entry_batch_np,
     make_exit_batch_np,
 )
+from sentinel_tpu.native import load_lease_ext
+
+# Resolved ONCE at module import (a one-time `make` + import, ~1s when
+# the .so isn't prebuilt): LocalLease objects are constructed by
+# build_lease_table UNDER THE ENGINE CONFIG LOCK on every rule push —
+# triggering a C compile there would stall admission behind gcc
+# (r5 review). None -> every lease runs the pure-Python ring.
+_LEASE_EXT = load_lease_ext()
 
 
 def _ladder_width(n: int) -> int:
@@ -53,10 +61,23 @@ def _ladder_width(n: int) -> int:
 
 
 class LocalLease:
-    """Host mirror of one resource's instant window + thresholds."""
+    """Host mirror of one resource's instant window + thresholds.
+
+    When the native lease extension builds (``native/lease_ext.c``) the
+    ring lives in C: rotate+sum+compare drop from ~3µs of interpreted
+    Python (lock acquire included — the contended hot spot VERDICT r4
+    measured convoying t8 to 3-6x t1) to ~0.3µs of C with no separate
+    lock (the GIL serializes the extension call, with a critical section
+    three orders of magnitude shorter). Identical admission math either
+    way, bucket for bucket; the Python ring remains the universal
+    fallback and the oracle ``test_native.py`` compares against.
+
+    Note a ctypes route through the shim's ``st_lease_*`` surface was
+    measured FIRST and rejected: the ~2-4µs ctypes trampoline erased the
+    win (r5). The C-ABI surface remains for non-Python hosts."""
 
     __slots__ = ("thresholds", "interval_ms", "bucket_ms", "buckets",
-                 "_counts", "_starts", "_lock")
+                 "_counts", "_starts", "_lock", "_ring")
 
     def __init__(self, thresholds: List[float], interval_ms: int,
                  buckets: int):
@@ -67,11 +88,21 @@ class LocalLease:
         self._counts = [0] * buckets
         self._starts = [-1] * buckets
         self._lock = threading.Lock()
+        self._ring = (_LEASE_EXT.LeaseRing(thresholds, interval_ms, buckets)
+                      if _LEASE_EXT is not None else None)
 
     def _rotate(self, now_ms: int) -> int:
-        """Lazy bucket reset (caller holds the lock); returns current idx."""
+        """Lazy bucket reset (caller holds the lock); returns current idx.
+
+        Hot path: when the current bucket's start is already right, the
+        whole ring is right — the full fix-up loop below establishes
+        that invariant whenever it runs, and within one bucket window no
+        other bucket can newly expire. High-rate admission then pays one
+        compare instead of an O(buckets) loop per entry."""
         idx = (now_ms // self.bucket_ms) % self.buckets
         cur_start = now_ms - now_ms % self.bucket_ms
+        if self._starts[idx] == cur_start:
+            return idx
         for b in range(self.buckets):
             expected = cur_start - ((idx - b) % self.buckets) * self.bucket_ms
             if self._starts[b] != expected:
@@ -86,6 +117,9 @@ class LocalLease:
 
     def try_acquire(self, count: int, now_ms: int) -> bool:
         """Device-exact DEFAULT admission against the mirrored ring."""
+        ring = self._ring
+        if ring is not None:
+            return ring.try_acquire(count, now_ms)
         with self._lock:
             idx = self._rotate(now_ms)
             used = self._used()
@@ -98,6 +132,10 @@ class LocalLease:
     def add(self, count: int, now_ms: int) -> None:
         """Record a DEVICE-decided pass so the mirror tracks the window in
         every mode (pipeline / prioritized / occupy-granted entries)."""
+        ring = self._ring
+        if ring is not None:
+            ring.add(count, now_ms)
+            return
         with self._lock:
             idx = self._rotate(now_ms)
             self._counts[idx] += count
@@ -115,17 +153,27 @@ class LocalLease:
         counts = [int(c) for c in counts]
         if len(starts) != self.buckets or len(counts) != self.buckets:
             return
+        ring = self._ring
+        if ring is not None:
+            ring.seed(starts, counts)
+            return
         with self._lock:
             self._starts = starts
             self._counts = counts
 
     def snapshot(self):
         """(starts, counts) under the lock — for mirror carry-over."""
+        ring = self._ring
+        if ring is not None:
+            return ring.snapshot()
         with self._lock:
             return list(self._starts), list(self._counts)
 
     def usage(self, now_ms: int) -> float:
         """Current per-second QPS usage of the mirrored window (ops)."""
+        ring = self._ring
+        if ring is not None:
+            return ring.usage(now_ms)
         with self._lock:
             self._rotate(now_ms)
             return self._used()

@@ -121,6 +121,12 @@ class NodeRegistry:
             return row
 
     def entrance_row(self, context: str) -> int:
+        # Lock-free hit: dict reads are GIL-atomic and entrance rows are
+        # never freed, so a present entry is immutable truth (hot path —
+        # every fresh context resolves its entrance once).
+        row = self._entrance.get(context)
+        if row is not None:
+            return row
         with self._lock:
             row = self._entrance.get(context)
             if row is None:

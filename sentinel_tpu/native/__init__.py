@@ -221,3 +221,44 @@ def native_now_ms() -> Optional[int]:
     if lib is None:
         return None
     return int(lib.st_now_ms())
+
+
+_lease_ext = None
+_lease_ext_failed = False
+
+
+def load_lease_ext():
+    """The ``sentinel_lease_ext`` CPython extension (the token-lease
+    admission ring at C speed — see ``native/lease_ext.c`` for why an
+    extension and not the shim's ctypes surface). Built on demand like
+    the shim; None when the toolchain or headers are unavailable."""
+    global _lease_ext, _lease_ext_failed
+    with _lock:
+        if _lease_ext is not None or _lease_ext_failed:
+            return _lease_ext
+        so = os.path.abspath(os.path.join(_NATIVE_DIR,
+                                          "sentinel_lease_ext.so"))
+        src = os.path.abspath(os.path.join(_NATIVE_DIR, "lease_ext.c"))
+        if os.path.exists(src):
+            try:
+                subprocess.run(["make", "-s", "sentinel_lease_ext.so"],
+                               cwd=os.path.abspath(_NATIVE_DIR),
+                               check=True, capture_output=True, timeout=120)
+            except (OSError, subprocess.SubprocessError):
+                _lease_ext_failed = True
+                return None
+        if not os.path.exists(so):
+            _lease_ext_failed = True
+            return None
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "sentinel_lease_ext", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except (OSError, ImportError):
+            _lease_ext_failed = True
+            return None
+        _lease_ext = mod
+        return _lease_ext

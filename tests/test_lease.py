@@ -133,6 +133,108 @@ def test_local_lease_window_mirror_math():
     assert lease.try_acquire(1, t0 + 1000)
 
 
+def _python_ring(thresholds, interval_ms, buckets) -> LocalLease:
+    lease = LocalLease.__new__(LocalLease)
+    lease.thresholds = thresholds
+    lease.interval_ms = interval_ms
+    lease.buckets = buckets
+    lease.bucket_ms = interval_ms // buckets
+    lease._counts = [0] * buckets
+    lease._starts = [-1] * buckets
+    import threading
+
+    lease._lock = threading.Lock()
+    lease._ring = None  # force the pure-Python path
+    return lease
+
+
+def test_native_ring_matches_python_ring_differentially():
+    """The C extension ring (native/lease_ext.c) and the Python fallback
+    must make IDENTICAL decisions on identical traffic — randomized
+    acquire/add/rotation sequences, compared call by call."""
+    import random
+
+    from sentinel_tpu.native import load_lease_ext
+
+    if load_lease_ext() is None:
+        pytest.skip("native lease extension unavailable")
+    rng = random.Random(7)
+    for trial in range(20):
+        buckets = rng.choice([1, 2, 4, 5])
+        interval = buckets * rng.choice([100, 250, 500])
+        thresholds = [float(rng.randint(1, 30))
+                      for _ in range(rng.randint(1, 3))]
+        native = LocalLease(thresholds, interval, buckets)
+        if native._ring is None:
+            pytest.skip("native lease extension unavailable")
+        oracle = _python_ring(thresholds, interval, buckets)
+        now = 1_700_000_000_000
+        for step in range(300):
+            now += rng.choice([0, 1, 7, interval // buckets,
+                               interval, 3 * interval])
+            op = rng.random()
+            count = rng.randint(1, 3)
+            if op < 0.75:
+                got = native.try_acquire(count, now)
+                want = oracle.try_acquire(count, now)
+                assert got == want, (trial, step, thresholds, interval)
+            elif op < 0.9:
+                native.add(count, now)
+                oracle.add(count, now)
+            else:
+                assert native.usage(now) == pytest.approx(
+                    oracle.usage(now)), (trial, step)
+        assert native.snapshot() == (oracle._starts, oracle._counts)
+
+
+def test_native_ring_seed_and_snapshot_round_trip():
+    from sentinel_tpu.native import load_lease_ext
+
+    if load_lease_ext() is None:
+        pytest.skip("native lease extension unavailable")
+    lease = LocalLease([100.0], 1000, 2)
+    lease.seed([1_700_000_000_000, 1_699_999_999_500], [5, 7])
+    assert lease.snapshot() == ([1_700_000_000_000, 1_699_999_999_500],
+                                [5, 7])
+    # geometry-mismatched seeds drop, like the Python ring
+    lease.seed([0], [1])
+    assert lease.snapshot() == ([1_700_000_000_000, 1_699_999_999_500],
+                                [5, 7])
+
+
+def test_auto_context_pooled_per_thread(engine, frozen_time):
+    """entry_ok() with no explicit context reuses ONE pooled auto
+    context per thread (r5 fast-path optimization) — but an explicit
+    context is never pooled, and an engine reset invalidates the pool
+    via the generation stamp."""
+    from sentinel_tpu.core import context as ctx_mod
+
+    st.load_flow_rules([st.FlowRule(resource="pool", count=1e9)])
+    h1 = st.entry_ok("pool")
+    ctx1 = h1.context
+    h1.exit()
+    assert ctx_mod.get_context() is None  # auto context detached on exit
+    h2 = st.entry_ok("pool")
+    ctx2 = h2.context
+    h2.exit()
+    assert ctx1 is ctx2  # pooled: same object reused
+    assert ctx1.entrance_row >= 0  # entrance resolution cached with it
+
+    # explicit contexts bypass the pool
+    st.context_enter("my_ctx")
+    h3 = st.entry_ok("pool")
+    assert h3.context is not ctx1 and h3.context.name == "my_ctx"
+    h3.exit()
+    st.exit_context()
+
+    # engine reset -> generation bump -> pooled context discarded
+    st.reset(capacity=512)
+    st.load_flow_rules([st.FlowRule(resource="pool", count=1e9)])
+    h4 = st.entry_ok("pool")
+    assert h4.context is not ctx1
+    h4.exit()
+
+
 def test_lease_disabled_by_config(engine, monkeypatch):
     from sentinel_tpu.core.config import config
 
