@@ -174,11 +174,21 @@ public class TpuBridgeSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
             fireEntry(context, resourceWrapper, node, count, prioritized, args);
             return;
         }
-        int status = -1;
+        // Marshalling failures are REQUEST-local (a hostile param type
+        // must not retire the healthy shared connection for the whole
+        // JVM): marshal before touching the connection, so only a -1
+        // from the shim itself — genuine transport death — retires it.
+        SentinelTpuShim.StParam[] arr;
+        try {
+            arr = marshalParams(args);
+        } catch (RuntimeException ex) {
+            conn.release();
+            throw ex;
+        }
+        int status;
         LongByReference outId = new LongByReference();
         IntByReference outReason = new IntByReference();
         try {
-            SentinelTpuShim.StParam[] arr = marshalParams(args);
             // Wire entry_type matches the backend's EntryType enum: IN=0,
             // OUT=1 (core/constants.py — note the inversion vs. a naive
             // boolean encoding).
@@ -189,12 +199,10 @@ public class TpuBridgeSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
                 prioritized ? 1 : 0, arr, args == null ? 0 : args.length,
                 outId, outReason);
         } finally {
-            if (status == -1) {
-                // transport death (or a thrown marshalling error):
-                // reconnect on the next entry
-                retireConnection(conn);
-            }
             conn.release();
+        }
+        if (status == -1) {
+            retireConnection(conn);  // transport death: reconnect later
         }
         if (status == -1) {
             ENTRY_IDS.get().push(0L);
@@ -227,7 +235,15 @@ public class TpuBridgeSlot extends AbstractLinkedProcessorSlot<DefaultNode> {
         Deque<Long> stack = ENTRY_IDS.get();
         Long entryId = stack.isEmpty() ? null : stack.pop();
         if (entryId != null && entryId != 0L) {
-            Conn conn = borrowConnection();
+            // Borrow WITHOUT dialing: the exit path must never pay a
+            // blocking connect (the old invariant) — if the connection
+            // died, the server's disconnect drain already released this
+            // entry, and a fresh connection would only answer
+            // BAD_REQUEST for the stale id anyway.
+            Conn conn = current;
+            if (conn != null && !conn.acquire()) {
+                conn = null;
+            }
             if (conn != null) {
                 try {
                     boolean error = context.getCurEntry() != null

@@ -346,9 +346,29 @@ def check_flow(
     counted in the prefixes; pass 2 re-evaluates with prefixes restricted to
     pass-1 survivors, so a request rejected by one rule no longer inflates
     the usage other requests see (nor consumes leaky-bucket tokens). For a
-    single rule per node this is exactly the serial semantics; with
-    interacting rules the residual error is second-order and bounded by one
-    micro-batch (documented delta, SURVEY.md §7 hard part #2).
+    single rule per node with UNIFORM acquire counts this is exactly the
+    serial semantics (the serial-admitted set is then a prefix of the
+    candidates, which two passes recover); with interacting rules the
+    residual error is second-order and bounded by one micro-batch
+    (documented delta, SURVEY.md §7 hard part #2).
+
+    MIXED acquire counts within one batch break the prefix property (a
+    small request can be serially admitted after a large one blocks), and
+    a fixed second pass could then over-admit without bound — its prefixes
+    never see the entries the second pass itself admits (r5 fuzz found
+    batches admitting 30 tokens against a 9-token rule this way). Such
+    batches take a fixpoint loop instead: ``survivors`` is iterated to
+    ``S_{k+1} = candidate & ~blocked(S_k)``. The serial outcome is a
+    fixpoint of that map, the map is antitone in S (more survivors ->
+    stricter prefixes), so odd iterates under-approximate and even
+    iterates over-approximate the serial set, sandwiching it; on
+    convergence the result IS serial, and at the iteration cap the last
+    EVEN iterate is handed to the final evaluation — whose one further
+    map application makes the shipped decisions an ODD iterate, which
+    can only UNDER-admit (safe direction). The loop is gated on a
+    per-batch uniformity check, so uniform batches (every shipped
+    reference call site acquires 1) pay exactly the two passes they
+    always did.
     """
     if spec is None:
         spec = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
@@ -361,15 +381,69 @@ def check_flow(
     rule_prev_pass = _gather(prev_pass_all, rt.sync_row, 0).astype(jnp.float32)
     fs = _sync_warmup(rt, fs, rule_prev_pass, now_ms)
 
-    blocked1, _, _, _, _, _ = _eval_flow_slots(
-        rt, fs, w1, cur_threads, batch, now_ms, candidate, extra_pass=extra_pass,
-        occupied_next=occupied_next, extra_next=extra_next,
-        extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
-        spec=spec, occupy_timeout_ms=occupy_timeout_ms,
-    )
+    def _blocked_for(survivors):
+        out = _eval_flow_slots(
+            rt, fs, w1, cur_threads, batch, now_ms, candidate,
+            survivors=survivors, extra_pass=extra_pass,
+            occupied_next=occupied_next, extra_next=extra_next,
+            extra_pass_global=extra_pass_global,
+            extra_next_global=extra_next_global,
+            spec=spec, occupy_timeout_ms=occupy_timeout_ms,
+        )
+        return out[0]
+
+    def _survivors_two_pass(_):
+        return candidate & (~_blocked_for(candidate))
+
+    def _survivors_fixpoint(_):
+        # S0 = candidate (even/over side). Iterate to the serial fixpoint.
+        # PARITY MATTERS at the cap: the caller applies the survivor map
+        # ONE MORE time (the final _eval_flow_slots below decides from
+        # prefixes over the returned set), so to ship an under-approxi-
+        # mating ODD iterate of decisions the loop must return an EVEN
+        # iterate (S0=candidate itself qualifies). Returning an odd
+        # iterate here would ship even/over decisions — the exact
+        # over-admission class this loop exists to prevent (r5 review).
+        # Cap 12: the fuzz's worst observed case converged in 6;
+        # width-32 batches of counts 1-3 stay well under.
+        def cond(carry):
+            _s, _even, k, done = carry
+            return (~done) & (k < 12)
+
+        def body(carry):
+            s, last_even, k, _done = carry
+            s_next = candidate & (~_blocked_for(s))
+            done = jnp.all(s_next == s)
+            # body computes S_{k+1}: even when k is odd
+            last_even = jax.lax.cond(k % 2 == 1, lambda: s_next,
+                                     lambda: last_even)
+            return s_next, last_even, k + 1, done
+
+        # done's initial False is derived from `candidate` so its
+        # varying-axes type matches the body's output under shard_map (a
+        # literal False would be unvarying and fail the pod-axis carry
+        # check).
+        done0 = jnp.all(candidate != candidate)
+        s, last_even, _k, done = jax.lax.while_loop(
+            cond, body, (candidate, candidate, jnp.asarray(0), done0))
+        return jax.lax.cond(done, lambda: s, lambda: last_even)
+
+    if batch.size == 0:
+        # Zero-width flushes must trace: min/max have no identity over a
+        # zero-size array, and there is nothing to admit anyway.
+        survivors = candidate
+    else:
+        cand_counts = batch.count.astype(jnp.int32)
+        big = jnp.int32(1 << 30)
+        c_min = jnp.min(jnp.where(candidate, cand_counts, big))
+        c_max = jnp.max(jnp.where(candidate, cand_counts, -big))
+        uniform = c_max <= c_min  # no candidates -> -big <= big -> True
+        survivors = jax.lax.cond(
+            uniform, _survivors_two_pass, _survivors_fixpoint, operand=None)
+
     blocked, wait_us, consumed, rl_cmax, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
-        survivors=candidate & (~blocked1), extra_pass=extra_pass,
+        survivors=survivors, extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
         extra_pass_global=extra_pass_global, extra_next_global=extra_next_global,
         spec=spec, occupy_timeout_ms=occupy_timeout_ms,

@@ -240,10 +240,19 @@ def load_lease_ext():
                                           "sentinel_lease_ext.so"))
         src = os.path.abspath(os.path.join(_NATIVE_DIR, "lease_ext.c"))
         if os.path.exists(src):
+            # PY_INCLUDE must come from THE RUNNING interpreter, not
+            # whatever python3 is on PATH: the untagged .so name carries
+            # no ABI tag, so a cross-version build would import anyway
+            # and crash in the admission hot path instead of falling
+            # back cleanly.
+            import sysconfig
+
             try:
-                subprocess.run(["make", "-s", "sentinel_lease_ext.so"],
-                               cwd=os.path.abspath(_NATIVE_DIR),
-                               check=True, capture_output=True, timeout=120)
+                subprocess.run(
+                    ["make", "-s", "sentinel_lease_ext.so",
+                     f"PY_INCLUDE={sysconfig.get_paths()['include']}"],
+                    cwd=os.path.abspath(_NATIVE_DIR),
+                    check=True, capture_output=True, timeout=120)
             except (OSError, subprocess.SubprocessError):
                 _lease_ext_failed = True
                 return None

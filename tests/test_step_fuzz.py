@@ -3,24 +3,33 @@
 SURVEY.md §4/§5 (the race-detector analog): the device step must agree
 with a sequential pure-Python re-implementation of the reference
 semantics on randomized mixed workloads. Scope is the serially-exact
-regime — uniform acquire counts (1-3), one rule per family per
-resource, flow and degrade on disjoint resources (their cross-family
-prefix interplay is the documented bounded delta), distinct
-non-colliding param values — where the two-pass prefix scheme is
-documented to equal serial execution, so any divergence is a bug, not
-an approximation.
+regime — one rule per family per resource, flow and degrade on
+disjoint resources (their cross-family prefix interplay is the
+documented bounded delta), distinct non-colliding param values — where
+the prefix scheme is documented to equal serial execution, so any
+divergence is a bug, not an approximation. MIXED per-entry acquire
+counts are covered too (``test_fuzz_mixed_acquire_counts`` — exact
+since r5's survivor-fixpoint loop in check_flow), with the
+rate-limiter's bounded mixed-count delta pinned separately
+(``test_fuzz_rate_limiter_mixed_counts_bounded``, SEMANTICS.md #7) and
+the warm-up controller fuzzed under randomized bursts
+(``test_fuzz_warmup_random_traffic``).
 
 The rule mix: flow QPS / THREAD / rate-limiter (exact (reason, wait_us)
-agreement) / origin-limited QPS; authority white+black lists; param
-QPS / THREAD; exception-count circuit breakers (probe-at-entry,
+agreement) / origin-limited QPS / warm-up; authority white+black lists;
+param QPS / THREAD; exception-count circuit breakers (probe-at-entry,
 feed-at-exit with bad-wins batch votes, calendar-tumbling stat
 windows); randomized exits carrying error flags and acquire counts.
-Already caught in round 4: the multi-token rate-limiter idle-grace
-fidelity bug, the zero-width batch trace crash, and the undocumented
-flow→degrade prefix delta.
+Already caught: the multi-token rate-limiter idle-grace fidelity bug,
+the zero-width batch trace crash, the undocumented flow→degrade prefix
+delta (r4), and the unbounded mixed-count over-admission the fixpoint
+loop now prevents (r5: 30 tokens admitted against a 9-token rule).
 
-One fixed batch width (padding with invalid rows) keeps this at two jit
-specializations.
+The pod-parallel twin lives in test_pod_fuzz.py (staleness-envelope
+assertions over the real shard_mapped step on the 8-device CPU mesh).
+
+One fixed batch width (padding with invalid rows) keeps each scenario
+at two jit specializations.
 """
 
 import numpy as np
@@ -386,6 +395,281 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed, steps):
             engine.complete_batch(
                 ExitBatch(**{k: np.asarray(a) for k, a in xbuf.items()}),
                 now_ms=now)
+
+
+@pytest.mark.parametrize("seed,steps", [(13, 50), (47, 50), (83, 80)])
+def test_fuzz_mixed_acquire_counts(engine, frozen_time, seed, steps):
+    """Per-ENTRY random acquire counts (1-3) — the regime the original
+    fuzz excluded. Round 5 made the flow sweep serially exact here via
+    the survivor-fixpoint loop (models/flow.py check_flow): before that,
+    a mixed batch could admit 30 tokens against a 9-token rule (pass 2's
+    prefixes never saw its own admissions). Families stay on DISJOINT
+    resources (flow vs param vs degrade) — cross-family prefix interplay
+    is the separately-documented bounded delta; rate-limiter rules are
+    excluded (their mixed-count delta is pinned by
+    test_fuzz_rate_limiter_mixed_counts_bounded below)."""
+    rng = np.random.default_rng(seed)
+    resources = [f"res{i}" for i in range(10)]
+    origins = ["appA", "appB", "appC"]
+
+    spec = {}
+    flow_rules, auth_rules, param_rules = [], [], []
+    for r in resources:
+        s = {}
+        roll = rng.random()
+        if roll < 0.35:
+            count = int(rng.integers(0, 10))
+            s["flow"] = (C.FLOW_GRADE_QPS, count)
+            flow_rules.append(st.FlowRule(resource=r, count=count))
+        elif roll < 0.5:
+            count = int(rng.integers(1, 4))
+            s["flow"] = (C.FLOW_GRADE_THREAD, count)
+            flow_rules.append(st.FlowRule(resource=r, count=count,
+                                          grade=C.FLOW_GRADE_THREAD))
+        elif roll < 0.65:
+            count = int(rng.integers(0, 6))
+            lim = origins[int(rng.integers(0, len(origins)))]
+            s["flow"] = ("qps_origin", count, lim)
+            flow_rules.append(st.FlowRule(resource=r, count=count,
+                                          limit_app=lim))
+        elif roll < 0.9:
+            pcount = int(rng.integers(1, 6))
+            s["param"] = ("qps", pcount)
+            param_rules.append(st.ParamFlowRule(r, param_idx=0,
+                                                count=pcount))
+        if rng.random() < 0.3 and "param" not in s:
+            allow = set(rng.choice(origins, size=int(rng.integers(1, 3)),
+                                   replace=False).tolist())
+            white = bool(rng.random() < 0.5)
+            s["authority"] = (allow, white)
+            auth_rules.append(st.AuthorityRule(
+                r, ",".join(sorted(allow)),
+                C.AUTHORITY_WHITE if white else C.AUTHORITY_BLACK))
+        spec[r] = s
+
+    st.load_flow_rules(flow_rules)
+    st.load_authority_rules(auth_rules)
+    st.load_param_flow_rules(param_rules)
+    engine._ensure_compiled()
+
+    reg = engine.registry
+    values = {r: _pick_param_values(rng) for r in resources
+              if spec[r].get("param") is not None}
+    oracle = Oracle(spec)
+    now = NOW0
+    open_handles = []
+
+    for step in range(steps):
+        now += int(rng.integers(0, 800))
+        frozen_time.freeze_time(now)
+        n = int(rng.integers(4, WIDTH + 1))
+        buf = make_entry_batch_np(WIDTH)
+        buf["cluster_row"][:] = -1
+        meta = []
+        for i in range(n):
+            r = resources[int(rng.integers(0, len(resources)))]
+            origin = origins[int(rng.integers(0, len(origins)))]
+            c = int(rng.integers(1, 4))  # MIXED: per entry
+            v = None
+            if spec[r].get("param") is not None and rng.random() < 0.8:
+                v = values[r][int(rng.integers(0, 4))]
+            buf["cluster_row"][i] = reg.cluster_row(r)
+            buf["origin_row"][i] = reg.origin_row(r, origin)
+            buf["origin_id"][i] = reg.origin_id(origin)
+            buf["origin_named"][i] = True
+            buf["dn_row"][i] = -1
+            buf["count"][i] = c
+            if v is not None:
+                buf["param_hash"][i, 0] = np.uint32(hash_param(v))
+                buf["param_present"][i, 0] = True
+            meta.append((r, origin, v, c))
+
+        dec = engine.check_batch(
+            EntryBatch(**{k: np.asarray(a) for k, a in buf.items()}),
+            now_ms=now)
+        reasons = np.asarray(dec.reason)[:n]
+        want = np.asarray(
+            [oracle.admit(r, o, v, now, c)[0] for r, o, v, c in meta])
+        assert (reasons == want).all(), (
+            f"seed {seed} step {step}: device {reasons.tolist()} "
+            f"!= oracle {want.tolist()} for {meta}")
+
+        open_handles += [(m[0], m[2], m[3]) for m, rr in zip(meta, reasons)
+                         if rr == C.BlockReason.PASS]
+        rng.shuffle(open_handles)
+        n_exit = int(rng.integers(0, len(open_handles) + 1))
+        if n_exit:
+            closing, open_handles = (open_handles[:n_exit][:WIDTH],
+                                     open_handles[n_exit:])
+            xbuf = make_exit_batch_np(WIDTH)
+            xbuf["cluster_row"][:] = -1
+            completions = []
+            for i, (r, v, hc) in enumerate(closing):
+                xbuf["cluster_row"][i] = reg.cluster_row(r)
+                xbuf["dn_row"][i] = -1
+                xbuf["count"][i] = hc
+                xbuf["rt_ms"][i] = int(rng.integers(1, 50))
+                xbuf["success"][i] = True
+                if v is not None:
+                    xbuf["param_hash"][i, 0] = np.uint32(hash_param(v))
+                    xbuf["param_present"][i, 0] = True
+                completions.append((r, v, False, hc))
+            oracle.exit_batch(completions, now)
+            engine.complete_batch(
+                ExitBatch(**{k: np.asarray(a) for k, a in xbuf.items()}),
+                now_ms=now)
+
+
+@pytest.mark.parametrize("seed", [3, 19, 71])
+def test_fuzz_rate_limiter_mixed_counts_bounded(engine, frozen_time, seed):
+    """Rate-limiter rules under MIXED acquire counts: the batch advance
+    clamps the bucket head per-rule with the batch's max admitted count
+    (models/flow.py ``rl_cmax``) while the serial reference clamps per
+    request — after an idle gap the head can sit up to
+    ``(c_max - c_min) * cost`` early, worth at most (c_max - c_min)
+    extra tokens of later admission per idle-gap batch (r4 advisory,
+    pinned here). Assert the cumulative divergence obeys that envelope
+    and never exceeds it."""
+    rng = np.random.default_rng(seed)
+    count, mq = 20, 500  # 20 QPS -> cost 50ms; queue up to 500ms
+    st.load_flow_rules([st.FlowRule(
+        resource="rl", count=count,
+        control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+        max_queueing_time_ms=mq)])
+    engine._ensure_compiled()
+    reg = engine.registry
+    oracle = OracleRateLimiter(count, mq)
+    now = NOW0
+    c_lo, c_hi = 1, 3
+    dev_total = orc_total = 0
+    idle_gap_batches = 0
+    for step in range(60):
+        gap = int(rng.choice([0, 30, 200, 2000]))
+        if gap >= 1000:
+            idle_gap_batches += 1  # full-drain idle: the clamp regime
+        now += gap
+        frozen_time.freeze_time(now)
+        n = int(rng.integers(2, 12))
+        buf = make_entry_batch_np(WIDTH)
+        buf["cluster_row"][:] = -1
+        counts = [int(rng.integers(c_lo, c_hi + 1)) for _ in range(n)]
+        for i, c in enumerate(counts):
+            buf["cluster_row"][i] = reg.cluster_row("rl")
+            buf["dn_row"][i] = -1
+            buf["count"][i] = c
+        dec = engine.check_batch(
+            EntryBatch(**{k: np.asarray(a) for k, a in buf.items()}),
+            now_ms=now)
+        reasons = np.asarray(dec.reason)[:n]
+        dev_total += sum(c for c, r in zip(counts, reasons) if r == 0)
+        for c in counts:
+            ok, _w = oracle.try_pass(now, acquire=c)
+            orc_total += c if ok else 0
+    # Envelope: every idle-gap mixed batch may leave the head early by
+    # at most (c_hi - c_lo) tokens; the device never under-admits by
+    # more than one acquire's worth of rounding.
+    bound = (c_hi - c_lo) * max(idle_gap_batches, 1) + c_hi
+    assert abs(dev_total - orc_total) <= bound, (
+        seed, dev_total, orc_total, idle_gap_batches)
+
+
+class OracleWarmUpWindowed:
+    """Serial WarmUpController against the fuzz's OracleWindow (1s/2
+    buckets — matching SPEC_1S), supporting arbitrary timestamps."""
+
+    def __init__(self, count: float, warm_up_sec: int):
+        cold = C.COLD_FACTOR
+        self.count = float(count)
+        self.wt = warm_up_sec * count / (cold - 1)
+        self.mt = self.wt + 2.0 * warm_up_sec * count / (1 + cold)
+        self.slope = (cold - 1.0) / count / (self.mt - self.wt)
+        self.stored = 0.0
+        self.last_filled = 0
+        self.win = OracleWindow()
+
+    def _prev_bucket_pass(self, now_ms):
+        idx = ((now_ms // 500) - 1) % 2
+        ws = (now_ms - now_ms % 500) - 500
+        if self.win.starts[idx] == ws:
+            return float(self.win.counts[idx])
+        return 0.0
+
+    def sync(self, now_ms):
+        cold = C.COLD_FACTOR
+        now_sec = now_ms // 1000 * 1000
+        if now_sec <= self.last_filled:
+            return
+        prev_pass = self._prev_bucket_pass(now_ms)
+        stored = self.stored
+        refill = stored + (now_sec - self.last_filled) / 1000.0 * self.count
+        below = stored < self.wt
+        above = stored > self.wt
+        if below or (above and prev_pass < self.count / cold):
+            stored = refill
+        stored = min(stored, self.mt)
+        stored = max(stored - prev_pass, 0.0)
+        self.stored = stored
+        self.last_filled = now_sec
+
+    def threshold(self):
+        if self.stored >= self.wt:
+            return 1.0 / ((self.stored - self.wt) * self.slope
+                          + 1.0 / self.count)
+        return self.count
+
+    def try_acquire(self, now_ms):
+        self.sync(now_ms)
+        if self.win.total(now_ms) + 1 <= self.threshold():
+            self.win.add(now_ms, 1)
+            return True
+        return False
+
+
+@pytest.mark.parametrize("seed,count,wp", [
+    (5, 40, 4), (31, 60, 8), (67, 25, 3),
+])
+def test_fuzz_warmup_random_traffic(engine, frozen_time, seed, count, wp):
+    """Warm-up controller under RANDOMIZED traffic (the r4 fuzz gap):
+    random burst widths and inter-batch gaps instead of the fixed
+    per-second trace of test_warmup_oracle.py. Per-batch admitted counts
+    must track the serial oracle within the float32-boundary tolerance,
+    and cumulative drift stays small (each boundary rounding is worth at
+    most one entry, and thresholds re-sync every second)."""
+    rng = np.random.default_rng(seed)
+    st.load_flow_rules([st.FlowRule(
+        resource="warm", count=count,
+        control_behavior=C.CONTROL_BEHAVIOR_WARM_UP, warm_up_period_sec=wp)])
+    engine._ensure_compiled()
+    reg = engine.registry
+    oracle = OracleWarmUpWindowed(count, wp)
+    now = NOW0
+    dev_cum = orc_cum = 0
+    checked = 0
+    for step in range(50):
+        now += int(rng.integers(50, 1500))
+        frozen_time.freeze_time(now)
+        n = int(rng.integers(1, WIDTH + 1))
+        buf = make_entry_batch_np(WIDTH)
+        buf["cluster_row"][:] = -1
+        for i in range(n):
+            buf["cluster_row"][i] = reg.cluster_row("warm")
+            buf["dn_row"][i] = -1
+            buf["count"][i] = 1
+        dec = engine.check_batch(
+            EntryBatch(**{k: np.asarray(a) for k, a in buf.items()}),
+            now_ms=now)
+        adm_e = int((np.asarray(dec.reason)[:n] == C.BlockReason.PASS).sum())
+        adm_o = sum(oracle.try_acquire(now) for _ in range(n))
+        dev_cum += adm_e
+        orc_cum += adm_o
+        checked += 1
+        # each batch may differ by 1 at a float32 admission boundary,
+        # and one boundary flip feeds at most ±1 into the next second's
+        # prev-bucket sync — drift tracks sqrt-ish, pin it linearly at 2
+        assert abs(adm_e - adm_o) <= 2, (
+            f"seed {seed} step {step}: device {adm_e} oracle {adm_o}")
+    assert abs(dev_cum - orc_cum) <= max(4, checked // 10), (
+        seed, dev_cum, orc_cum)
 
 
 def test_width_zero_batches_trace_and_preserve_state(engine, frozen_time):
