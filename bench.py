@@ -368,6 +368,177 @@ def bench_entry_overhead() -> dict:
     return out
 
 
+def _fused_entry_throughput(rules_builder, batch_builder, capacity=4096,
+                            batch_n=4096, scan_steps=8,
+                            budget_s=30.0) -> float:
+    """Shared harness for the per-config sections: build rules + a batch,
+    fuse ``scan_steps`` entry steps per dispatch, auto-calibrate the
+    iteration count to ``budget_s`` (the CPU fallback must stay inside
+    the driver window), return entries/s."""
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.batch import EntryBatch
+    from sentinel_tpu.core.registry import NodeRegistry
+    from sentinel_tpu.models import authority as A
+    from sentinel_tpu.models import degrade as D
+    from sentinel_tpu.models import flow as F
+    from sentinel_tpu.models import param_flow as P
+    from sentinel_tpu.models import system as Y
+    from sentinel_tpu.ops import step as S
+
+    now0 = 1_700_000_000_000
+    reg = NodeRegistry(capacity)
+    flow_rules, degrade_rules, param_rules = rules_builder(reg)
+    ft, _ = F.compile_flow_rules(flow_rules, reg, capacity)
+    dt, di = D.compile_degrade_rules(degrade_rules, reg, capacity)
+    pt = P.compile_param_rules(param_rules, reg, capacity)
+    pack = S.RulePack(
+        flow=ft, degrade=dt,
+        authority=A.compile_authority_rules([], reg, capacity),
+        system=Y.compile_system_rules([Y.SystemRule(qps=1e12)]),
+        param=pt,
+    )
+    state = S.make_state(capacity, ft.num_rules, now0,
+                         degrade=D.make_degrade_state(dt, di),
+                         param=P.make_param_state(pt.num_rules))
+    buf = batch_builder(reg, batch_n)
+    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+    def multi(st_, now_start):
+        def body(s_, i):
+            s_, dec = S.entry_step(s_, pack, batch, now_start + i)
+            return s_, dec.reason[0]
+
+        return jax.lax.scan(body, st_, jnp.arange(scan_steps, dtype=jnp.int64))
+
+    step = jax.jit(multi, donate_argnums=(0,))
+    state, _ = step(state, jnp.asarray(now0, jnp.int64))
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    state, last = step(state, jnp.asarray(now0 + scan_steps, jnp.int64))
+    jax.block_until_ready(last)
+    iter_s = time.perf_counter() - t0
+    iters = max(2, min(15, int(budget_s / max(iter_s, 1e-9))))
+    t0 = time.perf_counter()
+    for i in range(2, iters + 2):
+        state, last = step(state, jnp.asarray(now0 + i * scan_steps,
+                                              jnp.int64))
+    jax.block_until_ready(last)
+    return iters * scan_steps * batch_n / (time.perf_counter() - t0)
+
+
+def bench_degrade_1k() -> dict:
+    """BASELINE eval config #2: 1k resources ALL carrying circuit
+    breakers (slow-ratio and exception-ratio mixed) — the breaker state
+    machine dominates the step instead of the flow sweep."""
+    import numpy as np
+
+    from sentinel_tpu.core.batch import make_entry_batch_np
+    from sentinel_tpu.models import degrade as D
+
+    n_res = 1000
+
+    def rules(reg):
+        degrade_rules = [
+            D.DegradeRule(resource=f"deg{i}",
+                          grade=i % 2,  # RT (slow-ratio) / exception-ratio
+                          count=0.5 if i % 2 else 50,
+                          slow_ratio_threshold=0.5,
+                          time_window=10, min_request_amount=5)
+            for i in range(n_res)
+        ]
+        return [], degrade_rules, []
+
+    def batch(reg, n):
+        rng = np.random.default_rng(1)
+        rows = np.asarray([reg.cluster_row(f"deg{i}") for i in range(n_res)])
+        buf = make_entry_batch_np(n)
+        buf["cluster_row"][:] = rows[rng.integers(0, n_res, size=n)]
+        buf["dn_row"][:] = -1
+        buf["count"][:] = 1
+        return buf
+
+    return {"degrade_1k_entries_per_sec": round(
+        _fused_entry_throughput(rules, batch), 1)}
+
+
+def bench_param_cms_100k() -> dict:
+    """BASELINE eval config #3: hot-param limiting over 100k distinct
+    keys — traffic streams through the CMS cold tier with
+    promotion-gated top-k (models/param_flow.py)."""
+    import numpy as np
+
+    from sentinel_tpu.core.batch import make_entry_batch_np
+    from sentinel_tpu.models import param_flow as P
+
+    n_res = 64
+    n_keys = 100_000
+
+    def rules(reg):
+        param_rules = [P.ParamFlowRule(f"hot{i}", param_idx=0, count=1000)
+                       for i in range(n_res)]
+        return [], [], param_rules
+
+    def batch(reg, n):
+        rng = np.random.default_rng(2)
+        rows = np.asarray([reg.cluster_row(f"hot{i}") for i in range(n_res)])
+        buf = make_entry_batch_np(n)
+        buf["cluster_row"][:] = rows[rng.integers(0, n_res, size=n)]
+        buf["dn_row"][:] = -1
+        buf["count"][:] = 1
+        # Zipf-ish key mix over 100k distinct values: a hot head that
+        # should promote into the exact tier, a long CMS tail.
+        zipf = np.minimum(rng.zipf(1.3, size=n), n_keys).astype(np.int64)
+        buf["param_hash"][:, 0] = (zipf * 2654435761) % (1 << 31) + 1
+        buf["param_present"][:, 0] = True
+        return buf
+
+    return {"param_cms_100k_entries_per_sec": round(
+        _fused_entry_throughput(rules, batch), 1)}
+
+
+def bench_native_token_loopback() -> dict:
+    """Pipelined shim client against the token server over loopback
+    (config #4's transport layer): 512-request batched acquires through
+    ONE multi-in-flight handle. The r4 client serialized one request
+    per connection and measured 3.5k acquires/s through the tunnel
+    RTT; the target here is >10k/s on loopback."""
+    import sentinel_tpu as st
+    from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.native import NativeTokenClient, load_shim
+
+    if load_shim() is None:
+        return {"native_token_loopback_error": "shim unavailable"}
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [
+        st.FlowRule(resource=f"lp{i}", count=1e9, cluster_mode=True,
+                    cluster_config={"flowId": 5000 + i, "thresholdType": 1})
+        for i in range(64)
+    ])
+    server = ClusterTokenServer(DefaultTokenService(rules),
+                                host="127.0.0.1", port=0).start()
+    try:
+        with NativeTokenClient("127.0.0.1", server.bound_port,
+                               timeout_ms=30_000) as client:
+            reqs = [(5000 + (i % 64), 1, False) for i in range(512)]
+            # warm 3x: TCP chunking can split the first bursts into
+            # several group widths, each absorbing its own jit compile
+            for _ in range(3):
+                client.request_tokens_batch(reqs)
+            iters = 20
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                client.request_tokens_batch(reqs)
+            dt_ = time.perf_counter() - t0
+        return {"native_token_loopback_acquires_per_sec": round(
+            iters * len(reqs) / dt_, 1)}
+    finally:
+        server.stop()
+
+
 def _probe_backend(timeout_s: float = 90.0):
     """Probe jax backend init in a SUBPROCESS: when the axon tunnel is
     down, ``jax.devices()`` blocks forever inside ``make_c_api_client``
@@ -589,6 +760,16 @@ def main() -> None:
         persist(out)
         out["entry_overhead"] = bench_entry_overhead()
         persist(out)
+        # BASELINE per-config sections (eval configs #2/#3 + the shim
+        # loopback transport): each is individually guarded so one
+        # failure costs its own row, not the record.
+        for section in (bench_degrade_1k, bench_param_cms_100k,
+                        bench_native_token_loopback):
+            try:
+                out.update(section())
+            except Exception as ex:  # noqa: BLE001
+                out[f"{section.__name__}_error"] = f"{ex!r:.120}"
+            persist(out)
     except Exception as ex:  # noqa: BLE001 — any late failure keeps §1
         out["latency_section_error"] = f"{ex!r:.160}"
         persist(out)

@@ -55,16 +55,13 @@ class SentinelEnvoyRlsService:
 
     # -- gRPC transport ----------------------------------------------------
 
-    def grpc_should_rate_limit(self, request, context=None):
-        """gRPC method body over the dynamic proto messages."""
-        from sentinel_tpu.envoy_rls import proto
-
+    def _grpc_body(self, request, response_cls):
         descriptors = [
             [(e.key, e.value) for e in d.entries] for d in request.descriptors
         ]
         overall, statuses = self.should_rate_limit(
             request.domain, descriptors, request.hits_addend or 1)
-        resp = proto.RateLimitResponse()
+        resp = response_cls()
         resp.overall_code = overall
         for code, remaining in statuses:
             s = resp.statuses.add()
@@ -72,15 +69,30 @@ class SentinelEnvoyRlsService:
             s.limit_remaining = remaining
         return resp
 
+    def grpc_should_rate_limit(self, request, context=None):
+        """v2 gRPC method body over the dynamic proto messages."""
+        from sentinel_tpu.envoy_rls import proto
+
+        return self._grpc_body(request, proto.RateLimitResponse)
+
+    def grpc_should_rate_limit_v3(self, request, context=None):
+        """v3 twin (``envoy.service.ratelimit.v3`` — what current Envoy
+        speaks); identical semantics, renamed packages."""
+        from sentinel_tpu.envoy_rls import proto
+
+        return self._grpc_body(request, proto.RateLimitResponseV3)
+
     def serve_grpc(self, address: str = "0.0.0.0:10245", max_workers: int = 8):
-        """Start a gRPC server exposing RateLimitService; returns it."""
+        """Start a gRPC server exposing RateLimitService under BOTH the
+        v2 service name (the reference's surface) and the v3 one
+        (current Envoy's); returns it."""
         import concurrent.futures
 
         import grpc
 
         from sentinel_tpu.envoy_rls import proto
 
-        handler = grpc.method_handlers_generic_handler(
+        v2_handler = grpc.method_handlers_generic_handler(
             proto.SERVICE_NAME,
             {
                 proto.METHOD_NAME: grpc.unary_unary_rpc_method_handler(
@@ -90,9 +102,20 @@ class SentinelEnvoyRlsService:
                 )
             },
         )
+        v3_handler = grpc.method_handlers_generic_handler(
+            proto.SERVICE_NAME_V3,
+            {
+                proto.METHOD_NAME: grpc.unary_unary_rpc_method_handler(
+                    self.grpc_should_rate_limit_v3,
+                    request_deserializer=proto.RateLimitRequestV3.FromString,
+                    response_serializer=(
+                        proto.RateLimitResponseV3.SerializeToString),
+                )
+            },
+        )
         server = grpc.server(
             concurrent.futures.ThreadPoolExecutor(max_workers=max_workers))
-        server.add_generic_rpc_handlers((handler,))
+        server.add_generic_rpc_handlers((v2_handler, v3_handler))
         port = server.add_insecure_port(address)
         server.start()
         server.bound_port = port

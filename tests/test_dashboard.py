@@ -169,6 +169,55 @@ def test_ui_page_served(dash):
     assert "sentinel-tpu" in page and "queryTopResourceMetric" in page
 
 
+def test_ui_reaches_every_backend_endpoint(dash):
+    """VERDICT r4 #6 'done' criterion: every data endpoint the backend
+    serves is wired into the page. (The heartbeat registration endpoint
+    is machine-facing, not UI-facing, and is excluded.)"""
+    url = f"http://127.0.0.1:{dash.bound_port}/"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        page = r.read().decode()
+    for endpoint in [
+        "/auth/login",
+        "/app/names.json",
+        "/app/machines.json",
+        "/v1/rules",
+        "/v2/rules",
+        "/gateway/rules",
+        "/gateway/apis",
+        "/metric/queryTopResourceMetric.json",
+        "/metric/queryByAppAndResource.json",
+        "/resource/machineResource.json",
+        "/cluster/assign",
+        "/cluster/state.json",
+    ]:
+        assert endpoint in page, f"UI does not reference {endpoint}"
+
+
+def test_ui_rule_forms_cover_all_families(dash):
+    """The schema-driven CRUD forms cover the five rule families plus
+    both gateway kinds, with the reference's camelCase field names (the
+    same keys datasource/converters.py reads/writes — a form payload
+    must parse unchanged)."""
+    url = f"http://127.0.0.1:{dash.bound_port}/"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        page = r.read().decode()
+    # one schema per family in the SCHEMAS literal
+    for family in ("flow:", "degrade:", "system:", "authority:",
+                   "paramFlow:", "gatewayFlow:", "gatewayApi:"):
+        assert family in page, f"no CRUD schema for {family}"
+    # spot-check load-bearing field names against the converter keys
+    for field in ("controlBehavior", "slowRatioThreshold",
+                  "minRequestAmount", "statIntervalMs", "limitApp",
+                  "highestSystemLoad", "highestCpuUsage", "paramIdx",
+                  "durationInSec", "burstCount", "warmUpPeriodSec",
+                  "maxQueueingTimeMs", "clusterMode", "refResource",
+                  "intervalSec", "resourceMode", "paramItem", "apiName",
+                  "predicateItems"):
+        assert f'"{field}"' in page, f"schema missing field {field}"
+    # multi-resource overlay + machine drill-down wiring
+    assert "overlaySeries" in page and "machineResource" in page
+
+
 def _raw(dash, path, method="GET", body=b"", headers=None):
     url = f"http://127.0.0.1:{dash.bound_port}{path}"
     req = urllib.request.Request(url, data=body if method == "POST" else None,

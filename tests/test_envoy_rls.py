@@ -104,6 +104,84 @@ def test_proto_messages_round_trip():
     assert back.hits_addend == 2
 
 
+def test_proto_messages_round_trip_v3():
+    req = proto.RateLimitRequestV3()
+    req.domain = "web"
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "path", "/api"
+    req.hits_addend = 2
+    back = proto.RateLimitRequestV3.FromString(req.SerializeToString())
+    assert back.domain == "web"
+    assert back.descriptors[0].entries[0].value == "/api"
+    assert back.hits_addend == 2
+    assert back.DESCRIPTOR.full_name == \
+        "envoy.service.ratelimit.v3.RateLimitRequest"
+
+
+def test_v2_v3_wire_compatible():
+    """The schemas are shape-identical, so v2 bytes parse as v3 and
+    vice versa — exactly the migration property Envoy relied on when it
+    renamed the packages."""
+    req = proto.RateLimitRequest()
+    req.domain = "web"
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "k", "v"
+    req.hits_addend = 7
+    as_v3 = proto.RateLimitRequestV3.FromString(req.SerializeToString())
+    assert as_v3.domain == "web" and as_v3.hits_addend == 7
+    assert as_v3.descriptors[0].entries[0].key == "k"
+
+    resp = proto.RateLimitResponseV3()
+    resp.overall_code = proto.CODE_OVER_LIMIT
+    s = resp.statuses.add()
+    s.code = proto.CODE_OVER_LIMIT
+    s.limit_remaining = 0
+    as_v2 = proto.RateLimitResponse.FromString(resp.SerializeToString())
+    assert as_v2.overall_code == proto.CODE_OVER_LIMIT
+    assert as_v2.statuses[0].code == proto.CODE_OVER_LIMIT
+
+
+def test_grpc_round_trip_v3(frozen_time):
+    """current Envoy's service path: /envoy.service.ratelimit.v3.
+    RateLimitService/ShouldRateLimit — served alongside v2 from the
+    SAME server and token windows (a v2 and a v3 client drain one
+    quota)."""
+    grpc = pytest.importorskip("grpc")
+    svc = SentinelEnvoyRlsService()
+    svc.rules.load_rules([_rls_rule(count=2)])
+    server = svc.serve_grpc("127.0.0.1:0")
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{server.bound_port}")
+        call_v3 = channel.unary_unary(
+            f"/{proto.SERVICE_NAME_V3}/{proto.METHOD_NAME}",
+            request_serializer=proto.RateLimitRequestV3.SerializeToString,
+            response_deserializer=proto.RateLimitResponseV3.FromString,
+        )
+        call_v2 = channel.unary_unary(
+            f"/{proto.SERVICE_NAME}/{proto.METHOD_NAME}",
+            request_serializer=proto.RateLimitRequest.SerializeToString,
+            response_deserializer=proto.RateLimitResponse.FromString,
+        )
+        req3 = proto.RateLimitRequestV3()
+        req3.domain = "web"
+        d = req3.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "path", "/api"
+        req2 = proto.RateLimitRequest.FromString(req3.SerializeToString())
+        # one v3 + one v2 acquire exhaust the 2-token quota; the next v3
+        # call is over limit — both versions share the windows
+        assert call_v3(req3, timeout=5).overall_code == proto.CODE_OK
+        assert call_v2(req2, timeout=5).overall_code == proto.CODE_OK
+        r = call_v3(req3, timeout=5)
+        assert r.overall_code == proto.CODE_OVER_LIMIT
+        assert r.statuses[0].code == proto.CODE_OVER_LIMIT
+        channel.close()
+    finally:
+        server.stop(0)
+
+
 def test_grpc_round_trip(frozen_time):
     grpc = pytest.importorskip("grpc")
     svc = SentinelEnvoyRlsService()
