@@ -29,98 +29,52 @@ import numpy as np
 
 
 def bench_throughput() -> float:
-    import jax
-    import jax.numpy as jnp
-
-    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
-    from sentinel_tpu.core.registry import NodeRegistry
-    from sentinel_tpu.models import authority as A
+    """The headline config: 10k resources, mixed flow/degrade/param
+    rules, real ClusterNode AND DefaultNode rows (4-row fan-out),
+    16-step fused dispatches."""
+    from sentinel_tpu.core.batch import make_entry_batch_np
     from sentinel_tpu.models import degrade as D
     from sentinel_tpu.models import flow as F
     from sentinel_tpu.models import param_flow as P
-    from sentinel_tpu.models import system as Y
-    from sentinel_tpu.ops import step as S
 
     n_resources = 10_000
-    capacity = 32_768  # ClusterNode + DefaultNode rows for 10k resources
-    batch_n = 8192
-    scan_steps = 16  # fused steps per dispatch (amortizes dispatch latency)
-    now0 = 1_700_000_000_000
 
-    reg = NodeRegistry(capacity)
-    rules = [
-        F.FlowRule(resource=f"res{i}", count=1e9, control_behavior=0)
-        for i in range(0, n_resources, 10)  # every 10th resource ruled
-    ]
-    degrade_rules = [
-        D.DegradeRule(resource=f"res{i}", count=100, grade=i % 3, time_window=10)
-        for i in range(0, n_resources, 20)  # every 20th resource breakered
-    ]
-    param_rules = [
-        P.ParamFlowRule(f"res{i}", param_idx=0, count=1e9)
-        for i in range(0, n_resources, 40)  # every 40th resource param-ruled
-    ]
-    ctx = "sentinel_default_context"
-    ent_row = reg.entrance_row(ctx)
-    c_rows = np.asarray([reg.cluster_row(f"res{i}") for i in range(n_resources)])
-    d_rows = np.asarray(
-        [reg.default_row(ctx, f"res{i}", ent_row) for i in range(n_resources)]
-    )
-    ft, _ = F.compile_flow_rules(rules, reg, capacity)
-    dt, di = D.compile_degrade_rules(degrade_rules, reg, capacity)
-    pt = P.compile_param_rules(param_rules, reg, capacity)
-    pack = S.RulePack(
-        flow=ft, degrade=dt,
-        authority=A.compile_authority_rules([], reg, capacity),
-        system=Y.compile_system_rules([Y.SystemRule(qps=1e12)]),
-        param=pt,
-    )
-    state = S.make_state(capacity, ft.num_rules, now0,
-                         degrade=D.make_degrade_state(dt, di),
-                         param=P.make_param_state(pt.num_rules))
+    def rules(reg):
+        flow_rules = [
+            F.FlowRule(resource=f"res{i}", count=1e9, control_behavior=0)
+            for i in range(0, n_resources, 10)  # every 10th ruled
+        ]
+        degrade_rules = [
+            D.DegradeRule(resource=f"res{i}", count=100, grade=i % 3,
+                          time_window=10)
+            for i in range(0, n_resources, 20)  # every 20th breakered
+        ]
+        param_rules = [
+            P.ParamFlowRule(f"res{i}", param_idx=0, count=1e9)
+            for i in range(0, n_resources, 40)  # every 40th param-ruled
+        ]
+        return flow_rules, degrade_rules, param_rules
 
-    rng = np.random.default_rng(0)
-    buf = make_entry_batch_np(batch_n)
-    pick = rng.integers(0, n_resources, size=batch_n)
-    buf["cluster_row"][:] = c_rows[pick]
-    buf["dn_row"][:] = d_rows[pick]
-    buf["count"][:] = 1
-    buf["param_hash"][:, 0] = rng.integers(1, 1 << 31, size=batch_n)
-    buf["param_present"][:, 0] = True
-    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+    def batch(reg, n):
+        ctx = "sentinel_default_context"
+        ent_row = reg.entrance_row(ctx)
+        c_rows = np.asarray([reg.cluster_row(f"res{i}")
+                             for i in range(n_resources)])
+        d_rows = np.asarray([reg.default_row(ctx, f"res{i}", ent_row)
+                             for i in range(n_resources)])
+        rng = np.random.default_rng(0)
+        buf = make_entry_batch_np(n)
+        pick = rng.integers(0, n_resources, size=n)
+        buf["cluster_row"][:] = c_rows[pick]
+        buf["dn_row"][:] = d_rows[pick]
+        buf["count"][:] = 1
+        buf["param_hash"][:, 0] = rng.integers(1, 1 << 31, size=n)
+        buf["param_present"][:, 0] = True
+        return buf
 
-    # Fuse `scan_steps` admission steps into ONE dispatch with lax.scan —
-    # the pipelined engine's back-to-back step stream, minus per-step
-    # dispatch latency. The clock advances 1ms per inner step so window
-    # rotation work is real.
-    def multi(state, now_start):
-        def body(st_, i):
-            st_, dec = S.entry_step(st_, pack, batch, now_start + i)
-            return st_, dec.reason[0]
-
-        return jax.lax.scan(body, state, jnp.arange(scan_steps, dtype=jnp.int64))
-
-    step = jax.jit(multi, donate_argnums=(0,))
-
-    # Warm-up / compile.
-    state, _ = step(state, jnp.asarray(now0, jnp.int64))
-    jax.block_until_ready(state)
-
-    # Calibrate: one timed iteration picks how many fit a ~45s budget, so
-    # the CPU fallback (~35s/iter) stays driver-friendly while a TPU run
-    # (~0.1s/iter) keeps the full 20-iteration sample.
-    t0 = time.perf_counter()
-    state, last = step(state, jnp.asarray(now0 + scan_steps, jnp.int64))
-    jax.block_until_ready(last)
-    iter_s = time.perf_counter() - t0
-    iters = max(3, min(20, int(45.0 / max(iter_s, 1e-9))))
-
-    t0 = time.perf_counter()
-    for i in range(2, iters + 2):
-        state, last = step(state, jnp.asarray(now0 + i * scan_steps, jnp.int64))
-    jax.block_until_ready(last)
-    dt_ = time.perf_counter() - t0
-    return iters * scan_steps * batch_n / dt_
+    return _fused_entry_throughput(
+        rules, batch, capacity=32_768, batch_n=8192, scan_steps=16,
+        budget_s=45.0, iters_max=20, iters_min=3)
 
 
 def _tunnel_rtt_ms() -> float:
@@ -369,12 +323,16 @@ def bench_entry_overhead() -> dict:
 
 
 def _fused_entry_throughput(rules_builder, batch_builder, capacity=4096,
-                            batch_n=4096, scan_steps=8,
-                            budget_s=30.0) -> float:
-    """Shared harness for the per-config sections: build rules + a batch,
-    fuse ``scan_steps`` entry steps per dispatch, auto-calibrate the
-    iteration count to ``budget_s`` (the CPU fallback must stay inside
-    the driver window), return entries/s."""
+                            batch_n=4096, scan_steps=8, budget_s=30.0,
+                            iters_max=15, iters_min=2) -> float:
+    """Shared throughput harness (the headline section and every
+    per-config section use it): build rules + a batch, fuse
+    ``scan_steps`` entry steps into one donated-scan dispatch (the
+    pipelined engine's back-to-back stream minus dispatch latency; the
+    clock advances 1ms per inner step so window rotation is real), then
+    auto-calibrate the iteration count to ``budget_s`` — the CPU
+    fallback must stay inside the driver window while a TPU run keeps
+    the full sample. Returns entries/s."""
     import jax
     import jax.numpy as jnp
 
@@ -413,13 +371,13 @@ def _fused_entry_throughput(rules_builder, batch_builder, capacity=4096,
         return jax.lax.scan(body, st_, jnp.arange(scan_steps, dtype=jnp.int64))
 
     step = jax.jit(multi, donate_argnums=(0,))
-    state, _ = step(state, jnp.asarray(now0, jnp.int64))
+    state, _ = step(state, jnp.asarray(now0, jnp.int64))  # warm/compile
     jax.block_until_ready(state)
     t0 = time.perf_counter()
     state, last = step(state, jnp.asarray(now0 + scan_steps, jnp.int64))
     jax.block_until_ready(last)
     iter_s = time.perf_counter() - t0
-    iters = max(2, min(15, int(budget_s / max(iter_s, 1e-9))))
+    iters = max(iters_min, min(iters_max, int(budget_s / max(iter_s, 1e-9))))
     t0 = time.perf_counter()
     for i in range(2, iters + 2):
         state, last = step(state, jnp.asarray(now0 + i * scan_steps,

@@ -1,0 +1,194 @@
+"""Bisection harness for the Pallas in-step backend panic (VERDICT r5 #2).
+
+Round 4: ``ops/pallas_prefix.py`` measured 1.71x the XLA dense prefix
+standalone on the chip, but embedded in the DONATED 16-step fused
+``entry_step`` scan it crashed the axon backend with a non-unwinding
+panic and wedged the tunnel for hours. This harness isolates the
+triggering ingredient by running an escalating ladder of configurations,
+EACH IN A SUBPROCESS (a panic must not kill the harness), and STOPS at
+the first backend crash — every extra panic risks hours of tunnel
+recovery (memory: pallas-fused-scan-panic).
+
+Run it on a day the tunnel is healthy and NOT right before a driver
+bench window:
+
+    python pallas_bisect.py            # on the chip
+    BISECT_REHEARSE=1 python pallas_bisect.py   # CPU plumbing rehearsal
+
+Results land in pallas_bisect_results.json, one row per rung:
+{"rung", "desc", "rc", "seconds", "tail"} — rc 0 = clean, nonzero +
+tail = the crash signature to document in BASELINE.md/SEMANTICS.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REHEARSE = os.environ.get("BISECT_REHEARSE") == "1"
+
+# Each rung: (name, description, python source). Sources are
+# self-contained; SENTINEL_TPU_PALLAS=1 is set in the child env.
+_COMMON = """
+import os
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+REHEARSE = os.environ.get("BISECT_REHEARSE") == "1"
+if REHEARSE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # interpret-mode patch so the plumbing runs without mosaic
+    import sentinel_tpu.ops.pallas_prefix as PP
+    _orig = PP.prefix_pallas_multi
+    PP.prefix_pallas_multi = lambda pairs, **kw: _orig(pairs, interpret=True)
+    import sentinel_tpu.ops.segment as SEG
+    SEG._PALLAS_OPTED_IN = True
+    SEG._use_pallas = lambda: True
+
+from sentinel_tpu.ops.segment import segmented_prefix_dense_multi
+
+def tiny_pairs(n, bins=4, m=2, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, bins, size=n).astype(np.int32))
+    vals = jnp.asarray(rng.integers(1, 4, size=(n, m)).astype(np.float32))
+    return [(ids, vals)]
+"""
+
+RUNGS = [
+    ("standalone_tiny",
+     "kernel standalone, N=512 (r4: the N=8192 twin was clean)", _COMMON + """
+out, = jax.jit(lambda p: segmented_prefix_dense_multi(p))(tiny_pairs(512))
+jax.block_until_ready(out)
+print("OK", np.asarray(out[0]).sum())
+"""),
+    ("scan2_nondonated_tiny",
+     "kernel inside a 2-step lax.scan, NOT donated, N=512", _COMMON + """
+def step(carry, _):
+    (p, f), = segmented_prefix_dense_multi(tiny_pairs(512))
+    return carry + p.sum(), None
+
+out, _ = jax.jit(lambda c: jax.lax.scan(step, c, jnp.arange(2)))(
+    jnp.float32(0))
+jax.block_until_ready(out)
+print("OK", float(out))
+"""),
+    ("scan2_donated_tiny",
+     "kernel inside a 2-step scan with DONATED carry, N=512", _COMMON + """
+def step(carry, _):
+    (p, f), = segmented_prefix_dense_multi(tiny_pairs(512))
+    return carry + p.sum(), None
+
+fn = jax.jit(lambda c: jax.lax.scan(step, c, jnp.arange(2)),
+             donate_argnums=(0,))
+out, _ = fn(jnp.float32(0))
+jax.block_until_ready(out)
+print("OK", float(out))
+"""),
+    ("entry_step_single_tiny",
+     "FULL fused entry_step, single step (no scan), width 64", _COMMON + """
+from pallas_bisect_common import build_step_fixture
+state, pack, batch, now0 = build_step_fixture(width=64)
+from sentinel_tpu.ops import step as S
+st2, dec = jax.jit(S.entry_step)(state, pack, batch,
+                                 jnp.asarray(now0, jnp.int64))
+jax.block_until_ready(dec.reason)
+print("OK", int(np.asarray(dec.reason).sum()))
+"""),
+    ("entry_step_scan2_nondonated_tiny",
+     "entry_step in a 2-step scan, NOT donated, width 64", _COMMON + """
+from pallas_bisect_common import build_step_fixture
+state, pack, batch, now0 = build_step_fixture(width=64)
+from sentinel_tpu.ops import step as S
+
+def multi(st_, now_start):
+    def body(s_, i):
+        s_, dec = S.entry_step(s_, pack, batch, now_start + i)
+        return s_, dec.reason[0]
+    return jax.lax.scan(body, st_, jnp.arange(2, dtype=jnp.int64))
+
+st2, last = jax.jit(multi)(state, jnp.asarray(now0, jnp.int64))
+jax.block_until_ready(last)
+print("OK")
+"""),
+    ("entry_step_scan2_donated_tiny",
+     "entry_step in a 2-step scan, DONATED state, width 64 "
+     "(the r4 crash config at 1/128 the batch and 1/8 the steps)",
+     _COMMON + """
+from pallas_bisect_common import build_step_fixture
+state, pack, batch, now0 = build_step_fixture(width=64)
+from sentinel_tpu.ops import step as S
+
+def multi(st_, now_start):
+    def body(s_, i):
+        s_, dec = S.entry_step(s_, pack, batch, now_start + i)
+        return s_, dec.reason[0]
+    return jax.lax.scan(body, st_, jnp.arange(2, dtype=jnp.int64))
+
+st2, last = jax.jit(multi, donate_argnums=(0,))(
+    state, jnp.asarray(now0, jnp.int64))
+jax.block_until_ready(last)
+print("OK")
+"""),
+    ("entry_step_scan16_donated_bench",
+     "the exact r4 crash config: donated 16-step scan, width 8192",
+     _COMMON + """
+from pallas_bisect_common import build_step_fixture
+state, pack, batch, now0 = build_step_fixture(width=8192, n_resources=1000)
+from sentinel_tpu.ops import step as S
+
+def multi(st_, now_start):
+    def body(s_, i):
+        s_, dec = S.entry_step(s_, pack, batch, now_start + i)
+        return s_, dec.reason[0]
+    return jax.lax.scan(body, st_, jnp.arange(16, dtype=jnp.int64))
+
+st2, last = jax.jit(multi, donate_argnums=(0,))(
+    state, jnp.asarray(now0, jnp.int64))
+jax.block_until_ready(last)
+print("OK")
+"""),
+]
+
+
+def main() -> None:
+    results = []
+    env = dict(os.environ, SENTINEL_TPU_PALLAS="1")
+    env.pop("PYTHONPATH", None)
+    if REHEARSE:
+        env["BISECT_REHEARSE"] = "1"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    for name, desc, src in RUNGS:
+        print(f"=== {name}: {desc}", flush=True)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", src], env=env, cwd=os.path.dirname(
+                    os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=900)
+            rc = proc.returncode
+            tail = (proc.stdout + proc.stderr)[-1200:]
+        except subprocess.TimeoutExpired as ex:
+            rc = -9
+            tail = f"TIMEOUT 900s; partial: {(ex.stdout or '')[-400:]!r}"
+        row = {"rung": name, "desc": desc, "rc": rc,
+               "seconds": round(time.time() - t0, 1), "tail": tail}
+        results.append(row)
+        print(f"    rc={rc} in {row['seconds']}s", flush=True)
+        with open("pallas_bisect_results.json", "w") as f:
+            json.dump(results, f, indent=1)
+        if rc != 0 and not REHEARSE:
+            # FIRST crash stops the ladder: each panic risks hours of
+            # tunnel recovery. The signature in `tail` is the prize.
+            print("STOPPING at first failure — see "
+                  "pallas_bisect_results.json", flush=True)
+            break
+    print(json.dumps(results[-1], indent=1))
+
+
+if __name__ == "__main__":
+    main()

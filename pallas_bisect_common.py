@@ -1,0 +1,59 @@
+"""Shared fixture builder for pallas_bisect.py's entry-step rungs: the
+same rule/batch shape as bench.py's throughput section, scaled by
+``width`` (the r4 panic config is width=8192 / 16 steps / donated)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def build_step_fixture(width: int, n_resources: int = 64):
+    import jax.numpy as jnp
+
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+    from sentinel_tpu.core.registry import NodeRegistry
+    from sentinel_tpu.models import authority as A
+    from sentinel_tpu.models import degrade as D
+    from sentinel_tpu.models import flow as F
+    from sentinel_tpu.models import param_flow as P
+    from sentinel_tpu.models import system as Y
+    from sentinel_tpu.ops import step as S
+
+    now0 = 1_700_000_000_000
+    capacity = max(256, 4 * n_resources)
+    reg = NodeRegistry(capacity)
+    flow_rules = [F.FlowRule(resource=f"res{i}", count=1e9)
+                  for i in range(0, n_resources, 10)]
+    degrade_rules = [D.DegradeRule(resource=f"res{i}", count=100,
+                                   grade=i % 3, time_window=10)
+                     for i in range(0, n_resources, 20)]
+    param_rules = [P.ParamFlowRule(f"res{i}", param_idx=0, count=1e9)
+                   for i in range(0, n_resources, 40)]
+    ctx = "sentinel_default_context"
+    ent = reg.entrance_row(ctx)
+    c_rows = np.asarray([reg.cluster_row(f"res{i}")
+                         for i in range(n_resources)])
+    d_rows = np.asarray([reg.default_row(ctx, f"res{i}", ent)
+                         for i in range(n_resources)])
+    ft, _ = F.compile_flow_rules(flow_rules, reg, capacity)
+    dt, di = D.compile_degrade_rules(degrade_rules, reg, capacity)
+    pt = P.compile_param_rules(param_rules, reg, capacity)
+    pack = S.RulePack(
+        flow=ft, degrade=dt,
+        authority=A.compile_authority_rules([], reg, capacity),
+        system=Y.compile_system_rules([Y.SystemRule(qps=1e12)]),
+        param=pt,
+    )
+    state = S.make_state(capacity, ft.num_rules, now0,
+                         degrade=D.make_degrade_state(dt, di),
+                         param=P.make_param_state(pt.num_rules))
+    rng = np.random.default_rng(0)
+    buf = make_entry_batch_np(width)
+    pick = rng.integers(0, n_resources, size=width)
+    buf["cluster_row"][:] = c_rows[pick]
+    buf["dn_row"][:] = d_rows[pick]
+    buf["count"][:] = 1
+    buf["param_hash"][:, 0] = rng.integers(1, 1 << 31, size=width)
+    buf["param_present"][:, 0] = True
+    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+    return state, pack, batch, now0

@@ -80,16 +80,24 @@ class _Batcher:
                 groups.append(g)
                 n += len(g[0])
             flat = [r for g in groups for r in g[0]]
-            # Pad to a width ladder: request_tokens jits per batch
+            # Bound jit specializations: request_tokens jits per batch
             # LENGTH, and group granularity makes lengths client-
             # controlled — unpadded, a client sending varying burst
             # sizes would drive unbounded recompilation (and stall all
-            # token traffic per new width). Padding rows carry a None
-            # flow id -> slot -1 -> NO_RULE_EXISTS, then get sliced off.
+            # token traffic per new width). Small batches (<= 64) keep
+            # their EXACT width: their compiles are fast, and padding
+            # the first 1-request acquire to 16 measurably outlasted the
+            # client's 2s request timeout (r5 review — compile stall on
+            # the very first token). Larger bursts pad to a coarse
+            # ladder; padding rows carry a None flow id -> slot -1 ->
+            # NO_RULE_EXISTS, then get sliced off.
             n_flat = len(flat)
-            width = 16
-            while width < n_flat:
-                width = width * 4 if width < 4096 else width + 4096
+            if n_flat <= 64:
+                width = n_flat
+            else:
+                width = 256
+                while width < n_flat:
+                    width = width * 4 if width < 4096 else width + 4096
             try:
                 results = self.service.request_tokens(
                     flat + [(None, 0, False)] * (width - n_flat))[:n_flat]
