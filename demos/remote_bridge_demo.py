@@ -1,0 +1,77 @@
+"""Remote slot-chain bridge demo (SURVEY §7 M4): a host application —
+here standing in for a JVM running the reference framework with the
+sentinel-tpu bridge jar — forwards its ENTIRE rule-check + statistics
+pipeline to the backend over MSG_ENTRY/MSG_EXIT, getting back typed
+block reasons it can re-raise as the matching exception class.
+
+The client below is the C shim (the exact library the Java
+``TpuBridgeSlot`` binds via JNA); everything it sends rides the TLV
+protocol pinned by ``tests/fixtures/tlv/fixtures.json``."""
+
+import _demo_env  # noqa: F401
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster.constants import TokenResultStatus
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.core.exceptions import exception_for_reason
+from sentinel_tpu.native import NativeTokenClient, load_shim
+
+
+def reason_name(reason: int, resource: str) -> str:
+    """The real wire-code -> exception mapping a host re-raises with."""
+    return type(exception_for_reason(reason, resource)).__name__
+
+# The backend: rules of two families on the same engine the server taps.
+st.load_flow_rules([st.FlowRule(resource="checkout", count=3)])
+st.load_param_flow_rules([st.ParamFlowRule("search", param_idx=0, count=2)])
+server = ClusterTokenServer(host="127.0.0.1", port=0).start()
+print(f"backend token server (with M4 bridge) on :{server.bound_port}")
+
+if load_shim() is None:
+    print("native shim unavailable (no g++?) — demo needs the toolchain")
+    raise SystemExit(0)
+
+# Generous timeout: first entries absorb XLA compiles (tens of seconds
+# on a CPU host; sub-second once warm).
+with NativeTokenClient("127.0.0.1", server.bound_port,
+                       timeout_ms=120_000) as app:
+    # "JVM" request threads: entry -> work -> exit, rule checks remote.
+    print("\n-- flow rule (3 QPS) on 'checkout' --")
+    for i in range(5):
+        status, entry_id, reason = app.remote_entry("checkout",
+                                                    origin="web-app")
+        if status == TokenResultStatus.OK:
+            print(f"request {i + 1}: admitted (entry id {entry_id})")
+            app.remote_exit(entry_id)  # commits RT + releases threads
+        else:
+            print(f"request {i + 1}: blocked -> raise "
+                  + reason_name(reason, "checkout"))
+
+    print("\n-- hot-param rule (2/s per value) on 'search' --")
+    # the first acquire absorbs a compile (its second refills the
+    # bucket); the burst after it saturates the per-value quota
+    for q in ("tpu", "tpu", "tpu", "tpu", "gpu"):
+        status, entry_id, reason = app.remote_entry("search",
+                                                    params=[q])
+        verdict = ("admitted" if status == TokenResultStatus.OK
+                   else "blocked -> " + reason_name(reason, "search"))
+        print(f"search({q!r}): {verdict}")
+        if status == TokenResultStatus.OK:
+            app.remote_exit(entry_id)
+
+# The backend saw every entry: its node tree carries the stats the
+# JVM-side StatisticSlot would have kept locally.
+def tree_resources(node):
+    out = {node.get("resource")} if node.get("resource") else set()
+    for child in node.get("children", []):
+        out |= tree_resources(child)
+    return out
+
+
+snap = st.get_engine().tree_dict()
+print("\nbackend node tree carries the forwarded traffic:",
+      sorted(tree_resources(snap) & {"checkout", "search"}))
+server.stop()
+# Orderly engine shutdown: a daemon committer thread killed mid-XLA
+# call at interpreter exit aborts the process (core/lease.py).
+st.get_engine().close()

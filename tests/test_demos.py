@@ -29,6 +29,7 @@ SELF_TERMINATING = [
     "cluster_demo.py",
     "lease_demo.py",
     "datasource_demo.py",
+    "remote_bridge_demo.py",
 ]
 
 
