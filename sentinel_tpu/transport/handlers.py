@@ -209,7 +209,10 @@ def cmd_leases(req: CommandRequest) -> CommandResponse:
     now = time_util.current_time_millis()
     out = {res: {"thresholds": lease.thresholds,
                  "intervalMs": lease.interval_ms,
-                 "usageQps": round(lease.usage(now), 2)}
+                 "usageQps": round(lease.usage(now), 2),
+                 # which admission ring serves this lease: the C
+                 # extension (native/lease_ext.c) or the Python fallback
+                 "nativeRing": lease._ring is not None}
            for res, lease in sorted(eng._leases.items())}
     return CommandResponse.of_success({
         # configured vs EFFECTIVE: system rules / SPI registrations turn
