@@ -41,6 +41,7 @@ from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
 from sentinel_tpu.core.rule_manager import RuleManager
+from sentinel_tpu.ops import fixpoint as FX
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.segment import (
     segmented_prefix_dense,
@@ -392,54 +393,14 @@ def check_flow(
         )
         return out[0]
 
-    def _survivors_two_pass(_):
-        return candidate & (~_blocked_for(candidate))
-
-    def _survivors_fixpoint(_):
-        # S0 = candidate (even/over side). Iterate to the serial fixpoint.
-        # PARITY MATTERS at the cap: the caller applies the survivor map
-        # ONE MORE time (the final _eval_flow_slots below decides from
-        # prefixes over the returned set), so to ship an under-approxi-
-        # mating ODD iterate of decisions the loop must return an EVEN
-        # iterate (S0=candidate itself qualifies). Returning an odd
-        # iterate here would ship even/over decisions — the exact
-        # over-admission class this loop exists to prevent (r5 review).
-        # Cap 12: the fuzz's worst observed case converged in 6;
-        # width-32 batches of counts 1-3 stay well under.
-        def cond(carry):
-            _s, _even, k, done = carry
-            return (~done) & (k < 12)
-
-        def body(carry):
-            s, last_even, k, _done = carry
-            s_next = candidate & (~_blocked_for(s))
-            done = jnp.all(s_next == s)
-            # body computes S_{k+1}: even when k is odd
-            last_even = jax.lax.cond(k % 2 == 1, lambda: s_next,
-                                     lambda: last_even)
-            return s_next, last_even, k + 1, done
-
-        # done's initial False is derived from `candidate` so its
-        # varying-axes type matches the body's output under shard_map (a
-        # literal False would be unvarying and fail the pod-axis carry
-        # check).
-        done0 = jnp.all(candidate != candidate)
-        s, last_even, _k, done = jax.lax.while_loop(
-            cond, body, (candidate, candidate, jnp.asarray(0), done0))
-        return jax.lax.cond(done, lambda: s, lambda: last_even)
-
     if batch.size == 0:
         # Zero-width flushes must trace: min/max have no identity over a
         # zero-size array, and there is nothing to admit anyway.
         survivors = candidate
     else:
-        cand_counts = batch.count.astype(jnp.int32)
-        big = jnp.int32(1 << 30)
-        c_min = jnp.min(jnp.where(candidate, cand_counts, big))
-        c_max = jnp.max(jnp.where(candidate, cand_counts, -big))
-        uniform = c_max <= c_min  # no candidates -> -big <= big -> True
-        survivors = jax.lax.cond(
-            uniform, _survivors_two_pass, _survivors_fixpoint, operand=None)
+        survivors = FX.survivor_fixpoint(
+            candidate, _blocked_for,
+            two_pass=FX.counts_uniform(candidate, batch.count))
 
     blocked, wait_us, consumed, rl_cmax, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,

@@ -55,6 +55,7 @@ from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch, ExitBatch, MAX_PARAMS
 from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.ops import fixpoint as FX
 from sentinel_tpu.ops import window as W
 from sentinel_tpu.ops.segment import segmented_prefix_dense
 from sentinel_tpu.utils.shapes import round_up as _round_up
@@ -327,9 +328,15 @@ def check_param_flow(
 ) -> ParamVerdict:
     """Vectorized ``ParamFlowChecker.passLocalCheck`` over the micro-batch.
 
-    Two evaluation passes (same convention as check_flow): pass 1 computes
-    verdicts with every candidate consuming bucket prefixes; pass 2
-    restricts prefixes to pass-1 survivors and commits bucket state.
+    Survivor resolution follows check_flow's convention: uniform-count
+    batches take the classic two passes (pass 1 with every candidate
+    consuming bucket prefixes, pass 2 restricted to pass-1 survivors —
+    exact, the serial-admitted set per value is then a prefix); MIXED
+    acquire counts iterate the survivor set to fixpoint instead
+    (ops/fixpoint.py — without it a mixed batch on one hot value
+    over-admitted its bucket without bound, the same defect r5 found in
+    the flow sweep: 32 tokens against a 9-token bucket). The final
+    commit pass then evaluates + commits bucket state once.
 
     ``extra_cms`` (pod path): the psum of the OTHER devices' sketches.
     Sketch addition is the sketch of the union stream, so cluster-mode
@@ -337,15 +344,24 @@ def check_param_flow(
     one-sided like the local sketch, with the same one-step staleness
     bound as cluster flow rules. Local-mode rules ignore it.
     """
-    # Roll the sketch windows first so both passes see one view (see
+    # Roll the sketch windows first so every pass sees one view (see
     # roll_sketch_windows; the pod wrapper also calls it BEFORE its psum so
     # the cross-device extra never carries a stale window).
     ps = roll_sketch_windows(rt, ps, now_ms)
-    pass1 = _eval_param(rt, ps, batch, now_ms, candidate,
-                        survivors=candidate, commit=False,
-                        extra_cms=extra_cms)
+
+    def _blocked_for(survivors):
+        return _eval_param(rt, ps, batch, now_ms, candidate,
+                           survivors=survivors, commit=False,
+                           extra_cms=extra_cms).blocked
+
+    if batch.size == 0:
+        survivors = candidate  # zero-width flush: nothing to admit
+    else:
+        survivors = FX.survivor_fixpoint(
+            candidate, _blocked_for,
+            two_pass=FX.counts_uniform(candidate, batch.count))
     return _eval_param(rt, ps, batch, now_ms, candidate,
-                       survivors=candidate & (~pass1.blocked), commit=True,
+                       survivors=survivors, commit=True,
                        extra_cms=extra_cms)
 
 

@@ -520,6 +520,50 @@ def test_fuzz_mixed_acquire_counts(engine, frozen_time, seed, steps):
                 now_ms=now)
 
 
+@pytest.mark.parametrize("seed", [7, 41])
+def test_fuzz_param_hot_key_mixed_counts(engine, frozen_time, seed):
+    """Mixed acquire counts concentrated on ONE hot param value — the
+    density the general mixed-count fuzz's value spread masked (r5:
+    before the param sweep adopted the survivor fixpoint, a mixed batch
+    on one value admitted 32 tokens against a 9-token bucket)."""
+    rng = np.random.default_rng(seed)
+    pcount = int(rng.integers(3, 12))
+    st.load_param_flow_rules([
+        st.ParamFlowRule("hotres", param_idx=0, count=pcount)])
+    engine._ensure_compiled()
+    reg = engine.registry
+    oracle = Oracle({"hotres": {"param": ("qps", pcount)}})
+    values = _pick_param_values(rng)
+    now = NOW0
+    for step in range(40):
+        now += int(rng.integers(0, 1500))
+        frozen_time.freeze_time(now)
+        n = int(rng.integers(4, WIDTH + 1))
+        buf = make_entry_batch_np(WIDTH)
+        buf["cluster_row"][:] = -1
+        meta = []
+        for i in range(n):
+            c = int(rng.integers(1, 4))
+            # 70% of traffic on values[0]: heavy same-key density
+            v = values[0] if rng.random() < 0.7 else \
+                values[int(rng.integers(1, 4))]
+            buf["cluster_row"][i] = reg.cluster_row("hotres")
+            buf["dn_row"][i] = -1
+            buf["count"][i] = c
+            buf["param_hash"][i, 0] = np.uint32(hash_param(v))
+            buf["param_present"][i, 0] = True
+            meta.append((v, c))
+        dec = engine.check_batch(
+            EntryBatch(**{k: np.asarray(a) for k, a in buf.items()}),
+            now_ms=now)
+        reasons = np.asarray(dec.reason)[:n]
+        want = np.asarray(
+            [oracle.admit("hotres", "", v, now, c)[0] for v, c in meta])
+        assert (reasons == want).all(), (
+            f"seed {seed} step {step}: device {reasons.tolist()} "
+            f"!= oracle {want.tolist()} for {meta}")
+
+
 @pytest.mark.parametrize("seed", [3, 19, 71])
 def test_fuzz_rate_limiter_mixed_counts_bounded(engine, frozen_time, seed):
     """Rate-limiter rules under MIXED acquire counts: the batch advance
