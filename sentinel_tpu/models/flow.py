@@ -393,14 +393,7 @@ def check_flow(
         )
         return out[0]
 
-    if batch.size == 0:
-        # Zero-width flushes must trace: min/max have no identity over a
-        # zero-size array, and there is nothing to admit anyway.
-        survivors = candidate
-    else:
-        survivors = FX.survivor_fixpoint(
-            candidate, _blocked_for,
-            two_pass=FX.counts_uniform(candidate, batch.count))
+    survivors = FX.survivor_fixpoint(candidate, _blocked_for, batch.count)
 
     blocked, wait_us, consumed, rl_cmax, occupied, occ_add = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,

@@ -354,12 +354,7 @@ def check_param_flow(
                            survivors=survivors, commit=False,
                            extra_cms=extra_cms).blocked
 
-    if batch.size == 0:
-        survivors = candidate  # zero-width flush: nothing to admit
-    else:
-        survivors = FX.survivor_fixpoint(
-            candidate, _blocked_for,
-            two_pass=FX.counts_uniform(candidate, batch.count))
+    survivors = FX.survivor_fixpoint(candidate, _blocked_for, batch.count)
     return _eval_param(rt, ps, batch, now_ms, candidate,
                        survivors=survivors, commit=True,
                        extra_cms=extra_cms)

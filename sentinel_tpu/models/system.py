@@ -32,6 +32,7 @@ from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.rule_manager import RuleManager
 from sentinel_tpu.core.batch import EntryBatch
 from sentinel_tpu.core.registry import ENTRY_ROW
+from sentinel_tpu.ops import fixpoint as FX
 from sentinel_tpu.ops import window as W
 
 NOT_SET = C.SYSTEM_RULE_NOT_SET  # -1.0
@@ -114,15 +115,21 @@ def check_system(
 
     ``w60`` holds only folded (completed) seconds; the live second lives in
     ``sec_counts`` (the step's staging accumulator). The BBR read masks
-    stale buckets itself. Two evaluation passes reproduce the serial
-    "blocked requests never count" rule (same convention as check_flow).
+    stale buckets itself. Survivor resolution follows check_flow's
+    convention (ops/fixpoint.py): uniform-count batches take the classic
+    two passes reproducing the serial "blocked requests never count"
+    rule exactly; MIXED acquire counts iterate to the fixpoint — the
+    global IN prefix has the same truncated-second-pass over-admission
+    class the flow and param sweeps had (r5).
     """
-    pass1 = _eval_system(rt, signals, w1, w60, sec_counts, cur_threads, batch,
-                         candidate, survivors=candidate, now_ms=now_ms,
-                         spec1=spec1)
-    return _eval_system(rt, signals, w1, w60, sec_counts, cur_threads, batch,
-                        candidate, survivors=candidate & (~pass1),
-                        now_ms=now_ms, spec1=spec1)
+
+    def _blocked_for(survivors):
+        return _eval_system(rt, signals, w1, w60, sec_counts, cur_threads,
+                            batch, candidate, survivors=survivors,
+                            now_ms=now_ms, spec1=spec1)
+
+    survivors = FX.survivor_fixpoint(candidate, _blocked_for, batch.count)
+    return _blocked_for(survivors)
 
 
 def _eval_system(

@@ -32,19 +32,27 @@ import jax
 import jax.numpy as jnp
 
 
-def survivor_fixpoint(candidate: jax.Array, blocked_for, two_pass: bool,
+def survivor_fixpoint(candidate: jax.Array, blocked_for, counts: jax.Array,
                       cap: int = 12) -> jax.Array:
     """Resolve the survivor set for a batch.
 
     ``candidate``: bool[N] — entries eligible for admission.
     ``blocked_for(survivors) -> bool[N]`` — one evaluation sweep.
-    ``two_pass``: scalar bool (traced) — True routes through the classic
-    single extra pass (exact for uniform counts, the hot path: every
-    shipped reference call site acquires 1); False runs the fixpoint
-    loop. Callers compute it as a per-batch count-uniformity check.
+    ``counts``: the batch's per-entry acquire counts — a traced
+    uniformity check routes uniform batches (the hot path: every shipped
+    reference call site acquires 1) through the classic single extra
+    pass, which is exact there; mixed batches run the fixpoint loop.
     ``cap``: fixpoint iteration bound; the fuzz's worst observed case
     converged in 6.
+
+    Zero-width batches (empty pipeline flushes) return ``candidate``
+    unchanged — handled here, statically, because the uniformity min/max
+    has no identity over an empty array and every caller would otherwise
+    have to remember the special case.
     """
+    if candidate.shape[0] == 0:
+        return candidate
+    two_pass = _counts_uniform(candidate, counts)
 
     def _two_pass(_):
         return candidate & (~blocked_for(candidate))
@@ -76,10 +84,9 @@ def survivor_fixpoint(candidate: jax.Array, blocked_for, two_pass: bool,
     return jax.lax.cond(two_pass, _two_pass, _fixpoint, operand=None)
 
 
-def counts_uniform(candidate: jax.Array, counts: jax.Array) -> jax.Array:
+def _counts_uniform(candidate: jax.Array, counts: jax.Array) -> jax.Array:
     """Scalar bool: every candidate carries the same acquire count.
-    (No candidates -> True.) Callers must special-case zero-width
-    batches statically — min/max have no identity over empty arrays."""
+    (No candidates -> True. Caller guarantees non-empty arrays.)"""
     c = counts.astype(jnp.int32)
     big = jnp.int32(1 << 30)
     c_min = jnp.min(jnp.where(candidate, c, big))

@@ -564,6 +564,48 @@ def test_fuzz_param_hot_key_mixed_counts(engine, frozen_time, seed):
             f"!= oracle {want.tolist()} for {meta}")
 
 
+@pytest.mark.parametrize("seed", [9, 53])
+def test_fuzz_system_rule_mixed_counts(engine, frozen_time, seed):
+    """System-rule QPS cap under mixed acquire counts, system-ONLY (the
+    cross-family prefix interaction is the documented delta; alone, the
+    global IN prefix must be serially exact — it had the same truncated
+    second-pass defect as flow/param before adopting the fixpoint, r5)."""
+    rng = np.random.default_rng(seed)
+    qps = int(rng.integers(4, 15))
+    st.load_system_rules([st.SystemRule(qps=qps)])
+    engine._ensure_compiled()
+    reg = engine.registry
+    now = NOW0
+    for step in range(30):
+        now += 3000  # fresh second: the global budget resets to qps
+        frozen_time.freeze_time(now)
+        n = int(rng.integers(4, 24))
+        counts = [int(rng.integers(1, 4)) for _ in range(n)]
+        buf = make_entry_batch_np(WIDTH)
+        buf["cluster_row"][:] = -1
+        for i, c in enumerate(counts):
+            buf["cluster_row"][i] = reg.cluster_row(f"sys{i % 5}",
+                                                    C.EntryType.IN)
+            buf["dn_row"][i] = -1
+            buf["count"][i] = c
+            buf["entry_in"][i] = True
+        dec = engine.check_batch(
+            EntryBatch(**{k: np.asarray(a) for k, a in buf.items()}),
+            now_ms=now)
+        reasons = np.asarray(dec.reason)[:n]
+        used = 0
+        want = []
+        for c in counts:  # serial greedy against the global budget
+            if used + c <= qps:
+                want.append(int(C.BlockReason.PASS))
+                used += c
+            else:
+                want.append(int(C.BlockReason.SYSTEM))
+        assert (reasons == np.asarray(want)).all(), (
+            f"seed {seed} step {step}: device {reasons.tolist()} "
+            f"!= oracle {want} for counts {counts}")
+
+
 @pytest.mark.parametrize("seed", [3, 19, 71])
 def test_fuzz_rate_limiter_mixed_counts_bounded(engine, frozen_time, seed):
     """Rate-limiter rules under MIXED acquire counts: the batch advance
