@@ -128,7 +128,10 @@ def check_system(
                             batch, candidate, survivors=survivors,
                             now_ms=now_ms, spec1=spec1)
 
-    survivors = FX.survivor_fixpoint(candidate, _blocked_for, batch.count)
+    # Only IN entries feed the global prefix: an OUT entry's odd count
+    # must not push a uniform-IN batch off the exact two-pass hot path.
+    survivors = FX.survivor_fixpoint(candidate, _blocked_for, batch.count,
+                                     relevant=batch.entry_in)
     return _blocked_for(survivors)
 
 

@@ -1,7 +1,8 @@
 """Survivor-fixpoint iteration for within-batch greedy admission.
 
-The flow and param-flow sweeps both decide verdicts from within-batch
-prefixes over a ``survivors`` set (the entries presumed to commit PASS).
+The flow, param-flow, AND system sweeps all decide verdicts from
+within-batch prefixes over a ``survivors`` set (the entries presumed to
+commit PASS).
 With UNIFORM acquire counts the serial-admitted set is a prefix of the
 candidates, and the classic two passes (all-candidates, then pass-1
 survivors) recover it exactly. With MIXED counts the serial set need
@@ -33,7 +34,8 @@ import jax.numpy as jnp
 
 
 def survivor_fixpoint(candidate: jax.Array, blocked_for, counts: jax.Array,
-                      cap: int = 12) -> jax.Array:
+                      cap: int = 12,
+                      relevant: jax.Array | None = None) -> jax.Array:
     """Resolve the survivor set for a batch.
 
     ``candidate``: bool[N] — entries eligible for admission.
@@ -44,6 +46,9 @@ def survivor_fixpoint(candidate: jax.Array, blocked_for, counts: jax.Array,
     pass, which is exact there; mixed batches run the fixpoint loop.
     ``cap``: fixpoint iteration bound; the fuzz's worst observed case
     converged in 6.
+    ``relevant``: optional bool[N] narrowing WHOSE counts the uniformity
+    check looks at (e.g. the system sweep only prefixes IN entries, so
+    an OUT entry's odd count must not force the loop).
 
     Zero-width batches (empty pipeline flushes) return ``candidate``
     unchanged — handled here, statically, because the uniformity min/max
@@ -52,7 +57,8 @@ def survivor_fixpoint(candidate: jax.Array, blocked_for, counts: jax.Array,
     """
     if candidate.shape[0] == 0:
         return candidate
-    two_pass = _counts_uniform(candidate, counts)
+    two_pass = _counts_uniform(
+        candidate if relevant is None else candidate & relevant, counts)
 
     def _two_pass(_):
         return candidate & (~blocked_for(candidate))

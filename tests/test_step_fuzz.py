@@ -564,6 +564,62 @@ def test_fuzz_param_hot_key_mixed_counts(engine, frozen_time, seed):
             f"!= oracle {want.tolist()} for {meta}")
 
 
+@pytest.mark.parametrize("seed,interval_ms,buckets", [
+    (21, 2000, 4), (87, 500, 5), (133, 3000, 2),
+])
+def test_fuzz_qps_under_retuned_geometry(engine, frozen_time, seed,
+                                         interval_ms, buckets):
+    """QPS admission fuzz under NON-DEFAULT instant-window geometry
+    (engine.set_window_geometry — the reference's IntervalProperty/
+    SampleCountProperty): the default-geometry fuzz never exercises the
+    generalized bucket math, so a rotation bug specific to e.g. odd
+    bucket counts or multi-second intervals would hide. Oracle:
+    OracleLeapArray at the SAME geometry. Threshold semantics scale by
+    1000/interval (window_sum × 1000/interval + count ≤ thr)."""
+    from tests.oracle import OracleLeapArray
+
+    engine.set_window_geometry(interval_ms, buckets)
+    rng = np.random.default_rng(seed)
+    resources = [f"g{i}" for i in range(5)]
+    thr = {r: int(rng.integers(1, 12)) for r in resources}
+    st.load_flow_rules([st.FlowRule(resource=r, count=thr[r])
+                        for r in resources])
+    engine._ensure_compiled()
+    reg = engine.registry
+    oracles = {r: OracleLeapArray(interval_ms, buckets, 1)
+               for r in resources}
+    now = NOW0
+    for step in range(40):
+        now += int(rng.integers(0, int(interval_ms * 1.2)))
+        frozen_time.freeze_time(now)
+        n = int(rng.integers(3, WIDTH + 1))
+        buf = make_entry_batch_np(WIDTH)
+        buf["cluster_row"][:] = -1
+        meta = []
+        for i in range(n):
+            r = resources[int(rng.integers(0, len(resources)))]
+            buf["cluster_row"][i] = reg.cluster_row(r)
+            buf["dn_row"][i] = -1
+            buf["count"][i] = 1
+            meta.append(r)
+        dec = engine.check_batch(
+            EntryBatch(**{k: np.asarray(a) for k, a in buf.items()}),
+            now_ms=now)
+        reasons = np.asarray(dec.reason)[:n]
+        want = []
+        for r in meta:
+            o = oracles[r]
+            used = o.total(now, 0) * (1000.0 / interval_ms)
+            if used + 1 <= thr[r]:
+                want.append(int(C.BlockReason.PASS))
+                o.add(now, 0, 1)
+            else:
+                want.append(int(C.BlockReason.FLOW))
+        assert (reasons == np.asarray(want)).all(), (
+            f"seed {seed} geo {interval_ms}/{buckets} step {step}: "
+            f"device {reasons.tolist()} != oracle {want} for {meta}")
+
+
 @pytest.mark.parametrize("seed", [9, 53])
 def test_fuzz_system_rule_mixed_counts(engine, frozen_time, seed):
     """System-rule QPS cap under mixed acquire counts, system-ONLY (the
