@@ -176,7 +176,12 @@ def main() -> None:
             rc = -9
             tail = f"TIMEOUT 900s; partial: {(ex.stdout or '')[-400:]!r}"
         row = {"rung": name, "desc": desc, "rc": rc,
-               "seconds": round(time.time() - t0, 1), "tail": tail}
+               "seconds": round(time.time() - t0, 1),
+               # chip results and CPU interpret-mode rehearsals must be
+               # unmistakable — a clean rehearsal says nothing about the
+               # mosaic/axon panic this harness exists to isolate
+               "mode": "rehearse-cpu-interpret" if REHEARSE else "chip",
+               "tail": tail}
         results.append(row)
         print(f"    rc={rc} in {row['seconds']}s", flush=True)
         with open("pallas_bisect_results.json", "w") as f:
