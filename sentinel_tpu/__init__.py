@@ -192,6 +192,7 @@ from sentinel_tpu.core.spi import (
     unregister_device_checker,
     unregister_slot,
 )
+from sentinel_tpu import resilience
 
 __all__ = [
     "AuthorityException", "AuthorityRule", "BlockException", "BlockReason",
@@ -205,6 +206,6 @@ __all__ = [
     "get_engine", "init_func", "init_ops_plane", "load_authority_rules",
     "load_degrade_rules", "load_flow_rules", "load_param_flow_rules",
     "load_system_rules", "register_device_checker", "register_slot", "reset",
-    "shutdown_ops_plane", "trace", "unregister_device_checker",
+    "resilience", "shutdown_ops_plane", "trace", "unregister_device_checker",
     "unregister_slot",
 ]

@@ -1,7 +1,13 @@
 """The token client (reference: ``cluster-client:DefaultClusterTokenClient``
 + ``netty/NettyTransportClient`` + ``TokenClientPromiseHolder`` — SURVEY.md
 §2.4): one TCP connection, xid-correlated request/response futures, request
-timeouts, scheduled reconnect, and a namespace PING on connect.
+timeouts, backoff reconnect, and a namespace PING on connect.
+
+Resilience (sentinel_tpu/resilience/): reconnects follow a seedable
+``RetryPolicy`` instead of a fixed cadence, and a ``HealthGate`` breaker
+guards the request path — a connected-but-degraded server (slow, hung,
+partitioned) trips the gate after consecutive timeouts and token requests
+fail fast (no wire touch) until the gate's probe succeeds.
 """
 
 from __future__ import annotations
@@ -20,17 +26,40 @@ from sentinel_tpu.cluster.constants import (
     TokenResultStatus,
 )
 from sentinel_tpu.cluster.token_service import TokenResult
+from sentinel_tpu.resilience import HealthGate, RetryPolicy, faults
+
+
+class _GarbageFrame(Exception):
+    """Undecodable frame on the wire: the stream is desynced; treated as
+    a connection loss (internal to the read loop)."""
+
+
+_CONFIG_GATE = object()  # default marker: build the HealthGate from config
 
 
 class ClusterTokenClient:
     def __init__(self, host: str, port: int, namespace: str = "default",
                  request_timeout_s: float = 2.0,
-                 reconnect_interval_s: float = 2.0):
+                 reconnect_interval_s: float = 2.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 health_gate=_CONFIG_GATE):
         self.host = host
         self.port = port
         self.namespace = namespace
         self.request_timeout_s = request_timeout_s
         self.reconnect_interval_s = reconnect_interval_s
+        # Backoff schedule for the reconnect loop: first delay is exactly
+        # ``reconnect_interval_s`` (legacy cadence), repeated failures
+        # back off with decorrelated jitter instead of hammering a dead
+        # or recovering server every 2s forever.
+        self.retry_policy = retry_policy or RetryPolicy.from_config(
+            "cluster.client", base_ms=int(reconnect_interval_s * 1000),
+            max_ms=60_000)
+        # ``health_gate=None`` disables the breaker (raw client); the
+        # default builds one from csp.sentinel.resilience.breaker.*.
+        self.health_gate: Optional[HealthGate] = (
+            HealthGate.from_config() if health_gate is _CONFIG_GATE
+            else health_gate)
         self._xid = itertools.count(1)
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()  # serialize frame writes
@@ -76,12 +105,19 @@ class ClusterTokenClient:
         self._call(MSG_PING, codec.encode_ping(self.namespace))
 
     def _reconnect_loop(self):
-        while not self._stop.wait(self.reconnect_interval_s):
-            if not self.is_connected():
-                try:
-                    self._connect()
-                except OSError:
-                    continue
+        session = self.retry_policy.session()
+        delay_s = session.next_delay_ms() / 1000.0
+        while not self._stop.wait(delay_s):
+            if self.is_connected():
+                session.reset()
+                delay_s = session.next_delay_ms() / 1000.0
+                continue
+            try:
+                self._connect()
+                session.reset()
+            except OSError:
+                pass
+            delay_s = session.next_delay_ms() / 1000.0
 
     def is_connected(self) -> bool:
         with self._lock:
@@ -108,13 +144,22 @@ class ClusterTokenClient:
                 if not data:
                     break
                 for body in reader.feed(data):
-                    resp = codec.decode_response(body)
+                    try:
+                        resp = codec.decode_response(body)
+                    except (ValueError, struct.error, IndexError):
+                        # Garbage frame: the length-prefixed stream is
+                        # desynced beyond repair — drop the connection
+                        # (pending requests fail fast, the reconnector
+                        # dials fresh) instead of letting the decode
+                        # error kill this thread with the socket open
+                        # and every future request left to time out.
+                        raise _GarbageFrame()
                     with self._lock:
                         entry = self._pending.pop(resp.xid, None)
                     if entry is not None:
                         entry[1]["resp"] = resp
                         entry[0].set()
-        except OSError:
+        except (OSError, _GarbageFrame):
             pass
         finally:
             self._drop_connection()
@@ -128,7 +173,8 @@ class ClusterTokenClient:
 
     # -- requests ----------------------------------------------------------
 
-    def _call(self, msg_type: int, entity: bytes) -> Optional[codec.Response]:
+    def _call(self, msg_type: int, entity: bytes,
+              timeout_s: Optional[float] = None) -> Optional[codec.Response]:
         xid = next(self._xid)
         done = threading.Event()
         box: dict = {}
@@ -144,22 +190,55 @@ class ClusterTokenClient:
                 self._pending.pop(xid, None)
             return None
         try:
+            faults.fire("cluster.client.send")
             with self._send_lock:  # frames must not interleave on the wire
                 sock.sendall(raw)
         except OSError:
             self._drop_connection()
             return None
-        if not done.wait(self.request_timeout_s):
+        wait_s = self.request_timeout_s if timeout_s is None \
+            else min(timeout_s, self.request_timeout_s)
+        if not done.wait(wait_s):
             with self._lock:
                 self._pending.pop(xid, None)
             return None
         return box.get("resp")
 
+    def _gated_call(self, msg_type: int, entity: bytes,
+                    timeout_s: Optional[float] = None,
+                    gate_neutral: bool = False) -> Optional[codec.Response]:
+        """`_call` behind the health gate: an OPEN breaker fails fast
+        without touching the wire; outcomes feed the gate.
+
+        ``gate_neutral``: a failed call does NOT count against the
+        breaker. Deadline-budgeted callers set it when the remaining
+        budget is so small that a HEALTHY server could miss it — a miss
+        against a starved deadline says nothing about server health, and
+        counting it would spuriously trip the gate under load."""
+        gate = self.health_gate
+        if gate is not None and not gate.allow():
+            return None
+        resp = self._call(msg_type, entity, timeout_s)
+        if gate is not None:
+            if resp is not None:
+                gate.record_success()
+            elif not gate_neutral:
+                gate.record_failure()
+        return resp
+
     def request_token(self, flow_id: int, count: int = 1,
-                      prioritized: bool = False) -> TokenResult:
-        """One acquire; FAIL on disconnect/timeout (caller decides fallback)."""
-        resp = self._call(MSG_FLOW,
-                          codec.encode_flow_request(flow_id, count, prioritized))
+                      prioritized: bool = False,
+                      timeout_s: Optional[float] = None,
+                      gate_neutral: bool = False) -> TokenResult:
+        """One acquire; FAIL on disconnect/timeout/open-breaker — immediate
+        (no wire wait) when disconnected or the gate is OPEN; callers
+        decide fallback. ``timeout_s`` tightens (never widens) the
+        configured request timeout, for deadline-budgeted callers;
+        ``gate_neutral`` keeps a starved-deadline miss out of the
+        breaker's failure count."""
+        resp = self._gated_call(
+            MSG_FLOW, codec.encode_flow_request(flow_id, count, prioritized),
+            timeout_s, gate_neutral)
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
         remaining, wait_ms = codec.decode_flow_response(resp.entity)
@@ -167,10 +246,13 @@ class ClusterTokenClient:
             return TokenResult(resp.status, wait_ms=wait_ms)
         return TokenResult(resp.status, remaining=remaining)
 
-    def request_param_token(self, flow_id: int, count: int,
-                            params: Sequence) -> TokenResult:
-        resp = self._call(
-            MSG_PARAM_FLOW, codec.encode_param_flow_request(flow_id, count, params))
+    def request_param_token(self, flow_id: int, count: int, params: Sequence,
+                            timeout_s: Optional[float] = None,
+                            gate_neutral: bool = False) -> TokenResult:
+        resp = self._gated_call(
+            MSG_PARAM_FLOW,
+            codec.encode_param_flow_request(flow_id, count, params),
+            timeout_s, gate_neutral)
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
         return TokenResult(resp.status)

@@ -27,6 +27,7 @@ from sentinel_tpu.cluster.constants import (
     TokenResultStatus,
 )
 from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.resilience import faults
 
 
 class _Batcher:
@@ -121,6 +122,12 @@ class _Batcher:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def _send(self, data: bytes) -> None:
+        """Every reply write passes the ``cluster.server.frame`` fault
+        point, so the chaos suite can corrupt/delay/kill server->client
+        bytes without a proxy."""
+        self.request.sendall(faults.mutate("cluster.server.frame", data))
+
     def handle(self):
         server: "ClusterTokenServer" = self.server.token_server
         reader = codec.FrameReader()
@@ -172,7 +179,7 @@ class _Handler(socketserver.BaseRequestHandler):
                                     xid, MSG_FLOW, result.status,
                                     codec.encode_flow_response(
                                         result.remaining, result.wait_ms)))
-                        self.request.sendall(b"".join(replies))
+                        self._send(b"".join(replies))
                         i = j
                     else:
                         namespace = self._process(server, reqs[i], namespace)
@@ -202,12 +209,12 @@ class _Handler(socketserver.BaseRequestHandler):
             if namespace is None and ns:
                 server.service.connections.connect(ns)
                 namespace = ns
-            self.request.sendall(codec.encode_response(
+            self._send(codec.encode_response(
                 req.xid, MSG_PING, TokenResultStatus.OK))
         elif req.msg_type == MSG_PARAM_FLOW:
             flow_id, count, params = codec.decode_param_flow_request(req.entity)
             result = server.service.request_param_token(flow_id, count, params)
-            self.request.sendall(codec.encode_response(
+            self._send(codec.encode_response(
                 req.xid, MSG_PARAM_FLOW, result.status))
         elif req.msg_type == MSG_ENTRY:
             resource, origin, count, etype, prio, params = \
@@ -217,31 +224,31 @@ class _Handler(socketserver.BaseRequestHandler):
             if handle is not None:
                 entry_id = server.next_entry_id()
                 self._remote_entries[entry_id] = handle
-                self.request.sendall(codec.encode_response(
+                self._send(codec.encode_response(
                     req.xid, MSG_ENTRY, TokenResultStatus.OK,
                     codec.encode_entry_response(entry_id, 0)))
             elif reason < 0:  # engine unavailable, fail-open on the JVM
-                self.request.sendall(codec.encode_response(
+                self._send(codec.encode_response(
                     req.xid, MSG_ENTRY, TokenResultStatus.FAIL,
                     codec.encode_entry_response(0, 0)))
             else:
-                self.request.sendall(codec.encode_response(
+                self._send(codec.encode_response(
                     req.xid, MSG_ENTRY, TokenResultStatus.BLOCKED,
                     codec.encode_entry_response(0, reason)))
         elif req.msg_type == MSG_EXIT:
             entry_id, error, count = codec.decode_exit_request(req.entity)
             handle = self._remote_entries.pop(entry_id, None)
             if handle is None:
-                self.request.sendall(codec.encode_response(
+                self._send(codec.encode_response(
                     req.xid, MSG_EXIT, TokenResultStatus.BAD_REQUEST))
             else:
                 if error:
                     handle.trace(None)  # biz exception on the JVM side
                 handle.exit(count if count >= 0 else None)
-                self.request.sendall(codec.encode_response(
+                self._send(codec.encode_response(
                     req.xid, MSG_EXIT, TokenResultStatus.OK))
         else:
-            self.request.sendall(codec.encode_response(
+            self._send(codec.encode_response(
                 req.xid, req.msg_type, TokenResultStatus.BAD_REQUEST))
         return namespace
 

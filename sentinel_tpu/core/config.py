@@ -38,6 +38,16 @@ HEARTBEAT_CLIENT_IP = "csp.sentinel.heartbeat.client.ip"
 # sentinel.dashboard.* naming auth.py established); ONE constant so the
 # sender and the gate cannot drift onto different keys.
 HEARTBEAT_TOKEN = "sentinel.dashboard.heartbeat.token"
+# Resilience layer (sentinel_tpu/resilience/ — no reference twin; the
+# reference's own remote clients hard-code their retry cadences).
+# Per-component retry overrides follow the pattern
+# ``csp.sentinel.resilience.<component>.retry.*`` with components
+# ``cluster.client`` / ``datasource`` / ``heartbeat``.
+RESILIENCE_SEED = "csp.sentinel.resilience.seed"
+RESILIENCE_BREAKER_FAILURES = "csp.sentinel.resilience.breaker.failure.threshold"
+RESILIENCE_BREAKER_OPEN_MS = "csp.sentinel.resilience.breaker.open.ms"
+RESILIENCE_BREAKER_PROBES = "csp.sentinel.resilience.breaker.half.open.probes"
+RESILIENCE_ENTRY_BUDGET_MS = "csp.sentinel.resilience.cluster.entry.budget.ms"
 
 DEFAULT_CHARSET = "utf-8"
 DEFAULT_SINGLE_METRIC_FILE_SIZE = 50 * 1024 * 1024
@@ -47,6 +57,13 @@ DEFAULT_STATISTIC_MAX_RT = 4900
 DEFAULT_API_PORT = 8719
 DEFAULT_HEARTBEAT_INTERVAL_MS = 10_000
 DEFAULT_APP_NAME = "sentinel-tpu-app"
+DEFAULT_RESILIENCE_BREAKER_FAILURES = 3
+DEFAULT_RESILIENCE_BREAKER_OPEN_MS = 5_000
+DEFAULT_RESILIENCE_BREAKER_PROBES = 1
+# Aggregate remote-wait bound per entry(): well under the 2s request
+# timeout, so a degraded token server costs the data path a bounded,
+# configured amount — never a socket timeout per cluster rule.
+DEFAULT_RESILIENCE_ENTRY_BUDGET_MS = 500
 
 
 def _env_key(key: str) -> str:

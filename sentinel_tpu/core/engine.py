@@ -47,6 +47,7 @@ class DeviceDispatchError(RuntimeError):
     entry path fails open, batch-API callers see the typed error."""
 from sentinel_tpu.core.registry import NodeRegistry, ORIGIN_ID_NONE
 from sentinel_tpu.metrics.profiling import StepTimer, timed_call
+from sentinel_tpu.resilience import DeadlineBudget
 
 
 class _FastPathState:
@@ -218,6 +219,27 @@ class SentinelEngine:
         # observable through block logs).
         self.fail_open_count = 0
         self._fail_open_logged_ms = 0
+        # Resilience accounting (sentinel_tpu/resilience/): how often
+        # cluster-mode rules degraded to their local fallback, and the
+        # aggregate remote-wait budget one entry() may spend in
+        # _cluster_token_check (bounded-latency graceful degradation —
+        # the old behavior paid up to request_timeout_s PER cluster rule
+        # plus unbounded SHOULD_WAIT sleeps).
+        self.cluster_fallback_count = 0
+        self.cluster_budget_exhausted_count = 0
+        from sentinel_tpu.core.config import (
+            DEFAULT_RESILIENCE_ENTRY_BUDGET_MS, RESILIENCE_ENTRY_BUDGET_MS)
+
+        self.cluster_entry_budget_ms = _cfg.get_int(
+            RESILIENCE_ENTRY_BUDGET_MS, DEFAULT_RESILIENCE_ENTRY_BUDGET_MS)
+        if self.cluster_entry_budget_ms <= 0:
+            from sentinel_tpu.log.record_log import record_log
+
+            record_log.warn("invalid %s=%s; using default %dms",
+                            RESILIENCE_ENTRY_BUDGET_MS,
+                            self.cluster_entry_budget_ms,
+                            DEFAULT_RESILIENCE_ENTRY_BUDGET_MS)
+            self.cluster_entry_budget_ms = DEFAULT_RESILIENCE_ENTRY_BUDGET_MS
         # Per-step timing (SURVEY §5): enqueue wall per dispatch + sampled
         # synchronous step wall; surfaced via the `profile` ops command.
         self.step_timer = StepTimer()
@@ -877,6 +899,12 @@ class SentinelEngine:
                 "entry passed UNGUARDED (%s); fail_open_count=%d",
                 why, self.fail_open_count)
 
+    def _note_cluster_fallback(self, budget_exhausted: bool = False) -> None:
+        """A cluster-mode rule degraded to its local fallback this entry."""
+        self.cluster_fallback_count += 1
+        if budget_exhausted:
+            self.cluster_budget_exhausted_count += 1
+
     def _cluster_token_check(self, resource, count, prioritized, args) -> Tuple[bool, bool]:
         """Remote token acquire for cluster-mode rules (``passClusterCheck``).
 
@@ -886,6 +914,16 @@ class SentinelEngine:
         local check live when the rule's fallbackToLocalWhenFail is set
         (= ``fallbackToLocalOrPass``). No client/no cluster rules -> local
         (or pod-psum) enforcement as-is.
+
+        Bounded latency: ALL remote work for one entry — request waits
+        AND server-hinted SHOULD_WAIT sleeps, across every cluster rule —
+        shares one ``cluster_entry_budget_ms`` deadline budget. A slow,
+        hung, or partitioned token server costs the data path at most the
+        budget, never a socket timeout per rule; rules the budget can't
+        reach degrade to the local check. The client's own breaker
+        (resilience.HealthGate) makes the steady degraded state
+        effectively free: once OPEN, request_token fails fast without
+        touching the wire.
         """
         # Lock-free fast path: the info dicts are replaced wholesale on rule
         # load, and the common no-cluster-rules deployment returns here
@@ -899,28 +937,55 @@ class SentinelEngine:
             return False, False
         from sentinel_tpu.cluster.constants import TokenResultStatus
 
+        budget = DeadlineBudget(self.cluster_entry_budget_ms)
+        # A request launched with less than half the configured budget
+        # left is breaker-NEUTRAL on timeout: a healthy server can miss a
+        # starved deadline (earlier rules / SHOULD_WAIT sleeps ate it),
+        # and such misses must not trip the gate.
+        neutral_below_ms = self.cluster_entry_budget_ms / 2
         all_ok = True
         for flow_id, fallback in flow_info:
-            tr = client.request_token(flow_id, count, prioritized)
+            remaining_ms = budget.remaining_ms()
+            if remaining_ms <= 0:
+                if fallback:
+                    all_ok = False
+                self._note_cluster_fallback(budget_exhausted=True)
+                continue
+            tr = client.request_token(
+                flow_id, count, prioritized, timeout_s=remaining_ms / 1000.0,
+                gate_neutral=remaining_ms < neutral_below_ms)
             if tr.status == TokenResultStatus.OK:
                 continue
             if tr.status == TokenResultStatus.SHOULD_WAIT:
-                time.sleep(tr.wait_ms / 1000.0)
+                wait_ms = budget.clamp_wait_ms(tr.wait_ms)
+                if wait_ms > 0:
+                    time.sleep(wait_ms / 1000.0)
                 continue
             if tr.status == TokenResultStatus.BLOCKED:
                 return False, True
             if fallback:  # FAIL / NO_RULE / TOO_MANY_REQUEST -> local check
                 all_ok = False
+                self._note_cluster_fallback()
         for flow_id, fallback, param_idx in param_info:
             if param_idx >= len(args):
                 continue  # no such argument: the rule does not apply
-            tr = client.request_param_token(flow_id, count, [args[param_idx]])
+            remaining_ms = budget.remaining_ms()
+            if remaining_ms <= 0:
+                if fallback:
+                    all_ok = False
+                self._note_cluster_fallback(budget_exhausted=True)
+                continue
+            tr = client.request_param_token(
+                flow_id, count, [args[param_idx]],
+                timeout_s=remaining_ms / 1000.0,
+                gate_neutral=remaining_ms < neutral_below_ms)
             if tr.status == TokenResultStatus.OK:
                 continue
             if tr.status == TokenResultStatus.BLOCKED:
                 return False, True
             if fallback:
                 all_ok = False
+                self._note_cluster_fallback()
         return all_ok, False
 
     def _submit_entry(self, resource, cluster_row, dn_row, origin_row,
@@ -1186,6 +1251,34 @@ class SentinelEngine:
         return out
 
     # -- introspection (ops plane) ----------------------------------------
+
+    def resilience_stats(self) -> Dict:
+        """One ops view of every degradation channel: fail-open passes,
+        cluster-rule local fallbacks, the token client's breaker, and the
+        registered health probes (datasource pollers, heartbeat) with
+        last-success ages. Lock-free — plain counter/snapshot reads."""
+        from sentinel_tpu import resilience
+
+        now = time_util.current_time_millis()
+        out: Dict = {
+            "failOpenCount": self.fail_open_count,
+            "clusterFallbackCount": self.cluster_fallback_count,
+            "clusterBudgetExhaustedCount": self.cluster_budget_exhausted_count,
+            "clusterEntryBudgetMs": self.cluster_entry_budget_ms,
+            "tokenClientBreaker": None,
+            "probes": {},
+        }
+        client = self.cluster.token_client
+        gate = getattr(client, "health_gate", None)
+        if gate is not None:
+            out["tokenClientBreaker"] = gate.snapshot()
+        for name, snap in resilience.health_snapshot().items():
+            for key in ("lastSuccessMs", "lastCheckMs"):
+                v = snap.get(key)
+                if isinstance(v, (int, float)) and v > 0:
+                    snap[key.replace("Ms", "AgeMs")] = max(0, now - int(v))
+            out["probes"][name] = snap
+        return out
 
     def row_stats(self):
         """(per-second QPS totals f32[R, E], threads int[R]) as numpy.

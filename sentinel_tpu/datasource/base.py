@@ -15,6 +15,8 @@ from sentinel_tpu.core.property import (
     SentinelProperty,
     SimplePropertyListener,
 )
+from sentinel_tpu.resilience import RetryPolicy, faults, register_probe
+from sentinel_tpu.utils import time_util
 
 S = TypeVar("S")
 T = TypeVar("T")
@@ -67,6 +69,7 @@ class AbstractDataSource(ReadableDataSource[S, T]):
         self._property: DynamicSentinelProperty[T] = DynamicSentinelProperty()
 
     def load_config(self) -> Optional[T]:
+        faults.fire("datasource.read")
         return self.converter(self.read_source())
 
     @property
@@ -79,13 +82,49 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
 
     ``is_modified`` lets subclasses cheaply skip unchanged sources (the
     file impl checks mtime, mirroring the reference).
+
+    Resilience: consecutive refresh failures back off on a seedable
+    ``RetryPolicy`` (base = the poll cadence) instead of log-and-retry at
+    fixed cadence against a down source; ``last_success_ms`` exposes the
+    age of the last good poll (also published to the resilience
+    health-probe registry while the loop runs — last good rules keep
+    enforcing during an outage, and this is how ops sees how stale
+    they are).
     """
 
-    def __init__(self, converter: Converter, recommend_refresh_ms: int = 3000):
+    def __init__(self, converter: Converter, recommend_refresh_ms: int = 3000,
+                 retry_policy: Optional[RetryPolicy] = None):
         super().__init__(converter)
         self.refresh_ms = recommend_refresh_ms
+        self.retry_policy = retry_policy or RetryPolicy.from_config(
+            "datasource", base_ms=max(1, recommend_refresh_ms),
+            max_ms=max(60_000, recommend_refresh_ms * 20))
+        self._retry_session = self.retry_policy.session()
+        self._last_success_ms = -1
+        self._last_check_ms = -1
+        self.consecutive_failures = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._probe_off: Optional[Callable[[], None]] = None
+
+    @property
+    def last_success_ms(self) -> int:
+        """Clock time of the last successful READ (-1: never). A source
+        that simply hasn't changed keeps this at the last real read —
+        watch ``last_check_ms``/``consecutiveFailures`` for liveness."""
+        return self._last_success_ms
+
+    @property
+    def last_check_ms(self) -> int:
+        """Clock time of the last error-free poll, including polls
+        skipped as unmodified (-1: never)."""
+        return self._last_check_ms
+
+    def health(self) -> dict:
+        return {"lastSuccessMs": self._last_success_ms,
+                "lastCheckMs": self._last_check_ms,
+                "consecutiveFailures": self.consecutive_failures,
+                "refreshMs": self.refresh_ms}
 
     def start(self, initial_load: bool = True) -> "AutoRefreshDataSource":
         """``initial_load=False`` skips the (error-swallowing) first read —
@@ -93,6 +132,8 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
         value themselves and must not race a second read."""
         if initial_load:
             self.first_load()
+        self._probe_off = register_probe(
+            f"datasource.{type(self).__name__}.{id(self):x}", self.health)
         self._thread = threading.Thread(
             target=self._run, name="sentinel-datasource-auto-refresh", daemon=True
         )
@@ -104,31 +145,65 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
             value = self.load_config()
             if value is not None:
                 self._property.update_value(value)
+            self._note_success()
         except Exception as ex:
             _log_warn("datasource initial load failed: %r", ex)
 
     def is_modified(self) -> bool:
         return True
 
-    def refresh(self, force: bool = False) -> None:
+    def refresh(self, force: bool = False) -> bool:
         """One poll iteration (exposed for deterministic tests); ``force``
         skips the is_modified gate (coarse-mtime filesystems can miss a
-        same-tick rewrite)."""
+        same-tick rewrite). Returns whether the source was actually READ
+        (False = skipped as unmodified)."""
         if not force and not self.is_modified():
-            return
+            return False
         value = self.load_config()
         if value is not None:
             self._property.update_value(value)
+        return True
+
+    def _note_success(self) -> None:
+        now = time_util.current_time_millis()
+        self._last_success_ms = now
+        self._last_check_ms = now
+        self.consecutive_failures = 0
+        self._retry_session.reset()
+
+    def _poll_once(self) -> int:
+        """One poll; returns the wait before the next one. Successful
+        reads keep the configured cadence; consecutive failures back
+        off. Polls skipped by ``is_modified`` leave ``last_success_ms``
+        (last real read) and the failure counter alone — a deleted file
+        also reads as "unmodified" — but refresh ``last_check_ms``: an
+        unchanged-for-hours source is healthy, not stale."""
+        try:
+            did_read = self.refresh()
+        except Exception as ex:  # poll loop survives, with a trace
+            self.consecutive_failures += 1
+            delay_ms = max(self.refresh_ms,
+                           self._retry_session.next_delay_ms())
+            _log_warn("datasource refresh failed (%d consecutive, "
+                      "next poll in %dms): %r",
+                      self.consecutive_failures, delay_ms, ex)
+            return delay_ms
+        if did_read:
+            self._note_success()
+        else:
+            self._last_check_ms = time_util.current_time_millis()
+        return self.refresh_ms
 
     def _run(self):
-        while not self._stop.wait(self.refresh_ms / 1000.0):
-            try:
-                self.refresh()
-            except Exception as ex:  # poll loop survives, with a trace
-                _log_warn("datasource refresh failed: %r", ex)
+        wait_ms = self.refresh_ms
+        while not self._stop.wait(wait_ms / 1000.0):
+            wait_ms = self._poll_once()
 
     def close(self) -> None:
         self._stop.set()
+        if self._probe_off is not None:
+            self._probe_off()
+            self._probe_off = None
         if self._thread is not None:
             self._thread.join(timeout=1.0)
 
@@ -137,8 +212,10 @@ class FileRefreshableDataSource(AutoRefreshDataSource[str, T]):
     """Reference: ``FileRefreshableDataSource`` — mtime-polled file source."""
 
     def __init__(self, file_path: str, converter: Converter,
-                 recommend_refresh_ms: int = 3000, charset: str = "utf-8"):
-        super().__init__(converter, recommend_refresh_ms)
+                 recommend_refresh_ms: int = 3000, charset: str = "utf-8",
+                 retry_policy: Optional[RetryPolicy] = None):
+        super().__init__(converter, recommend_refresh_ms,
+                         retry_policy=retry_policy)
         self.file_path = os.path.abspath(file_path)
         self.charset = charset
         self._last_mtime = -1.0
@@ -195,6 +272,7 @@ class ContentDedupPollMixin:
     _applied: Optional[str] = None
 
     def load_config(self):
+        faults.fire("datasource.read")
         raw = self.read_source()
         if raw is None or raw == self._applied:
             return None

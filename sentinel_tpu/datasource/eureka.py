@@ -58,8 +58,10 @@ class EurekaDataSource(ContentDedupPollMixin, AutoRefreshDataSource[str, T]):
 
     def __init__(self, service_urls: Sequence[str], app_id: str,
                  instance_id: str, rule_key: str, converter: Converter,
-                 recommend_refresh_ms: int = 3000, timeout_s: float = 5.0):
-        super().__init__(converter, recommend_refresh_ms)
+                 recommend_refresh_ms: int = 3000, timeout_s: float = 5.0,
+                 retry_policy=None):
+        super().__init__(converter, recommend_refresh_ms,
+                         retry_policy=retry_policy)
         if not service_urls:
             raise ValueError("service_urls can't be empty")
         self.service_urls = [normalize_base(u) for u in service_urls]

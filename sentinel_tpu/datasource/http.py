@@ -36,8 +36,10 @@ class HttpRefreshableDataSource(AutoRefreshDataSource[str, T]):
     def __init__(self, url: str, converter: Converter,
                  recommend_refresh_ms: int = 3000,
                  timeout_s: float = 5.0,
-                 headers: Optional[dict] = None):
-        super().__init__(converter, recommend_refresh_ms)
+                 headers: Optional[dict] = None,
+                 retry_policy=None):
+        super().__init__(converter, recommend_refresh_ms,
+                         retry_policy=retry_policy)
         self.url = url
         self.timeout_s = timeout_s
         self.headers = dict(headers or {})

@@ -171,8 +171,9 @@ class PollingKVDataSource(AutoRefreshDataSource[str, T]):
     """Consul/etcd-shaped source: poll a key, push when its version moves."""
 
     def __init__(self, broker: InProcessBroker, key: str, converter: Converter,
-                 recommend_refresh_ms: int = 3000):
-        super().__init__(converter, recommend_refresh_ms)
+                 recommend_refresh_ms: int = 3000, retry_policy=None):
+        super().__init__(converter, recommend_refresh_ms,
+                         retry_policy=retry_policy)
         self.broker = broker
         self.key = key
         self._last_version = -1

@@ -57,8 +57,10 @@ class SpringCloudConfigDataSource(ContentDedupPollMixin,
                  converter: Converter, profile: str = "default",
                  label: Optional[str] = None,
                  auth: Optional[Tuple[str, str]] = None,
-                 recommend_refresh_ms: int = 3000, timeout_s: float = 5.0):
-        super().__init__(converter, recommend_refresh_ms)
+                 recommend_refresh_ms: int = 3000, timeout_s: float = 5.0,
+                 retry_policy=None):
+        super().__init__(converter, recommend_refresh_ms,
+                         retry_policy=retry_policy)
         self.base = normalize_base(server_addr)
         self.application = application
         self.profile = profile

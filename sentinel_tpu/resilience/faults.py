@@ -1,0 +1,189 @@
+"""Deterministic fault injection for every remote touchpoint.
+
+Named fault points live at the repo's remote seams:
+
+* ``cluster.client.send``  — token client, before each frame write
+* ``cluster.server.frame`` — token server, every reply write (bytes pass
+  through :func:`mutate`, so garbage mode can corrupt the stream)
+* ``datasource.read``      — every ``AbstractDataSource.load_config``
+* ``heartbeat.post``       — heartbeat sender, before each POST
+
+A :class:`FaultInjector` arms specs per point — ``error`` (raise),
+``delay`` (sleep), ``garbage`` (replace bytes) — triggered by a schedule
+(``after`` N calls, at most ``times`` fires) and/or a seeded
+probability. All randomness comes from one ``random.Random(seed)``, and
+un-armed points never consume from it, so a chaos run replays exactly.
+
+Zero overhead when disabled: the module-level ``fire``/``mutate`` hooks
+test one global against ``None`` and return. Production never installs
+an injector; the hot-path cost is a no-arg attribute read.
+
+Use as a context manager (installs/uninstalls the process-wide hook):
+
+    with FaultInjector(seed=7) as inj:
+        inj.arm("cluster.client.send", "error", after=2, times=3)
+        ...
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+FAULT_POINTS = (
+    "cluster.client.send",
+    "cluster.server.frame",
+    "datasource.read",
+    "heartbeat.post",
+)
+
+
+class FaultInjected(OSError):
+    """Default injected error: an OSError subclass so every remote seam's
+    existing except-clause treats it exactly like a real I/O failure."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault at {point}")
+        self.point = point
+
+
+@dataclass
+class FaultSpec:
+    mode: str                       # "error" | "delay" | "garbage"
+    probability: float = 1.0        # seeded coin per triggering call
+    after: int = 0                  # skip the first N calls at this point
+    times: Optional[int] = None     # max fires (None = unlimited)
+    delay_ms: int = 0               # delay mode
+    error: Optional[BaseException] = None  # error mode override
+    garbage: Optional[bytes] = None  # garbage mode payload (None = random)
+    calls: int = 0
+    fires: int = 0
+
+    def __post_init__(self):
+        if self.mode not in ("error", "delay", "garbage"):
+            raise ValueError(f"unknown fault mode {self.mode!r}")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError(f"probability {self.probability} not in [0, 1]")
+
+
+class FaultInjector:
+    def __init__(self, seed: int = 0):
+        import random
+
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._specs: Dict[str, FaultSpec] = {}
+
+    # -- configuration ----------------------------------------------------
+
+    def arm(self, point: str, mode: str, probability: float = 1.0,
+            after: int = 0, times: Optional[int] = None, delay_ms: int = 0,
+            error: Optional[BaseException] = None,
+            garbage: Optional[bytes] = None) -> FaultSpec:
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; known: {FAULT_POINTS}")
+        spec = FaultSpec(mode=mode, probability=probability, after=after,
+                         times=times, delay_ms=delay_ms, error=error,
+                         garbage=garbage)
+        with self._lock:
+            self._specs[point] = spec
+        return spec
+
+    def disarm(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            if point is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(point, None)
+
+    def fires(self, point: str) -> int:
+        with self._lock:
+            spec = self._specs.get(point)
+            return spec.fires if spec is not None else 0
+
+    # -- hook implementation ----------------------------------------------
+
+    def _should_fire(self, spec: FaultSpec) -> bool:
+        # Caller holds self._lock.
+        spec.calls += 1
+        if spec.calls <= spec.after:
+            return False
+        if spec.times is not None and spec.fires >= spec.times:
+            return False
+        if spec.probability < 1.0 and self._rng.random() >= spec.probability:
+            return False
+        spec.fires += 1
+        return True
+
+    def _fire(self, point: str) -> None:
+        with self._lock:
+            spec = self._specs.get(point)
+            if spec is None or not self._should_fire(spec):
+                return
+            mode, delay_ms, error = spec.mode, spec.delay_ms, spec.error
+        if mode == "delay":
+            time.sleep(delay_ms / 1000.0)
+        elif mode == "error":
+            raise error if error is not None else FaultInjected(point)
+        # garbage mode is a no-op at a fire-only point: there are no
+        # bytes to corrupt.
+
+    def _mutate(self, point: str, data: bytes) -> bytes:
+        with self._lock:
+            spec = self._specs.get(point)
+            if spec is None or not self._should_fire(spec):
+                return data
+            mode, delay_ms, error = spec.mode, spec.delay_ms, spec.error
+            if mode == "garbage":
+                if spec.garbage is not None:
+                    return spec.garbage
+                n = max(8, len(data))
+                return bytes(self._rng.randrange(256) for _ in range(n))
+        if mode == "delay":
+            time.sleep(delay_ms / 1000.0)
+            return data
+        raise error if error is not None else FaultInjected(point)
+
+    # -- process-wide installation ----------------------------------------
+
+    def install(self) -> "FaultInjector":
+        global _active
+        if _active is not None and _active is not self:
+            raise RuntimeError("another FaultInjector is already installed")
+        _active = self
+        return self
+
+    def uninstall(self) -> None:
+        global _active
+        if _active is self:
+            _active = None
+
+    def __enter__(self) -> "FaultInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+_active: Optional[FaultInjector] = None
+
+
+def fire(point: str) -> None:
+    """Hook at a control-flow seam: may raise or delay per the armed spec.
+    One global None-check when no injector is installed."""
+    inj = _active
+    if inj is not None:
+        inj._fire(point)
+
+
+def mutate(point: str, data: bytes) -> bytes:
+    """Hook at a byte-stream seam: may corrupt/replace ``data`` (garbage
+    mode), delay, or raise per the armed spec."""
+    inj = _active
+    if inj is None:
+        return data
+    return inj._mutate(point, data)

@@ -184,7 +184,18 @@ def cmd_system_status(req: CommandRequest) -> CommandResponse:
         "avgRt": float(t[C.MetricEvent.RT]) / succ if succ > 0 else 0.0,
         "maxThread": int(threads[ENTRY_ROW]),
         "failOpenCount": int(getattr(eng, "fail_open_count", 0)),
+        "clusterFallbackCount": int(getattr(eng, "cluster_fallback_count", 0)),
     })
+
+
+@command_mapping("resilience", "degradation channels: fail-open, cluster "
+                               "fallbacks, breaker state, remote-loop health")
+def cmd_resilience(req: CommandRequest) -> CommandResponse:
+    """Resilience snapshot (no reference twin — the reference surfaces
+    none of its own remote clients' health): fail-open and cluster
+    fallback counters, the token client's CLOSED/OPEN/HALF_OPEN gate,
+    and last-success ages for every registered remote loop."""
+    return CommandResponse.of_success(req.engine.resilience_stats())
 
 
 @command_mapping("profile", "device step timing stats")
