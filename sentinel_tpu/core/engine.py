@@ -209,6 +209,18 @@ class SentinelEngine:
         from sentinel_tpu.cluster.state import ClusterStateManager
 
         self.cluster = ClusterStateManager()
+        # Staged rollout (sentinel_tpu/rollout/): candidate rulesets
+        # evaluated in shadow lanes of the fused step, optionally enforced
+        # for a deterministic canary slice. The compiled candidate pack +
+        # the traced canary scalars live here; the manager owns lifecycle
+        # and guardrails. Constructed AFTER the rule managers (it reads
+        # their staged partitions) but BEFORE any listener can fire.
+        self._shadow_rules: Optional[S.RulePack] = None
+        self._canary_bps: Optional[int] = None
+        self._canary_salt = 0
+        from sentinel_tpu.rollout.manager import RolloutManager
+
+        self.rollout = RolloutManager(self)
         self._cluster_flow_info: Dict[str, list] = {}
         self._cluster_param_info: Dict[str, list] = {}
         self._pipeline = None
@@ -268,7 +280,7 @@ class SentinelEngine:
         self._rules: Optional[S.RulePack] = None
         self._named_origins: Dict[str, set] = {}
         self._dirty = {"flow": True, "degrade": True, "authority": True,
-                       "system": True, "param": True}
+                       "system": True, "param": True, "rollout": False}
         # Slot-count ratchet per family: empty families compile to ZERO
         # slots (their per-slot loops vanish — a no-rules step is ~4x
         # cheaper), but 0 -> 1 slots is a tensor-SHAPE change that would
@@ -452,7 +464,26 @@ class SentinelEngine:
         # queue behind an in-flight dispatch's compile (see _config_lock).
         with self._config_lock:
             self._dirty[family] = True
+            self._sync_rollout_sources()
             self._rebuild_leases()
+
+    def _sync_rollout_sources(self) -> None:
+        """Rule pushes may carry staged (candidate-tagged) rules, and the
+        active candidate's MERGED view depends on the live rules — both
+        make the compiled shadow pack stale. Caller holds the config lock."""
+        rollout = getattr(self, "rollout", None)
+        if rollout is None:
+            return
+        rollout.refresh_staged()
+        if rollout.device_active():
+            self._dirty["rollout"] = True
+
+    def _set_canary(self, bps: Optional[int], salt: int) -> None:
+        """Canary knobs are TRACED step scalars: tuning the percentage or
+        salt never recompiles; only the None<->set flip (enter/leave the
+        canary stage) retraces, like any argument-structure change."""
+        self._canary_bps = None if bps is None else int(bps)
+        self._canary_salt = int(salt)
 
     def _on_rules_changed(self, family: str):
         """Flow/param loads also rebuild the host-side cluster-rule maps
@@ -460,6 +491,7 @@ class SentinelEngine:
         lock-free: the dicts are replaced wholesale, never mutated."""
         with self._config_lock:
             self._dirty[family] = True
+            self._sync_rollout_sources()
             self._rebuild_leases()
             if family == "flow":
                 rules = self.flow_rules.get_rules()
@@ -517,6 +549,7 @@ class SentinelEngine:
                                        param=P.make_param_state(pt.num_rules),
                                        spec1=self._spec1)
             self._maybe_start_system_listener()
+            self._compile_shadow()
             return
         if not any(self._dirty.values()):
             return
@@ -558,6 +591,50 @@ class SentinelEngine:
             self._ratchet_slots(param=pt)
             self._rules = self._rules._replace(param=pt)
             self._state = self._state._replace(param=P.make_param_state(pt.num_rules))
+        if self._dirty["rollout"]:
+            self._dirty["rollout"] = False
+            self._compile_shadow()
+
+    def _compile_shadow(self) -> None:
+        """(Re)build the candidate pack + a fresh shadow world, or tear
+        both down when no candidate holds the device.
+
+        The candidate compiles from the MERGED view (live rules plus the
+        candidate's per-resource overrides — rollout/manager.py), with the
+        same slot floors as the live pack so the common candidate-close-
+        to-live case shares tensor shapes. Installing/removing a shadow is
+        a state-STRUCTURE change: one retrace, like a family's first use.
+        Like a live rule load, a candidate edit re-creates controller
+        state — the shadow world (and its counters) restarts cold; the
+        rollout guardrail re-baselines on its next tick.
+        """
+        self._dirty["rollout"] = False
+        rollout = getattr(self, "rollout", None)
+        spec = rollout.device_spec() if rollout is not None else None
+        if spec is None:
+            self._shadow_rules = None
+            if self._state is not None and self._state.shadow is not None:
+                self._state = self._state._replace(shadow=None)
+            return
+        ft, _ = F.compile_flow_rules(
+            spec["flow"], self.registry, self.capacity,
+            min_slots=self._slot_floor["flow"])
+        dt, di = D.compile_degrade_rules(
+            spec["degrade"], self.registry, self.capacity,
+            min_slots=self._slot_floor["degrade"])
+        at = A.compile_authority_rules(
+            spec["authority"], self.registry, self.capacity,
+            min_slots=self._slot_floor["authority"])
+        pt = P.compile_param_rules(
+            spec["param"], self.registry, self.capacity,
+            min_slots=self._slot_floor["param"])
+        self._shadow_rules = S.RulePack(
+            flow=ft, degrade=dt, authority=at,
+            system=Y.compile_system_rules(spec["system"]), param=pt)
+        if self._state is not None:
+            self._state = self._state._replace(shadow=S.make_shadow_state(
+                self.capacity, self._shadow_rules,
+                D.make_degrade_state(dt, di), spec1=self._spec1))
 
     def _ratchet_slots(self, **tensors) -> None:
         """Raise each family's slot floor to what was just compiled, so
@@ -688,6 +765,11 @@ class SentinelEngine:
                     occupied_next=jnp.zeros((self.capacity,), jnp.int32),
                     occupied_stamp=jnp.int64(-1),
                 )
+            # The shadow world's instant window carries the OLD bucket
+            # geometry — rebuild it under the new spec at the next
+            # compile (its stats reset with the live window's, same
+            # stance as the 1s-window reset above).
+            self._dirty["rollout"] = True
             self._rebuild_leases()  # mirrors carry the window geometry
 
     def close(self) -> None:
@@ -1042,7 +1124,10 @@ class SentinelEngine:
             self._state, dec = timed_call(
                 self.step_timer, "entry", batch.size, self._entry_jit,
                 self._state, self._rules, batch, now,
-                occupy_timeout_ms=self._occupy_timeout_ms)
+                occupy_timeout_ms=self._occupy_timeout_ms,
+                shadow_rules=self._shadow_rules,
+                canary_bps=self._canary_bps,
+                canary_salt=self._canary_salt)
         except Exception as ex:  # noqa: BLE001 — dispatch only (donation)
             self._state = None  # buffers possibly consumed: restart cold
             raise DeviceDispatchError(f"entry dispatch failed: {ex!r:.200}") from ex
@@ -1059,7 +1144,8 @@ class SentinelEngine:
             try:
                 self._state = timed_call(
                     self.step_timer, "exit", batch.size, self._exit_jit,
-                    self._state, self._rules, batch, now)
+                    self._state, self._rules, batch, now,
+                    shadow_rules=self._shadow_rules)
             except Exception as ex:  # noqa: BLE001
                 self._state = None
                 raise DeviceDispatchError(
@@ -1159,7 +1245,10 @@ class SentinelEngine:
             try:
                 self._state, dec = self._entry_jit(
                     self._state, self._rules, batch, now,
-                    occupy_timeout_ms=self._occupy_timeout_ms)
+                    occupy_timeout_ms=self._occupy_timeout_ms,
+                    shadow_rules=self._shadow_rules,
+                    canary_bps=self._canary_bps,
+                    canary_salt=self._canary_salt)
             except Exception as ex:  # noqa: BLE001
                 self._state = None
                 raise DeviceDispatchError(
@@ -1171,7 +1260,9 @@ class SentinelEngine:
             self._ensure_compiled()
             now = now_ms if now_ms is not None else time_util.current_time_millis()
             try:
-                self._state = self._exit_jit(self._state, self._rules, batch, now)
+                self._state = self._exit_jit(self._state, self._rules, batch,
+                                             now,
+                                             shadow_rules=self._shadow_rules)
             except Exception as ex:  # noqa: BLE001
                 self._state = None
                 raise DeviceDispatchError(
@@ -1266,6 +1357,11 @@ class SentinelEngine:
             "clusterBudgetExhaustedCount": self.cluster_budget_exhausted_count,
             "clusterEntryBudgetMs": self.cluster_entry_budget_ms,
             "tokenClientBreaker": None,
+            # Staged-rollout guardrail beside the degradation channels:
+            # active candidate set, stage, and windows-to-abort — one
+            # unified picture of everything currently between the live
+            # ruleset and what traffic actually experiences.
+            "rollout": self.rollout.guardrail_state(),
             "probes": {},
         }
         client = self.cluster.token_client
@@ -1279,6 +1375,19 @@ class SentinelEngine:
                     snap[key.replace("Ms", "AgeMs")] = max(0, now - int(v))
             out["probes"][name] = snap
         return out
+
+    def shadow_counts(self) -> Optional[np.ndarray]:
+        """Cumulative rollout counters since the candidate was installed:
+        ``np.int64[S.NUM_SHADOW_COUNTERS, R]`` (would-pass/would-block per
+        family beside the live outcome of the same lanes), or None when no
+        candidate holds the device. The rollout manager's guardrail and
+        the dashboard diff view read through this."""
+        with self._lock:
+            self._ensure_compiled()
+            st = self._state
+            if st is None or st.shadow is None:
+                return None
+            return np.asarray(st.shadow.counts)
 
     def row_stats(self):
         """(per-second QPS totals f32[R, E], threads int[R]) as numpy.

@@ -197,6 +197,15 @@ def build_lease_table(engine):
         return {}, set(), False
     if engine._spi.host_slots() or engine._spi.device_checkers():
         return {}, set(), False
+    rollout = getattr(engine, "rollout", None)
+    if rollout is not None and rollout.device_active():
+        # A staged candidate (shadow/canary) needs EVERY entry on the
+        # device path: shadow lanes ride the fused step, and host-leased
+        # admissions would be invisible to the candidate's would-verdict
+        # counters (and un-enforceable for canary lanes). The fast path
+        # stands down for the rollout's duration — the documented cost of
+        # running a rollout (docs/OPERATIONS.md).
+        return {}, set(), False
     flow_rules = engine.flow_rules.get_rules()
     ruled = {}
     for r in flow_rules:

@@ -49,12 +49,20 @@ class SentinelProperty(Generic[T]):
 
 
 class DynamicSentinelProperty(SentinelProperty[T]):
-    """Reference: ``DynamicSentinelProperty<T>``."""
+    """Reference: ``DynamicSentinelProperty<T>``.
+
+    ``epoch`` counts ACCEPTED updates (the equality short-circuit does
+    not bump it) — a monotonic version observers can compare without
+    holding the value itself. The staged-rollout manager uses the same
+    scheme for promotion epochs: a promote is one accepted wholesale
+    update through this property path, observable as one epoch step.
+    """
 
     def __init__(self, value: Optional[T] = None):
         self._lock = threading.RLock()
         self._listeners: List[PropertyListener[T]] = []
         self.value: Optional[T] = value
+        self.epoch = 0
 
     def add_listener(self, listener: PropertyListener[T]) -> None:
         with self._lock:
@@ -73,6 +81,7 @@ class DynamicSentinelProperty(SentinelProperty[T]):
             if value == self.value:
                 return False
             self.value = value
+            self.epoch += 1
             listeners = list(self._listeners)
         for l in listeners:
             l.config_update(value)

@@ -98,6 +98,21 @@ class SentinelApiClient:
     def fetch_cluster_mode(self, ip: str, port: int) -> Dict:
         return json.loads(self.get(ip, port, "getClusterMode"))
 
+    # -- staged rollout (sentinel_tpu/rollout/) ---------------------------
+
+    def fetch_rollout(self, ip: str, port: int, op: str = "status") -> Dict:
+        """``rollout`` read ops: status / diff."""
+        return json.loads(self.get(ip, port, "rollout", {"op": op}))
+
+    def rollout_command(self, ip: str, port: int, params: Dict,
+                        body: str = "") -> Dict:
+        """``rollout`` mutating ops (load/stage/promote/abort/tick)."""
+        out = self.post(ip, port, "rollout", params, body=body)
+        try:
+            return json.loads(out)
+        except ValueError as ex:
+            raise ApiError(f"rollout command rejected: {out}") from ex
+
     def set_cluster_mode(self, ip: str, port: int, mode: int) -> None:
         out = self.post(ip, port, "setClusterMode", {"mode": mode})
         if out != "success":

@@ -23,6 +23,10 @@ set as a small JSON API plus one static page:
   * ``GET  /metric/queryByAppAndResource.json?app=&identity=``
     (``MetricController`` over ``InMemoryMetricsRepository``)
   * ``GET  /resource/machineResource.json?ip=&port=``    clusterNode proxy
+  * ``GET  /rollout/status.json?app=``        staged-rollout state
+  * ``GET  /rollout/diff.json?app=``          shadow-vs-live outcome deltas
+  * ``POST /rollout/command?app=&op=``        stage/canary/promote/abort/tick
+    (no reference twin — proxies the engines' ``rollout`` command)
   * ``POST /cluster/assign?app=&ip=&port=``   token-server assignment
     (``ClusterConfigController.assign``: chosen machine -> SERVER, every
     other healthy machine -> CLIENT of it)
@@ -203,6 +207,28 @@ class DashboardServer:
                 out[m.key] = True
             except ApiError:
                 out[m.key] = False
+        if not out:
+            raise ApiError(f"no healthy machine for app {app!r}")
+        return out
+
+    def get_rollout(self, app: str, op: str = "status"):
+        """Staged-rollout read path (status / shadow-vs-live diff) from
+        the first healthy machine — like the V1 rule read path."""
+        m = self._first_healthy(app)
+        return self.api.fetch_rollout(m.ip, m.port, op)
+
+    def rollout_command(self, app: str, params: Dict[str, str],
+                        body: str = "") -> Dict:
+        """Staged-rollout mutation (load/stage/promote/abort/tick) pushed
+        to EVERY healthy machine, V1 publish semantics: each engine runs
+        its own shadow/canary/guardrail over its own traffic slice."""
+        out = {}
+        for m in self.apps.healthy_machines(app):
+            try:
+                out[m.key] = self.api.rollout_command(m.ip, m.port, params,
+                                                      body=body)
+            except ApiError as ex:
+                out[m.key] = {"error": str(ex)}
         if not out:
             raise ApiError(f"no healthy machine for app {app!r}")
         return out
@@ -403,6 +429,16 @@ class _Handler(BaseHTTPRequestHandler):
                     q.get("app", ""), q.get("ip", ""),
                     int(q.get("port", "8719")),
                     int(q.get("tokenPort", "0"))))
+            if path in ("/rollout/status.json", "/rollout/diff.json"):
+                op = "diff" if path.endswith("diff.json") else "status"
+                return self._ok(d.get_rollout(q.get("app", ""), op))
+            if path == "/rollout/command":
+                # Mutating: POST-only, like /cluster/assign above.
+                if self.command != "POST":
+                    return self._fail("POST required", 405)
+                params = {k: v for k, v in q.items() if k != "app"}
+                return self._ok(d.rollout_command(
+                    q.get("app", ""), params, body=body))
             if path == "/cluster/state.json":
                 out = []
                 for m in d.apps.healthy_machines(q.get("app", "")):

@@ -29,6 +29,31 @@ def _loads(source) -> list:
     return data
 
 
+# -- staged rollout tags (sentinel_tpu/rollout/) ----------------------------
+# Any rule may carry ``candidateSet`` (a named candidate ruleset evaluated
+# in shadow lanes instead of enforced) and ``rolloutStage`` ("shadow" |
+# "canary" — the initial stage a datasource-tagged candidate starts in).
+# Absent fields keep the reference wire schema byte-identical.
+
+def _rollout_fields(d: dict) -> dict:
+    out = {}
+    cs = d.get("candidateSet")
+    if cs:
+        out["candidate_set"] = str(cs)
+    rs = d.get("rolloutStage")
+    if rs:
+        out["rollout_stage"] = str(rs)
+    return out
+
+
+def _emit_rollout(d: dict, r) -> dict:
+    if getattr(r, "candidate_set", None):
+        d["candidateSet"] = r.candidate_set
+    if getattr(r, "rollout_stage", None):
+        d["rolloutStage"] = r.rollout_stage
+    return d
+
+
 # -- flow -------------------------------------------------------------------
 
 def flow_rule_from_dict(d: dict) -> FlowRule:
@@ -44,6 +69,7 @@ def flow_rule_from_dict(d: dict) -> FlowRule:
         max_queueing_time_ms=int(d.get("maxQueueingTimeMs", 500)),
         cluster_mode=bool(d.get("clusterMode", False)),
         cluster_config=d.get("clusterConfig"),
+        **_rollout_fields(d),
     )
 
 
@@ -60,7 +86,7 @@ def flow_rule_to_dict(r: FlowRule) -> dict:
         d["refResource"] = r.ref_resource
     if r.cluster_config:
         d["clusterConfig"] = r.cluster_config
-    return d
+    return _emit_rollout(d, r)
 
 
 def flow_rules_from_json(source) -> List[FlowRule]:
@@ -86,17 +112,18 @@ def degrade_rule_from_dict(d: dict) -> DegradeRule:
         stat_interval_ms=int(
             d.get("statIntervalMs", C.DEGRADE_DEFAULT_STAT_INTERVAL_MS)),
         limit_app=d.get("limitApp") or C.LIMIT_APP_DEFAULT,
+        **_rollout_fields(d),
     )
 
 
 def degrade_rule_to_dict(r: DegradeRule) -> dict:
-    return {
+    return _emit_rollout({
         "resource": r.resource, "limitApp": r.limit_app, "grade": r.grade,
         "count": r.count, "timeWindow": r.time_window,
         "slowRatioThreshold": r.slow_ratio_threshold,
         "minRequestAmount": r.min_request_amount,
         "statIntervalMs": r.stat_interval_ms,
-    }
+    }, r)
 
 
 def degrade_rules_from_json(source) -> List[DegradeRule]:
@@ -120,15 +147,16 @@ def system_rule_from_dict(d: dict) -> SystemRule:
         qps=g("qps"),
         max_thread=g("maxThread"),
         avg_rt=g("avgRt"),
+        **_rollout_fields(d),
     )
 
 
 def system_rule_to_dict(r: SystemRule) -> dict:
-    return {
+    return _emit_rollout({
         "highestSystemLoad": r.highest_system_load,
         "highestCpuUsage": r.highest_cpu_usage,
         "qps": r.qps, "maxThread": r.max_thread, "avgRt": r.avg_rt,
-    }
+    }, r)
 
 
 def system_rules_from_json(source) -> List[SystemRule]:
@@ -146,11 +174,13 @@ def authority_rule_from_dict(d: dict) -> AuthorityRule:
         resource=d.get("resource", ""),
         limit_app=d.get("limitApp", ""),
         strategy=int(d.get("strategy", C.AUTHORITY_WHITE)),
+        **_rollout_fields(d),
     )
 
 
 def authority_rule_to_dict(r: AuthorityRule) -> dict:
-    return {"resource": r.resource, "limitApp": r.limit_app, "strategy": r.strategy}
+    return _emit_rollout({"resource": r.resource, "limitApp": r.limit_app,
+                          "strategy": r.strategy}, r)
 
 
 def authority_rules_from_json(source) -> List[AuthorityRule]:
@@ -218,6 +248,7 @@ def param_rule_from_dict(d: dict) -> ParamFlowRule:
         items=items,
         cluster_mode=bool(d.get("clusterMode", False)),
         cluster_config=d.get("clusterConfig"),
+        **_rollout_fields(d),
     )
 
 
@@ -240,7 +271,7 @@ def param_rule_to_dict(r: ParamFlowRule) -> dict:
         ]
     if r.cluster_config:
         d["clusterConfig"] = r.cluster_config
-    return d
+    return _emit_rollout(d, r)
 
 
 def param_rules_from_json(source) -> List[ParamFlowRule]:

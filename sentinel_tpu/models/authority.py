@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, NamedTuple, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,9 @@ class AuthorityRule:
     resource: str
     limit_app: str  # comma-separated origin names
     strategy: int = C.AUTHORITY_WHITE
+    # Staged rollout (sentinel_tpu/rollout/): see FlowRule.candidate_set.
+    candidate_set: Optional[str] = None
+    rollout_stage: Optional[str] = None
 
     def is_valid(self) -> bool:
         return bool(self.resource) and bool(self.limit_app) and self.strategy in (

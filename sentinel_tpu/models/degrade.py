@@ -67,6 +67,9 @@ class DegradeRule:
     min_request_amount: int = C.DEGRADE_DEFAULT_MIN_REQUEST_AMOUNT
     stat_interval_ms: int = C.DEGRADE_DEFAULT_STAT_INTERVAL_MS
     limit_app: str = C.LIMIT_APP_DEFAULT
+    # Staged rollout (sentinel_tpu/rollout/): see FlowRule.candidate_set.
+    candidate_set: Optional[str] = None
+    rollout_stage: Optional[str] = None
 
     def is_valid(self) -> bool:
         if not self.resource or self.count < 0 or self.time_window < 0:

@@ -68,6 +68,12 @@ class FlowRule:
     max_queueing_time_ms: int = 500
     cluster_mode: bool = False
     cluster_config: Optional[dict] = None
+    # Staged rollout (sentinel_tpu/rollout/): a named rule is part of a
+    # CANDIDATE set — excluded from live enforcement, compiled into the
+    # shadow pack instead. ``rollout_stage`` hints the initial stage for
+    # datasource-tagged candidates ("shadow" default, "canary").
+    candidate_set: Optional[str] = None
+    rollout_stage: Optional[str] = None
 
     def is_valid(self) -> bool:
         if not self.resource or self.count < 0:

@@ -49,6 +49,9 @@ class SystemRule:
     qps: float = NOT_SET
     max_thread: float = NOT_SET
     avg_rt: float = NOT_SET
+    # Staged rollout (sentinel_tpu/rollout/): see FlowRule.candidate_set.
+    candidate_set: Optional[str] = None
+    rollout_stage: Optional[str] = None
 
     def is_valid(self) -> bool:
         return any(

@@ -17,7 +17,7 @@ Backend quirks (measured, this image's mosaic lowering):
 - i64 anywhere in the kernel (or its index maps) sends lowering into
   infinite `_convert_helper` recursion. sentinel_tpu enables jax x64,
   under which python-int constants trace as i64 — so the call is traced
-  under ``jax.enable_x64(False)``; all kernel I/O is int32/f32, making
+  under ``enable_x64(False)``; all kernel I/O is int32/f32, making
   that semantics-free.
 - bool→bf16 converts recurse the same way (bool→f32 select is fine).
 - A shape-free ``BlockSpec(memory_space=VMEM)`` recurses too; explicit
@@ -30,6 +30,7 @@ from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import enable_x64 as _enable_x64
 from jax.experimental import pallas as pl
 
 _BLOCK = 512   # rows per grid step
@@ -75,7 +76,9 @@ def prefix_pallas(ids: jnp.ndarray, values: jnp.ndarray,
     n = ids.shape[0]
     npad = -(-n // _BLOCK) * _BLOCK
     squeeze, m, ids32, vals1 = prep_prefix_pair(ids, values, npad)
-    with jax.enable_x64(False):
+    # jax.enable_x64 was removed in jax 0.4.37; the experimental context
+    # manager is the surviving spelling of the same switch.
+    with _enable_x64(False):
         out = pl.pallas_call(
             _make_kernel(npad, m + 1),
             grid=(npad // _BLOCK,),

@@ -20,7 +20,7 @@ reference's node fan-out.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,22 @@ from sentinel_tpu.ops import window as W
 SPEC_1S = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
 SPEC_60S = W.WindowSpec(C.MINUTE_WINDOW_MS, C.MINUTE_BUCKETS)
 
+# Shadow-lane counter channels (sentinel_tpu/rollout/): cumulative per
+# ClusterNode row since the candidate set was installed. WOULD_* rows are
+# the candidate ("shadow world") verdicts; LIVE_* mirror the live commit
+# so a rollout guardrail can diff the two worlds from ONE tensor read
+# with no sampling skew between them.
+SH_WOULD_PASS = 0
+SH_WOULD_BLOCK = 1
+SH_WB_AUTHORITY = 2
+SH_WB_SYSTEM = 3
+SH_WB_PARAM = 4
+SH_WB_FLOW = 5
+SH_WB_DEGRADE = 6
+SH_LIVE_PASS = 7
+SH_LIVE_BLOCK = 8
+NUM_SHADOW_COUNTERS = 9
+
 
 class SecondAccum(NamedTuple):
     """Staging buffer for the current second's statistics.
@@ -55,6 +71,28 @@ class SecondAccum(NamedTuple):
     counts: jax.Array  # int32[E, R] event deltas of the second at `stamp`
     min_rt: jax.Array  # int32[R] min RT observed this second
     stamp: jax.Array   # int64[] bucket-start ms of the second; -1 = unset
+
+
+class ShadowState(NamedTuple):
+    """The candidate ruleset's parallel world (sentinel_tpu/rollout/).
+
+    A staged candidate ruleset is evaluated in extra non-enforcing lanes
+    of the SAME fused step. Exactness requires the shadow flow/param
+    checks to admit against what the candidate WOULD have passed — not
+    the live window, which under real enforcement would not contain the
+    candidate-blocked traffic — so the shadow world carries its own
+    instant window plus per-rule controller state for every stateful
+    family. Live state that the shadow world cannot diverge (thread
+    gauges, RT/exception outcomes, host OS signals — all driven by which
+    requests actually RAN) is read from the live tensors; the exactness
+    domain this buys is documented in docs/SEMANTICS.md.
+    """
+
+    w1: W.Window          # shadow instant window (candidate-passed traffic)
+    flow: F.FlowState     # candidate warm-up / leaky-bucket state
+    param: P.ParamFlowState
+    degrade: D.DegradeState  # candidate breakers, fed by LIVE completions
+    counts: jax.Array     # int64[NUM_SHADOW_COUNTERS, R] cumulative
 
 
 class SentinelState(NamedTuple):
@@ -76,6 +114,10 @@ class SentinelState(NamedTuple):
     # exactly like a borrow bucket the ring never rotates into.
     occupied_next: jax.Array   # int32[R] pending borrow counts per node row
     occupied_stamp: jax.Array  # int64[] bucket-start of the granting bucket
+    # Staged-rollout shadow world, present only while a candidate ruleset
+    # is installed (None otherwise — installing/removing one is a pytree
+    # STRUCTURE change, i.e. exactly one retrace, like a rule-shape change).
+    shadow: Optional[ShadowState] = None
 
 
 class RulePack(NamedTuple):
@@ -112,6 +154,25 @@ def make_state(num_rows: int, flow_rules: int, now_ms: int,
         ),
         occupied_next=jnp.zeros((num_rows,), jnp.int32),
         occupied_stamp=jnp.int64(-1),
+    )
+
+
+def make_shadow_state(num_rows: int, shadow_rules: RulePack,
+                      degrade_state: D.DegradeState,
+                      spec1: W.WindowSpec = SPEC_1S) -> ShadowState:
+    """Fresh shadow world for a just-installed candidate ruleset.
+
+    Controller state starts cold, exactly like a live rule load
+    (§3.2 "WarmUp state re-created!"), and the shadow window starts
+    empty — the candidate world begins accumulating its own passed
+    traffic from install time.
+    """
+    return ShadowState(
+        w1=W.make_window(num_rows, spec1),
+        flow=F.make_flow_state(shadow_rules.flow.num_rules, 0),
+        param=P.make_param_state(shadow_rules.param.num_rules),
+        degrade=degrade_state,
+        counts=jnp.zeros((NUM_SHADOW_COUNTERS, num_rows), jnp.int64),
     )
 
 
@@ -210,6 +271,80 @@ def _apply_delta(w1: W.Window, sec: SecondAccum, delta: jax.Array, now_ms,
     return w1, sec._replace(counts=sec.counts + delta)
 
 
+def _shadow_entry_eval(
+    state: SentinelState,
+    shadow_rules: RulePack,
+    batch: EntryBatch,
+    now_ms: jax.Array,
+    w1_live: W.Window,
+    w60_live: W.Window,
+    sec_counts: jax.Array,
+    spec1: W.WindowSpec,
+    occupy_timeout_ms,
+    shadow_extra_pass=None,
+    shadow_extra_cms=None,
+):
+    """Run the candidate ruleset's slot cascade in non-enforcing lanes.
+
+    Same slot order as the live chain (authority → system → param → flow
+    → degrade). Stateful families admit against the SHADOW world (its own
+    window + controller state); thread gauges, OS signals and the live
+    windows feeding the system check come from the live tensors (shadow
+    cannot know which requests would have completed — SEMANTICS.md
+    "Shadow-lane exactness"). Occupy borrows are not simulated: a
+    prioritized request the candidate would reject counts as would-block.
+
+    Returns ``(s_blocked, s_reason, s_wait_us, new_shadow_substate_parts,
+    rotated_shadow_w1, per-family block masks)``.
+    """
+    sh = state.shadow
+    lanes = batch.cluster_row >= 0  # every real lane, pre-decided or not
+    sh_w1 = W.rotate(sh.w1, now_ms, spec1)
+
+    s_reason = jnp.where(lanes, C.BlockReason.PASS, -1).astype(jnp.int32)
+    s_auth = A.check_authority(shadow_rules.authority, batch, lanes)
+    s_reason = jnp.where(lanes & s_auth, C.BlockReason.AUTHORITY, s_reason)
+    s_blocked = s_auth
+
+    cand = lanes & (~s_blocked)
+    # w60/sec must be the step's ROLLED pair (the same tensors the live
+    # system check reads): at a second boundary the pre-roll w60 plus the
+    # reset accumulator would miss the just-completed second entirely.
+    s_sys = Y.check_system(shadow_rules.system, state.sys_signals, w1_live,
+                           w60_live, sec_counts, state.cur_threads, batch,
+                           cand, now_ms, spec1=spec1)
+    s_reason = jnp.where(cand & s_sys, C.BlockReason.SYSTEM, s_reason)
+    s_blocked = s_blocked | s_sys
+
+    cand = lanes & (~s_blocked)
+    s_pv = P.check_param_flow(shadow_rules.param, sh.param, batch, now_ms,
+                              cand, extra_cms=shadow_extra_cms)
+    s_reason = jnp.where(cand & s_pv.blocked, C.BlockReason.PARAM_FLOW,
+                         s_reason)
+    s_blocked = s_blocked | s_pv.blocked
+
+    s_fv = F.check_flow(shadow_rules.flow, sh.flow, sh_w1, state.cur_threads,
+                        batch, now_ms, s_blocked | (~lanes),
+                        extra_pass=shadow_extra_pass, spec=spec1,
+                        occupy_timeout_ms=occupy_timeout_ms)
+    s_flow = lanes & (~s_blocked) & s_fv.blocked
+    s_reason = jnp.where(s_flow, C.BlockReason.FLOW, s_reason)
+    s_blocked = s_blocked | s_fv.blocked
+
+    cand = lanes & (~s_blocked)
+    s_dv = D.check_degrade(shadow_rules.degrade, sh.degrade, batch, now_ms,
+                           cand)
+    s_degr = cand & s_dv.blocked
+    s_reason = jnp.where(s_degr, C.BlockReason.DEGRADE, s_reason)
+    s_blocked = s_blocked | s_dv.blocked
+
+    s_wait_us = jnp.where(lanes & (~s_blocked),
+                          jnp.maximum(s_fv.wait_us, s_pv.wait_us), 0)
+    fam_blocks = (s_auth & lanes, s_sys, s_pv.blocked & lanes, s_flow, s_degr)
+    return (s_blocked & lanes, s_reason, s_wait_us,
+            (s_fv.state, s_pv.state, s_dv.state), sh_w1, fam_blocks)
+
+
 def entry_step(
     state: SentinelState,
     rules: RulePack,
@@ -223,6 +358,11 @@ def entry_step(
     extra_next_global=None,
     spec1: W.WindowSpec = SPEC_1S,
     occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
+    shadow_rules: Optional[RulePack] = None,
+    canary_bps=None,
+    canary_salt=None,
+    shadow_extra_pass=None,
+    shadow_extra_cms=None,
 ) -> Tuple[SentinelState, Decisions]:
     """One admission step. ``extra_pass`` / ``extra_next`` (int32[R]) /
     ``extra_cms`` (f32[PR, D, W] param sketch), all optional, are the
@@ -231,7 +371,19 @@ def entry_step(
 
     ``extra_checkers``: SPI-registered pure device checkers (core/spi.py),
     spliced between the param-flow and flow slots — the reference's
-    SlotChainBuilder splice point. Static (closed over at jit time)."""
+    SlotChainBuilder splice point. Static (closed over at jit time).
+
+    ``shadow_rules`` (with ``state.shadow`` present) evaluates a staged
+    candidate ruleset in extra non-enforcing lanes of this same step
+    (sentinel_tpu/rollout/): would-verdicts accumulate in
+    ``state.shadow.counts`` with zero effect on live decisions — unless
+    ``canary_bps`` is set, in which case lanes whose deterministic
+    (origin, context) hash falls inside the canary slice are ENFORCED by
+    the candidate verdict instead of the live one. ``canary_bps`` /
+    ``canary_salt`` are traced scalars (tuning them never retraces);
+    ``shadow_extra_pass`` / ``shadow_extra_cms`` are the pod-psum'd
+    cross-device shadow contributions, mirroring ``extra_pass`` /
+    ``extra_cms``."""
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, spec1)
     # Minute-window commits are staged in the [E, R] second accumulator and
@@ -314,6 +466,37 @@ def entry_step(
     reason = jnp.where(valid & (~decided) & dv.blocked, C.BlockReason.DEGRADE, reason)
     blocked = blocked | dv.blocked
 
+    # --- shadow lanes (sentinel_tpu/rollout/) -----------------------------
+    # Candidate-world verdicts ride the same step; canary lanes swap their
+    # ENFORCED verdict to the candidate's BEFORE the stat commit, so the
+    # live windows record what actually happened to them.
+    shadow_new = state.shadow
+    s_eval = None
+    wait_pick = jnp.maximum(fv.wait_us, pv.wait_us)
+    if shadow_rules is not None and state.shadow is not None:
+        from sentinel_tpu.rollout.canary import device_in_canary
+
+        s_eval = _shadow_entry_eval(
+            state, shadow_rules, batch, now_ms, w1, w60, sec.counts, spec1,
+            occupy_timeout_ms, shadow_extra_pass=shadow_extra_pass,
+            shadow_extra_cms=shadow_extra_cms)
+        s_blocked, s_reason, s_wait_us, s_states, sh_w1, s_fam = s_eval
+        if canary_bps is not None:
+            # Canary enforcement: deterministic (origin, context) hash
+            # selects a stable slice of traffic the candidate governs.
+            # Pre-decided lanes (remote token verdicts, lease commits)
+            # and occupy-granted lanes stay live-governed — their
+            # decision was already made elsewhere.
+            mix = (valid & (~batch.pre_blocked) & (~batch.pre_passed)
+                   & (~granted)
+                   & device_in_canary(
+                       batch.origin_id, batch.context_id,
+                       0 if canary_salt is None else canary_salt,
+                       canary_bps))
+            blocked = jnp.where(mix, s_blocked, blocked)
+            reason = jnp.where(mix, s_reason, reason)
+            wait_pick = jnp.where(mix, s_wait_us, wait_pick)
+
     # --- StatisticSlot commit --------------------------------------------
     rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
     admit = valid & (~blocked)
@@ -327,10 +510,23 @@ def entry_step(
     block4 = jnp.broadcast_to(block_counts[:, None], rows4.shape)
 
     thread_inc = jnp.broadcast_to(jnp.where(admit, 1, 0)[:, None], rows4.shape)
+    extra_cols = [thread_inc]
+    if s_eval is not None:
+        # Every shadow commit — the shadow window's PASS plus all the
+        # would-verdict counter channels — rides the live commit's
+        # bincount as extra value columns: the one-hot operands (the
+        # expensive part on TPU) are shared, no second sweep. The LIVE
+        # counter channels need no columns at all — they are exactly
+        # delta[PASS] / delta[BLOCK].
+        s_pass_counts = jnp.where(valid & (~s_blocked), batch.count, 0)
+        s_block_counts = jnp.where(valid & s_blocked, batch.count, 0)
+        for col in (s_pass_counts, s_block_counts,
+                    *(jnp.where(m, batch.count, 0) for m in s_fam)):
+            extra_cols.append(jnp.broadcast_to(col[:, None], rows4.shape))
     delta, extras = _event_delta(
         rows4, [(C.MetricEvent.PASS, pass4, False),
                 (C.MetricEvent.BLOCK, block4, False)], w1.num_rows,
-        extra_cols=[thread_inc])
+        extra_cols=extra_cols)
     w1, sec = _apply_delta(w1, sec, delta, now_ms, spec1)
     occupied_next = occupied_next + fv.occ_add
     occupied_stamp = cur_start
@@ -340,13 +536,30 @@ def entry_step(
 
     cur_threads = state.cur_threads + extras[0].astype(jnp.int32)
 
-    wait_us = jnp.where(admit, jnp.maximum(fv.wait_us, pv.wait_us), 0)
+    if s_eval is not None:
+        sh_w1 = sh_w1._replace(counts=sh_w1.counts.at[
+            idx1, C.MetricEvent.PASS].add(extras[1].astype(jnp.int32)))
+        counts = state.shadow.counts
+        for ch, vec in (
+                (SH_WOULD_PASS, extras[1]), (SH_WOULD_BLOCK, extras[2]),
+                (SH_WB_AUTHORITY, extras[3]), (SH_WB_SYSTEM, extras[4]),
+                (SH_WB_PARAM, extras[5]), (SH_WB_FLOW, extras[6]),
+                (SH_WB_DEGRADE, extras[7]),
+                (SH_LIVE_PASS, delta[C.MetricEvent.PASS]),
+                (SH_LIVE_BLOCK, delta[C.MetricEvent.BLOCK])):
+            counts = counts.at[ch].add(vec.astype(jnp.int64))
+        shadow_new = ShadowState(
+            w1=sh_w1, flow=s_states[0], param=s_states[1],
+            degrade=s_states[2], counts=counts)
+
+    wait_us = jnp.where(admit, wait_pick, 0)
 
     new_state = SentinelState(w1=w1, w60=w60, cur_threads=cur_threads,
                               flow=fv.state, degrade=dv.state, param=pv.state,
                               sys_signals=state.sys_signals, sec=sec,
                               occupied_next=occupied_next,
-                              occupied_stamp=occupied_stamp)
+                              occupied_stamp=occupied_stamp,
+                              shadow=shadow_new)
     return new_state, Decisions(reason=reason, wait_us=wait_us)
 
 
@@ -356,11 +569,17 @@ def exit_step(
     batch: ExitBatch,
     now_ms: jax.Array,
     spec1: W.WindowSpec = SPEC_1S,
+    shadow_rules: Optional[RulePack] = None,
 ) -> SentinelState:
     """Completion commit: RT + success/exception, thread decrement.
 
     Mirrors ``StatisticSlot.exit`` + ``Tracer`` exception accounting
-    (SURVEY.md §3.1 "LeapArray write #2").
+    (SURVEY.md §3.1 "LeapArray write #2"). With a staged candidate set
+    installed (``shadow_rules`` + ``state.shadow``), live completions
+    also feed the candidate's breakers and THREAD-grade param gauges —
+    the shadow world shares the live RT/exception stream, since which
+    requests completed (and how) is decided by what actually ran
+    (SEMANTICS.md "Shadow-lane exactness").
     """
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, spec1)
@@ -405,5 +624,14 @@ def exit_step(
     degrade = D.feed_degrade(rules.degrade, state.degrade, batch, now_ms)
     param = P.feed_param_exit(rules.param, state.param, batch)
 
+    shadow = state.shadow
+    if shadow_rules is not None and shadow is not None:
+        shadow = shadow._replace(
+            degrade=D.feed_degrade(shadow_rules.degrade, shadow.degrade,
+                                   batch, now_ms),
+            param=P.feed_param_exit(shadow_rules.param, shadow.param, batch),
+        )
+
     return state._replace(w1=w1, w60=w60, cur_threads=cur_threads,
-                          degrade=degrade, param=param, sec=sec)
+                          degrade=degrade, param=param, sec=sec,
+                          shadow=shadow)

@@ -96,6 +96,7 @@ def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
                now_ms: jax.Array, *, axis: str, cluster_param: bool,
                extra_checkers: tuple = (),
                occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
+               shadow_rules=None, canary_bps=None, canary_salt=None,
                ) -> Tuple[S.SentinelState, Decisions]:
     local = _squeeze0(state)
     now_ms = jnp.asarray(now_ms, jnp.int64)
@@ -114,24 +115,59 @@ def _pod_entry(state: S.SentinelState, rules: S.RulePack, batch: EntryBatch,
         local = local._replace(param=PF.roll_sketch_windows(
             rules.param, local.param, now_ms))
         extra_cms = jax.lax.psum(local.param.cms, axis) - local.param.cms
+    shadow_extra_pass = None
+    shadow_extra_cms = None
+    if shadow_rules is not None and local.shadow is not None:
+        # Shadow counters ride the same psum: the candidate's cluster-mode
+        # rules admit against the POD-GLOBAL shadow window (other devices'
+        # candidate-passed counts), so shadow-vs-live deltas are pod-exact
+        # rather than per-slice. Rotate before the psum, same discipline
+        # as the live window above.
+        sh_w1 = W.rotate(local.shadow.w1, now_ms, S.SPEC_1S)
+        shadow_extra_pass, _ = global_pass_counts(sh_w1, axis)
+        local = local._replace(shadow=local.shadow._replace(w1=sh_w1))
+        if cluster_param:
+            sh_param = PF.roll_sketch_windows(
+                shadow_rules.param, local.shadow.param, now_ms)
+            local = local._replace(
+                shadow=local.shadow._replace(param=sh_param))
+            shadow_extra_cms = (jax.lax.psum(sh_param.cms, axis)
+                                - sh_param.cms)
     # Hand the rotated window through so entry_step's own rotate hits the
     # cheap restamp branch instead of re-sweeping the counts tensor.
     new_local, dec = S.entry_step(local._replace(w1=w1), rules, batch, now_ms,
                                   extra_pass=extra_pass, extra_next=extra_next,
                                   extra_cms=extra_cms,
                                   extra_checkers=extra_checkers,
-                                  occupy_timeout_ms=occupy_timeout_ms)
+                                  occupy_timeout_ms=occupy_timeout_ms,
+                                  shadow_rules=shadow_rules,
+                                  canary_bps=canary_bps,
+                                  canary_salt=canary_salt,
+                                  shadow_extra_pass=shadow_extra_pass,
+                                  shadow_extra_cms=shadow_extra_cms)
     return _expand0(new_local), dec
 
 
 def _pod_exit(state: S.SentinelState, rules: S.RulePack, batch: ExitBatch,
-              now_ms: jax.Array, *, axis: str) -> S.SentinelState:
+              now_ms: jax.Array, *, axis: str,
+              shadow_rules=None) -> S.SentinelState:
     del axis
-    return _expand0(S.exit_step(_squeeze0(state), rules, batch, now_ms))
+    return _expand0(S.exit_step(_squeeze0(state), rules, batch, now_ms,
+                                shadow_rules=shadow_rules))
+
+
+def global_shadow_counts(state: S.SentinelState) -> Optional[jax.Array]:
+    """Pod-global rollout counters from a [D, ...] pod state: the shadow
+    counter tensor summed over the device axis (host-side read — every
+    device accumulated only its own shard's lanes)."""
+    if state.shadow is None:
+        return None
+    return jnp.sum(state.shadow.counts, axis=0)
 
 
 def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True,
-                   occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS):
+                   occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
+                   shadow_rules=None, canary_bps=None, canary_salt=None):
     """Build (entry_step, exit_step) shard_mapped over ``mesh[axis]``.
 
     State leaves carry a leading device axis (sharded); batches are sharded
@@ -150,21 +186,37 @@ def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True,
     into the pod step like the single-device engine's; later registrations
     need a fresh ``make_pod_steps`` (pod callers own their jit lifecycle —
     watch ``spi.device_version()`` the way the engine does).
+
+    ``shadow_rules`` / ``canary_bps`` / ``canary_salt`` stage a candidate
+    ruleset pod-wide (sentinel_tpu/rollout/), build-static like the SPI
+    splice: the pod state must carry a matching shadow world
+    (``S.make_shadow_state`` broadcast by ``make_pod_state``), and the
+    candidate's cluster-mode rules admit against the psum'd shadow
+    window, so would-verdicts are pod-global like live verdicts.
     """
     from sentinel_tpu.core import spi as _spi
 
     entry = _shard_map(
         functools.partial(_pod_entry, axis=axis, cluster_param=cluster_param,
                           extra_checkers=_spi.device_checkers(),
-                          occupy_timeout_ms=occupy_timeout_ms),
+                          occupy_timeout_ms=occupy_timeout_ms,
+                          shadow_rules=shadow_rules, canary_bps=canary_bps,
+                          canary_salt=canary_salt),
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P()),
         out_specs=(P(axis), P(axis)),
+        # The r5 survivor-fixpoint (ops/fixpoint.py) is a lax.while_loop;
+        # jax's shard_map replication checker has no while rule yet
+        # (mixed-acquire batches crashed with "No replication rule for
+        # while"), so the static rep check is off. Collective correctness
+        # is unaffected — psums are explicit in the step body.
+        check_rep=False,
     )
     exit_ = _shard_map(
-        functools.partial(_pod_exit, axis=axis),
+        functools.partial(_pod_exit, axis=axis, shadow_rules=shadow_rules),
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P()),
         out_specs=P(axis),
+        check_rep=False,
     )
     return entry, exit_

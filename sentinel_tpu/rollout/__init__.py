@@ -1,0 +1,27 @@
+"""Shadow-rule evaluation & staged rollout (shadow → canary → promote).
+
+The tensor design makes "what would this candidate ruleset have
+blocked?" nearly free: a candidate set is just extra vectorized rule
+rows evaluated in the same fused device step (``ops/step.py`` shadow
+lanes), so operators can stage a rule edit against live traffic before
+it rejects a single request — then enforce it for a deterministic
+hash-selected canary slice, and finally promote it through the same
+rule-manager path every datasource push takes (or let the block-rate
+guardrail auto-abort it).
+
+Import surface: :mod:`~sentinel_tpu.rollout.canary` (pure assignment
+math, importable from device code) is re-exported here;
+:class:`~sentinel_tpu.rollout.manager.RolloutManager` must be imported
+from its module directly — ``manager`` pulls in the device step, and
+the device step pulls in ``canary``, so re-exporting the manager here
+would make that import a cycle.
+"""
+
+from sentinel_tpu.rollout.canary import (  # noqa: F401
+    CANARY_BPS_MAX,
+    canary_bucket,
+    canary_hash,
+    in_canary,
+)
+
+__all__ = ["CANARY_BPS_MAX", "canary_bucket", "canary_hash", "in_canary"]

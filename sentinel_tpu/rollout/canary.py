@@ -1,0 +1,72 @@
+"""Deterministic canary assignment for staged rollouts.
+
+A request's rollout stage must be STABLE: the same caller (context,
+origin) lands on the same side of the canary split on every step, or a
+paced client would flap between the live and candidate rulesets and see
+neither's semantics. There is no reference twin — the reference has no
+staged rollout; the closest analog is its ``limitApp`` origin routing,
+which is why the canary key is the same (origin, context) pair the flow
+checker already carries on device.
+
+The assignment is a pure function of (origin_id, context_id, salt): a
+32-bit multiplicative mix hashed into basis points and compared against
+the candidate's ``canary_bps`` knob. It runs identically under numpy on
+the host (tests, ops introspection) and jnp inside the fused step —
+both go through the same arithmetic below, so host predictions match
+device verdicts bit-for-bit.
+"""
+
+from __future__ import annotations
+
+# Odd multiplicative constants (Knuth / murmur-finalizer lineage), same
+# family param_flow's CMS hashes use. Arithmetic is mod 2^32 throughout.
+_MIX_A = 0x9E3779B1
+_MIX_B = 0x85EBCA77
+_MIX_C = 0xC2B2AE3D
+
+CANARY_BPS_MAX = 10_000  # basis points: 10000 == 100% of traffic
+
+
+def canary_hash(origin_id, context_id, salt):
+    """uint32 mix of the canary key. Works on python ints, numpy arrays
+    and jnp arrays alike (all ops are +, *, ^, >> on uint32).
+
+    origin_id may be negative (ORIGIN_ID_NONE / padding); the +0x101
+    offset keeps distinct small negatives distinct after the uint cast.
+    """
+    h = ((origin_id + 0x101) * _MIX_A + (context_id + 0x7F) * _MIX_B) & 0xFFFFFFFF
+    h ^= (salt * _MIX_C) & 0xFFFFFFFF
+    h = (h ^ (h >> 15)) * _MIX_B & 0xFFFFFFFF
+    h ^= h >> 13
+    return h & 0xFFFFFFFF
+
+
+def canary_bucket(origin_id, context_id, salt):
+    """Basis-point bucket in [0, 10000) for the canary key."""
+    return canary_hash(origin_id, context_id, salt) % CANARY_BPS_MAX
+
+
+def in_canary(origin_id, context_id, salt, bps):
+    """True when the key falls inside the canary slice of ``bps`` basis
+    points. ``bps=0`` selects nobody, ``bps=10000`` everybody."""
+    return canary_bucket(origin_id, context_id, salt) < bps
+
+
+def device_in_canary(origin_id, context_id, salt, bps):
+    """jnp variant for the fused step: bool[N] from int32[N] batch lanes.
+
+    Mirrors :func:`in_canary` exactly — the arithmetic is uint32 modular
+    either way, so a host-side ``in_canary`` prediction for a key equals
+    the device verdict.
+    """
+    import jax.numpy as jnp
+
+    o = origin_id.astype(jnp.uint32) + jnp.uint32(0x101)
+    c = context_id.astype(jnp.uint32) + jnp.uint32(0x7F)
+    h = o * jnp.uint32(_MIX_A) + c * jnp.uint32(_MIX_B)
+    h = h ^ (jnp.asarray(salt).astype(jnp.uint32) * jnp.uint32(_MIX_C))
+    h = (h ^ (h >> jnp.uint32(15))) * jnp.uint32(_MIX_B)
+    h = h ^ (h >> jnp.uint32(13))
+    bucket = h % jnp.uint32(CANARY_BPS_MAX)
+    bps_u = jnp.asarray(bps).astype(jnp.uint32)
+    return bucket < bps_u

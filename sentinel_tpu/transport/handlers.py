@@ -198,6 +198,72 @@ def cmd_resilience(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success(req.engine.resilience_stats())
 
 
+@command_mapping("rollout", "staged rollout: shadow/canary candidate rulesets")
+def cmd_rollout(req: CommandRequest) -> CommandResponse:
+    """Staged-rollout control plane (sentinel_tpu/rollout/ — no reference
+    twin: the reference pushes rule edits straight to enforcement).
+
+    ``op`` selects the action:
+      * ``status`` (default) — candidate sets + guardrail snapshot
+      * ``diff``   — per-resource shadow-vs-live outcome deltas
+      * ``load``   — stage a candidate: ``name=`` + JSON body/data
+                     ``{family: [rule dicts]}`` (+ optional ``stage=``,
+                     ``canaryBps=``)
+      * ``stage``  — move the active candidate: ``stage=shadow|canary``
+                     (+ ``canaryBps=``)
+      * ``promote`` / ``abort`` — end the rollout (``name=`` optional
+                     cross-check)
+      * ``tick``   — run one guardrail window now (ops cadence / cron)
+    """
+    from sentinel_tpu.rollout.manager import ACTIVE_STAGES
+
+    rollout = req.engine.rollout
+    op = req.get_param("op", "status")
+    name = req.get_param("name")
+    try:
+        if op == "status":
+            return CommandResponse.of_success(rollout.snapshot())
+        if op == "diff":
+            return CommandResponse.of_success(rollout.diff())
+        if op == "tick":
+            return CommandResponse.of_success(rollout.tick())
+        if op == "load":
+            if not name:
+                return CommandResponse.of_failure("missing parameter: name")
+            data = req.get_param("data") or req.body
+            rules = json.loads(data or "{}")
+            if not isinstance(rules, dict):
+                return CommandResponse.of_failure(
+                    "expected a JSON object {family: [rules]}")
+            stage = req.get_param("stage", "shadow")
+            bps = req.get_param("canaryBps")
+            cand = rollout.load_candidate(
+                name, rules, stage=stage,
+                canary_bps=int(bps) if bps is not None else None)
+            return CommandResponse.of_success(
+                {"loaded": cand.name, "stage": cand.stage,
+                 "families": {f: len(cand.rules[f]) for f in cand.families()}})
+        if op == "stage":
+            stage = req.get_param("stage", "")
+            if stage not in ACTIVE_STAGES:
+                return CommandResponse.of_failure(
+                    f"stage must be one of {list(ACTIVE_STAGES)}")
+            bps = req.get_param("canaryBps")
+            cand = rollout.set_stage(
+                name, stage, canary_bps=int(bps) if bps is not None else None)
+            return CommandResponse.of_success(
+                {"name": cand.name, "stage": cand.stage,
+                 "canaryBps": cand.canary_bps})
+        if op == "promote":
+            return CommandResponse.of_success(rollout.promote(name))
+        if op == "abort":
+            return CommandResponse.of_success(
+                rollout.abort(name, reason=req.get_param("reason", "manual")))
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("profile", "device step timing stats")
 def cmd_profile(req: CommandRequest) -> CommandResponse:
     """Per-step timing snapshot (SURVEY §5 — no reference twin: the

@@ -1,0 +1,41 @@
+"""Syntax-rot and lint gates (CI/tooling tier-1 smoke).
+
+Most datasource connector modules import lazily (their wire deps are
+optional extras), so a syntax error in one can sit unnoticed until a
+production config first selects it. ``compileall`` forces every module
+through the parser/compiler on every tier-1 run. The ruff gate runs the
+repo's pyproject config when a ruff binary is available (the container
+image does not ship one; CI images that do get the full lint).
+"""
+
+import py_compile
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_compileall_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", "-f",
+         str(REPO / "sentinel_tpu")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_compile_driver_entry_points():
+    for name in ("__graft_entry__.py", "bench.py"):
+        py_compile.compile(str(REPO / name), doraise=True)
+
+
+@pytest.mark.skipif(shutil.which("ruff") is None,
+                    reason="ruff binary not in this image")
+def test_ruff_clean():
+    proc = subprocess.run(
+        ["ruff", "check", "--no-cache", str(REPO)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -12,6 +12,7 @@ import pytest
 
 import jax.numpy as jnp
 
+import sentinel_tpu.ops.segment as seg_mod
 from sentinel_tpu.core import constants as C
 from sentinel_tpu.ops.segment import (
     bincount_matmul,
@@ -20,6 +21,15 @@ from sentinel_tpu.ops.segment import (
 )
 
 assert C.MAX_ACQUIRE_COUNT == 256  # the bound these kernels are exact for
+
+
+@pytest.fixture(params=["cpu-exact", "dense"], autouse=True)
+def _both_routings(request, monkeypatch):
+    """Exercise BOTH implementations on the CPU test backend: the
+    sort/scatter route tier-1 actually runs, and the dense MXU forms
+    (forced via the same switch SENTINEL_TPU_FORCE_DENSE flips) that
+    real devices run."""
+    monkeypatch.setattr(seg_mod, "_FORCE_DENSE", request.param == "dense")
 
 
 def _oracle_prefix(ids, values):
