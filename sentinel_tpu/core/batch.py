@@ -69,6 +69,11 @@ class Decisions(NamedTuple):
 
     reason: jax.Array   # int32[N] BlockReason (0 = pass)
     wait_us: jax.Array  # int64[N] host must sleep this long before admitting
+    # First-blocking rule slot within the blocking family (load order per
+    # resource; -1 = pass, remote verdict, or slot-less family). With
+    # ``reason`` this is the full attribution code — see
+    # telemetry/attribution.py encode_reason_code.
+    rule_slot: jax.Array  # int32[N]
 
 
 MAX_PARAMS = 4

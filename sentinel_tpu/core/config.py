@@ -48,6 +48,15 @@ RESILIENCE_BREAKER_FAILURES = "csp.sentinel.resilience.breaker.failure.threshold
 RESILIENCE_BREAKER_OPEN_MS = "csp.sentinel.resilience.breaker.open.ms"
 RESILIENCE_BREAKER_PROBES = "csp.sentinel.resilience.breaker.half.open.probes"
 RESILIENCE_ENTRY_BUDGET_MS = "csp.sentinel.resilience.cluster.entry.budget.ms"
+# Telemetry layer (sentinel_tpu/telemetry/ — no reference twin).
+# profile.syncEvery: every Nth device dispatch blocks for a true
+# synchronous step wall (StepTimer sampling cadence; the rest record
+# enqueue wall only, keeping the steady-state stream async).
+PROFILE_SYNC_EVERY = "csp.sentinel.profile.syncEvery"
+# trace.sampleEvery: every Nth BLOCKED entry is retained as a decision
+# trace (0 disables); trace.capacity bounds the host-side ring.
+TELEMETRY_TRACE_SAMPLE_EVERY = "csp.sentinel.telemetry.trace.sampleEvery"
+TELEMETRY_TRACE_CAPACITY = "csp.sentinel.telemetry.trace.capacity"
 
 DEFAULT_CHARSET = "utf-8"
 DEFAULT_SINGLE_METRIC_FILE_SIZE = 50 * 1024 * 1024
@@ -64,6 +73,9 @@ DEFAULT_RESILIENCE_BREAKER_PROBES = 1
 # timeout, so a degraded token server costs the data path a bounded,
 # configured amount — never a socket timeout per cluster rule.
 DEFAULT_RESILIENCE_ENTRY_BUDGET_MS = 500
+DEFAULT_PROFILE_SYNC_EVERY = 64
+DEFAULT_TELEMETRY_TRACE_SAMPLE_EVERY = 64
+DEFAULT_TELEMETRY_TRACE_CAPACITY = 256
 
 
 def _env_key(key: str) -> str:

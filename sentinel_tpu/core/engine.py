@@ -254,7 +254,27 @@ class SentinelEngine:
             self.cluster_entry_budget_ms = DEFAULT_RESILIENCE_ENTRY_BUDGET_MS
         # Per-step timing (SURVEY §5): enqueue wall per dispatch + sampled
         # synchronous step wall; surfaced via the `profile` ops command.
-        self.step_timer = StepTimer()
+        # The sampling cadence is config-tunable (`csp.sentinel.profile.
+        # syncEvery`): every Nth dispatch blocks for a true step wall.
+        from sentinel_tpu.core.config import (
+            DEFAULT_PROFILE_SYNC_EVERY, PROFILE_SYNC_EVERY)
+
+        sync_every = _cfg.get_int(PROFILE_SYNC_EVERY,
+                                  DEFAULT_PROFILE_SYNC_EVERY)
+        if sync_every <= 0:
+            from sentinel_tpu.log.record_log import record_log
+
+            record_log.warn("invalid %s=%s; using default %d",
+                            PROFILE_SYNC_EVERY, sync_every,
+                            DEFAULT_PROFILE_SYNC_EVERY)
+            sync_every = DEFAULT_PROFILE_SYNC_EVERY
+        self.step_timer = StepTimer(sync_every=sync_every)
+        # Sampled decision traces (sentinel_tpu/telemetry/): every Nth
+        # blocked entry pulled off-device asynchronously, served by the
+        # `traces` ops command and the dashboard.
+        from sentinel_tpu.telemetry.trace_ring import DecisionTraceBuffer
+
+        self.traces = DecisionTraceBuffer(self)
         # Token-lease fast path (core/lease.py): host-admitted resources +
         # the async stats committer. Rebuilt on every rule push.
         self.lease_enabled = (
@@ -791,6 +811,7 @@ class SentinelEngine:
         self.stop_pipeline()
         self.system_status.stop()
         self.cluster.stop()
+        self.traces.stop()
 
     @staticmethod
     def _cluster_info(rules, with_param_idx: bool = False) -> Dict[str, list]:
@@ -1131,6 +1152,9 @@ class SentinelEngine:
         except Exception as ex:  # noqa: BLE001 — dispatch only (donation)
             self._state = None  # buffers possibly consumed: restart cold
             raise DeviceDispatchError(f"entry dispatch failed: {ex!r:.200}") from ex
+        # Sampled decision traces: enqueue only (the worker materializes
+        # off this thread) — never blocks the step stream.
+        self.traces.submit(batch, dec, now)
         return dec
 
     def _run_entry_batch(self, batch: EntryBatch) -> Decisions:
@@ -1253,6 +1277,7 @@ class SentinelEngine:
                 self._state = None
                 raise DeviceDispatchError(
                     f"entry dispatch failed: {ex!r:.200}") from ex
+            self.traces.submit(batch, dec, now)
             return dec
 
     def complete_batch(self, batch: ExitBatch, now_ms: Optional[int] = None) -> None:
@@ -1388,6 +1413,82 @@ class SentinelEngine:
             if st is None or st.shadow is None:
                 return None
             return np.asarray(st.shadow.counts)
+
+    def telemetry_counts(self) -> Dict[str, np.ndarray]:
+        """Cumulative device telemetry since engine start, as numpy:
+        ``blockByReason`` int64[NUM_ATTR_REASONS, R] per-(reason family,
+        node row) block attribution, ``rtHist`` int64[NUM_RT_BUCKETS, R]
+        success-RT histogram, ``totals`` int64[NUM_EVENTS, R] event
+        counters. Queued leased commits are flushed first so counter
+        reads are deterministic."""
+        self._flush_committer()
+        with self._lock:
+            self._ensure_compiled()
+            tele = self._state.telemetry
+            sec_counts = np.asarray(self._state.sec.counts)
+            block = np.asarray(tele.block_by_reason)
+            hist = np.asarray(tele.rt_hist)
+            totals = np.asarray(tele.totals)
+            stage_attr = np.asarray(tele.stage_attr)
+            stage_hist = np.asarray(tele.stage_hist)
+        # Read-side fold of the live staged second (S.telemetry_view
+        # semantics, done host-side so reads never dispatch a program):
+        # exact at any instant, whatever the fold cadence on device.
+        return {
+            "blockByReason": block + stage_attr.astype(np.int64),
+            "rtHist": hist + stage_hist.astype(np.int64),
+            "totals": totals + sec_counts.astype(np.int64),
+        }
+
+    def telemetry_snapshot(self) -> Dict:
+        """JSON-shaped telemetry view (`telemetry` ops command parity
+        with the OpenMetrics endpoint): per-resource cumulative counters,
+        block attribution by reason family, and RT percentiles estimated
+        from the device histogram."""
+        from sentinel_tpu.core.registry import KIND_CLUSTER
+        from sentinel_tpu.telemetry.attribution import (
+            ATTR_REASON_NAMES, histogram_quantile)
+
+        counts = self.telemetry_counts()
+        totals = counts["totals"]
+        by_reason = counts["blockByReason"]
+        rt_hist = counts["rtHist"]
+        active = totals.any(axis=0) | by_reason.any(axis=0)
+        resources: Dict[str, Dict] = {}
+        for row, meta in enumerate(self.registry.meta):
+            if meta.kind != KIND_CLUSTER or row >= active.shape[0] \
+                    or not active[row]:
+                continue
+            hist = rt_hist[:, row]
+            reasons = {name: int(by_reason[ch, row])
+                       for ch, name in enumerate(ATTR_REASON_NAMES)
+                       if by_reason[ch, row]}
+            resources[meta.resource] = {
+                "passTotal": int(totals[C.MetricEvent.PASS, row]),
+                "blockTotal": int(totals[C.MetricEvent.BLOCK, row]),
+                "successTotal": int(totals[C.MetricEvent.SUCCESS, row]),
+                "exceptionTotal": int(totals[C.MetricEvent.EXCEPTION, row]),
+                "rtSumMs": int(totals[C.MetricEvent.RT, row]),
+                "blockByReason": reasons,
+                "rtP50Ms": round(histogram_quantile(hist, 0.50), 2),
+                "rtP95Ms": round(histogram_quantile(hist, 0.95), 2),
+                "rtP99Ms": round(histogram_quantile(hist, 0.99), 2),
+            }
+        return {
+            "resources": resources,
+            "counters": {
+                "failOpenCount": self.fail_open_count,
+                "clusterFallbackCount": self.cluster_fallback_count,
+                "clusterBudgetExhaustedCount":
+                    self.cluster_budget_exhausted_count,
+            },
+            "stepTimer": self.step_timer.snapshot(),
+            # snapshot(limit=0): the counter fields without the traces.
+            "traceSampling": {
+                k: v for k, v in self.traces.snapshot(limit=0).items()
+                if k != "traces"
+            },
+        }
 
     def row_stats(self):
         """(per-second QPS totals f32[R, E], threads int[R]) as numpy.

@@ -104,6 +104,18 @@ class SentinelApiClient:
         """``rollout`` read ops: status / diff."""
         return json.loads(self.get(ip, port, "rollout", {"op": op}))
 
+    def fetch_telemetry(self, ip: str, port: int) -> Dict:
+        """``telemetry`` snapshot (attribution / RT percentiles / timers)."""
+        return json.loads(self.get(ip, port, "telemetry"))
+
+    def fetch_traces(self, ip: str, port: int,
+                     limit: Optional[int] = None) -> Dict:
+        """Sampled decision traces (``traces`` command), drained first."""
+        params = {"drain": "true"}
+        if limit is not None:
+            params["limit"] = limit
+        return json.loads(self.get(ip, port, "traces", params))
+
     def rollout_command(self, ip: str, port: int, params: Dict,
                         body: str = "") -> Dict:
         """``rollout`` mutating ops (load/stage/promote/abort/tick)."""

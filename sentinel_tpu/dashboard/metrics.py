@@ -80,6 +80,13 @@ class InMemoryMetricsRepository:
                 if not series:
                     del self._data[key]
 
+    def apps(self) -> List[str]:
+        """Apps with any retained series (OpenMetrics export iterates
+        this, not discovery — aggregates can outlive a machine's
+        heartbeat within the retention window)."""
+        with self._lock:
+            return sorted({a for (a, _r) in self._data})
+
     def resources_of(self, app: str) -> List[str]:
         with self._lock:
             return sorted({r for (a, r) in self._data if a == app})

@@ -25,6 +25,11 @@ set as a small JSON API plus one static page:
   * ``GET  /resource/machineResource.json?ip=&port=``    clusterNode proxy
   * ``GET  /rollout/status.json?app=``        staged-rollout state
   * ``GET  /rollout/diff.json?app=``          shadow-vs-live outcome deltas
+  * ``GET  /metrics``                         dashboard aggregates as
+    OpenMetrics text (fleet view; each engine serves its own /metrics)
+  * ``GET  /telemetry/summary.json?app=``     engine telemetry snapshot
+  * ``GET  /telemetry/traces.json?app=``      sampled decision traces
+    (both proxy the machines' ``telemetry`` / ``traces`` commands)
   * ``POST /rollout/command?app=&op=``        stage/canary/promote/abort/tick
     (no reference twin — proxies the engines' ``rollout`` command)
   * ``POST /cluster/assign?app=&ip=&port=``   token-server assignment
@@ -57,10 +62,12 @@ from sentinel_tpu.dashboard.metrics import InMemoryMetricsRepository, MetricFetc
 
 RULE_TYPES = ("flow", "degrade", "system", "authority", "paramFlow")
 _STATIC_DIR = Path(__file__).parent / "static"
-# LoginAuthenticationFilter exemptions: login itself, the UI shell, and
-# the heartbeat receiver (engines are not logged-in browsers).
+# LoginAuthenticationFilter exemptions: login itself, the UI shell, the
+# heartbeat receiver (engines are not logged-in browsers), and the
+# OpenMetrics endpoint (scrapers are not logged-in browsers either; it
+# exposes aggregate numbers only, no rule mutation).
 _PUBLIC_PATHS = ("/", "/index.html", "/auth/login", "/auth/check",
-                 "/registry/machine")
+                 "/registry/machine", "/metrics")
 
 
 def _flat_qs(qs: str) -> Dict[str, str]:
@@ -217,6 +224,16 @@ class DashboardServer:
         m = self._first_healthy(app)
         return self.api.fetch_rollout(m.ip, m.port, op)
 
+    def get_telemetry(self, app: str, kind: str = "summary",
+                      limit: Optional[int] = None):
+        """Engine telemetry read path: attribution/histogram snapshot
+        (kind='summary') or sampled decision traces (kind='traces') from
+        the first healthy machine."""
+        m = self._first_healthy(app)
+        if kind == "traces":
+            return self.api.fetch_traces(m.ip, m.port, limit=limit)
+        return self.api.fetch_telemetry(m.ip, m.port)
+
     def rollout_command(self, app: str, params: Dict[str, str],
                         body: str = "") -> Dict:
         """Staged-rollout mutation (load/stage/promote/abort/tick) pushed
@@ -282,6 +299,14 @@ class _Handler(BaseHTTPRequestHandler):
     def _fail(self, msg: str, code: int = 400):
         self._json({"success": False, "code": code, "msg": msg, "data": None},
                    code=code)
+
+    def _text(self, text: str, ctype: str, code: int = 200):
+        data = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _static(self, name: str):
         path = _STATIC_DIR / name
@@ -432,6 +457,20 @@ class _Handler(BaseHTTPRequestHandler):
             if path in ("/rollout/status.json", "/rollout/diff.json"):
                 op = "diff" if path.endswith("diff.json") else "status"
                 return self._ok(d.get_rollout(q.get("app", ""), op))
+            if path == "/metrics":
+                from sentinel_tpu.telemetry.exporter import (
+                    render_dashboard_metrics)
+                from sentinel_tpu.telemetry.openmetrics import (
+                    OPENMETRICS_CONTENT_TYPE)
+
+                return self._text(render_dashboard_metrics(d),
+                                  OPENMETRICS_CONTENT_TYPE)
+            if path in ("/telemetry/summary.json", "/telemetry/traces.json"):
+                kind = "traces" if path.endswith("traces.json") else "summary"
+                limit = q.get("limit")
+                return self._ok(d.get_telemetry(
+                    q.get("app", ""), kind,
+                    limit=int(limit) if limit else None))
             if path == "/rollout/command":
                 # Mutating: POST-only, like /cluster/assign above.
                 if self.command != "POST":

@@ -9,7 +9,10 @@ tensor design makes natural:
     measures host-side submit cost), and a *sampled* synchronous wall
     time every ``sync_every``-th dispatch (block on the decisions) that
     estimates true end-to-end step latency without serializing the
-    steady-state stream. Snapshots feed the ``profile`` ops command.
+    steady-state stream. The cadence is config-tunable
+    (``csp.sentinel.profile.syncEvery``). Snapshots report p50/p95/p99
+    per kind and feed the ``profile`` ops command plus the OpenMetrics
+    exporter (sentinel_tpu/telemetry/).
   * **kernel traces** — :func:`trace` wraps ``jax.profiler`` so a window
     of real traffic can be captured for TensorBoard/Perfetto kernel-level
     inspection.
@@ -68,11 +71,13 @@ class StepTimer:
                     "dispatches": n,
                     "entries": self._entries.get(kind, 0),
                     "enqueueP50Ms": round(float(np.percentile(enq, 50)), 3),
+                    "enqueueP95Ms": round(float(np.percentile(enq, 95)), 3),
                     "enqueueP99Ms": round(float(np.percentile(enq, 99)), 3),
                 }
                 if sync:
                     s = np.asarray(sync)
                     row["stepP50Ms"] = round(float(np.percentile(s, 50)), 3)
+                    row["stepP95Ms"] = round(float(np.percentile(s, 95)), 3)
                     row["stepP99Ms"] = round(float(np.percentile(s, 99)), 3)
                     row["stepSamples"] = len(sync)
                 out[kind] = row

@@ -123,14 +123,22 @@ class AuthorityRuleManager(RuleManager):
     """Wholesale-swap registry (reference: ``AuthorityRuleManager``)."""
 
 
+class AuthorityVerdict(NamedTuple):
+    blocked: jax.Array  # bool[N]
+    slot: jax.Array  # int32[N] first-blocking rule slot (-1 = not blocked)
+
+
 def check_authority(
     rt: AuthorityRuleTensors,
     batch: EntryBatch,
     candidate: jax.Array,  # bool[N]
-) -> jax.Array:
-    """Vectorized ``AuthorityRuleChecker.passCheck``: bool[N] blocked."""
+) -> AuthorityVerdict:
+    """Vectorized ``AuthorityRuleChecker.passCheck``."""
     n = batch.size
     blocked = jnp.zeros((n,), bool)
+    # First blocking rule slot per request (sequential chain's throw
+    # site) for decision attribution; -1 while unblocked.
+    first_slot = jnp.full((n,), -1, jnp.int32)
     has_origin = batch.origin_id >= 0
 
     for k in range(rt.slots):
@@ -148,6 +156,8 @@ def check_authority(
         ok = jnp.where(strat == C.AUTHORITY_WHITE, member, ~member)
         # Empty-origin requests always pass (reference checker's early out).
         applicable = has_rule & candidate & has_origin
-        blocked = blocked | (applicable & (~ok))
+        slot_blocked = applicable & (~ok)
+        first_slot = jnp.where(slot_blocked & (~blocked), k, first_slot)
+        blocked = blocked | slot_blocked
 
-    return blocked
+    return AuthorityVerdict(blocked=blocked, slot=first_slot)

@@ -184,6 +184,7 @@ class DegradeRuleManager(RuleManager):
 class DegradeVerdict(NamedTuple):
     blocked: jax.Array  # bool[N]
     state: DegradeState
+    slot: jax.Array  # int32[N] first-blocking rule slot (-1 = not blocked)
 
 
 def check_degrade(
@@ -196,6 +197,9 @@ def check_degrade(
     """Vectorized ``CircuitBreaker.tryPass`` over the micro-batch."""
     n = batch.size
     blocked = jnp.zeros((n,), bool)
+    # First blocking rule slot per request (sequential chain's throw
+    # site) for decision attribution; -1 while unblocked.
+    first_slot = jnp.full((n,), -1, jnp.int32)
     state = ds.state
     next_retry = ds.next_retry_ms
     probe_rules = []  # per-slot int32[N]: rule id probed by request i, or -1
@@ -219,6 +223,9 @@ def check_degrade(
         probe = has_rule & retry_due & first_in_segment(probe_ids, rt.num_rules)
 
         blocked_k = has_rule & (is_half | (is_open & ~probe))
+        # has_rule already excludes earlier-slot blocks, so blocked_k is
+        # true at most once per request across the loop.
+        first_slot = jnp.where(blocked_k, k, first_slot)
         blocked = blocked | blocked_k
 
         # OPEN -> HALF_OPEN where a probe was admitted.
@@ -236,7 +243,8 @@ def check_degrade(
         dead = jnp.where(blocked, pr, -1)
         state = state.at[W.oob(dead, rt.num_rules)].set(C.BREAKER_OPEN, mode="drop")
 
-    return DegradeVerdict(blocked=blocked, state=ds._replace(state=state))
+    return DegradeVerdict(blocked=blocked, state=ds._replace(state=state),
+                          slot=first_slot)
 
 
 def feed_degrade(

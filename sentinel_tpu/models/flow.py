@@ -292,6 +292,7 @@ class FlowVerdict(NamedTuple):
     occupied: jax.Array  # bool[N] prioritized grant borrowing the next bucket
     occ_add: jax.Array  # int32[R] borrow counts granted this step, per node row
     state: FlowState
+    slot: jax.Array  # int32[N] first-blocking rule slot (-1 = not blocked)
 
 
 def _gather(arr, idx, fill):
@@ -401,7 +402,8 @@ def check_flow(
 
     survivors = FX.survivor_fixpoint(candidate, _blocked_for, batch.count)
 
-    blocked, wait_us, consumed, rl_cmax, occupied, occ_add = _eval_flow_slots(
+    (blocked, wait_us, consumed, rl_cmax, occupied, occ_add,
+     first_slot) = _eval_flow_slots(
         rt, fs, w1, cur_threads, batch, now_ms, candidate,
         survivors=survivors, extra_pass=extra_pass,
         occupied_next=occupied_next, extra_next=extra_next,
@@ -422,7 +424,7 @@ def check_flow(
         latest_passed_us=jnp.where(consumed > 0, new_latest, fs.latest_passed_us)
     )
     return FlowVerdict(blocked=blocked, wait_us=wait_us, occupied=occupied,
-                       occ_add=occ_add, state=fs)
+                       occ_add=occ_add, state=fs, slot=first_slot)
 
 
 def _eval_flow_slots(
@@ -468,6 +470,10 @@ def _eval_flow_slots(
     ent3 = jnp.stack([c[:, 1] for c in cols], axis=1)
 
     blocked = jnp.zeros((n,), bool)
+    # First rule slot (per-resource load order) that blocked each request
+    # — the sequential chain's throw site, surfaced for decision
+    # attribution (telemetry/attribution.py). -1 while unblocked.
+    first_slot = jnp.full((n,), -1, jnp.int32)
     # Cond-gated accumulators: varying-typed seeds (W.varying_zeros) so
     # the no-traffic branches type-check under shard_map.
     wait_us = W.varying_zeros(batch.count, (n,), jnp.int64)
@@ -685,6 +691,7 @@ def _eval_flow_slots(
                 jnp.any(occ_cand), _occupy, lambda args: args,
                 (occupied, wait_us, slot_blocked, occ_add))
 
+        first_slot = jnp.where(slot_blocked & (~blocked), k, first_slot)
         blocked = blocked | slot_blocked
 
         # Bucket tokens are consumed only by requests that survive every
@@ -708,4 +715,4 @@ def _eval_flow_slots(
         consumed, rl_cmax = jax.lax.cond(
             any_rl, _consume, lambda args: args, (consumed, rl_cmax))
 
-    return blocked, wait_us, consumed, rl_cmax, occupied, occ_add
+    return blocked, wait_us, consumed, rl_cmax, occupied, occ_add, first_slot

@@ -296,6 +296,7 @@ class ParamVerdict(NamedTuple):
     blocked: jax.Array  # bool[N]
     wait_us: jax.Array  # int64[N] throttle-mode sleep-then-pass
     state: ParamFlowState
+    slot: jax.Array  # int32[N] first-blocking rule slot (-1 = not blocked)
 
 
 def _gather1(arr, idx, fill):
@@ -411,6 +412,9 @@ def _eval_param(
     table_slots = ps.key.shape[1]
 
     blocked = jnp.zeros((n,), bool)
+    # First blocking rule slot per request (sequential chain's throw
+    # site) for decision attribution; -1 while unblocked.
+    first_slot = jnp.full((n,), -1, jnp.int32)
     wait_us = jnp.zeros((n,), jnp.int64)
     now_us = now_ms.astype(jnp.int64) * 1000
 
@@ -513,6 +517,7 @@ def _eval_param(
         ok = jnp.where(is_thread, thread_ok, jnp.where(is_rl, rl_ok, qps_ok))
 
         slot_blocked = applicable & (~ok)
+        first_slot = jnp.where(slot_blocked & (~blocked), k, first_slot)
         blocked = blocked | slot_blocked
         admitted = applicable & ok & survivors
         wait_us = jnp.maximum(wait_us, jnp.where(admitted & is_rl, rl_wait, 0))
@@ -630,7 +635,8 @@ def _eval_param(
                 jnp.any(applicable & is_thread), _advance_threads,
                 lambda t: t, ps.threads))
 
-    return ParamVerdict(blocked=blocked, wait_us=wait_us, state=ps)
+    return ParamVerdict(blocked=blocked, wait_us=wait_us, state=ps,
+                        slot=first_slot)
 
 
 def feed_param_exit(

@@ -35,6 +35,12 @@ from sentinel_tpu.models import param_flow as P
 from sentinel_tpu.models import system as Y
 from sentinel_tpu.ops import segment as seg
 from sentinel_tpu.ops import window as W
+from sentinel_tpu.telemetry.attribution import (
+    NUM_ATTR_REASONS,
+    NUM_RT_BUCKETS,
+    REASON_CHANNEL_TABLE,
+    rt_bucket_index,
+)
 
 SPEC_1S = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
 SPEC_60S = W.WindowSpec(C.MINUTE_WINDOW_MS, C.MINUTE_BUCKETS)
@@ -95,6 +101,64 @@ class ShadowState(NamedTuple):
     counts: jax.Array     # int64[NUM_SHADOW_COUNTERS, R] cumulative
 
 
+class TelemetryState(NamedTuple):
+    """Cumulative device-resident telemetry (sentinel_tpu/telemetry/).
+
+    The attribution/histogram deltas commit as ONE in-place single-column
+    scatter each into the int32 STAGING tensors, and ``totals`` is
+    derived from the second accumulator the stat commit already stages —
+    never a second sweep. The wide int64 cumulative tensors fold once
+    per second on the ``_roll_second`` ride (the SecondAccum trick —
+    updating them per step, or riding the shared bincount as extra value
+    columns, each measured ~7-13% on the tier-1 bench step; staged
+    scatters are inside measurement noise). Read-side,
+    :func:`telemetry_view` adds the live staging back, so counter reads
+    are exact at any instant. Counters are cumulative since engine start
+    (Prometheus counter semantics; a restart is an ordinary reset).
+    """
+
+    # Blocked counts per (reason family, node row); channel order is
+    # telemetry.attribution.ATTR_REASON_VALUES. Oracle-exact: the step's
+    # reason codes follow the sequential chain's first-blocking order.
+    block_by_reason: jax.Array  # int64[NUM_ATTR_REASONS, R]
+    # Success-completion RT histogram per node row, log2 bucket edges
+    # (telemetry.attribution.RT_BUCKET_EDGES_MS + overflow).
+    rt_hist: jax.Array          # int64[NUM_RT_BUCKETS, R]
+    # Cumulative MetricEvent totals per node row (the instant/minute
+    # windows forget; exporters need monotonic counters). Folded from
+    # ``sec.counts`` — which already carries every commit, including
+    # occupy grants — so it costs nothing per step.
+    totals: jax.Array           # int64[NUM_EVENTS, R]
+    # Current-second staging (the only per-step telemetry writes).
+    stage_attr: jax.Array       # int32[NUM_ATTR_REASONS, R]
+    stage_hist: jax.Array       # int32[NUM_RT_BUCKETS, R]
+
+
+def make_telemetry_state(num_rows: int) -> TelemetryState:
+    return TelemetryState(
+        block_by_reason=jnp.zeros((NUM_ATTR_REASONS, num_rows), jnp.int64),
+        rt_hist=jnp.zeros((NUM_RT_BUCKETS, num_rows), jnp.int64),
+        totals=jnp.zeros((C.NUM_EVENTS, num_rows), jnp.int64),
+        stage_attr=jnp.zeros((NUM_ATTR_REASONS, num_rows), jnp.int32),
+        stage_hist=jnp.zeros((NUM_RT_BUCKETS, num_rows), jnp.int32),
+    )
+
+
+def telemetry_view(state: "SentinelState") -> TelemetryState:
+    """Read-side exact telemetry: cumulative plus the live staged second
+    (staging zeroed in the returned view — it has been folded in). Works
+    on pod states too (leading device axis broadcasts elementwise)."""
+    tele = state.telemetry
+    return TelemetryState(
+        block_by_reason=tele.block_by_reason
+        + tele.stage_attr.astype(jnp.int64),
+        rt_hist=tele.rt_hist + tele.stage_hist.astype(jnp.int64),
+        totals=tele.totals + state.sec.counts.astype(jnp.int64),
+        stage_attr=jnp.zeros_like(tele.stage_attr),
+        stage_hist=jnp.zeros_like(tele.stage_hist),
+    )
+
+
 class SentinelState(NamedTuple):
     """All mutable device state. One pytree, donated every step."""
 
@@ -114,6 +178,10 @@ class SentinelState(NamedTuple):
     # exactly like a borrow bucket the ring never rotates into.
     occupied_next: jax.Array   # int32[R] pending borrow counts per node row
     occupied_stamp: jax.Array  # int64[] bucket-start of the granting bucket
+    # Decision attribution + RT histograms + cumulative totals
+    # (sentinel_tpu/telemetry/) — always present; per-step cost is one
+    # in-place staging scatter per direction (see TelemetryState).
+    telemetry: TelemetryState
     # Staged-rollout shadow world, present only while a candidate ruleset
     # is installed (None otherwise — installing/removing one is a pytree
     # STRUCTURE change, i.e. exactly one retrace, like a rule-shape change).
@@ -154,6 +222,7 @@ def make_state(num_rows: int, flow_rules: int, now_ms: int,
         ),
         occupied_next=jnp.zeros((num_rows,), jnp.int32),
         occupied_stamp=jnp.int64(-1),
+        telemetry=make_telemetry_state(num_rows),
     )
 
 
@@ -177,13 +246,17 @@ def make_shadow_state(num_rows: int, shadow_rules: RulePack,
 
 
 def _roll_second(
-    w60: W.Window, sec: SecondAccum, now_ms: jax.Array
-) -> Tuple[W.Window, SecondAccum]:
+    w60: W.Window, sec: SecondAccum, telemetry: TelemetryState,
+    now_ms: jax.Array
+) -> Tuple[W.Window, SecondAccum, TelemetryState]:
     """Fold the staged second into the minute window if the second rolled.
 
     The fold rotates only the stamped bucket (lazy reset, exactly
     ``LeapArray.currentWindow`` semantics) and lands the whole [E, R] delta
     with one dense add — at most once per second instead of per step.
+    The cumulative telemetry counters fold on the same ride (and from the
+    same pre-reset ``sec.counts``), so the wide int64 tensors are touched
+    once per second, not per step.
     """
     sec_start = now_ms.astype(jnp.int64) - now_ms.astype(jnp.int64) % SPEC_60S.bucket_ms
     need = (sec.stamp >= 0) & (sec.stamp != sec_start)
@@ -195,24 +268,37 @@ def _roll_second(
         min_rt = wf.min_rt.at[idx].set(jnp.minimum(wf.min_rt[idx], sec.min_rt))
         return W.Window(counts, min_rt, wf.starts)
 
+    def fold_tele(t):
+        return TelemetryState(
+            block_by_reason=t.block_by_reason + t.stage_attr.astype(jnp.int64),
+            rt_hist=t.rt_hist + t.stage_hist.astype(jnp.int64),
+            totals=t.totals + sec.counts.astype(jnp.int64),
+            stage_attr=jnp.zeros_like(t.stage_attr),
+            stage_hist=jnp.zeros_like(t.stage_hist),
+        )
+
     w60 = jax.lax.cond(need, fold, lambda w: w, w60)
+    telemetry = jax.lax.cond(need, fold_tele, lambda t: t, telemetry)
     return w60, SecondAccum(
         counts=jnp.where(need, 0, sec.counts),
         min_rt=jnp.where(need, W.MIN_RT_EMPTY, sec.min_rt),
         stamp=sec_start,
-    )
+    ), telemetry
 
 
 def flush_seconds(state: SentinelState, now_ms: jax.Array) -> SentinelState:
-    """Host-boundary flush: fold any completed staged second into ``w60``.
+    """Host-boundary flush: fold any completed staged second into ``w60``
+    (and the cumulative telemetry counters).
 
     Called by the engine before reading the minute window (metric sealing).
     A stamp equal to the current second stays staged — that second is not
-    sealed yet anyway.
+    sealed yet anyway (telemetry readers add live staging back through
+    :func:`telemetry_view`).
     """
     now_ms = jnp.asarray(now_ms, jnp.int64)
-    w60, sec = _roll_second(state.w60, state.sec, now_ms)
-    return state._replace(w60=w60, sec=sec)
+    w60, sec, telemetry = _roll_second(state.w60, state.sec,
+                                       state.telemetry, now_ms)
+    return state._replace(w60=w60, sec=sec, telemetry=telemetry)
 
 
 def _target_rows(cluster_row, dn_row, origin_row, entry_in):
@@ -295,15 +381,18 @@ def _shadow_entry_eval(
     prioritized request the candidate would reject counts as would-block.
 
     Returns ``(s_blocked, s_reason, s_wait_us, new_shadow_substate_parts,
-    rotated_shadow_w1, per-family block masks)``.
+    rotated_shadow_w1, per-family block masks, s_slot)``.
     """
     sh = state.shadow
     lanes = batch.cluster_row >= 0  # every real lane, pre-decided or not
     sh_w1 = W.rotate(sh.w1, now_ms, spec1)
 
     s_reason = jnp.where(lanes, C.BlockReason.PASS, -1).astype(jnp.int32)
-    s_auth = A.check_authority(shadow_rules.authority, batch, lanes)
+    s_slot = jnp.full_like(s_reason, -1)
+    s_av = A.check_authority(shadow_rules.authority, batch, lanes)
+    s_auth = s_av.blocked
     s_reason = jnp.where(lanes & s_auth, C.BlockReason.AUTHORITY, s_reason)
+    s_slot = jnp.where(lanes & s_auth, s_av.slot, s_slot)
     s_blocked = s_auth
 
     cand = lanes & (~s_blocked)
@@ -314,6 +403,7 @@ def _shadow_entry_eval(
                            w60_live, sec_counts, state.cur_threads, batch,
                            cand, now_ms, spec1=spec1)
     s_reason = jnp.where(cand & s_sys, C.BlockReason.SYSTEM, s_reason)
+    s_slot = jnp.where(cand & s_sys, 0, s_slot)
     s_blocked = s_blocked | s_sys
 
     cand = lanes & (~s_blocked)
@@ -321,6 +411,7 @@ def _shadow_entry_eval(
                               cand, extra_cms=shadow_extra_cms)
     s_reason = jnp.where(cand & s_pv.blocked, C.BlockReason.PARAM_FLOW,
                          s_reason)
+    s_slot = jnp.where(cand & s_pv.blocked, s_pv.slot, s_slot)
     s_blocked = s_blocked | s_pv.blocked
 
     s_fv = F.check_flow(shadow_rules.flow, sh.flow, sh_w1, state.cur_threads,
@@ -329,6 +420,7 @@ def _shadow_entry_eval(
                         occupy_timeout_ms=occupy_timeout_ms)
     s_flow = lanes & (~s_blocked) & s_fv.blocked
     s_reason = jnp.where(s_flow, C.BlockReason.FLOW, s_reason)
+    s_slot = jnp.where(s_flow, s_fv.slot, s_slot)
     s_blocked = s_blocked | s_fv.blocked
 
     cand = lanes & (~s_blocked)
@@ -336,13 +428,14 @@ def _shadow_entry_eval(
                            cand)
     s_degr = cand & s_dv.blocked
     s_reason = jnp.where(s_degr, C.BlockReason.DEGRADE, s_reason)
+    s_slot = jnp.where(s_degr, s_dv.slot, s_slot)
     s_blocked = s_blocked | s_dv.blocked
 
     s_wait_us = jnp.where(lanes & (~s_blocked),
                           jnp.maximum(s_fv.wait_us, s_pv.wait_us), 0)
     fam_blocks = (s_auth & lanes, s_sys, s_pv.blocked & lanes, s_flow, s_degr)
     return (s_blocked & lanes, s_reason, s_wait_us,
-            (s_fv.state, s_pv.state, s_dv.state), sh_w1, fam_blocks)
+            (s_fv.state, s_pv.state, s_dv.state), sh_w1, fam_blocks, s_slot)
 
 
 def entry_step(
@@ -389,7 +482,8 @@ def entry_step(
     # Minute-window commits are staged in the [E, R] second accumulator and
     # folded at most once per second; readers (BBR check below, host metric
     # sealing) combine w60 + the live accumulator themselves.
-    w60, sec = _roll_second(state.w60, state.sec, now_ms)
+    w60, sec, tele = _roll_second(state.w60, state.sec, state.telemetry,
+                                  now_ms)
 
     # Land pending occupy borrows: once the bucket after the granting one is
     # current, its borrowed counts become real PASS there (reference:
@@ -406,9 +500,13 @@ def entry_step(
 
     valid = batch.cluster_row >= 0
     reason = jnp.where(valid, C.BlockReason.PASS, -1).astype(jnp.int32)
+    # First-blocking rule slot beside the reason (decision attribution —
+    # telemetry/attribution.py): -1 until a slotted family blocks.
+    rule_slot = jnp.full_like(reason, -1)
     # Remote token-server rejections arrive pre-decided: record the block
     # (StatisticSlot catches the cluster FlowException the same way) and
-    # skip every local slot.
+    # skip every local slot. Their rule identity lives on the token
+    # server — rule_slot stays -1 ("remote/unknown").
     blocked = valid & batch.pre_blocked
     reason = jnp.where(blocked, C.BlockReason.FLOW, reason)
     # Host-leased admissions (core/lease.py) arrive pre-PASSED: commit
@@ -420,8 +518,10 @@ def entry_step(
 
     # --- rule slots (order mirrors the reference chain: authority →
     # system → param-flow → flow → degrade) --------------------------------
-    auth_blocked = A.check_authority(rules.authority, batch, valid & (~decided))
+    av = A.check_authority(rules.authority, batch, valid & (~decided))
+    auth_blocked = av.blocked
     reason = jnp.where(valid & (~decided) & auth_blocked, C.BlockReason.AUTHORITY, reason)
+    rule_slot = jnp.where(valid & (~decided) & auth_blocked, av.slot, rule_slot)
     blocked = blocked | auth_blocked
     decided = decided | blocked
 
@@ -430,6 +530,8 @@ def entry_step(
                                  sec.counts, state.cur_threads, batch, cand,
                                  now_ms, spec1=spec1)
     reason = jnp.where(cand & sys_blocked, C.BlockReason.SYSTEM, reason)
+    # System rules are one global set, not per-resource slots: slot 0.
+    rule_slot = jnp.where(cand & sys_blocked, 0, rule_slot)
     blocked = blocked | sys_blocked
     decided = decided | blocked
 
@@ -437,14 +539,17 @@ def entry_step(
     pv = P.check_param_flow(rules.param, state.param, batch, now_ms, cand,
                             extra_cms=extra_cms)
     reason = jnp.where(cand & pv.blocked, C.BlockReason.PARAM_FLOW, reason)
+    rule_slot = jnp.where(cand & pv.blocked, pv.slot, rule_slot)
     blocked = blocked | pv.blocked
     decided = decided | blocked
 
-    for chk in extra_checkers:
+    for chk_idx, chk in enumerate(extra_checkers):
         cand = valid & (~decided)
         custom_blocked = cand & chk(state._replace(w1=w1), rules, batch,
                                     now_ms, cand)
         reason = jnp.where(custom_blocked, C.BlockReason.CUSTOM, reason)
+        # CUSTOM attribution: the splice position of the blocking checker.
+        rule_slot = jnp.where(custom_blocked, chk_idx, rule_slot)
         blocked = blocked | custom_blocked
         decided = decided | blocked
 
@@ -455,6 +560,7 @@ def entry_step(
                       extra_next_global=extra_next_global, spec=spec1,
                       occupy_timeout_ms=occupy_timeout_ms)
     reason = jnp.where(valid & (~decided) & fv.blocked, C.BlockReason.FLOW, reason)
+    rule_slot = jnp.where(valid & (~decided) & fv.blocked, fv.slot, rule_slot)
     blocked = blocked | fv.blocked
     decided = decided | blocked
 
@@ -464,6 +570,7 @@ def entry_step(
     dv = D.check_degrade(rules.degrade, state.degrade, batch, now_ms,
                          valid & (~decided) & (~granted))
     reason = jnp.where(valid & (~decided) & dv.blocked, C.BlockReason.DEGRADE, reason)
+    rule_slot = jnp.where(valid & (~decided) & dv.blocked, dv.slot, rule_slot)
     blocked = blocked | dv.blocked
 
     # --- shadow lanes (sentinel_tpu/rollout/) -----------------------------
@@ -480,7 +587,8 @@ def entry_step(
             state, shadow_rules, batch, now_ms, w1, w60, sec.counts, spec1,
             occupy_timeout_ms, shadow_extra_pass=shadow_extra_pass,
             shadow_extra_cms=shadow_extra_cms)
-        s_blocked, s_reason, s_wait_us, s_states, sh_w1, s_fam = s_eval
+        (s_blocked, s_reason, s_wait_us, s_states, sh_w1, s_fam,
+         s_slot) = s_eval
         if canary_bps is not None:
             # Canary enforcement: deterministic (origin, context) hash
             # selects a stable slice of traffic the candidate governs.
@@ -495,6 +603,7 @@ def entry_step(
                        canary_bps))
             blocked = jnp.where(mix, s_blocked, blocked)
             reason = jnp.where(mix, s_reason, reason)
+            rule_slot = jnp.where(mix, s_slot, rule_slot)
             wait_pick = jnp.where(mix, s_wait_us, wait_pick)
 
     # --- StatisticSlot commit --------------------------------------------
@@ -511,6 +620,7 @@ def entry_step(
 
     thread_inc = jnp.broadcast_to(jnp.where(admit, 1, 0)[:, None], rows4.shape)
     extra_cols = [thread_inc]
+    sh_base = len(extra_cols)
     if s_eval is not None:
         # Every shadow commit — the shadow window's PASS plus all the
         # would-verdict counter channels — rides the live commit's
@@ -536,15 +646,38 @@ def entry_step(
 
     cur_threads = state.cur_threads + extras[0].astype(jnp.int32)
 
+    # Telemetry commit: ONE single-column scatter-add of the blocked
+    # lanes into the staged per-(reason, ClusterNode) counters. In-place
+    # on the donated staging tensor — measured cheaper than riding the
+    # shared bincount as 6 extra value columns, whose operand/target
+    # widening cost ~13% of the bench step; a width-N single-column
+    # scatter is noise on both backends (CPU scatter-add; TPU ~7ns/
+    # update × N). ``reason`` here is post-canary-mix, so attribution
+    # always matches what the live windows recorded for the lane.
+    # ``totals`` needs NO write at all — it folds from ``sec.counts`` at
+    # second-roll, and the second staging already carries every commit
+    # including occupy grants (the ``occ_add`` adds below —
+    # StatisticNode.addOccupiedPass semantics).
+    attr_ch = jnp.asarray(REASON_CHANNEL_TABLE)[
+        jnp.clip(reason, 0, REASON_CHANNEL_TABLE.shape[0] - 1)]
+    attr_on = valid & blocked & (attr_ch >= 0)
+    attr_rows = W.oob(jnp.where(attr_on, batch.cluster_row, -1), w1.num_rows)
+    tele = tele._replace(stage_attr=tele.stage_attr.at[
+        jnp.maximum(attr_ch, 0), attr_rows].add(
+        jnp.where(attr_on, batch.count, 0), mode="drop"))
+
     if s_eval is not None:
         sh_w1 = sh_w1._replace(counts=sh_w1.counts.at[
-            idx1, C.MetricEvent.PASS].add(extras[1].astype(jnp.int32)))
+            idx1, C.MetricEvent.PASS].add(extras[sh_base].astype(jnp.int32)))
         counts = state.shadow.counts
         for ch, vec in (
-                (SH_WOULD_PASS, extras[1]), (SH_WOULD_BLOCK, extras[2]),
-                (SH_WB_AUTHORITY, extras[3]), (SH_WB_SYSTEM, extras[4]),
-                (SH_WB_PARAM, extras[5]), (SH_WB_FLOW, extras[6]),
-                (SH_WB_DEGRADE, extras[7]),
+                (SH_WOULD_PASS, extras[sh_base]),
+                (SH_WOULD_BLOCK, extras[sh_base + 1]),
+                (SH_WB_AUTHORITY, extras[sh_base + 2]),
+                (SH_WB_SYSTEM, extras[sh_base + 3]),
+                (SH_WB_PARAM, extras[sh_base + 4]),
+                (SH_WB_FLOW, extras[sh_base + 5]),
+                (SH_WB_DEGRADE, extras[sh_base + 6]),
                 (SH_LIVE_PASS, delta[C.MetricEvent.PASS]),
                 (SH_LIVE_BLOCK, delta[C.MetricEvent.BLOCK])):
             counts = counts.at[ch].add(vec.astype(jnp.int64))
@@ -559,8 +692,10 @@ def entry_step(
                               sys_signals=state.sys_signals, sec=sec,
                               occupied_next=occupied_next,
                               occupied_stamp=occupied_stamp,
+                              telemetry=tele,
                               shadow=shadow_new)
-    return new_state, Decisions(reason=reason, wait_us=wait_us)
+    return new_state, Decisions(reason=reason, wait_us=wait_us,
+                                rule_slot=rule_slot)
 
 
 def exit_step(
@@ -583,7 +718,8 @@ def exit_step(
     """
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, spec1)
-    w60, sec = _roll_second(state.w60, state.sec, now_ms)
+    w60, sec, tele = _roll_second(state.w60, state.sec, state.telemetry,
+                                  now_ms)
 
     valid = batch.cluster_row >= 0
     rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
@@ -602,6 +738,24 @@ def exit_step(
                 (C.MetricEvent.RT, rt4, True)], w1.num_rows,
         extra_cols=[thread_dec])
     w1, sec = _apply_delta(w1, sec, delta, now_ms, spec1)
+    # Device-side log-bucketed RT histogram (telemetry/attribution.py):
+    # one single-column scatter-add of success completions into the
+    # staged per-(bucket, ClusterNode) counters — per-resource latency
+    # percentiles replace relying on the avg-only RT/SUCCESS ratio for
+    # tail visibility. In-place on the donated staging tensor (see the
+    # entry commit's attribution note for why this beats extra bincount
+    # columns); the int64 histogram folds at second-roll, and totals
+    # ride sec.counts — no per-step write to the wide tensors.
+    bidx = rt_bucket_index(batch.rt_ms)
+    succ_mask = valid & batch.success
+    hist_rows = W.oob(jnp.where(succ_mask, batch.cluster_row, -1),
+                      w1.num_rows)
+    # Weight 1 per COMPLETION, not per acquire token: the RT sum records
+    # each completion's rt once (reference Tracer semantics), and the
+    # OpenMetrics histogram contract requires _bucket/_count/_sum to
+    # describe the same observation stream.
+    telemetry = tele._replace(stage_hist=tele.stage_hist.at[
+        bidx, hist_rows].add(jnp.where(succ_mask, 1, 0), mode="drop"))
 
     # min-RT: stage one dense [R] min then fold into the current buckets.
     num_rows = w1.num_rows
@@ -634,4 +788,4 @@ def exit_step(
 
     return state._replace(w1=w1, w60=w60, cur_threads=cur_threads,
                           degrade=degrade, param=param, sec=sec,
-                          shadow=shadow)
+                          telemetry=telemetry, shadow=shadow)

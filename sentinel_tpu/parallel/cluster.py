@@ -165,6 +165,20 @@ def global_shadow_counts(state: S.SentinelState) -> Optional[jax.Array]:
     return jnp.sum(state.shadow.counts, axis=0)
 
 
+def global_telemetry_counts(state: S.SentinelState) -> S.TelemetryState:
+    """Pod-global decision attribution / RT histograms / totals from a
+    [D, ...] pod state: each device's step accumulated only its own
+    shard's lanes (the telemetry columns ride each device's local
+    bincount), so the pod view is the device-axis sum — the same
+    reduction the in-step psum applies to the shared window, applied at
+    read time because cumulative counters are only read host-side
+    (keeping every device's steady-state step free of an extra
+    collective). The live staged second is folded in
+    (``S.telemetry_view``), so the read is exact at any instant."""
+    return jax.tree.map(lambda x: jnp.sum(x, axis=0),
+                        S.telemetry_view(state))
+
+
 def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True,
                    occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
                    shadow_rules=None, canary_bps=None, canary_salt=None):

@@ -1,0 +1,42 @@
+"""Device-integrated telemetry (no reference twin — the upstream stops at
+metric log files and ad-hoc JSON command dumps).
+
+Three pieces, spanning kernel to scrape endpoint:
+
+* **Decision attribution** (``attribution.py``): the fused step emits a
+  per-entry block-reason code (family × first-blocking-rule slot) beside
+  the verdict, and commits per-(resource, reason) counters inside the
+  same step — one in-place single-column scatter into an int32 staging
+  tensor, folded into the cumulative int64 counters once per second on
+  the existing second-roll ride. Attribution is oracle-exact (the
+  first-blocking-slot order IS the sequential slot chain's;
+  docs/SEMANTICS.md "Attribution exactness") and costs no second pass
+  over the batch.
+* **Decision traces** (``trace_ring.py``): every Nth blocked entry is
+  pulled off-device asynchronously and retained host-side (resource,
+  origin, reason, rule slot, window snapshot) for the ``traces`` ops
+  command and the dashboard.
+* **Unified export** (``openmetrics.py`` + ``exporter.py``): one
+  Prometheus/OpenMetrics text endpoint (``/metrics`` on the command
+  center; ``telemetry`` ops command for JSON parity) exposing engine
+  counters, resilience channels, rollout guardrail state, StepTimer
+  percentiles, and the attribution/RT-histogram series under stable
+  ``sentinel_tpu_*`` names.
+"""
+
+from sentinel_tpu.telemetry.attribution import (  # noqa: F401
+    ATTR_REASON_NAMES,
+    ATTR_REASON_VALUES,
+    NUM_ATTR_REASONS,
+    NUM_RT_BUCKETS,
+    RT_BUCKET_EDGES_MS,
+    decode_reason_code,
+    encode_reason_code,
+    histogram_quantile,
+    rt_bucket_index,
+)
+from sentinel_tpu.telemetry.openmetrics import (  # noqa: F401
+    OPENMETRICS_CONTENT_TYPE,
+    OpenMetricsBuilder,
+)
+from sentinel_tpu.telemetry.trace_ring import DecisionTraceBuffer  # noqa: F401

@@ -1,0 +1,144 @@
+"""Block-reason attribution + RT histogram geometry (device/host shared).
+
+The fused step (``ops/step.py``) decides admit/block for every entry but
+the window tensors record only aggregate PASS/BLOCK per node row — an
+operator seeing a block-rate spike cannot tell WHICH family (or which
+rule of that family) is rejecting traffic. This module fixes the
+vocabulary both sides share:
+
+* **Reason channels**: the cumulative per-(resource, reason) counter
+  tensor carries one channel per blockable family, indexed by
+  :data:`ATTR_REASON_VALUES` order. The step commits blocked lanes with
+  ONE in-place single-column scatter into an int32 staging tensor (the
+  SecondAccum trick: the wide int64 cumulative fold happens once per
+  second, not per step — riding the shared bincount as 6 extra value
+  columns was measured at ~13% of the bench step; the scatter is noise).
+* **Reason codes**: the per-entry detail is ``(family, first-blocking
+  rule slot)`` packed into one int (``encode_reason_code``) — the slot is
+  the index into the resource's per-family rule list in load order,
+  exactly the position the sequential slot chain would have thrown from.
+* **RT buckets**: log2-spaced response-time histogram edges. The exit
+  step buckets each success completion on device and commits one column
+  per bucket, replacing avg-only RT readings with real percentiles
+  (``histogram_quantile``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+
+# Families a device verdict can attribute a block to, in channel order.
+# WAIT is not a block (pass-after-sleep) and PASS is not attributed.
+ATTR_REASON_VALUES: Tuple[int, ...] = (
+    int(C.BlockReason.FLOW),
+    int(C.BlockReason.DEGRADE),
+    int(C.BlockReason.SYSTEM),
+    int(C.BlockReason.AUTHORITY),
+    int(C.BlockReason.PARAM_FLOW),
+    int(C.BlockReason.CUSTOM),
+)
+ATTR_REASON_NAMES: Tuple[str, ...] = tuple(
+    C.BlockReason(v).name for v in ATTR_REASON_VALUES)
+NUM_ATTR_REASONS = len(ATTR_REASON_VALUES)
+
+# Channel index for a BlockReason value (-1 for PASS/WAIT).
+_CHANNEL_OF = {v: i for i, v in enumerate(ATTR_REASON_VALUES)}
+
+
+def reason_channel(reason: int) -> int:
+    return _CHANNEL_OF.get(int(reason), -1)
+
+
+# Device-side lookup: channel per BlockReason value (-1 = unattributed).
+# numpy, created at import — folds as a constant per trace (never a
+# cached tracer).
+REASON_CHANNEL_TABLE = np.full((max(int(v) for v in C.BlockReason) + 1,),
+                               -1, np.int32)
+for _v, _ch in _CHANNEL_OF.items():
+    REASON_CHANNEL_TABLE[_v] = _ch
+
+
+# Rule-slot field width in the packed reason code. MAX_SLOT_CODE bounds
+# the encodable slot index; real slot counts are the engine's per-family
+# ratchet (single digits in practice).
+_SLOT_BITS = 8
+MAX_SLOT_CODE = (1 << _SLOT_BITS) - 2  # one value reserved for "unknown"
+
+
+def encode_reason_code(reason: int, slot: int) -> int:
+    """``family × first-blocking-slot`` packed as one int.
+
+    ``slot`` is the 0-based index into the resource's rule list for the
+    blocking family; -1 (unknown — e.g. a remote token-server verdict
+    carries no local rule identity) encodes as the reserved top value.
+    ``reason`` 0 (PASS) always encodes to 0.
+    """
+    if reason == 0:
+        return 0
+    s = MAX_SLOT_CODE + 1 if slot < 0 else min(int(slot), MAX_SLOT_CODE)
+    return (int(reason) << _SLOT_BITS) | s
+
+
+def decode_reason_code(code: int) -> Tuple[int, int]:
+    """Inverse of :func:`encode_reason_code` -> ``(reason, slot)``."""
+    if code == 0:
+        return 0, -1
+    slot = code & ((1 << _SLOT_BITS) - 1)
+    return code >> _SLOT_BITS, (-1 if slot > MAX_SLOT_CODE else slot)
+
+
+# ---------------------------------------------------------------------------
+# RT histogram geometry: log2 buckets 1ms..4096ms + overflow. The top edge
+# clears DEFAULT_MAX_RT_MS (4900 is clamped on commit, landing in +Inf
+# only for the raw >4096 tail), and 14 buckets keep the per-step commit at
+# 14 extra bincount columns — shared-operand, one fused scatter.
+# ---------------------------------------------------------------------------
+
+RT_BUCKET_EDGES_MS: Tuple[int, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+NUM_RT_BUCKETS = len(RT_BUCKET_EDGES_MS) + 1  # + overflow (+Inf)
+
+# numpy, NOT jnp: created at import (never inside a trace, where a cached
+# jnp array would be a leaked tracer) and folded as a constant per trace.
+_EDGES = np.asarray(RT_BUCKET_EDGES_MS, np.int32)
+
+
+def rt_bucket_index(rt_ms: jax.Array) -> jax.Array:
+    """int32[N] histogram bucket per response time (device-side).
+
+    Bucket b counts ``rt <= edge_b`` (Prometheus ``le`` semantics per
+    bucket, cumulated at export time); the last bucket is the +Inf
+    overflow.
+    """
+    return jnp.sum(rt_ms[:, None] > _EDGES[None, :], axis=1).astype(jnp.int32)
+
+
+def histogram_quantile(counts: Sequence[float], q: float) -> float:
+    """Estimate the q-quantile (0..1) from per-bucket counts.
+
+    ``counts`` is indexed like :data:`RT_BUCKET_EDGES_MS` plus the
+    overflow bucket. Linear interpolation within the winning bucket
+    (Prometheus ``histogram_quantile`` convention); the overflow bucket
+    reports its lower edge. Returns 0.0 on an empty histogram.
+    """
+    total = float(sum(counts))
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for b, cnt in enumerate(counts):
+        prev = cum
+        cum += float(cnt)
+        if cum >= target and cnt > 0:
+            if b >= len(RT_BUCKET_EDGES_MS):  # overflow: no upper edge
+                return float(RT_BUCKET_EDGES_MS[-1])
+            lo = 0.0 if b == 0 else float(RT_BUCKET_EDGES_MS[b - 1])
+            hi = float(RT_BUCKET_EDGES_MS[b])
+            return lo + (hi - lo) * (target - prev) / float(cnt)
+    return float(RT_BUCKET_EDGES_MS[-1])

@@ -1,0 +1,217 @@
+"""Render one engine's full telemetry as OpenMetrics text.
+
+Everything an operator previously had to collect from four surfaces —
+Sentinel metric log files, the ``resilience`` / ``rollout`` / ``profile``
+JSON ops commands — plus the new device-resident attribution counters
+and RT histograms, under stable ``sentinel_tpu_*`` names any Prometheus
+scraper ingests. Served by the ``metrics`` command
+(``GET /metrics`` on the command center); the ``telemetry`` command is
+the JSON-parity view of the same numbers.
+
+Per-resource series cover ClusterNode rows with any recorded traffic
+(cardinality = active resources, the same set the metric log writes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.telemetry.attribution import (
+    ATTR_REASON_NAMES,
+    RT_BUCKET_EDGES_MS,
+)
+from sentinel_tpu.telemetry.openmetrics import OpenMetricsBuilder
+
+_EVENT_FAMILIES = (
+    # (family name, MetricEvent, help)
+    ("sentinel_tpu_pass", C.MetricEvent.PASS,
+     "Admitted entries per resource since engine start"),
+    ("sentinel_tpu_block", C.MetricEvent.BLOCK,
+     "Blocked entries per resource since engine start"),
+    ("sentinel_tpu_success", C.MetricEvent.SUCCESS,
+     "Successful completions per resource since engine start"),
+    ("sentinel_tpu_exception", C.MetricEvent.EXCEPTION,
+     "Business-exception completions per resource since engine start"),
+)
+
+
+def _active_rows(engine, counts: Dict[str, np.ndarray]) -> Dict[str, int]:
+    """resource -> ClusterNode row, for rows with any telemetry signal."""
+    from sentinel_tpu.core.registry import KIND_CLUSTER
+
+    totals = counts["totals"]
+    by_reason = counts["blockByReason"]
+    active = (totals.any(axis=0) | by_reason.any(axis=0))
+    out: Dict[str, int] = {}
+    for row, meta in enumerate(engine.registry.meta):
+        if meta.kind == KIND_CLUSTER and row < active.shape[0] \
+                and active[row]:
+            out[meta.resource] = row
+    return out
+
+
+def render_engine_metrics(engine) -> str:
+    counts = engine.telemetry_counts()
+    rows = _active_rows(engine, counts)
+    totals = counts["totals"]
+    by_reason = counts["blockByReason"]
+    rt_hist = counts["rtHist"]
+
+    b = OpenMetricsBuilder()
+
+    for name, ev, help_text in _EVENT_FAMILIES:
+        b.family(name, "counter", help_text)
+        for res, row in rows.items():
+            b.sample(name + "_total", {"resource": res},
+                     int(totals[int(ev), row]))
+
+    b.family("sentinel_tpu_block_reason", "counter",
+             "Blocked entries per (resource, first-blocking rule family) "
+             "— device-exact attribution from the fused step")
+    for res, row in rows.items():
+        for ch, reason in enumerate(ATTR_REASON_NAMES):
+            v = int(by_reason[ch, row])
+            if v:
+                b.sample("sentinel_tpu_block_reason_total",
+                         {"resource": res, "reason": reason}, v)
+
+    b.family("sentinel_tpu_rt_ms", "histogram",
+             "Response time of successful completions, device-bucketed "
+             "(log2 edges, ms)")
+    for res, row in rows.items():
+        buckets = rt_hist[:, row]
+        if not buckets.any():
+            continue
+        b.histogram("sentinel_tpu_rt_ms", {"resource": res},
+                    [float(e) for e in RT_BUCKET_EDGES_MS],
+                    [float(x) for x in buckets],
+                    float(totals[int(C.MetricEvent.RT), row]))
+
+    # -- degradation channels (resilience_stats parity) -------------------
+    res_stats = engine.resilience_stats()
+    b.counter("sentinel_tpu_fail_open",
+              "Entries passed unguarded because no verdict could be "
+              "produced", res_stats["failOpenCount"])
+    b.counter("sentinel_tpu_cluster_fallback",
+              "Cluster-mode rule evaluations degraded to the local check",
+              res_stats["clusterFallbackCount"])
+    b.counter("sentinel_tpu_cluster_budget_exhausted",
+              "Entries whose remote-wait deadline budget ran out",
+              res_stats["clusterBudgetExhaustedCount"])
+    breaker = res_stats.get("tokenClientBreaker")
+    b.family("sentinel_tpu_token_client_breaker_state", "gauge",
+             "Token client health gate: 0=closed 1=open 2=half-open "
+             "(-1: no client)")
+    _BRK = {"CLOSED": 0, "OPEN": 1, "HALF_OPEN": 2}
+    b.sample("sentinel_tpu_token_client_breaker_state", None,
+             _BRK.get((breaker or {}).get("state"), -1))
+    b.family("sentinel_tpu_probe_last_success_age_ms", "gauge",
+             "Age of each registered health probe's last success")
+    for probe, snap in sorted(res_stats.get("probes", {}).items()):
+        age = snap.get("lastSuccessAgeMs")
+        if age is not None:
+            b.sample("sentinel_tpu_probe_last_success_age_ms",
+                     {"probe": probe}, age)
+
+    # -- staged rollout guardrail ----------------------------------------
+    guard = res_stats.get("rollout") or {}
+    b.family("sentinel_tpu_rollout_active", "gauge",
+             "1 while a candidate ruleset holds the device")
+    active = guard.get("activeCandidateSet")
+    b.sample("sentinel_tpu_rollout_active", None, 1 if active else 0)
+    if active:
+        b.family("sentinel_tpu_rollout", "info",
+                 "Active candidate set and stage")
+        b.sample("sentinel_tpu_rollout_info",
+                 {"name": active, "stage": guard.get("stage") or ""}, 1)
+    b.family("sentinel_tpu_rollout_breach_streak", "gauge",
+             "Consecutive guardrail windows over the block-rate delta")
+    b.sample("sentinel_tpu_rollout_breach_streak", None,
+             guard.get("breachStreak", 0))
+    b.family("sentinel_tpu_rollout_promotion_epoch", "gauge",
+             "Promotions since engine start")
+    b.sample("sentinel_tpu_rollout_promotion_epoch", None,
+             guard.get("promotionEpoch", 0))
+
+    # -- step timing (profile parity) ------------------------------------
+    timer = engine.step_timer.snapshot()
+    b.family("sentinel_tpu_step_dispatches", "counter",
+             "Device step dispatches per kind")
+    for kind, row in sorted(timer.items()):
+        b.sample("sentinel_tpu_step_dispatches_total", {"kind": kind},
+                 row["dispatches"])
+    b.family("sentinel_tpu_step_entries", "counter",
+             "Entries carried by device dispatches per kind")
+    for kind, row in sorted(timer.items()):
+        b.sample("sentinel_tpu_step_entries_total", {"kind": kind},
+                 row["entries"])
+    b.family("sentinel_tpu_step_ms", "gauge",
+             "Sampled synchronous step wall time percentiles (ms)")
+    for kind, row in sorted(timer.items()):
+        for q in ("50", "95", "99"):
+            v = row.get(f"stepP{q}Ms")
+            if v is not None:
+                b.sample("sentinel_tpu_step_ms",
+                         {"kind": kind, "quantile": f"0.{q}"}, v)
+    b.family("sentinel_tpu_enqueue_ms", "gauge",
+             "Dispatch enqueue wall time percentiles (ms)")
+    for kind, row in sorted(timer.items()):
+        for q in ("50", "95", "99"):
+            v = row.get(f"enqueueP{q}Ms")
+            if v is not None:
+                b.sample("sentinel_tpu_enqueue_ms",
+                         {"kind": kind, "quantile": f"0.{q}"}, v)
+
+    # -- trace sampling health -------------------------------------------
+    tsnap = engine.traces.snapshot(limit=0)
+    b.counter("sentinel_tpu_traces_seen_blocked",
+              "Blocked entries observed by the trace sampler",
+              tsnap["seenBlocked"])
+    b.counter("sentinel_tpu_traces_recorded",
+              "Decision traces retained in the host ring",
+              tsnap["recorded"])
+    b.counter("sentinel_tpu_traces_dropped_batches",
+              "Dispatched batches the sampler dropped (hand-off queue "
+              "full) — sampling degradation signal",
+              tsnap["droppedBatches"])
+    b.counter("sentinel_tpu_traces_errors",
+              "Queued batches the trace worker failed to process",
+              tsnap["errors"])
+
+    return b.render()
+
+
+def render_dashboard_metrics(dashboard) -> str:
+    """Dashboard-side aggregates (its repository + discovery state) as
+    OpenMetrics — the fleet view beside each engine's own ``/metrics``."""
+    import time as _time
+
+    b = OpenMetricsBuilder()
+    apps = dashboard.apps
+    b.family("sentinel_tpu_dashboard_machines", "gauge",
+             "Machines registered per app (healthy only)")
+    for app in sorted(apps.app_names()):
+        b.sample("sentinel_tpu_dashboard_machines", {"app": app},
+                 len(apps.healthy_machines(app)))
+    now_ms = int(_time.time() * 1000)
+    rows = []
+    for app in dashboard.repository.apps():
+        for res in dashboard.repository.resources_of(app):
+            series = dashboard.repository.query(
+                app, res, now_ms - 120_000, now_ms)
+            if series:
+                rows.append((app, res, series[-1]))
+    b.family("sentinel_tpu_dashboard_resource_pass_qps", "gauge",
+             "Latest aggregated pass QPS per (app, resource)")
+    for app, res, latest in rows:
+        b.sample("sentinel_tpu_dashboard_resource_pass_qps",
+                 {"app": app, "resource": res}, latest["passQps"])
+    b.family("sentinel_tpu_dashboard_resource_block_qps", "gauge",
+             "Latest aggregated block QPS per (app, resource)")
+    for app, res, latest in rows:
+        b.sample("sentinel_tpu_dashboard_resource_block_qps",
+                 {"app": app, "resource": res}, latest["blockQps"])
+    return b.render()

@@ -1,0 +1,245 @@
+"""Sampled decision traces: every Nth blocked entry, pulled off-device
+asynchronously and retained host-side.
+
+Aggregate attribution counters (``attribution.py``) say WHICH rule
+family is blocking a resource; a trace says what one concrete rejected
+request looked like — (resource, origin, reason, first-blocking rule
+slot, window snapshot) — the per-request debuggability the reference
+gets for free from its BlockException stack traces.
+
+The dispatch path only enqueues device-array references (bounded queue;
+an arriving batch is dropped when it is full — sampling is lossy by
+design, and the drop is counted); a
+daemon worker materializes them (``np.asarray`` blocks on the transfer
+in ITS thread, never the step stream), subsamples blocked lanes at the
+configured cadence, resolves node rows to names through the registry,
+and snapshots the blocked rows' instant window. Served by the ``traces``
+ops command and the dashboard.
+"""
+
+from __future__ import annotations
+
+import atexit
+import queue
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.config import (
+    DEFAULT_TELEMETRY_TRACE_CAPACITY,
+    DEFAULT_TELEMETRY_TRACE_SAMPLE_EVERY,
+    TELEMETRY_TRACE_CAPACITY,
+    TELEMETRY_TRACE_SAMPLE_EVERY,
+)
+from sentinel_tpu.telemetry.attribution import encode_reason_code
+
+
+class DecisionTraceBuffer:
+    """Host-side ring of sampled blocked-entry traces for one engine."""
+
+    def __init__(self, engine, sample_every: Optional[int] = None,
+                 capacity: Optional[int] = None):
+        from sentinel_tpu.core.config import config as _cfg
+
+        self.engine = engine
+        if sample_every is None:
+            sample_every = _cfg.get_int(
+                TELEMETRY_TRACE_SAMPLE_EVERY,
+                DEFAULT_TELEMETRY_TRACE_SAMPLE_EVERY)
+        if capacity is None:
+            capacity = _cfg.get_int(TELEMETRY_TRACE_CAPACITY,
+                                    DEFAULT_TELEMETRY_TRACE_CAPACITY)
+        self.sample_every = max(0, int(sample_every))  # 0 = disabled
+        self.capacity = max(1, int(capacity))
+        self._ring: List[Dict] = []
+        self._lock = threading.Lock()
+        # Bounded hand-off: the dispatch path must never block on
+        # telemetry. A full queue drops the batch (counted).
+        self._queue: "queue.Queue" = queue.Queue(maxsize=8)
+        # Serializes _process between the worker and drain(): drain must
+        # not return while the worker is mid-item, or readers would see
+        # partial counts.
+        self._proc_lock = threading.Lock()
+        self._dropped = 0
+        self._errors = 0
+        self._error_logged_ms = 0.0
+        self._seen_blocked = 0
+        self._recorded = 0
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- dispatch-path side (cheap; may run under the engine lock) --------
+
+    def submit(self, batch, decisions, now_ms: int) -> None:
+        """Queue one dispatched batch's verdicts for async sampling."""
+        if self.sample_every <= 0:
+            return
+        self._ensure_worker()
+        try:
+            self._queue.put_nowait((batch, decisions, int(now_ms)))
+        except queue.Full:
+            self._dropped += 1
+
+    # -- worker side ------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            with self._lock:
+                if self._worker is None or not self._worker.is_alive():
+                    self._stop.clear()
+                    self._worker = threading.Thread(
+                        target=self._run, name="sentinel-trace-pump",
+                        daemon=True)
+                    self._worker.start()
+                    # The worker materializes device arrays; a daemon
+                    # thread frozen inside an XLA call at interpreter
+                    # teardown aborts the process ("terminate called
+                    # without an active exception") — stop it BEFORE
+                    # Python finalizes, even when the engine is never
+                    # close()d (scripts, demos).
+                    atexit.register(self.stop)
+
+    def _pump_one(self) -> bool:
+        """Dequeue + process ONE item, atomically under the processing
+        lock. Dequeue-inside-the-lock is what makes drain() sound: an
+        item is either still in the queue (drain takes it) or being
+        processed under the lock drain must acquire — never invisibly
+        in-flight between the two."""
+        with self._proc_lock:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return False
+            try:
+                self._process(*item)
+            except Exception as ex:
+                # Telemetry must never take the engine down, but its own
+                # failure must be observable: counted (exported as
+                # sentinel_tpu_traces_errors) + rate-limited logged.
+                self._errors += 1
+                self._note_error(ex)
+            return True
+
+    def _note_error(self, ex: Exception) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._error_logged_ms >= 10.0:
+            self._error_logged_ms = now
+            try:
+                from sentinel_tpu.log.record_log import record_log
+
+                record_log.warn("trace worker failed to process a batch "
+                                "(errors=%d): %r", self._errors, ex)
+            except Exception:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self._pump_one():
+                # Idle poll: sampled traces tolerate ~50ms of latency,
+                # and the wait doubles as the stop signal.
+                self._stop.wait(0.05)
+
+    def drain(self) -> None:
+        """Process everything queued, in the CALLER's thread, and wait
+        out any item the worker has in flight — after drain() returns,
+        every batch submitted BEFORE the call is fully reflected in the
+        ring (deterministic reads for tests and the ops command)."""
+        while self._pump_one():
+            pass
+        with self._proc_lock:  # worker mid-item: wait for it to land
+            pass
+
+    def _process(self, batch, decisions, now_ms: int) -> None:
+        reasons = np.asarray(decisions.reason)
+        blocked_idx = np.nonzero(reasons > 0)[0]
+        if blocked_idx.size == 0:
+            return
+        slots = np.asarray(decisions.rule_slot)
+        rows = np.asarray(batch.cluster_row)
+        origin_rows = np.asarray(batch.origin_row)
+        counts = np.asarray(batch.count)
+        entry_in = np.asarray(batch.entry_in)
+        picked = []
+        with self._lock:
+            for i in blocked_idx.tolist():
+                self._seen_blocked += 1
+                if self._seen_blocked % self.sample_every == 0:
+                    picked.append(i)
+        if not picked:
+            return
+        window = self._window_snapshot([int(rows[i]) for i in picked])
+        metas = self.engine.registry.meta
+        for i in picked:
+            row = int(rows[i])
+            orow = int(origin_rows[i])
+            reason = int(reasons[i])
+            slot = int(slots[i])
+            trace = {
+                "timestamp": now_ms,
+                "resource": metas[row].resource if 0 <= row < len(metas)
+                else f"row:{row}",
+                "origin": metas[orow].origin if 0 <= orow < len(metas)
+                else "",
+                "reason": C.BlockReason(reason).name
+                if reason in C.BlockReason._value2member_map_ else str(reason),
+                "ruleSlot": slot,
+                "reasonCode": encode_reason_code(reason, slot),
+                "count": int(counts[i]),
+                "entryIn": bool(entry_in[i]),
+                "window": window.get(row, {}),
+            }
+            with self._lock:
+                self._recorded += 1
+                self._ring.append(trace)
+                del self._ring[:-self.capacity]
+
+    def _window_snapshot(self, rows: List[int]) -> Dict[int, Dict]:
+        """Instant-window view of the blocked rows at trace time — one
+        jitted read per sampled batch, amortized by the sampling cadence."""
+        try:
+            totals, threads = self.engine.row_stats()
+        except Exception:
+            return {}
+        out: Dict[int, Dict] = {}
+        for row in set(rows):
+            if not 0 <= row < totals.shape[0]:
+                continue
+            t = totals[row]
+            out[row] = {
+                "passQps": round(float(t[C.MetricEvent.PASS]), 2),
+                "blockQps": round(float(t[C.MetricEvent.BLOCK]), 2),
+                "curThreadNum": int(threads[row]),
+            }
+        return out
+
+    # -- read side --------------------------------------------------------
+
+    def snapshot(self, limit: Optional[int] = None) -> Dict:
+        """Ring + sampler counters, newest trace first. ``limit=0`` is
+        the counters-only read (exporter / `telemetry` command)."""
+        with self._lock:
+            traces = list(self._ring)
+            seen, recorded = self._seen_blocked, self._recorded
+        traces.reverse()  # newest first
+        if limit is not None:
+            traces = traces[:max(0, int(limit))]
+        return {
+            "sampleEvery": self.sample_every,
+            "capacity": self.capacity,
+            "seenBlocked": seen,
+            "recorded": recorded,
+            "droppedBatches": self._dropped,
+            "errors": self._errors,
+            "traces": traces,
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.join(timeout=2.0)
+        atexit.unregister(self.stop)  # idempotent; re-armed on next start

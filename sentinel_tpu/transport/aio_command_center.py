@@ -105,11 +105,12 @@ class AsyncCommandCenter:
                 # Off-loop dispatch: a handler may recompile rules or block
                 # on the engine lock for seconds — the event loop (possibly
                 # the HOST app's loop under start_async) must keep serving.
-                code, text = await asyncio.to_thread(
+                code, text, ctype = await asyncio.to_thread(
                     dispatch_command, self, path, body)
                 keep = headers.get("connection", "keep-alive").lower() \
                     != "close"
-                await self._respond(writer, code, text, close=not keep)
+                await self._respond(writer, code, text, close=not keep,
+                                    ctype=ctype)
                 if not keep:
                     return
         except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
@@ -125,13 +126,14 @@ class AsyncCommandCenter:
                 pass
 
     async def _respond(self, writer: asyncio.StreamWriter, code: int,
-                       text: str, close: bool = False) -> None:
+                       text: str, close: bool = False,
+                       ctype: str = "text/plain; charset=utf-8") -> None:
         reason = {200: "OK", 400: "Bad Request", 405: "Method Not Allowed",
                   413: "Payload Too Large", 431: "Headers Too Large",
                   500: "Internal Server Error"}.get(code, "Error")
         data = text.encode("utf-8")
         head = (f"HTTP/1.1 {code} {reason}\r\n"
-                f"Content-Type: text/plain; charset=utf-8\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(data)}\r\n"
                 f"Connection: {'close' if close else 'keep-alive'}\r\n"
                 f"\r\n").encode("latin-1")

@@ -42,10 +42,16 @@ class CommandRequest:
 
 @dataclass
 class CommandResponse:
-    """Reference: ``CommandResponse`` — success flag + result string."""
+    """Reference: ``CommandResponse`` — success flag + result string.
+
+    ``content_type`` lets non-JSON commands (the OpenMetrics ``metrics``
+    exposition) declare their media type; the default matches the
+    reference's plain-text bodies.
+    """
 
     success: bool
     result: str
+    content_type: str = "text/plain; charset=utf-8"
 
     @classmethod
     def of_success(cls, result) -> "CommandResponse":
@@ -84,11 +90,12 @@ def registered_commands() -> Dict[str, str]:
 
 
 def dispatch_command(center, path: str, body: str):
-    """Shared request->handler dispatch: ``(status_code, text)``.
+    """Shared request->handler dispatch: ``(status_code, text, ctype)``.
 
     Used by both transports (threaded simple-http here, the event-loop
     center in ``aio_command_center.py``) so command semantics cannot
     drift between them."""
+    plain = "text/plain; charset=utf-8"
     parsed = urllib.parse.urlparse(path)
     name = parsed.path.strip("/")
     params = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
@@ -99,13 +106,13 @@ def dispatch_command(center, path: str, body: str):
         body = ""
     handler = get_handler(name)
     if handler is None:
-        return 400, f"Unknown command `{name}`"
+        return 400, f"Unknown command `{name}`", plain
     try:
         resp = handler(CommandRequest(parameters=params, body=body,
                                       engine=center.engine, center=center))
     except Exception as ex:
-        return 500, f"command error: {ex!r}"
-    return (200 if resp.success else 400), resp.result
+        return 500, f"command error: {ex!r}", plain
+    return (200 if resp.success else 400), resp.result, resp.content_type
 
 
 class _HttpHandler(BaseHTTPRequestHandler):
@@ -115,14 +122,15 @@ class _HttpHandler(BaseHTTPRequestHandler):
         pass
 
     def _dispatch(self, body: str):
-        code, text = dispatch_command(self.server.command_center, self.path,
-                                      body)
-        self._reply(code, text)
+        code, text, ctype = dispatch_command(self.server.command_center,
+                                             self.path, body)
+        self._reply(code, text, ctype)
 
-    def _reply(self, code: int, text: str):
+    def _reply(self, code: int, text: str,
+               ctype: str = "text/plain; charset=utf-8"):
         data = text.encode("utf-8")
         self.send_response(code)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)

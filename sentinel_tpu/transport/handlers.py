@@ -268,11 +268,57 @@ def cmd_rollout(req: CommandRequest) -> CommandResponse:
 def cmd_profile(req: CommandRequest) -> CommandResponse:
     """Per-step timing snapshot (SURVEY §5 — no reference twin: the
     upstream has no in-process profiler; the TPU build's dispatch timing
-    is the analog of its entry-overhead JMH harness, live). ``reset=true``
-    clears the rings after reading."""
+    is the analog of its entry-overhead JMH harness, live). Per-kind
+    p50/p95/p99 of the sampled synchronous step walls AND the always-on
+    enqueue walls; the sampling cadence is ``csp.sentinel.profile.
+    syncEvery``. ``reset=true`` clears the rings after reading."""
     reset = (req.get_param("reset") or "").lower() == "true"
-    return CommandResponse.of_success(
-        req.engine.step_timer.snapshot(reset=reset))
+    # Kinds stay top-level (the pre-existing response shape tooling
+    # parses); the sampling cadence rides beside them.
+    out = dict(req.engine.step_timer.snapshot(reset=reset))
+    out["syncEvery"] = req.engine.step_timer.sync_every
+    return CommandResponse.of_success(out)
+
+
+@command_mapping("telemetry", "unified telemetry snapshot (JSON parity "
+                              "with the /metrics exposition)")
+def cmd_telemetry(req: CommandRequest) -> CommandResponse:
+    """Device-resident decision attribution + RT histograms + cumulative
+    counters as JSON (sentinel_tpu/telemetry/ — no reference twin). The
+    same series the OpenMetrics ``metrics`` command exposes for
+    scrapers."""
+    return CommandResponse.of_success(req.engine.telemetry_snapshot())
+
+
+@command_mapping("traces", "sampled blocked-entry decision traces")
+def cmd_traces(req: CommandRequest) -> CommandResponse:
+    """The decision-trace ring (telemetry/trace_ring.py): every Nth
+    blocked entry's (resource, origin, reason, rule slot, window
+    snapshot), newest first. ``limit=`` caps the returned traces;
+    ``drain=true`` processes any queued batches synchronously first
+    (deterministic reads for tooling)."""
+    traces = req.engine.traces
+    if (req.get_param("drain") or "").lower() == "true":
+        traces.drain()
+    limit = req.get_param("limit")
+    try:
+        limit_n = int(limit) if limit is not None else None
+    except ValueError:
+        return CommandResponse.of_failure("invalid parameter: limit")
+    return CommandResponse.of_success(traces.snapshot(limit=limit_n))
+
+
+@command_mapping("metrics", "Prometheus/OpenMetrics exposition")
+def cmd_metrics(req: CommandRequest) -> CommandResponse:
+    """``GET /metrics``: the whole engine — attribution counters, RT
+    histograms, resilience channels, rollout guardrail, step timing —
+    as OpenMetrics text under stable ``sentinel_tpu_*`` names
+    (docs/OPERATIONS.md "Telemetry & scraping")."""
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+    from sentinel_tpu.telemetry.openmetrics import OPENMETRICS_CONTENT_TYPE
+
+    return CommandResponse(True, render_engine_metrics(req.engine),
+                           content_type=OPENMETRICS_CONTENT_TYPE)
 
 
 @command_mapping("leases", "token-lease fast-path state")

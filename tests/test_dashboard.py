@@ -162,6 +162,52 @@ def test_top_resources_ranked_by_volume():
     assert repo.top_resources("a", 0, 5000) == ["high", "low"]
 
 
+def test_top_resources_deterministic_tie_break():
+    """Equal-volume resources rank by name — the ordering is a UI/API
+    contract and must not depend on dict insertion order."""
+    repo = InMemoryMetricsRepository()
+    for res in ("zeta", "alpha", "mid"):  # adversarial insertion order
+        repo.save("a", MetricNode(timestamp=1000, resource=res, pass_qps=7))
+    repo.save("a", MetricNode(timestamp=1000, resource="big", pass_qps=9))
+    assert repo.top_resources("a", 0, 5000) == ["big", "alpha", "mid", "zeta"]
+    # limit applies after the deterministic ordering
+    assert repo.top_resources("a", 0, 5000, limit=2) == ["big", "alpha"]
+
+
+def test_repository_eviction_keeps_fresh_seconds():
+    """TTL eviction is per-second, not per-series: seconds at/after the
+    retention floor survive while older ones in the SAME series go."""
+    repo = InMemoryMetricsRepository(retention_ms=10_000)
+    repo.save("a", MetricNode(timestamp=4_999, resource="r", pass_qps=1))
+    repo.save("a", MetricNode(timestamp=5_000, resource="r", pass_qps=2))
+    repo.save("a", MetricNode(timestamp=9_000, resource="r", pass_qps=3))
+    repo._evict(now_ms=15_000)  # floor = 5_000
+    kept = [e["timestamp"] for e in repo.query("a", "r", 0, 2**60)]
+    assert kept == [5_000, 9_000]
+    # fully-evicted series disappear from the resource listing
+    repo.save("a", MetricNode(timestamp=5_500, resource="old", pass_qps=1))
+    repo._evict(now_ms=40_000)
+    assert repo.resources_of("a") == []
+
+
+def test_dashboard_metrics_endpoint_openmetrics(dash):
+    from prometheus_client.openmetrics import parser as om_parser
+
+    dash.repository.save("appZ", MetricNode(
+        timestamp=int(__import__("time").time() * 1000) - 3_000,
+        resource="resQ", pass_qps=11, block_qps=4))
+    url = f"http://127.0.0.1:{dash.bound_port}/metrics"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        ctype = r.headers["Content-Type"]
+        text = r.read().decode()
+    assert "openmetrics-text" in ctype
+    fams = {f.name: f for f in om_parser.text_string_to_metric_families(text)}
+    samples = [s for s in
+               fams["sentinel_tpu_dashboard_resource_pass_qps"].samples
+               if s.labels == {"app": "appZ", "resource": "resQ"}]
+    assert samples and samples[0].value == 11
+
+
 def test_ui_page_served(dash):
     url = f"http://127.0.0.1:{dash.bound_port}/"
     with urllib.request.urlopen(url, timeout=5) as r:
@@ -189,6 +235,9 @@ def test_ui_reaches_every_backend_endpoint(dash):
         "/resource/machineResource.json",
         "/cluster/assign",
         "/cluster/state.json",
+        "/telemetry/summary.json",
+        "/telemetry/traces.json",
+        "/metrics",
     ]:
         assert endpoint in page, f"UI does not reference {endpoint}"
 

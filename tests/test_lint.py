@@ -32,6 +32,35 @@ def test_compile_driver_entry_points():
         py_compile.compile(str(REPO / name), doraise=True)
 
 
+def test_no_bare_print_in_package():
+    """Telemetry goes through the record log / telemetry subsystem, not
+    stdout: a bare ``print(`` in library code is invisible to operators
+    scraping /metrics and pollutes embedding hosts' stdout. CLI entry
+    points (``__main__.py``) are the one legitimate stdout surface."""
+    import re
+
+    pattern = re.compile(r"(?<![\w.])print\(")
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        if path.name == "__main__.py":
+            continue  # CLI surface: user-facing stdout is the point
+        in_doc = False
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            stripped = line.strip()
+            # crude but sufficient docstring/comment skip for this gate
+            if stripped.count('"""') % 2 == 1 or stripped.count("'''") % 2 == 1:
+                in_doc = not in_doc
+                continue
+            if in_doc or stripped.startswith("#"):
+                continue
+            code = line.split("#", 1)[0]
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "bare print( in library code (route through record_log): "
+        + ", ".join(offenders))
+
+
 @pytest.mark.skipif(shutil.which("ruff") is None,
                     reason="ruff binary not in this image")
 def test_ruff_clean():

@@ -84,6 +84,81 @@ def test_writer_is_idempotent_per_second(tmp_path):
     assert [n.pass_qps for n in nodes] == [1]
 
 
+def test_writer_rolls_at_midnight_boundary(tmp_path):
+    """A date change starts a fresh ``.1`` for the new day; the old day's
+    file stays intact and the searcher reads across the boundary."""
+    import datetime
+
+    from sentinel_tpu.metrics.writer import metric_file_name
+
+    # Local-time midnight boundary (the writer names files by local date).
+    before = datetime.datetime(2023, 11, 14, 23, 59, 59)
+    after = datetime.datetime(2023, 11, 15, 0, 0, 1)
+    writer = MetricWriter(app="appA", base_dir=str(tmp_path))
+    writer.write(int(before.timestamp() * 1000),
+                 [MetricNode(0, "r", pass_qps=1)])
+    writer.write(int(after.timestamp() * 1000),
+                 [MetricNode(0, "r", pass_qps=2)])
+    writer.close()
+
+    names = sorted(n for n in os.listdir(tmp_path) if not n.endswith(".idx"))
+    assert names == [
+        metric_file_name("appA", before.strftime("%Y-%m-%d"), 1),
+        metric_file_name("appA", after.strftime("%Y-%m-%d"), 1),
+    ]
+    # both days' index files exist and the search spans the boundary
+    assert all(os.path.exists(os.path.join(tmp_path, n + ".idx"))
+               for n in names)
+    nodes = MetricSearcher(str(tmp_path), "appA").find(0)
+    assert [n.pass_qps for n in nodes] == [1, 2]
+
+
+def test_writer_index_rolls_at_size_cap(tmp_path):
+    """Crossing ``single_file_size`` rolls ``.n`` -> ``.n+1`` within the
+    same date, each data file with its own ``.idx`` sibling, and the
+    index resumes correct offsets in the new file."""
+    import datetime
+
+    day = datetime.datetime(2023, 11, 14, 12, 0, 0)
+    base = int(day.timestamp() * 1000)
+    writer = MetricWriter(app="appA", base_dir=str(tmp_path),
+                          single_file_size=120, total_file_count=10)
+    for k in range(6):
+        writer.write(base + 1000 * k, [MetricNode(0, f"res{k}", pass_qps=k)])
+    writer.close()
+    date = day.strftime("%Y-%m-%d")
+    data = sorted(n for n in os.listdir(tmp_path) if not n.endswith(".idx"))
+    indices = [int(n.rsplit(".", 1)[1]) for n in data]
+    assert all(date in n for n in data)
+    assert indices == list(range(1, len(data) + 1)) and len(data) >= 2
+    for n in data:
+        assert os.path.getsize(os.path.join(tmp_path, n + ".idx")) > 0
+    # every written second still resolves through the per-file indexes
+    nodes = MetricSearcher(str(tmp_path), "appA").find(0)
+    assert [n.pass_qps for n in nodes] == list(range(6))
+
+
+def test_writer_trim_keeps_exactly_file_keep(tmp_path):
+    """``_trim_old`` retains exactly ``total_file_count`` data files
+    (oldest first to go), and removes their ``.idx`` siblings too."""
+    import datetime
+
+    base = int(datetime.datetime(2023, 11, 14, 12, 0, 0).timestamp() * 1000)
+    keep = 3
+    writer = MetricWriter(app="appA", base_dir=str(tmp_path),
+                          single_file_size=1, total_file_count=keep)
+    for k in range(9):  # size cap 1 byte: every second rolls a new file
+        writer.write(base + 1000 * k, [MetricNode(0, f"res{k}", pass_qps=k)])
+    writer.close()
+    data = sorted((n for n in os.listdir(tmp_path) if not n.endswith(".idx")),
+                  key=lambda n: int(n.rsplit(".", 1)[1]))
+    assert len(data) == keep
+    idx = sorted(n for n in os.listdir(tmp_path) if n.endswith(".idx"))
+    assert idx == sorted(n + ".idx" for n in data)
+    # survivors are the NEWEST files
+    assert [int(n.rsplit(".", 1)[1]) for n in data] == [7, 8, 9]
+
+
 def test_writer_rolls_by_size_and_trims(tmp_path):
     base = 1700000000000
     writer = MetricWriter(app="appA", base_dir=str(tmp_path),
@@ -274,6 +349,34 @@ def test_step_timer_ring_bounded():
     assert snap["dispatches"] == 20
     # only the last 4 samples survive: p50 of {16..19}
     assert snap["stepP50Ms"] >= 16.0
+
+
+def test_step_timer_reports_p95(frozen_time):
+    from sentinel_tpu.metrics import StepTimer
+
+    t = StepTimer(ring=128, sync_every=1)
+    for i in range(100):
+        t.record("entry", 1, float(i), float(i))
+    snap = t.snapshot()["entry"]
+    assert snap["stepP50Ms"] <= snap["stepP95Ms"] <= snap["stepP99Ms"]
+    assert 90 <= snap["stepP95Ms"] <= 99
+    assert snap["enqueueP50Ms"] <= snap["enqueueP95Ms"] <= snap["enqueueP99Ms"]
+
+
+def test_profile_sync_every_configurable(frozen_time, monkeypatch):
+    """`csp.sentinel.profile.syncEvery` seeds StepTimer's sampling
+    cadence; invalid values fall back to the default loudly."""
+    from sentinel_tpu.core.config import DEFAULT_PROFILE_SYNC_EVERY
+
+    monkeypatch.setenv("CSP_SENTINEL_PROFILE_SYNCEVERY", "8")
+    eng = st.reset(capacity=64)
+    assert eng.step_timer.sync_every == 8
+
+    monkeypatch.setenv("CSP_SENTINEL_PROFILE_SYNCEVERY", "-3")
+    eng = st.reset(capacity=64)
+    assert eng.step_timer.sync_every == DEFAULT_PROFILE_SYNC_EVERY
+    monkeypatch.delenv("CSP_SENTINEL_PROFILE_SYNCEVERY")
+    st.reset(capacity=64)
 
 
 def test_engine_step_timing_via_profile_command(engine, frozen_time):

@@ -1,0 +1,433 @@
+"""Telemetry subsystem (sentinel_tpu/telemetry/): decision attribution,
+RT histograms, decision traces, and the OpenMetrics exporter.
+
+The load-bearing property is the differential ORACLE check: the fused
+step's per-(resource, reason) block-attribution counters must EXACTLY
+equal a sequential slot-chain replay of the same stream — deterministic
+multi-family scenarios, the randomized flow window oracle, the
+mixed-acquire fixpoint regime, and canary-enforced batches all included.
+The exporter test round-trips the ``/metrics`` exposition through the
+OpenMetrics reference parser (tier-1 smoke for the scrape surface).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.core.batch import (
+    EntryBatch,
+    ExitBatch,
+    make_entry_batch_np,
+    make_exit_batch_np,
+)
+from sentinel_tpu.telemetry import attribution as AT
+from sentinel_tpu.utils.param_hash import hash_param
+
+import jax.numpy as jnp
+
+BASE_MS = 1_700_000_000_000
+
+
+def _batch(engine, lanes, counts=None):
+    """EntryBatch from [(resource, origin, param_or_None)] lanes."""
+    reg = engine.registry
+    n = len(lanes)
+    buf = make_entry_batch_np(n)
+    parent = reg.entrance_row("ctx")
+    for i, (res, origin, param) in enumerate(lanes):
+        cr, dn, orow, oid = reg.resolve_entry(res, "ctx", origin, parent,
+                                              int(C.EntryType.OUT))
+        buf["cluster_row"][i] = cr
+        buf["dn_row"][i] = dn
+        buf["origin_row"][i] = orow
+        buf["origin_id"][i] = oid
+        buf["context_id"][i] = reg.context_id("ctx")
+        buf["count"][i] = 1 if counts is None else counts[i]
+        if param is not None:
+            buf["param_hash"][i, 0] = hash_param(param)
+            buf["param_present"][i, 0] = True
+    return EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+
+def _exit_batch(engine, lanes, rts, success=True, error=False):
+    reg = engine.registry
+    n = len(lanes)
+    buf = make_exit_batch_np(n)
+    parent = reg.entrance_row("ctx")
+    for i, (res, origin, _p) in enumerate(lanes):
+        cr, dn, orow, _ = reg.resolve_entry(res, "ctx", origin, parent,
+                                            int(C.EntryType.OUT))
+        buf["cluster_row"][i] = cr
+        buf["dn_row"][i] = dn
+        buf["origin_row"][i] = orow
+        buf["count"][i] = 1
+        buf["rt_ms"][i] = rts[i]
+        buf["success"][i] = success
+        buf["error"][i] = error
+    return ExitBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+
+
+def _attr(engine):
+    """per-resource {reason name: blocked tokens} from the device counters."""
+    counts = engine.telemetry_counts()["blockByReason"]
+    out = {}
+    for res, row in engine.registry.resources().items():
+        reasons = {name: int(counts[ch, row])
+                   for ch, name in enumerate(AT.ATTR_REASON_NAMES)
+                   if counts[ch, row]}
+        if reasons:
+            out[res] = reasons
+    return out
+
+
+# -- differential oracle: attribution == sequential slot chain ---------------
+
+def test_attribution_matches_slot_chain_multi_family(engine):
+    """Deterministic multi-family batch: each family's blocked lanes land
+    in exactly that family's counter channel, with first-blocking-chain
+    order (an authority-blocked lane never reaches the flow counter)."""
+    st.load_flow_rules([st.FlowRule(resource="f", count=3)])
+    st.load_authority_rules([st.AuthorityRule(
+        resource="a", limit_app="evil", strategy=C.AUTHORITY_BLACK)])
+    st.load_param_flow_rules([st.ParamFlowRule(
+        resource="p", param_idx=0, count=2, duration_in_sec=1)])
+
+    lanes = ([("f", "", None)] * 6
+             + [("a", "evil", None)] * 2 + [("a", "good", None)]
+             + [("p", "", 7)] * 4)
+    dec = engine.check_batch(_batch(engine, lanes), now_ms=BASE_MS)
+    reasons = np.asarray(dec.reason)
+    # slot-chain replay: flow admits 3 of 6; authority blocks evil only;
+    # param admits 2 of the 4 same-value lanes.
+    assert _attr(engine) == {
+        "f": {"FLOW": 3},
+        "a": {"AUTHORITY": 2},
+        "p": {"PARAM_FLOW": 2},
+    }
+    # per-entry codes agree with the counters they committed
+    assert (reasons[:6] == 0).sum() == 3
+    assert list(reasons[6:8]) == [C.BlockReason.AUTHORITY] * 2
+    assert reasons[8] == 0
+
+
+def test_attribution_matches_flow_window_oracle_randomized(engine):
+    """Randomized stream vs a serial DefaultController/LeapArray oracle:
+    per-resource FLOW attribution (in acquire tokens) matches exactly,
+    including the MIXED acquire-count fixpoint regime."""
+    rng = np.random.default_rng(11)
+    thr = {"rA": 7, "rB": 3}
+    st.load_flow_rules([st.FlowRule(resource=r, count=c)
+                        for r, c in thr.items()])
+
+    class Win:  # 1s/2-bucket lazy LeapArray (SPEC_1S twin)
+        def __init__(self):
+            self.starts, self.counts = [-1, -1], [0, 0]
+
+        def total(self, now):
+            idx, ws = (now // 500) % 2, now - now % 500
+            return sum(self.counts[b]
+                       for b in range(2)
+                       if self.starts[b] == (ws if b == idx else ws - 500))
+
+        def add(self, now, c):
+            idx, ws = (now // 500) % 2, now - now % 500
+            if self.starts[idx] != ws:
+                self.starts[idx], self.counts[idx] = ws, 0
+            self.counts[idx] += c
+
+    wins = {r: Win() for r in thr}
+    expect = {r: {"pass": 0, "block": 0} for r in thr}
+    now = BASE_MS
+    for _ in range(10):
+        lanes, counts = [], []
+        for _ in range(24):
+            res = "rA" if rng.integers(0, 2) else "rB"
+            lanes.append((res, "", None))
+            counts.append(int(rng.integers(1, 4)))  # mixed: fixpoint path
+        dec = engine.check_batch(_batch(engine, lanes, counts=counts),
+                                 now_ms=now)
+        reasons = np.asarray(dec.reason)
+        for i, (res, _, _) in enumerate(lanes):
+            w, c = wins[res], counts[i]
+            if w.total(now) + c <= thr[res]:
+                w.add(now, c)
+                expect[res]["pass"] += c
+                assert reasons[i] == 0, (i, res)
+            else:
+                expect[res]["block"] += c
+                assert reasons[i] == C.BlockReason.FLOW, (i, res)
+        now += 130
+
+    attr = _attr(engine)
+    totals = engine.telemetry_counts()["totals"]
+    rows = engine.registry.resources()
+    for res in thr:
+        assert attr.get(res, {}).get("FLOW", 0) == expect[res]["block"]
+        assert int(totals[C.MetricEvent.PASS, rows[res]]) \
+            == expect[res]["pass"]
+
+
+def test_attribution_exact_under_canary_enforcement(engine):
+    """Canary-enforced lanes attribute to the CANDIDATE's verdict (the
+    decision that actually governed them), matching a replay of the
+    candidate ruleset as live rules."""
+    st.load_flow_rules([st.FlowRule(resource="c", count=100000)])
+    engine.rollout.load_candidate(
+        "vc", {"flow": [{"resource": "c", "count": 2}]},
+        stage="canary", canary_bps=10000)  # whole slice canary-governed
+    lanes = [("c", "", None)] * 5
+    dec = engine.check_batch(_batch(engine, lanes), now_ms=BASE_MS)
+    blocked = int((np.asarray(dec.reason) > 0).sum())
+
+    oracle = st.reset(capacity=512)
+    oracle.flow_rules.load_rules([st.FlowRule(resource="c", count=2)])
+    odec = oracle.check_batch(_batch(oracle, lanes), now_ms=BASE_MS)
+    oracle_blocked = int((np.asarray(odec.reason) > 0).sum())
+
+    assert blocked == oracle_blocked == 3
+    assert _attr(engine) == {"c": {"FLOW": 3}}
+
+
+def test_degrade_attribution_and_rule_slot(engine, frozen_time):
+    """An OPEN breaker attributes to DEGRADE; a second-slot flow rule
+    reports rule_slot 1 (load order = sequential chain order)."""
+    st.load_degrade_rules([st.DegradeRule(
+        resource="d", grade=C.DEGRADE_GRADE_EXCEPTION_COUNT, count=1,
+        time_window=60, min_request_amount=1, stat_interval_ms=1000)])
+    # Open the breaker: the trip is strictly-greater, so two errors.
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            with st.entry("d"):
+                raise RuntimeError("boom")
+    engine._flush_committer()
+    dec = engine.check_batch(
+        _batch(engine, [("d", "", None)] * 3),
+        now_ms=frozen_time.current_time_millis())
+    reasons = np.asarray(dec.reason)
+    assert (reasons == C.BlockReason.DEGRADE).all()
+    assert (np.asarray(dec.rule_slot) == 0).all()
+    assert _attr(engine)["d"] == {"DEGRADE": 3}
+
+    st.load_flow_rules([
+        st.FlowRule(resource="m", count=100000),   # slot 0: never blocks
+        st.FlowRule(resource="m", count=2),        # slot 1: blocks
+    ])
+    dec = engine.check_batch(
+        _batch(engine, [("m", "", None)] * 4),
+        now_ms=frozen_time.current_time_millis())
+    reasons = np.asarray(dec.reason)
+    slots = np.asarray(dec.rule_slot)
+    assert (reasons > 0).sum() == 2
+    assert (slots[reasons > 0] == 1).all()
+    assert (slots[reasons == 0] == -1).all()
+
+
+def test_reason_code_round_trip():
+    for reason, slot in ((0, -1), (1, 0), (5, 3), (2, -1), (7, 250)):
+        code = AT.encode_reason_code(reason, slot)
+        assert AT.decode_reason_code(code) == (reason, slot)
+
+
+# -- RT histograms -----------------------------------------------------------
+
+def test_rt_histogram_buckets_and_quantiles(engine):
+    lanes = [("h", "", None)] * 5
+    engine.check_batch(_batch(engine, lanes), now_ms=BASE_MS)
+    rts = [1, 3, 10, 600, 4900]
+    engine.complete_batch(_exit_batch(engine, lanes, rts),
+                          now_ms=BASE_MS + 10)
+    counts = engine.telemetry_counts()
+    row = engine.registry.resources()["h"]
+    hist = counts["rtHist"][:, row]
+    # buckets: le=1 -> rt 1; le=4 -> rt 3; le=16 -> rt 10; le=1024 -> 600;
+    # overflow -> 4900
+    expected = np.zeros(AT.NUM_RT_BUCKETS, np.int64)
+    for rt in rts:
+        expected[int(np.sum(rt > np.asarray(AT.RT_BUCKET_EDGES_MS)))] += 1
+    assert (hist == expected).all()
+    assert int(counts["totals"][C.MetricEvent.SUCCESS, row]) == 5
+    assert int(counts["totals"][C.MetricEvent.RT, row]) == sum(rts)
+    snap = engine.telemetry_snapshot()["resources"]["h"]
+    assert 0 < snap["rtP50Ms"] <= 16
+    assert snap["rtP99Ms"] >= 1024
+
+
+def test_histogram_quantile_estimator():
+    counts = [0] * AT.NUM_RT_BUCKETS
+    counts[2] = 100  # all samples in (2, 4]
+    assert 2.0 < AT.histogram_quantile(counts, 0.5) <= 4.0
+    assert AT.histogram_quantile([0] * AT.NUM_RT_BUCKETS, 0.9) == 0.0
+    counts = [0] * AT.NUM_RT_BUCKETS
+    counts[-1] = 10  # overflow-only: reports the top edge
+    assert AT.histogram_quantile(counts, 0.5) == AT.RT_BUCKET_EDGES_MS[-1]
+
+
+# -- decision traces ---------------------------------------------------------
+
+def test_trace_ring_records_blocked_entries(engine):
+    engine.traces.sample_every = 1  # retain every blocked entry
+    st.load_flow_rules([st.FlowRule(resource="t", count=1)])
+    engine.check_batch(_batch(engine, [("t", "userA", None)] * 3),
+                       now_ms=BASE_MS)
+    engine.traces.drain()
+    snap = engine.traces.snapshot()
+    assert snap["seenBlocked"] == 2 and snap["recorded"] == 2
+    tr = snap["traces"][0]
+    assert tr["resource"] == "t" and tr["reason"] == "FLOW"
+    assert tr["origin"] == "userA"
+    assert tr["ruleSlot"] == 0
+    assert tr["reasonCode"] == AT.encode_reason_code(int(C.BlockReason.FLOW), 0)
+    assert "passQps" in tr["window"]
+
+
+def test_trace_ring_sampling_and_capacity(engine):
+    engine.traces.sample_every = 2
+    engine.traces.capacity = 3
+    st.load_flow_rules([st.FlowRule(resource="t2", count=0)])
+    engine.check_batch(_batch(engine, [("t2", "", None)] * 10),
+                       now_ms=BASE_MS)
+    engine.traces.drain()
+    snap = engine.traces.snapshot()
+    assert snap["seenBlocked"] == 10
+    assert snap["recorded"] == 5          # every 2nd blocked entry
+    assert len(snap["traces"]) == 3       # ring capacity bounds retention
+    assert engine.traces.snapshot(limit=1)["traces"][0] == snap["traces"][0]
+
+
+def test_trace_sampling_disabled(engine):
+    engine.traces.sample_every = 0
+    st.load_flow_rules([st.FlowRule(resource="t3", count=0)])
+    engine.check_batch(_batch(engine, [("t3", "", None)] * 4),
+                       now_ms=BASE_MS)
+    engine.traces.drain()
+    assert engine.traces.snapshot()["traces"] == []
+
+
+# -- ops commands + exporter (tier-1 scrape smoke) ---------------------------
+
+def _http(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.headers, r.read().decode()
+
+
+def test_telemetry_and_traces_ops_commands(engine):
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    engine.traces.sample_every = 1
+    st.load_flow_rules([st.FlowRule(resource="cmd", count=1)])
+    engine.check_batch(_batch(engine, [("cmd", "", None)] * 4),
+                       now_ms=BASE_MS)
+    center = CommandCenter(engine, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{center.bound_port}"
+        _, body = _http(f"{base}/telemetry")
+        out = json.loads(body)
+        assert out["resources"]["cmd"]["blockByReason"] == {"FLOW": 3}
+        assert out["resources"]["cmd"]["passTotal"] == 1
+        assert "stepTimer" in out and "traceSampling" in out
+        _, body = _http(f"{base}/traces?drain=true&limit=2")
+        traces = json.loads(body)
+        assert traces["recorded"] == 3 and len(traces["traces"]) == 2
+        assert traces["traces"][0]["resource"] == "cmd"
+    finally:
+        center.stop()
+
+
+def test_metrics_endpoint_parses_as_openmetrics(engine):
+    """Tier-1 smoke: scrape /metrics and round-trip it through the
+    OpenMetrics reference parser; attribution series match the device
+    counters."""
+    from prometheus_client.openmetrics import parser as om_parser
+
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    st.load_flow_rules([st.FlowRule(resource="scrape", count=2)])
+    lanes = [("scrape", "", None)] * 5
+    engine.check_batch(_batch(engine, lanes), now_ms=BASE_MS)
+    engine.complete_batch(_exit_batch(engine, lanes[:2], [5, 9]),
+                          now_ms=BASE_MS + 10)
+    center = CommandCenter(engine, port=0).start()
+    try:
+        headers, text = _http(f"http://127.0.0.1:{center.bound_port}/metrics")
+        assert "openmetrics-text" in headers["Content-Type"]
+    finally:
+        center.stop()
+
+    families = {f.name: f for f in om_parser.text_string_to_metric_families(text)}
+    assert "sentinel_tpu_pass" in families
+    assert "sentinel_tpu_block_reason" in families
+    assert "sentinel_tpu_rt_ms" in families
+    assert "sentinel_tpu_fail_open" in families
+    assert "sentinel_tpu_rollout_active" in families
+
+    def sample(fam, name, match):
+        return [s for s in families[fam].samples if s.name == name
+                and all(s.labels.get(k) == v for k, v in match.items())]
+
+    blocks = sample("sentinel_tpu_block_reason",
+                    "sentinel_tpu_block_reason_total",
+                    {"resource": "scrape", "reason": "FLOW"})
+    assert len(blocks) == 1 and blocks[0].value == 3
+    passes = sample("sentinel_tpu_pass", "sentinel_tpu_pass_total",
+                    {"resource": "scrape"})
+    assert passes[0].value == 2
+    cnt = sample("sentinel_tpu_rt_ms", "sentinel_tpu_rt_ms_count",
+                 {"resource": "scrape"})
+    assert cnt[0].value == 2
+    inf = sample("sentinel_tpu_rt_ms", "sentinel_tpu_rt_ms_bucket",
+                 {"resource": "scrape", "le": "+Inf"})
+    assert inf[0].value == 2
+
+
+# -- pod fold ----------------------------------------------------------------
+
+def test_pod_telemetry_counts_fold_device_axis(engine):
+    """Pod path: every device attributes its own shard's lanes; the
+    pod-global view is the device-axis fold (parallel/cluster.py)."""
+    import jax
+    from jax.sharding import Mesh
+    from sentinel_tpu.core.registry import NodeRegistry
+    from sentinel_tpu.models import authority as A
+    from sentinel_tpu.models import degrade as Dg
+    from sentinel_tpu.models import flow as F
+    from sentinel_tpu.models import param_flow as PF
+    from sentinel_tpu.models import system as Y
+    from sentinel_tpu.ops import step as S
+    from sentinel_tpu.parallel import cluster as PC
+
+    ndev, capacity, per_dev = 8, 128, 4
+    mesh = Mesh(np.asarray(jax.devices()[:ndev]), (PC.AXIS,))
+    reg = NodeRegistry(capacity)
+    row = reg.cluster_row("shared")
+    ft, _ = F.compile_flow_rules([st.FlowRule(resource="shared", count=2)],
+                                 reg, capacity)
+    dt, di = Dg.compile_degrade_rules([], reg, capacity)
+    pack = S.RulePack(flow=ft, degrade=dt,
+                      authority=A.compile_authority_rules([], reg, capacity),
+                      system=Y.compile_system_rules([]),
+                      param=PF.compile_param_rules([], reg, capacity))
+    one = S.make_state(capacity, ft.num_rules, BASE_MS,
+                       degrade=Dg.make_degrade_state(dt, di),
+                       param=PF.make_param_state(pack.param.num_rules))
+    state = PC.make_pod_state(ndev, one)
+    entry_fn, _ = PC.make_pod_steps(mesh, cluster_param=False)
+    entry_jit = jax.jit(entry_fn, donate_argnums=(0,))
+
+    buf = make_entry_batch_np(ndev * per_dev)
+    buf["cluster_row"][:] = row
+    buf["dn_row"][:] = -1
+    buf["count"][:] = 1
+    batch = EntryBatch(**{k: jnp.asarray(v) for k, v in buf.items()})
+    state, dec = entry_jit(state, pack, batch, jnp.int64(BASE_MS))
+    blocked = int((np.asarray(dec.reason) > 0).sum())
+    assert blocked == ndev * (per_dev - 2)  # local rule: 2 pass per device
+
+    tele = jax.tree.map(np.asarray, PC.global_telemetry_counts(state))
+    flow_ch = AT.ATTR_REASON_NAMES.index("FLOW")
+    assert int(tele.block_by_reason[flow_ch, row]) == blocked
+    assert int(tele.totals[C.MetricEvent.PASS, row]) == 2 * ndev
+    assert int(tele.totals[C.MetricEvent.BLOCK, row]) == blocked
