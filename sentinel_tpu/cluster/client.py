@@ -226,33 +226,57 @@ class ClusterTokenClient:
                 gate.record_failure()
         return resp
 
+    @staticmethod
+    def _read_server_span(entity: bytes, offset: int):
+        """Server-side span info TLV from a response entity, or None."""
+        tlv = codec.read_trace_tlv(entity, offset)
+        if not tlv:
+            return None
+        info = codec.decode_span_info(tlv)
+        if info is None:
+            return None
+        return {"spanId": info[0], "startMs": info[1], "durationUs": info[2]}
+
     def request_token(self, flow_id: int, count: int = 1,
                       prioritized: bool = False,
                       timeout_s: Optional[float] = None,
-                      gate_neutral: bool = False) -> TokenResult:
+                      gate_neutral: bool = False,
+                      trace=None) -> TokenResult:
         """One acquire; FAIL on disconnect/timeout/open-breaker — immediate
         (no wire wait) when disconnected or the gate is OPEN; callers
         decide fallback. ``timeout_s`` tightens (never widens) the
         configured request timeout, for deadline-budgeted callers;
         ``gate_neutral`` keeps a starved-deadline miss out of the
-        breaker's failure count."""
-        resp = self._gated_call(
-            MSG_FLOW, codec.encode_flow_request(flow_id, count, prioritized),
-            timeout_s, gate_neutral)
+        breaker's failure count. ``trace`` (telemetry/spans.py
+        TraceContext) rides the wire as a trailing TLV old servers
+        ignore; a new server ships its token-service span back in
+        ``TokenResult.server_span``."""
+        entity = codec.encode_flow_request(flow_id, count, prioritized)
+        if trace is not None:
+            entity = codec.append_trace_tlv(entity, trace.traceparent())
+        resp = self._gated_call(MSG_FLOW, entity, timeout_s, gate_neutral)
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
         remaining, wait_ms = codec.decode_flow_response(resp.entity)
+        span = (self._read_server_span(resp.entity, codec.FLOW_RESP_SIZE)
+                if trace is not None else None)
         if resp.status == TokenResultStatus.SHOULD_WAIT:
-            return TokenResult(resp.status, wait_ms=wait_ms)
-        return TokenResult(resp.status, remaining=remaining)
+            return TokenResult(resp.status, wait_ms=wait_ms,
+                               server_span=span)
+        return TokenResult(resp.status, remaining=remaining,
+                           server_span=span)
 
     def request_param_token(self, flow_id: int, count: int, params: Sequence,
                             timeout_s: Optional[float] = None,
-                            gate_neutral: bool = False) -> TokenResult:
-        resp = self._gated_call(
-            MSG_PARAM_FLOW,
-            codec.encode_param_flow_request(flow_id, count, params),
-            timeout_s, gate_neutral)
+                            gate_neutral: bool = False,
+                            trace=None) -> TokenResult:
+        entity = codec.encode_param_flow_request(flow_id, count, params)
+        if trace is not None:
+            entity = codec.append_trace_tlv(entity, trace.traceparent())
+        resp = self._gated_call(MSG_PARAM_FLOW, entity, timeout_s,
+                                gate_neutral)
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
-        return TokenResult(resp.status)
+        span = (self._read_server_span(resp.entity, 0)
+                if trace is not None else None)
+        return TokenResult(resp.status, server_span=span)

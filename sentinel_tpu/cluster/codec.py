@@ -96,6 +96,71 @@ class FrameReader:
         return frames
 
 
+# -- trace-context TLV (telemetry/spans.py — the M5 cross-process hop) --------
+#
+# An OPTIONAL trailing field appended after any entity:
+# ``tag:u8(0x54 'T') | len:u16 | value utf-8``. Wire-compatible both
+# ways: every pre-existing entity decoder reads its fixed/self-delimited
+# prefix with ``unpack_from`` and ignores trailing bytes, so an old peer
+# simply never sees the field, and a new peer treats a missing/garbled
+# TLV as "no trace" (tracing is sampling-lossy by design — a mangled
+# context must never fail the token request it rides on).
+#
+# Request direction carries a W3C traceparent (``00-<trace32>-<span16>-
+# <flags2>``); response direction carries the server-side span as
+# ``<span16>:<start_ms>:<duration_us>`` so the client can stitch per-hop
+# timings without a second round trip.
+
+TLV_TRACE = 0x54
+
+_TLV_HEAD = struct.Struct(">BH")
+
+
+def append_trace_tlv(entity: bytes, value: str) -> bytes:
+    raw = value.encode("utf-8")[:0xFF00]
+    return entity + _TLV_HEAD.pack(TLV_TRACE, len(raw)) + raw
+
+
+def read_trace_tlv(entity: bytes, offset: int) -> Optional[str]:
+    """The TLV's utf-8 value at ``offset`` (= the entity's fixed size),
+    or None when absent/garbled."""
+    if offset < 0 or len(entity) < offset + _TLV_HEAD.size:
+        return None
+    tag, n = _TLV_HEAD.unpack_from(entity, offset)
+    if tag != TLV_TRACE or len(entity) < offset + _TLV_HEAD.size + n:
+        return None
+    try:
+        return entity[offset + _TLV_HEAD.size:
+                      offset + _TLV_HEAD.size + n].decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+
+
+def encode_span_info(span_id: str, start_ms: int, duration_us: int) -> str:
+    return f"{span_id}:{int(start_ms)}:{int(duration_us)}"
+
+
+def decode_span_info(value: str) -> Optional[Tuple[str, int, int]]:
+    parts = value.split(":")
+    if len(parts) != 3:
+        return None
+    try:
+        return parts[0], int(parts[1]), int(parts[2])
+    except ValueError:
+        return None
+
+
+FLOW_REQ_SIZE = _FLOW_REQ.size
+FLOW_RESP_SIZE = _FLOW_RESP.size
+
+
+def param_flow_request_size(entity: bytes) -> int:
+    """Offset just past a PARAM_FLOW request entity (where a trace TLV
+    would start) — params are self-delimiting."""
+    _, end = decode_params(entity, 12)
+    return end
+
+
 # -- entities -----------------------------------------------------------------
 
 

@@ -157,12 +157,22 @@ class _Handler(socketserver.BaseRequestHandler):
                         # linger+device-step cycles — and the replies go
                         # out as ONE write (per-frame sendall was ~30%
                         # of the r5 loopback ceiling).
+                        from sentinel_tpu.telemetry.spans import (
+                            parse_traceparent)
+
                         j = i
                         burst = []
                         while j < len(reqs) and reqs[j].msg_type == MSG_FLOW:
+                            # Optional trailing trace TLV (spans): a
+                            # traced request becomes a 4-tuple the token
+                            # service records a server span for.
+                            tp = codec.read_trace_tlv(
+                                reqs[j].entity, codec.FLOW_REQ_SIZE)
+                            ctx = parse_traceparent(tp) if tp else None
+                            r = codec.decode_flow_request(reqs[j].entity)
                             burst.append(
                                 (reqs[j].xid,
-                                 codec.decode_flow_request(reqs[j].entity)))
+                                 r + (ctx,) if ctx is not None else r))
                             j += 1
                         done, box = server.batcher.submit_many(
                             [r for _, r in burst])
@@ -175,10 +185,16 @@ class _Handler(socketserver.BaseRequestHandler):
                                 replies.append(codec.encode_response(
                                     xid, MSG_FLOW, TokenResultStatus.FAIL))
                             else:
+                                entity = codec.encode_flow_response(
+                                    result.remaining, result.wait_ms)
+                                if result.server_span is not None:
+                                    sp = result.server_span
+                                    entity = codec.append_trace_tlv(
+                                        entity, codec.encode_span_info(
+                                            sp["spanId"], sp["startMs"],
+                                            sp["durationUs"]))
                                 replies.append(codec.encode_response(
-                                    xid, MSG_FLOW, result.status,
-                                    codec.encode_flow_response(
-                                        result.remaining, result.wait_ms)))
+                                    xid, MSG_FLOW, result.status, entity))
                         self._send(b"".join(replies))
                         i = j
                     else:
@@ -212,10 +228,22 @@ class _Handler(socketserver.BaseRequestHandler):
             self._send(codec.encode_response(
                 req.xid, MSG_PING, TokenResultStatus.OK))
         elif req.msg_type == MSG_PARAM_FLOW:
+            from sentinel_tpu.telemetry.spans import parse_traceparent
+
             flow_id, count, params = codec.decode_param_flow_request(req.entity)
-            result = server.service.request_param_token(flow_id, count, params)
+            tp = codec.read_trace_tlv(
+                req.entity, codec.param_flow_request_size(req.entity))
+            ctx = parse_traceparent(tp) if tp else None
+            result = server.service.request_param_token(
+                flow_id, count, params, trace=ctx)
+            entity = b""
+            if result.server_span is not None:
+                sp = result.server_span
+                entity = codec.append_trace_tlv(
+                    b"", codec.encode_span_info(
+                        sp["spanId"], sp["startMs"], sp["durationUs"]))
             self._send(codec.encode_response(
-                req.xid, MSG_PARAM_FLOW, result.status))
+                req.xid, MSG_PARAM_FLOW, result.status, entity))
         elif req.msg_type == MSG_ENTRY:
             resource, origin, count, etype, prio, params = \
                 codec.decode_entry_request(req.entity)

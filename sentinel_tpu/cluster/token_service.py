@@ -45,11 +45,16 @@ from sentinel_tpu.utils.param_hash import hash_param
 
 
 class TokenResult(NamedTuple):
-    """Reference: ``TokenResult`` (status + optional wait hint)."""
+    """Reference: ``TokenResult`` (status + optional wait hint).
+
+    ``server_span`` rides only on traced requests (telemetry/spans.py):
+    the server-side token-service span's identity + timing, shipped back
+    over the wire so the client can stitch per-hop latency."""
 
     status: int
     remaining: int = 0
     wait_ms: int = 0
+    server_span: Optional[Dict] = None  # {"spanId","startMs","durationUs"}
 
 
 class ConnectionManager:
@@ -229,6 +234,12 @@ class DefaultTokenService:
             donate_argnums=(0,))
         # Param-flow cluster buckets: (flowId, param_hash) -> (window_start, used)
         self._param_buckets: Dict[Tuple[int, int], Tuple[int, float]] = {}
+        # Server-side spans (telemetry/spans.py): every TRACED request
+        # records a token-service span here — sampling already happened
+        # on the client, so the server keeps whatever arrives traced.
+        from sentinel_tpu.telemetry.spans import SpanCollector
+
+        self.spans = SpanCollector(sample_every=0)
 
     def _ensure_compiled(self):
         if self._compiled_version == self.rules.version:
@@ -271,17 +282,29 @@ class DefaultTokenService:
         results = self.request_tokens([(flow_id, count, prioritized)], now_ms)
         return results[0]
 
-    def request_tokens(self, requests: Sequence[Tuple[int, int, bool]],
+    def request_tokens(self, requests: Sequence[Tuple],
                        now_ms: Optional[int] = None) -> List[TokenResult]:
-        """Batched acquire — the TCP frontend folds concurrent clients in."""
+        """Batched acquire — the TCP frontend folds concurrent clients in.
+
+        Each request is ``(flow_id, count, prioritized)`` or, for traced
+        requests (telemetry/spans.py), ``(flow_id, count, prioritized,
+        TraceContext)`` — the trace context from the client's traceparent
+        TLV. Traced requests get a server-side span (recorded in
+        ``self.spans`` AND returned in ``TokenResult.server_span``)
+        timing the actual device acquire step their verdict came from.
+        """
+        import time as _time
+
         now = now_ms if now_ms is not None else time_util.current_time_millis()
+        traces = [r[3] if len(r) > 3 else None for r in requests]
         with self._lock:
             self._ensure_compiled()
             out: List[Optional[TokenResult]] = [None] * len(requests)
             slots = np.full(len(requests), -1, np.int32)
             counts = np.zeros(len(requests), np.int32)
             prio = np.zeros(len(requests), bool)
-            for i, (flow_id, count, prioritized) in enumerate(requests):
+            for i, req in enumerate(requests):
+                flow_id, count, prioritized = req[0], req[1], req[2]
                 try:
                     flow_id = int(flow_id)
                 except (TypeError, ValueError):
@@ -293,6 +316,7 @@ class DefaultTokenService:
                 slots[i] = self._slot_of.get(flow_id, -1)
                 counts[i] = count
                 prio[i] = prioritized
+            t0 = _time.perf_counter()
             self._state, status, extra = self._acquire_jit(
                 self._state, self._rt, self._conn_tensor(),
                 jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(prio),
@@ -301,6 +325,10 @@ class DefaultTokenService:
             )
             status = np.asarray(status)
             extra = np.asarray(extra)
+            # The batch shares one device step; each traced request's span
+            # carries the step wall (its verdict's true compute cost) plus
+            # its own verdict attributes.
+            step_us = int((_time.perf_counter() - t0) * 1e6)
             for i in range(len(requests)):
                 if out[i] is None:
                     s = int(status[i])
@@ -308,12 +336,41 @@ class DefaultTokenService:
                         out[i] = TokenResult(s, wait_ms=int(extra[i]))
                     else:
                         out[i] = TokenResult(s, remaining=int(extra[i]))
+                if traces[i] is not None:
+                    out[i] = out[i]._replace(server_span=self._record_span(
+                        traces[i], requests[i][0], now, step_us,
+                        int(out[i].status), len(requests)))
             return out  # type: ignore[return-value]
 
+    def _record_span(self, ctx, flow_id, start_ms: int, duration_us: int,
+                     status: int, batch_n: int) -> Dict:
+        """One server-side token-service span; returns the wire-shippable
+        identity+timing dict (TokenResult.server_span)."""
+        child = ctx.child()
+        self.spans.record_remote(
+            child, "cluster.token_service", ctx.span_id, start_ms,
+            duration_us, attrs={"flowId": flow_id, "status": status,
+                                "batch": batch_n})
+        return {"spanId": child.span_id, "startMs": int(start_ms),
+                "durationUs": int(duration_us)}
+
     def request_param_token(self, flow_id: int, count: int,
-                            params: Sequence, now_ms: Optional[int] = None) -> TokenResult:
+                            params: Sequence, now_ms: Optional[int] = None,
+                            trace=None) -> TokenResult:
         """Per-(flowId, param) global QPS buckets (``ClusterParamFlowChecker``)."""
+        import time as _time
+
         now = now_ms if now_ms is not None else time_util.current_time_millis()
+        t0 = _time.perf_counter()
+        result = self._request_param_token(flow_id, count, params, now)
+        if trace is not None:
+            result = result._replace(server_span=self._record_span(
+                trace, flow_id, now, int((_time.perf_counter() - t0) * 1e6),
+                int(result.status), 1))
+        return result
+
+    def _request_param_token(self, flow_id: int, count: int,
+                             params: Sequence, now: int) -> TokenResult:
         try:
             flow_id = int(flow_id)  # one bucket key space for "123" and 123
         except (TypeError, ValueError):

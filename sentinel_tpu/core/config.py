@@ -57,6 +57,17 @@ PROFILE_SYNC_EVERY = "csp.sentinel.profile.syncEvery"
 # trace (0 disables); trace.capacity bounds the host-side ring.
 TELEMETRY_TRACE_SAMPLE_EVERY = "csp.sentinel.telemetry.trace.sampleEvery"
 TELEMETRY_TRACE_CAPACITY = "csp.sentinel.telemetry.trace.capacity"
+# timeseries.seconds: device-resident flight-recorder ring length in
+# seconds (0 disables recording entirely — no ring tensors on device);
+# timeseries.history.seconds bounds the compacted host-side spill.
+TELEMETRY_TIMESERIES_SECONDS = "csp.sentinel.telemetry.timeseries.seconds"
+TELEMETRY_TIMESERIES_HISTORY = \
+    "csp.sentinel.telemetry.timeseries.history.seconds"
+# spans.sampleEvery: every Nth cluster-checked entry carries a W3C-style
+# trace context across the token-server wire (0 disables); spans.capacity
+# bounds the host-side span ring on each side.
+TELEMETRY_SPANS_SAMPLE_EVERY = "csp.sentinel.telemetry.spans.sampleEvery"
+TELEMETRY_SPANS_CAPACITY = "csp.sentinel.telemetry.spans.capacity"
 
 DEFAULT_CHARSET = "utf-8"
 DEFAULT_SINGLE_METRIC_FILE_SIZE = 50 * 1024 * 1024
@@ -76,6 +87,14 @@ DEFAULT_RESILIENCE_ENTRY_BUDGET_MS = 500
 DEFAULT_PROFILE_SYNC_EVERY = 64
 DEFAULT_TELEMETRY_TRACE_SAMPLE_EVERY = 64
 DEFAULT_TELEMETRY_TRACE_CAPACITY = 256
+# ~128 s on device (≈ int32 ring of [S, E+A+H, R] rows-minor slices);
+# at the default 4096-row capacity that is ~55 MB of device memory —
+# size it down (or to 0) on memory-tight deployments, up for longer
+# on-device lookback (docs/OPERATIONS.md "Tracing & flight recorder").
+DEFAULT_TELEMETRY_TIMESERIES_SECONDS = 128
+DEFAULT_TELEMETRY_TIMESERIES_HISTORY = 1024
+DEFAULT_TELEMETRY_SPANS_SAMPLE_EVERY = 64
+DEFAULT_TELEMETRY_SPANS_CAPACITY = 256
 
 
 def _env_key(key: str) -> str:

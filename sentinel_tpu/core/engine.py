@@ -275,6 +275,30 @@ class SentinelEngine:
         from sentinel_tpu.telemetry.trace_ring import DecisionTraceBuffer
 
         self.traces = DecisionTraceBuffer(self)
+        # Cross-process spans (telemetry/spans.py): every Nth cluster-
+        # checked entry carries a trace context over the token-server
+        # wire; the stitched spans land here for the `traces` command's
+        # span view and the OTLP export.
+        from sentinel_tpu.telemetry.spans import SpanCollector
+
+        self.spans = SpanCollector()
+        # Flight recorder (telemetry/timeseries.py): device ring length
+        # (0 disables the device tensors entirely) + the compacted
+        # host-side history the ring spills into on reads.
+        from sentinel_tpu.core.config import (
+            DEFAULT_TELEMETRY_TIMESERIES_HISTORY,
+            DEFAULT_TELEMETRY_TIMESERIES_SECONDS,
+            TELEMETRY_TIMESERIES_HISTORY,
+            TELEMETRY_TIMESERIES_SECONDS,
+        )
+        from sentinel_tpu.telemetry.timeseries import TimeseriesHistory
+
+        self.flight_seconds = max(0, _cfg.get_int(
+            TELEMETRY_TIMESERIES_SECONDS,
+            DEFAULT_TELEMETRY_TIMESERIES_SECONDS))
+        self.timeseries = TimeseriesHistory(_cfg.get_int(
+            TELEMETRY_TIMESERIES_HISTORY,
+            DEFAULT_TELEMETRY_TIMESERIES_HISTORY))
         # Token-lease fast path (core/lease.py): host-admitted resources +
         # the async stats committer. Rebuilt on every rule push.
         self.lease_enabled = (
@@ -316,6 +340,12 @@ class SentinelEngine:
         self._flush_jit = jax.jit(S.flush_seconds, donate_argnums=(0,))
         self._w60_read_jit = jax.jit(lambda st_, now, idx: jnp.transpose(
             W_.rotate(st_.w60, now, S.SPEC_60S).counts[idx], (2, 0, 1)))
+        # Flight-recorder spill read: gather only the requested ring
+        # slots on device, ONE host transfer (full-ring reads would move
+        # the whole ~55MB ring per spill).
+        self._flight_read_jit = jax.jit(lambda st_, idx: (
+            st_.flight.events[idx], st_.flight.attr[idx],
+            st_.flight.hist[idx], st_.flight.slot_attr[idx]))
         # SPI boot (reference: Env static init -> InitExecutor.doInit) +
         # device-checker splice: the step re-jits when registrations change.
         from sentinel_tpu.core import spi as spi_mod
@@ -567,7 +597,8 @@ class SentinelEngine:
             self._state = S.make_state(self.capacity, ft.num_rules, now,
                                        degrade=D.make_degrade_state(dt, di),
                                        param=P.make_param_state(pt.num_rules),
-                                       spec1=self._spec1)
+                                       spec1=self._spec1,
+                                       flight_seconds=self.flight_seconds)
             self._maybe_start_system_listener()
             self._compile_shadow()
             return
@@ -961,13 +992,33 @@ class SentinelEngine:
             # device check sees them, and mirror the verdict below so the
             # lease never drifts from the device window.
             self._flush_committer()
+        # Cross-process span sampling (telemetry/spans.py): only entries
+        # with cluster-mode rules can cross the wire, so only those are
+        # sampled — the root "entry" span records the final verdict, the
+        # cluster check hangs token_request + server-side spans under it.
+        trace_ctx = root_span = None
+        if self._cluster_flow_info.get(resource) \
+                or self._cluster_param_info.get(resource):
+            trace_ctx = self.spans.sample()
+        if trace_ctx is not None:
+            from sentinel_tpu.telemetry.spans import Span
+
+            root_span = Span("sentinel.entry", trace_ctx,
+                             attrs={"resource": resource,
+                                    "origin": ctx.origin})
         skip_cluster, pre_blocked = self._cluster_token_check(
-            resource, count, prioritized, args)
+            resource, count, prioritized, args, trace=trace_ctx)
         reason, wait_us = self._submit_entry(
             resource, cluster_row, dn_row, origin_row, origin_id,
             reg.context_id(ctx.name), count, prioritized, entry_in, params,
             skip_cluster=skip_cluster, pre_blocked=pre_blocked,
         )
+        if root_span is not None:
+            root_span.attrs.update(
+                reason=int(reason),
+                blocked=bool(reason > 0 and reason != C.BlockReason.WAIT),
+                preBlocked=bool(pre_blocked))
+            self.spans.record(root_span.finish())
         if reason > 0 and reason != C.BlockReason.WAIT:
             # Drop an auto-entered context with no live entries so a fresh
             # ContextUtil.enter on this thread isn't shadowed by it.
@@ -1008,7 +1059,8 @@ class SentinelEngine:
         if budget_exhausted:
             self.cluster_budget_exhausted_count += 1
 
-    def _cluster_token_check(self, resource, count, prioritized, args) -> Tuple[bool, bool]:
+    def _cluster_token_check(self, resource, count, prioritized, args,
+                             trace=None) -> Tuple[bool, bool]:
         """Remote token acquire for cluster-mode rules (``passClusterCheck``).
 
         Returns (skip_cluster, pre_blocked): with a healthy token client,
@@ -1040,6 +1092,31 @@ class SentinelEngine:
             return False, False
         from sentinel_tpu.cluster.constants import TokenResultStatus
 
+        def traced_call(kind, flow_id, fn):
+            """Run one remote acquire under a child span when tracing;
+            the server-side span (shipped in the response TLV) joins the
+            local collector so the stitched trace reads in one place."""
+            if trace is None:
+                return fn(None)
+            from sentinel_tpu.telemetry.spans import Span, TraceContext
+
+            child = trace.child()
+            sp = Span("cluster.token_request", child,
+                      parent_span_id=trace.span_id,
+                      attrs={"flowId": flow_id, "kind": kind})
+            tr = fn(child)
+            sp.finish()
+            sp.attrs["status"] = int(tr.status)
+            self.spans.record(sp)
+            if tr.server_span is not None:
+                srv = tr.server_span
+                self.spans.record_remote(
+                    TraceContext(trace.trace_id, srv["spanId"]),
+                    "cluster.token_service", child.span_id,
+                    srv["startMs"], srv["durationUs"],
+                    attrs={"flowId": flow_id})
+            return tr
+
         budget = DeadlineBudget(self.cluster_entry_budget_ms)
         # A request launched with less than half the configured budget
         # left is breaker-NEUTRAL on timeout: a healthy server can miss a
@@ -1054,9 +1131,9 @@ class SentinelEngine:
                     all_ok = False
                 self._note_cluster_fallback(budget_exhausted=True)
                 continue
-            tr = client.request_token(
+            tr = traced_call("flow", flow_id, lambda t: client.request_token(
                 flow_id, count, prioritized, timeout_s=remaining_ms / 1000.0,
-                gate_neutral=remaining_ms < neutral_below_ms)
+                gate_neutral=remaining_ms < neutral_below_ms, trace=t))
             if tr.status == TokenResultStatus.OK:
                 continue
             if tr.status == TokenResultStatus.SHOULD_WAIT:
@@ -1078,10 +1155,11 @@ class SentinelEngine:
                     all_ok = False
                 self._note_cluster_fallback(budget_exhausted=True)
                 continue
-            tr = client.request_param_token(
-                flow_id, count, [args[param_idx]],
-                timeout_s=remaining_ms / 1000.0,
-                gate_neutral=remaining_ms < neutral_below_ms)
+            tr = traced_call(
+                "param", flow_id, lambda t: client.request_param_token(
+                    flow_id, count, [args[param_idx]],
+                    timeout_s=remaining_ms / 1000.0,
+                    gate_neutral=remaining_ms < neutral_below_ms, trace=t))
             if tr.status == TokenResultStatus.OK:
                 continue
             if tr.status == TokenResultStatus.BLOCKED:
@@ -1429,8 +1507,10 @@ class SentinelEngine:
             block = np.asarray(tele.block_by_reason)
             hist = np.asarray(tele.rt_hist)
             totals = np.asarray(tele.totals)
+            block_slot = np.asarray(tele.block_by_slot)
             stage_attr = np.asarray(tele.stage_attr)
             stage_hist = np.asarray(tele.stage_hist)
+            stage_slot_bins = np.asarray(tele.stage_slot)
         # Read-side fold of the live staged second (S.telemetry_view
         # semantics, done host-side so reads never dispatch a program):
         # exact at any instant, whatever the fold cadence on device.
@@ -1438,6 +1518,7 @@ class SentinelEngine:
             "blockByReason": block + stage_attr.astype(np.int64),
             "rtHist": hist + stage_hist.astype(np.int64),
             "totals": totals + sec_counts.astype(np.int64),
+            "blockBySlot": block_slot + stage_slot_bins.astype(np.int64),
         }
 
     def telemetry_snapshot(self) -> Dict:
@@ -1474,6 +1555,9 @@ class SentinelEngine:
                 "rtP95Ms": round(histogram_quantile(hist, 0.95), 2),
                 "rtP99Ms": round(histogram_quantile(hist, 0.99), 2),
             }
+        from sentinel_tpu.telemetry.attribution import slot_bins_to_dict
+
+        slot_out = slot_bins_to_dict(counts["blockBySlot"])
         return {
             "resources": resources,
             "counters": {
@@ -1482,11 +1566,152 @@ class SentinelEngine:
                 "clusterBudgetExhaustedCount":
                     self.cluster_budget_exhausted_count,
             },
+            "blockBySlot": slot_out,
             "stepTimer": self.step_timer.snapshot(),
             # snapshot(limit=0): the counter fields without the traces.
             "traceSampling": {
                 k: v for k, v in self.traces.snapshot(limit=0).items()
                 if k != "traces"
+            },
+            "spanSampling": {
+                k: v for k, v in self.spans.snapshot(limit=0).items()
+                if k != "spans"
+            },
+        }
+
+    # -- flight recorder (per-second time series) --------------------------
+
+    def _spill_flight(self, now_ms: Optional[int] = None) -> None:
+        """Pull completed seconds off the device ring into the host
+        history. Gathers ONLY slots newer than the last spilled stamp
+        (one jitted gather, one transfer); no-op when recording is off."""
+        from sentinel_tpu.telemetry.timeseries import compact_second
+
+        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        with self._lock:
+            self._ensure_compiled()
+            if self._state is None or self._state.flight is None:
+                return
+            # Fold any completed staged second into the ring first, so a
+            # read right after a second boundary sees that second.
+            self._state = self._flush_jit(self._state, now)
+            stamps = np.asarray(self._state.flight.stamps)
+            last = self.timeseries.last_stamp_ms
+            fresh = sorted((int(s), i) for i, s in enumerate(stamps.tolist())
+                           if s >= 0 and s > last)
+            if not fresh:
+                return
+            idx_list = [i for _, i in fresh]
+            # Pad to a power-of-two ladder: a backlog of k new seconds
+            # costs at most log2(ring) distinct compiles ever (the
+            # seal_metrics discipline).
+            k = len(idx_list)
+            k_pad = 1 << (k - 1).bit_length()
+            idx = jnp.asarray(idx_list + [idx_list[0]] * (k_pad - k),
+                              jnp.int32)
+            ev, attr, hist, slot = (np.asarray(x)[:k] for x in
+                                    self._flight_read_jit(self._state, idx))
+        for j, (stamp, _i) in enumerate(fresh):
+            self.timeseries.append(
+                compact_second(stamp, ev[j], attr[j], hist[j], slot[j]))
+
+    def timeseries_view(self, resource: Optional[str] = None,
+                        start_ms: Optional[int] = None,
+                        end_ms: Optional[int] = None,
+                        limit: Optional[int] = None,
+                        offset: int = 0,
+                        now_ms: Optional[int] = None) -> Dict:
+        """Exact per-second telemetry series at any offset within the
+        host retention (`timeseries` ops command / dashboard SSE source).
+
+        Seconds return in CHRONOLOGICAL order; ``offset``/``limit``
+        paginate newest-first (offset 0 ends at the most recent complete
+        second). ``resource`` filters each second's per-resource map (a
+        second with no data for it is dropped)."""
+        from sentinel_tpu.telemetry.timeseries import (
+            page_newest_first,
+            second_to_dict,
+        )
+
+        self._flush_committer()  # leased commits land before the fold
+        # ``now_ms`` drives the fold boundary: batch-API callers feeding
+        # virtual clocks pass the stream's own now so the in-progress
+        # second stays staged (exactness = COMPLETE seconds only).
+        self._spill_flight(now_ms)
+        recs = self.timeseries.query(start_ms, end_ms)
+        metas = self.registry.meta
+        # Filter + paginate on the compact RECORDS, render only the
+        # served page: a periodic caller (the exporter's limit=1, each
+        # SSE poll) must not pay a full-history JSON render per read.
+        if resource is not None:
+            row = self.registry.get_cluster_row(resource)
+            recs = ([r for r in recs if row in r.rows]
+                    if row is not None else [])
+        total = len(recs)
+        recs = page_newest_first(recs, limit, offset)
+        seconds = [second_to_dict(r, metas, resource) for r in recs]
+        return {
+            "seconds": seconds,
+            "total": total,
+            "retainedSeconds": self.timeseries.retained(),
+            "recorderSeconds": self.flight_seconds,
+        }
+
+    def explain_trace(self, resource: Optional[str] = None,
+                      index: int = 0,
+                      now_ms: Optional[int] = None) -> Optional[Dict]:
+        """Join one sampled blocked-entry trace with the flight-recorder
+        second it occurred in: what the verdict was (reason + rule slot),
+        what that resource's traffic looked like THAT second (window
+        occupancy, per-reason blocks), and which rules of the blocking
+        family were loaded — the "why was this blocked" reconstruction,
+        with no step re-run (`explain` ops command)."""
+        from sentinel_tpu.datasource import converters as CV
+
+        self.traces.drain()
+        traces = self.traces.snapshot()["traces"]
+        if resource is not None:
+            traces = [t for t in traces if t["resource"] == resource]
+        index = max(0, int(index))
+        if index >= len(traces):
+            return None
+        tr = traces[index]
+        sec_start = tr["timestamp"] - tr["timestamp"] % 1000
+        view = self.timeseries_view(resource=tr["resource"],
+                                    start_ms=sec_start,
+                                    end_ms=sec_start + 1000,
+                                    now_ms=now_ms)
+        second = view["seconds"][0] if view["seconds"] else None
+        fam_rules = {
+            "FLOW": (self.flow_rules, CV.flow_rule_to_dict),
+            "DEGRADE": (self.degrade_rules, CV.degrade_rule_to_dict),
+            "AUTHORITY": (self.authority_rules, CV.authority_rule_to_dict),
+            "PARAM_FLOW": (self.param_rules, CV.param_rule_to_dict),
+            "SYSTEM": (self.system_rules, CV.system_rule_to_dict),
+        }.get(tr["reason"])
+        matched = []
+        if fam_rules is not None:
+            mgr, to_dict = fam_rules
+            matched = [to_dict(r) for r in mgr.get_rules()
+                       if getattr(r, "resource", tr["resource"])
+                       == tr["resource"]]
+        res_second = (second or {}).get("resources", {}).get(
+            tr["resource"], {})
+        return {
+            "trace": tr,
+            # The full second the entry fell in (None when it predates
+            # retention or recording is disabled).
+            "second": second,
+            "occupancy": {
+                "passThatSecond": res_second.get("pass", 0),
+                "blockThatSecond": res_second.get("block", 0),
+                "occupiedPassThatSecond": res_second.get("occupiedPass", 0),
+                "windowAtTrace": tr.get("window", {}),
+            },
+            "verdict": {
+                "reason": tr["reason"],
+                "ruleSlot": tr["ruleSlot"],
+                "matchedRules": matched,
             },
         }
 

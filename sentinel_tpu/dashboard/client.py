@@ -109,12 +109,39 @@ class SentinelApiClient:
         return json.loads(self.get(ip, port, "telemetry"))
 
     def fetch_traces(self, ip: str, port: int,
-                     limit: Optional[int] = None) -> Dict:
+                     limit: Optional[int] = None,
+                     offset: Optional[int] = None) -> Dict:
         """Sampled decision traces (``traces`` command), drained first."""
         params = {"drain": "true"}
         if limit is not None:
             params["limit"] = limit
+        if offset is not None:
+            params["offset"] = offset
         return json.loads(self.get(ip, port, "traces", params))
+
+    def fetch_timeseries(self, ip: str, port: int,
+                         since_ms: Optional[int] = None,
+                         resource: Optional[str] = None,
+                         limit: Optional[int] = None) -> Dict:
+        """Flight-recorder per-second windows (``timeseries`` command);
+        ``since_ms`` is the SSE pump's cursor (strictly-after filter)."""
+        params: Dict = {}
+        if since_ms is not None:
+            params["sinceMs"] = since_ms
+        if resource is not None:
+            params["resource"] = resource
+        if limit is not None:
+            params["limit"] = limit
+        return json.loads(self.get(ip, port, "timeseries", params))
+
+    def fetch_explain(self, ip: str, port: int,
+                      resource: Optional[str] = None,
+                      index: int = 0) -> Dict:
+        """``explain`` join: sampled trace × flight-recorder second."""
+        params: Dict = {"index": index}
+        if resource is not None:
+            params["resource"] = resource
+        return json.loads(self.get(ip, port, "explain", params))
 
     def rollout_command(self, ip: str, port: int, params: Dict,
                         body: str = "") -> Dict:

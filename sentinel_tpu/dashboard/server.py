@@ -30,6 +30,10 @@ set as a small JSON API plus one static page:
   * ``GET  /telemetry/summary.json?app=``     engine telemetry snapshot
   * ``GET  /telemetry/traces.json?app=``      sampled decision traces
     (both proxy the machines' ``telemetry`` / ``traces`` commands)
+  * ``GET  /telemetry/stream?app=``           Server-Sent Events: one
+    ``event: second`` per new complete flight-recorder second (proxies
+    the machines' ``timeseries`` command on a ~1s cadence; fetch
+    failures surface as ``event: error`` frames, the stream stays up)
   * ``POST /rollout/command?app=&op=``        stage/canary/promote/abort/tick
     (no reference twin — proxies the engines' ``rollout`` command)
   * ``POST /cluster/assign?app=&ip=&port=``   token-server assignment
@@ -99,6 +103,12 @@ class DashboardServer:
         self.repository = InMemoryMetricsRepository()
         self.fetcher = MetricFetcher(self.apps, self.repository,
                                      interval_s=fetch_interval_s)
+        # SSE (/telemetry/stream): poll cadence against the machines'
+        # `timeseries` command, and the live consumer gauge the
+        # dashboard /metrics exposition reports.
+        self.stream_interval_s = 1.0
+        self.sse_clients = 0
+        self._sse_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -465,6 +475,8 @@ class _Handler(BaseHTTPRequestHandler):
 
                 return self._text(render_dashboard_metrics(d),
                                   OPENMETRICS_CONTENT_TYPE)
+            if path == "/telemetry/stream":
+                return self._sse_stream(d, q)
             if path in ("/telemetry/summary.json", "/telemetry/traces.json"):
                 kind = "traces" if path.endswith("traces.json") else "summary"
                 limit = q.get("limit")
@@ -494,6 +506,75 @@ class _Handler(BaseHTTPRequestHandler):
             return self._fail(f"bad request: {ex}")
         except BrokenPipeError:
             pass
+
+    def _sse_stream(self, d: DashboardServer, q):
+        """``/telemetry/stream``: Server-Sent Events pushing each new
+        complete flight-recorder second of the app's first healthy
+        machine (``event: second``, data = the `timeseries` command's
+        per-second JSON). A failed upstream fetch emits ``event: error``
+        with a structured body and the stream keeps polling — a machine
+        restart mid-stream degrades to error frames, not a dropped
+        connection. ``maxEvents=`` closes the stream after N second
+        events (bounded consumption for tests/tools)."""
+        app = q.get("app", "")
+        try:
+            max_events = int(q.get("maxEvents", "0") or 0)
+        except ValueError:
+            return self._fail("bad request: maxEvents")
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream; charset=utf-8")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+
+        def emit(event: str, payload) -> None:
+            self.wfile.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n"
+                .encode("utf-8"))
+            self.wfile.flush()
+
+        with d._sse_lock:
+            d.sse_clients += 1
+        cursor = None
+        sent = 0
+        try:
+            # stop() nulls _server; without this check a connected
+            # stream would keep polling engines ~1/s forever after the
+            # server is stopped (ThreadingHTTPServer's server_close
+            # only closes the LISTENING socket, never handler threads).
+            while d._server is not None:
+                try:
+                    m = d._first_healthy(app)
+                    # First poll: only the newest 60 (a fresh consumer
+                    # wants recent context, not the whole history).
+                    # Cursor polls: EVERYTHING after the cursor — a
+                    # capped catch-up would silently skip the seconds
+                    # beyond the cap while the cursor jumped past them.
+                    out = d.api.fetch_timeseries(
+                        m.ip, m.port, since_ms=cursor,
+                        limit=60 if cursor is None else 1_000_000)
+                    for sec in out.get("seconds", []):
+                        cursor = max(cursor or 0, int(sec["timestamp"]))
+                        emit("second", sec)
+                        sent += 1
+                        if max_events and sent >= max_events:
+                            return
+                except (ApiError, ValueError, KeyError) as ex:
+                    # Structured failure INSIDE the stream: consumers see
+                    # what broke instead of a silent stall.
+                    emit("error", {"error": str(ex)})
+                if max_events and sent >= max_events:
+                    return
+                # Comment frame doubles as the disconnect probe: a gone
+                # client surfaces as BrokenPipe here, ending the loop.
+                self.wfile.write(b": keepalive\n\n")
+                self.wfile.flush()
+                time.sleep(d.stream_interval_s)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            with d._sse_lock:
+                d.sse_clients -= 1
 
     def _range(self, q):
         now = int(time.time() * 1000)

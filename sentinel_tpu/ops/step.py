@@ -38,8 +38,10 @@ from sentinel_tpu.ops import window as W
 from sentinel_tpu.telemetry.attribution import (
     NUM_ATTR_REASONS,
     NUM_RT_BUCKETS,
+    NUM_SLOT_BINS,
     REASON_CHANNEL_TABLE,
     rt_bucket_index,
+    slot_bin_index,
 )
 
 SPEC_1S = W.WindowSpec(C.SECOND_WINDOW_MS, C.SECOND_BUCKETS)
@@ -101,6 +103,41 @@ class ShadowState(NamedTuple):
     counts: jax.Array     # int64[NUM_SHADOW_COUNTERS, R] cumulative
 
 
+class FlightRecorder(NamedTuple):
+    """Device-resident per-second telemetry ring (the "flight recorder").
+
+    One slot per wall-clock second, indexed ``(second_start_ms // 1000)
+    % ring``: each slot holds that second's EXACT deltas — the same
+    tensors the ``_roll_second`` fold already stages (``sec.counts``,
+    the attribution/histogram/slot staging), snapshotted with one
+    in-place dynamic-slice write per tensor AT the fold, i.e. at most
+    once per second and zero new per-step work. ``stamps`` carries each
+    slot's second-start ms (-1 = never written); a reader validates the
+    stamp before trusting a slot, so ring wrap-around and idle seconds
+    (nothing staged -> nothing folded -> stale slot) are self-describing
+    rather than silently wrong. Host-side spill + longer bounded history
+    live in telemetry/timeseries.py; rows-minor layout like every other
+    stats tensor (ops/window.py docstring).
+    """
+
+    stamps: jax.Array      # int64[RING] second-start ms per slot; -1 unset
+    events: jax.Array      # int32[RING, NUM_EVENTS, R] per-second deltas
+    attr: jax.Array        # int32[RING, NUM_ATTR_REASONS, R]
+    hist: jax.Array        # int32[RING, NUM_RT_BUCKETS, R]
+    slot_attr: jax.Array   # int32[RING, NUM_ATTR_REASONS, NUM_SLOT_BINS]
+
+
+def make_flight_recorder(num_rows: int, seconds: int) -> FlightRecorder:
+    return FlightRecorder(
+        stamps=jnp.full((seconds,), -1, jnp.int64),
+        events=jnp.zeros((seconds, C.NUM_EVENTS, num_rows), jnp.int32),
+        attr=jnp.zeros((seconds, NUM_ATTR_REASONS, num_rows), jnp.int32),
+        hist=jnp.zeros((seconds, NUM_RT_BUCKETS, num_rows), jnp.int32),
+        slot_attr=jnp.zeros((seconds, NUM_ATTR_REASONS, NUM_SLOT_BINS),
+                            jnp.int32),
+    )
+
+
 class TelemetryState(NamedTuple):
     """Cumulative device-resident telemetry (sentinel_tpu/telemetry/).
 
@@ -129,9 +166,15 @@ class TelemetryState(NamedTuple):
     # ``sec.counts`` — which already carries every commit, including
     # occupy grants — so it costs nothing per step.
     totals: jax.Array           # int64[NUM_EVENTS, R]
+    # Cumulative blocked counts per (reason family, rule-slot bin) —
+    # engine-global, not per-resource (the per-resource split is
+    # ``block_by_reason``; the slot axis answers "WHICH rule of that
+    # family", telemetry/attribution.py SLOT_BIN_LABELS).
+    block_by_slot: jax.Array    # int64[NUM_ATTR_REASONS, NUM_SLOT_BINS]
     # Current-second staging (the only per-step telemetry writes).
     stage_attr: jax.Array       # int32[NUM_ATTR_REASONS, R]
     stage_hist: jax.Array       # int32[NUM_RT_BUCKETS, R]
+    stage_slot: jax.Array       # int32[NUM_ATTR_REASONS, NUM_SLOT_BINS]
 
 
 def make_telemetry_state(num_rows: int) -> TelemetryState:
@@ -139,8 +182,10 @@ def make_telemetry_state(num_rows: int) -> TelemetryState:
         block_by_reason=jnp.zeros((NUM_ATTR_REASONS, num_rows), jnp.int64),
         rt_hist=jnp.zeros((NUM_RT_BUCKETS, num_rows), jnp.int64),
         totals=jnp.zeros((C.NUM_EVENTS, num_rows), jnp.int64),
+        block_by_slot=jnp.zeros((NUM_ATTR_REASONS, NUM_SLOT_BINS), jnp.int64),
         stage_attr=jnp.zeros((NUM_ATTR_REASONS, num_rows), jnp.int32),
         stage_hist=jnp.zeros((NUM_RT_BUCKETS, num_rows), jnp.int32),
+        stage_slot=jnp.zeros((NUM_ATTR_REASONS, NUM_SLOT_BINS), jnp.int32),
     )
 
 
@@ -154,8 +199,10 @@ def telemetry_view(state: "SentinelState") -> TelemetryState:
         + tele.stage_attr.astype(jnp.int64),
         rt_hist=tele.rt_hist + tele.stage_hist.astype(jnp.int64),
         totals=tele.totals + state.sec.counts.astype(jnp.int64),
+        block_by_slot=tele.block_by_slot + tele.stage_slot.astype(jnp.int64),
         stage_attr=jnp.zeros_like(tele.stage_attr),
         stage_hist=jnp.zeros_like(tele.stage_hist),
+        stage_slot=jnp.zeros_like(tele.stage_slot),
     )
 
 
@@ -186,6 +233,11 @@ class SentinelState(NamedTuple):
     # is installed (None otherwise — installing/removing one is a pytree
     # STRUCTURE change, i.e. exactly one retrace, like a rule-shape change).
     shadow: Optional[ShadowState] = None
+    # Per-second flight-recorder ring (telemetry/timeseries.py), present
+    # when the engine enables time-series retention (None = disabled, the
+    # default for bare make_state callers; same structure-change stance
+    # as ``shadow``). Written only at the ``_roll_second`` fold.
+    flight: Optional[FlightRecorder] = None
 
 
 class RulePack(NamedTuple):
@@ -201,7 +253,8 @@ class RulePack(NamedTuple):
 def make_state(num_rows: int, flow_rules: int, now_ms: int,
                degrade: D.DegradeState = None,
                param: P.ParamFlowState = None,
-               spec1: W.WindowSpec = SPEC_1S) -> SentinelState:
+               spec1: W.WindowSpec = SPEC_1S,
+               flight_seconds: int = 0) -> SentinelState:
     if degrade is None:
         dt, di = D.compile_degrade_rules([], None, num_rows)
         degrade = D.make_degrade_state(dt, di)
@@ -223,6 +276,8 @@ def make_state(num_rows: int, flow_rules: int, now_ms: int,
         occupied_next=jnp.zeros((num_rows,), jnp.int32),
         occupied_stamp=jnp.int64(-1),
         telemetry=make_telemetry_state(num_rows),
+        flight=(make_flight_recorder(num_rows, flight_seconds)
+                if flight_seconds > 0 else None),
     )
 
 
@@ -247,8 +302,8 @@ def make_shadow_state(num_rows: int, shadow_rules: RulePack,
 
 def _roll_second(
     w60: W.Window, sec: SecondAccum, telemetry: TelemetryState,
-    now_ms: jax.Array
-) -> Tuple[W.Window, SecondAccum, TelemetryState]:
+    flight: Optional[FlightRecorder], now_ms: jax.Array
+) -> Tuple[W.Window, SecondAccum, TelemetryState, Optional[FlightRecorder]]:
     """Fold the staged second into the minute window if the second rolled.
 
     The fold rotates only the stamped bucket (lazy reset, exactly
@@ -256,7 +311,10 @@ def _roll_second(
     with one dense add — at most once per second instead of per step.
     The cumulative telemetry counters fold on the same ride (and from the
     same pre-reset ``sec.counts``), so the wide int64 tensors are touched
-    once per second, not per step.
+    once per second, not per step. The flight recorder (when present)
+    snapshots the SAME pre-reset staging tensors into its per-second ring
+    slot on the same ride — one in-place dynamic-slice write per tensor,
+    at most once per second, zero new per-step work.
     """
     sec_start = now_ms.astype(jnp.int64) - now_ms.astype(jnp.int64) % SPEC_60S.bucket_ms
     need = (sec.stamp >= 0) & (sec.stamp != sec_start)
@@ -268,22 +326,41 @@ def _roll_second(
         min_rt = wf.min_rt.at[idx].set(jnp.minimum(wf.min_rt[idx], sec.min_rt))
         return W.Window(counts, min_rt, wf.starts)
 
+    tele0 = telemetry
+
     def fold_tele(t):
         return TelemetryState(
             block_by_reason=t.block_by_reason + t.stage_attr.astype(jnp.int64),
             rt_hist=t.rt_hist + t.stage_hist.astype(jnp.int64),
             totals=t.totals + sec.counts.astype(jnp.int64),
+            block_by_slot=t.block_by_slot + t.stage_slot.astype(jnp.int64),
             stage_attr=jnp.zeros_like(t.stage_attr),
             stage_hist=jnp.zeros_like(t.stage_hist),
+            stage_slot=jnp.zeros_like(t.stage_slot),
+        )
+
+    def fold_flight(f):
+        # Slot for the COMPLETED second (sec.stamp, not sec_start): ring
+        # index = absolute second number mod ring length, so any reader
+        # can address an offset directly and validate against ``stamps``.
+        idx = (sec.stamp // SPEC_60S.bucket_ms) % f.stamps.shape[0]
+        return FlightRecorder(
+            stamps=f.stamps.at[idx].set(sec.stamp),
+            events=f.events.at[idx].set(sec.counts),
+            attr=f.attr.at[idx].set(tele0.stage_attr),
+            hist=f.hist.at[idx].set(tele0.stage_hist),
+            slot_attr=f.slot_attr.at[idx].set(tele0.stage_slot),
         )
 
     w60 = jax.lax.cond(need, fold, lambda w: w, w60)
     telemetry = jax.lax.cond(need, fold_tele, lambda t: t, telemetry)
+    if flight is not None:
+        flight = jax.lax.cond(need, fold_flight, lambda f: f, flight)
     return w60, SecondAccum(
         counts=jnp.where(need, 0, sec.counts),
         min_rt=jnp.where(need, W.MIN_RT_EMPTY, sec.min_rt),
         stamp=sec_start,
-    ), telemetry
+    ), telemetry, flight
 
 
 def flush_seconds(state: SentinelState, now_ms: jax.Array) -> SentinelState:
@@ -296,9 +373,10 @@ def flush_seconds(state: SentinelState, now_ms: jax.Array) -> SentinelState:
     :func:`telemetry_view`).
     """
     now_ms = jnp.asarray(now_ms, jnp.int64)
-    w60, sec, telemetry = _roll_second(state.w60, state.sec,
-                                       state.telemetry, now_ms)
-    return state._replace(w60=w60, sec=sec, telemetry=telemetry)
+    w60, sec, telemetry, flight = _roll_second(
+        state.w60, state.sec, state.telemetry, state.flight, now_ms)
+    return state._replace(w60=w60, sec=sec, telemetry=telemetry,
+                          flight=flight)
 
 
 def _target_rows(cluster_row, dn_row, origin_row, entry_in):
@@ -482,8 +560,9 @@ def entry_step(
     # Minute-window commits are staged in the [E, R] second accumulator and
     # folded at most once per second; readers (BBR check below, host metric
     # sealing) combine w60 + the live accumulator themselves.
-    w60, sec, tele = _roll_second(state.w60, state.sec, state.telemetry,
-                                  now_ms)
+    w60, sec, tele, flight = _roll_second(state.w60, state.sec,
+                                          state.telemetry, state.flight,
+                                          now_ms)
 
     # Land pending occupy borrows: once the bucket after the granting one is
     # current, its borrowed counts become real PASS there (reference:
@@ -662,9 +741,17 @@ def entry_step(
         jnp.clip(reason, 0, REASON_CHANNEL_TABLE.shape[0] - 1)]
     attr_on = valid & blocked & (attr_ch >= 0)
     attr_rows = W.oob(jnp.where(attr_on, batch.cluster_row, -1), w1.num_rows)
-    tele = tele._replace(stage_attr=tele.stage_attr.at[
-        jnp.maximum(attr_ch, 0), attr_rows].add(
-        jnp.where(attr_on, batch.count, 0), mode="drop"))
+    # The (reason, rule-slot) staging shares the same mask: one more tiny
+    # scatter into a [A, SLOT_BINS] tensor (remote/pre-decided verdicts
+    # carry slot -1 and land in the "unknown" bin).
+    slot_bins = jnp.where(attr_on, slot_bin_index(rule_slot), NUM_SLOT_BINS)
+    tele = tele._replace(
+        stage_attr=tele.stage_attr.at[
+            jnp.maximum(attr_ch, 0), attr_rows].add(
+            jnp.where(attr_on, batch.count, 0), mode="drop"),
+        stage_slot=tele.stage_slot.at[
+            jnp.maximum(attr_ch, 0), slot_bins].add(
+            jnp.where(attr_on, batch.count, 0), mode="drop"))
 
     if s_eval is not None:
         sh_w1 = sh_w1._replace(counts=sh_w1.counts.at[
@@ -693,7 +780,8 @@ def entry_step(
                               occupied_next=occupied_next,
                               occupied_stamp=occupied_stamp,
                               telemetry=tele,
-                              shadow=shadow_new)
+                              shadow=shadow_new,
+                              flight=flight)
     return new_state, Decisions(reason=reason, wait_us=wait_us,
                                 rule_slot=rule_slot)
 
@@ -718,8 +806,9 @@ def exit_step(
     """
     now_ms = jnp.asarray(now_ms, jnp.int64)
     w1 = W.rotate(state.w1, now_ms, spec1)
-    w60, sec, tele = _roll_second(state.w60, state.sec, state.telemetry,
-                                  now_ms)
+    w60, sec, tele, flight = _roll_second(state.w60, state.sec,
+                                          state.telemetry, state.flight,
+                                          now_ms)
 
     valid = batch.cluster_row >= 0
     rows4 = _target_rows(batch.cluster_row, batch.dn_row, batch.origin_row, batch.entry_in)
@@ -788,4 +877,4 @@ def exit_step(
 
     return state._replace(w1=w1, w60=w60, cur_threads=cur_threads,
                           degrade=degrade, param=param, sec=sec,
-                          telemetry=telemetry, shadow=shadow)
+                          telemetry=telemetry, shadow=shadow, flight=flight)

@@ -179,6 +179,24 @@ def global_telemetry_counts(state: S.SentinelState) -> S.TelemetryState:
                         S.telemetry_view(state))
 
 
+def global_flight_recorder(state: S.SentinelState) -> Optional[S.FlightRecorder]:
+    """Pod-global flight recorder from a [D, ...] pod state: per-slot
+    stamps are clock-derived and identical on every device, so the
+    global per-second deltas are the device-axis sum of the ring tensors
+    (same read-time reduction as :func:`global_telemetry_counts`).
+    None when recording is disabled."""
+    fl = state.flight
+    if fl is None:
+        return None
+    return S.FlightRecorder(
+        stamps=fl.stamps[0],
+        events=jnp.sum(fl.events, axis=0),
+        attr=jnp.sum(fl.attr, axis=0),
+        hist=jnp.sum(fl.hist, axis=0),
+        slot_attr=jnp.sum(fl.slot_attr, axis=0),
+    )
+
+
 def make_pod_steps(mesh: Mesh, axis: str = AXIS, cluster_param: bool = True,
                    occupy_timeout_ms: int = C.DEFAULT_OCCUPY_TIMEOUT_MS,
                    shadow_rules=None, canary_bps=None, canary_salt=None):

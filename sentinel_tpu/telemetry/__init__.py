@@ -22,6 +22,18 @@ Three pieces, spanning kernel to scrape endpoint:
   counters, resilience channels, rollout guardrail state, StepTimer
   percentiles, and the attribution/RT-histogram series under stable
   ``sentinel_tpu_*`` names.
+* **Flight recorder** (``timeseries.py`` + the ``FlightRecorder`` ring
+  in ``ops/step.py``): exact per-second telemetry deltas retained on
+  device (~128 s) and spilled to a compacted host history — the
+  time-resolved view the cumulative counters cannot give, served by the
+  ``timeseries`` ops command, the dashboard's ``/telemetry/stream``
+  SSE route, and the ``explain`` trace×second join.
+* **Cross-process spans** (``spans.py``): W3C-traceparent-style trace
+  context carried across the cluster token-server wire (trailing TLV,
+  wire-compatible with old peers), so a sampled entry's trace stitches
+  engine decision -> token request -> server-side token-service span
+  with per-hop timings; OTLP-flavored JSON export via the ``traces``
+  command.
 """
 
 from sentinel_tpu.telemetry.attribution import (  # noqa: F401
@@ -38,5 +50,17 @@ from sentinel_tpu.telemetry.attribution import (  # noqa: F401
 from sentinel_tpu.telemetry.openmetrics import (  # noqa: F401
     OPENMETRICS_CONTENT_TYPE,
     OpenMetricsBuilder,
+)
+from sentinel_tpu.telemetry.spans import (  # noqa: F401
+    SpanCollector,
+    TraceContext,
+    new_trace_context,
+    parse_traceparent,
+    to_otlp,
+)
+from sentinel_tpu.telemetry.timeseries import (  # noqa: F401
+    SecondRecord,
+    TimeseriesHistory,
+    second_to_dict,
 )
 from sentinel_tpu.telemetry.trace_ring import DecisionTraceBuffer  # noqa: F401

@@ -94,6 +94,44 @@ def decode_reason_code(code: int) -> Tuple[int, int]:
 
 
 # ---------------------------------------------------------------------------
+# Rule-slot bins for the flight recorder's per-(reason, slot) series
+# (telemetry/timeseries.py): slots 0..MAX individually, one bin for the
+# long tail, one for "unknown" (-1: remote token-server verdicts, system
+# rules' global set). Real per-resource slot counts are single digits
+# (the engine's per-family ratchet), so 8 exact bins cover practice.
+# ---------------------------------------------------------------------------
+
+SLOT_BIN_MAX_EXACT = 8                    # bins 0..7 are exact slot indices
+SLOT_BIN_OVERFLOW = SLOT_BIN_MAX_EXACT    # slot >= 8
+SLOT_BIN_UNKNOWN = SLOT_BIN_MAX_EXACT + 1  # slot -1 (remote / unattributed)
+NUM_SLOT_BINS = SLOT_BIN_MAX_EXACT + 2
+
+SLOT_BIN_LABELS: Tuple[str, ...] = tuple(
+    [str(i) for i in range(SLOT_BIN_MAX_EXACT)] + ["8+", "unknown"])
+
+
+def slot_bin_index(slot: jax.Array) -> jax.Array:
+    """int32[N] slot bin per rule-slot value (device-side)."""
+    binned = jnp.minimum(slot, SLOT_BIN_OVERFLOW)
+    return jnp.where(slot < 0, SLOT_BIN_UNKNOWN, binned).astype(jnp.int32)
+
+
+def slot_bins_to_dict(arr) -> dict:
+    """[NUM_ATTR_REASONS, NUM_SLOT_BINS] counts -> {reason: {label:
+    count}} with zero bins and empty reasons skipped — the ONE rendering
+    of the (reason, slot) split every JSON surface shares (`telemetry`
+    snapshot, `timeseries` seconds, SSE, `explain`)."""
+    out = {}
+    for ch, reason in enumerate(ATTR_REASON_NAMES):
+        bins = {SLOT_BIN_LABELS[b]: int(arr[ch, b])
+                for b in range(min(arr.shape[1], NUM_SLOT_BINS))
+                if arr[ch, b]}
+        if bins:
+            out[reason] = bins
+    return out
+
+
+# ---------------------------------------------------------------------------
 # RT histogram geometry: log2 buckets 1ms..4096ms + overflow. The top edge
 # clears DEFAULT_MAX_RT_MS (4900 is clamped on commit, landing in +Inf
 # only for the raw >4096 tail), and 14 buckets keep the per-step commit at

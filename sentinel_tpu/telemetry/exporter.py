@@ -22,6 +22,7 @@ from sentinel_tpu.core import constants as C
 from sentinel_tpu.telemetry.attribution import (
     ATTR_REASON_NAMES,
     RT_BUCKET_EDGES_MS,
+    SLOT_BIN_LABELS,
 )
 from sentinel_tpu.telemetry.openmetrics import OpenMetricsBuilder
 
@@ -77,6 +78,18 @@ def render_engine_metrics(engine) -> str:
             if v:
                 b.sample("sentinel_tpu_block_reason_total",
                          {"resource": res, "reason": reason}, v)
+
+    b.family("sentinel_tpu_block_slot", "counter",
+             "Blocked entries per (rule family, first-blocking rule-slot "
+             "bin) — engine-global; 'unknown' = remote/pre-decided "
+             "verdicts with no local rule identity")
+    by_slot = counts["blockBySlot"]
+    for ch, reason in enumerate(ATTR_REASON_NAMES):
+        for bin_i, label in enumerate(SLOT_BIN_LABELS):
+            v = int(by_slot[ch, bin_i])
+            if v:
+                b.sample("sentinel_tpu_block_slot_total",
+                         {"reason": reason, "slot": label}, v)
 
     b.family("sentinel_tpu_rt_ms", "histogram",
              "Response time of successful completions, device-bucketed "
@@ -165,6 +178,46 @@ def render_engine_metrics(engine) -> str:
                 b.sample("sentinel_tpu_enqueue_ms",
                          {"kind": kind, "quantile": f"0.{q}"}, v)
 
+    # -- flight recorder (per-second series) ------------------------------
+    # The LAST complete second per resource as gauges: scrapers that
+    # cannot ingest the `timeseries` command still get a per-second
+    # trajectory at 1 Hz scrape cadence (cumulative counters above give
+    # totals; these give the derivative, device-exact).
+    ts = engine.timeseries_view(limit=1)
+    last = ts["seconds"][-1] if ts["seconds"] else None
+    b.family("sentinel_tpu_second_pass", "gauge",
+             "Admitted entries in the last complete flight-recorder "
+             "second, per resource")
+    if last is not None:
+        for res, vals in sorted(last["resources"].items()):
+            b.sample("sentinel_tpu_second_pass", {"resource": res},
+                     vals["pass"])
+    b.family("sentinel_tpu_second_block", "gauge",
+             "Blocked entries in the last complete flight-recorder "
+             "second, per resource")
+    if last is not None:
+        for res, vals in sorted(last["resources"].items()):
+            b.sample("sentinel_tpu_second_block", {"resource": res},
+                     vals["block"])
+    b.family("sentinel_tpu_timeseries_last_second", "gauge",
+             "Stamp (ms) of the newest complete flight-recorder second "
+             "(-1: none recorded yet)")
+    b.sample("sentinel_tpu_timeseries_last_second", None,
+             last["timestamp"] if last is not None else -1)
+    b.family("sentinel_tpu_timeseries_retained_seconds", "gauge",
+             "Complete seconds retained in the host-side history")
+    b.sample("sentinel_tpu_timeseries_retained_seconds", None,
+             ts["retainedSeconds"])
+
+    # -- span sampling health --------------------------------------------
+    ssnap = engine.spans.snapshot(limit=0)
+    b.counter("sentinel_tpu_spans_seen",
+              "Cluster-checked entries observed by the span sampler",
+              ssnap["seen"])
+    b.counter("sentinel_tpu_spans_recorded",
+              "Cross-process spans retained in the host ring",
+              ssnap["recorded"])
+
     # -- trace sampling health -------------------------------------------
     tsnap = engine.traces.snapshot(limit=0)
     b.counter("sentinel_tpu_traces_seen_blocked",
@@ -214,4 +267,8 @@ def render_dashboard_metrics(dashboard) -> str:
     for app, res, latest in rows:
         b.sample("sentinel_tpu_dashboard_resource_block_qps",
                  {"app": app, "resource": res}, latest["blockQps"])
+    b.family("sentinel_tpu_dashboard_sse_clients", "gauge",
+             "Live /telemetry/stream consumers currently connected")
+    b.sample("sentinel_tpu_dashboard_sse_clients", None,
+             getattr(dashboard, "sse_clients", 0))
     return b.render()

@@ -17,11 +17,20 @@ from typing import Dict, List, Optional, Sequence, Tuple
 OPENMETRICS_CONTENT_TYPE = (
     "application/openmetrics-text; version=1.0.0; charset=utf-8")
 
-_ESCAPES = {"\\": "\\\\", "\"": "\\\"", "\n": "\\n"}
+# Escaping per the OpenMetrics 1.0 ABNF: label VALUES escape backslash,
+# double-quote and newline; HELP text escapes only backslash and newline
+# (a quote is legal there verbatim — escaping it produces the invalid
+# sequence ``\"`` strict parsers reject).
+_LABEL_ESCAPES = {"\\": "\\\\", "\"": "\\\"", "\n": "\\n"}
+_HELP_ESCAPES = {"\\": "\\\\", "\n": "\\n"}
 
 
 def _escape_label(v: str) -> str:
-    return "".join(_ESCAPES.get(ch, ch) for ch in str(v))
+    return "".join(_LABEL_ESCAPES.get(ch, ch) for ch in str(v))
+
+
+def _escape_help(v: str) -> str:
+    return "".join(_HELP_ESCAPES.get(ch, ch) for ch in str(v))
 
 
 def _fmt_value(v) -> str:
@@ -49,7 +58,7 @@ class OpenMetricsBuilder:
         """Start a family. ``mtype``: gauge | counter | histogram | info."""
         self._lines.append(f"# TYPE {name} {mtype}")
         if help_text:
-            self._lines.append(f"# HELP {name} {_escape_label(help_text)}")
+            self._lines.append(f"# HELP {name} {_escape_help(help_text)}")
 
     def sample(self, name: str, labels: Optional[Dict[str, str]],
                value) -> None:

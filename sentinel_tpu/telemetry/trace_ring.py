@@ -69,12 +69,18 @@ class DecisionTraceBuffer:
         self._recorded = 0
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # stop() is terminal until start(): a submit racing (or
+        # following) stop must be a silent no-op, never a worker
+        # resurrection — engine.close() joins the worker exactly once.
+        self._stopped = False
 
     # -- dispatch-path side (cheap; may run under the engine lock) --------
 
     def submit(self, batch, decisions, now_ms: int) -> None:
-        """Queue one dispatched batch's verdicts for async sampling."""
-        if self.sample_every <= 0:
+        """Queue one dispatched batch's verdicts for async sampling.
+        Never blocks: a full hand-off queue drops the batch (counted),
+        and a stopped buffer ignores the submit entirely."""
+        if self.sample_every <= 0 or self._stopped:
             return
         self._ensure_worker()
         try:
@@ -87,6 +93,13 @@ class DecisionTraceBuffer:
     def _ensure_worker(self) -> None:
         if self._worker is None or not self._worker.is_alive():
             with self._lock:
+                # Re-check _stopped under the lock: a submit that passed
+                # the unsynchronized fast-path check while stop() ran to
+                # completion must NOT resurrect the worker (stop() flips
+                # _stopped under this same lock before swapping the
+                # worker out).
+                if self._stopped:
+                    return
                 if self._worker is None or not self._worker.is_alive():
                     self._stop.clear()
                     self._worker = threading.Thread(
@@ -218,15 +231,18 @@ class DecisionTraceBuffer:
 
     # -- read side --------------------------------------------------------
 
-    def snapshot(self, limit: Optional[int] = None) -> Dict:
+    def snapshot(self, limit: Optional[int] = None,
+                 offset: int = 0) -> Dict:
         """Ring + sampler counters, newest trace first. ``limit=0`` is
-        the counters-only read (exporter / `telemetry` command)."""
+        the counters-only read (exporter / `telemetry` command);
+        ``offset`` skips the newest N traces (pagination)."""
+        from sentinel_tpu.telemetry.timeseries import page_newest_first
+
         with self._lock:
             traces = list(self._ring)
             seen, recorded = self._seen_blocked, self._recorded
+        traces = page_newest_first(traces, limit, offset)
         traces.reverse()  # newest first
-        if limit is not None:
-            traces = traces[:max(0, int(limit))]
         return {
             "sampleEvery": self.sample_every,
             "capacity": self.capacity,
@@ -237,9 +253,24 @@ class DecisionTraceBuffer:
             "traces": traces,
         }
 
+    def start(self) -> "DecisionTraceBuffer":
+        """Re-arm a stopped buffer (tests / engine restart); the worker
+        itself spawns lazily on the next submit."""
+        self._stopped = False
+        return self
+
     def stop(self) -> None:
-        self._stop.set()
-        worker, self._worker = self._worker, None
+        """Terminal until :meth:`start`: joins the worker, and later
+        submits are silent no-ops (never a worker resurrection)."""
+        # Flip + swap under the spawn lock so a racing _ensure_worker
+        # either sees _stopped or finishes spawning before we take the
+        # worker out — never a fresh worker left behind after stop().
+        # The join stays OUTSIDE the lock: the worker takes self._lock
+        # in _process and would deadlock against a lock-holding join.
+        with self._lock:
+            self._stopped = True
+            self._stop.set()
+            worker, self._worker = self._worker, None
         if worker is not None:
             worker.join(timeout=2.0)
-        atexit.unregister(self.stop)  # idempotent; re-armed on next start
+        atexit.unregister(self.stop)  # idempotent; re-armed on start()

@@ -290,22 +290,94 @@ def cmd_telemetry(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success(req.engine.telemetry_snapshot())
 
 
-@command_mapping("traces", "sampled blocked-entry decision traces")
+@command_mapping("traces", "sampled blocked-entry decision traces + "
+                           "cross-process spans")
 def cmd_traces(req: CommandRequest) -> CommandResponse:
     """The decision-trace ring (telemetry/trace_ring.py): every Nth
     blocked entry's (resource, origin, reason, rule slot, window
-    snapshot), newest first. ``limit=`` caps the returned traces;
-    ``drain=true`` processes any queued batches synchronously first
-    (deterministic reads for tooling)."""
+    snapshot), newest first. ``limit=`` caps the returned traces and
+    ``offset=`` skips the newest N (pagination); ``drain=true``
+    processes any queued batches synchronously first (deterministic
+    reads for tooling). ``spans=true`` adds the cross-process span view
+    (telemetry/spans.py — engine decision -> token request -> server
+    token-service, grouped per trace id); ``format=otlp`` returns the
+    collected spans as OTLP-flavored JSON instead (feed it to any OTLP
+    HTTP receiver / trace viewer)."""
     traces = req.engine.traces
     if (req.get_param("drain") or "").lower() == "true":
         traces.drain()
-    limit = req.get_param("limit")
     try:
+        limit = req.get_param("limit")
         limit_n = int(limit) if limit is not None else None
+        offset_n = int(req.get_param("offset", "0"))
     except ValueError:
-        return CommandResponse.of_failure("invalid parameter: limit")
-    return CommandResponse.of_success(traces.snapshot(limit=limit_n))
+        return CommandResponse.of_failure("invalid parameter: limit/offset")
+    if (req.get_param("format") or "").lower() == "otlp":
+        from sentinel_tpu.core.config import config as _cfg
+        from sentinel_tpu.telemetry.spans import to_otlp
+
+        snap = req.engine.spans.snapshot(limit=limit_n, offset=offset_n)
+        return CommandResponse.of_success(
+            to_otlp(snap["spans"], service_name=_cfg.app_name()))
+    out = traces.snapshot(limit=limit_n, offset=offset_n)
+    if (req.get_param("spans") or "").lower() == "true":
+        out["spanTraces"] = req.engine.spans.traces(limit=limit_n)
+        out["spanSampling"] = {
+            k: v for k, v in req.engine.spans.snapshot(limit=0).items()
+            if k != "spans"}
+    return CommandResponse.of_success(out)
+
+
+@command_mapping("timeseries", "flight recorder: exact per-second "
+                               "telemetry series")
+def cmd_timeseries(req: CommandRequest) -> CommandResponse:
+    """Per-second flight-recorder windows (telemetry/timeseries.py):
+    pass/block/success/exception/RT-bucket deltas per resource plus the
+    per-(reason, rule-slot) split, exact per wall-clock second.
+    ``resource=`` filters; ``sinceMs=`` returns only seconds strictly
+    after the given stamp (the SSE pump's cursor); ``startMs=``/
+    ``endMs=`` bound the window; ``limit=``/``offset=`` paginate
+    newest-first (chronological inside the page). Cursor reads
+    (``sinceMs`` without an explicit ``limit``) are UNBOUNDED: the
+    newest-first default cap would silently drop the oldest unserved
+    seconds for a consumer more than one page behind, advancing its
+    cursor past data the host still retains."""
+    try:
+        limit = req.get_param("limit")
+        since = req.get_param("sinceMs")
+        limit_n = (int(limit) if limit is not None
+                   else None if since is not None else 60)
+        offset_n = int(req.get_param("offset", "0"))
+        start = req.get_param("startMs")
+        start_n = int(since) + 1 if since is not None else (
+            int(start) if start is not None else None)
+        end = req.get_param("endMs")
+        end_n = int(end) if end is not None else None
+    except ValueError:
+        return CommandResponse.of_failure("invalid parameter")
+    return CommandResponse.of_success(req.engine.timeseries_view(
+        resource=req.get_param("resource"), start_ms=start_n, end_ms=end_n,
+        limit=limit_n, offset=offset_n))
+
+
+@command_mapping("explain", "why was this entry blocked: trace × "
+                            "flight-recorder join")
+def cmd_explain(req: CommandRequest) -> CommandResponse:
+    """Join a sampled blocked-entry trace with the flight-recorder
+    second it occurred in: verdict (reason + first-blocking rule slot),
+    that second's window occupancy for the resource, and the loaded
+    rules of the blocking family — reconstructed from recorded data, no
+    step re-run. ``resource=`` picks the newest trace for a resource,
+    ``index=`` pages further back (0 = newest)."""
+    try:
+        index = int(req.get_param("index", "0"))
+    except ValueError:
+        return CommandResponse.of_failure("invalid parameter: index")
+    out = req.engine.explain_trace(resource=req.get_param("resource"),
+                                   index=index)
+    if out is None:
+        return CommandResponse.of_failure("no matching trace sampled yet")
+    return CommandResponse.of_success(out)
 
 
 @command_mapping("metrics", "Prometheus/OpenMetrics exposition")

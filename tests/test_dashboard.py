@@ -7,6 +7,7 @@ server.
 """
 
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -237,6 +238,7 @@ def test_ui_reaches_every_backend_endpoint(dash):
         "/cluster/state.json",
         "/telemetry/summary.json",
         "/telemetry/traces.json",
+        "/telemetry/stream",
         "/metrics",
     ]:
         assert endpoint in page, f"UI does not reference {endpoint}"
@@ -490,6 +492,112 @@ def test_metric_history_series_shape(dash, engine, frozen_time, tmp_path,
                               "successQps", "exceptionQps", "rt"}
     finally:
         center.stop()
+
+
+def _read_sse_events(url, timeout=10):
+    """Consume one bounded SSE response into [(event, data_dict)]."""
+    events = []
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        event = None
+        for raw in r:
+            line = raw.decode("utf-8").rstrip("\n")
+            if line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: ") and event is not None:
+                events.append((event, json.loads(line[len("data: "):])))
+                event = None
+    return events
+
+
+def test_sse_stream_pushes_flight_recorder_seconds(dash, engine):
+    """/telemetry/stream proxies the machines' `timeseries` command as
+    SSE: each new complete second arrives as one `event: second` with
+    the per-resource deltas."""
+    from sentinel_tpu.utils import time_util
+
+    from tests.test_telemetry import _batch
+
+    c1 = CommandCenter(engine, port=0).start()
+    try:
+        HeartbeatSender(dashboards=[f"127.0.0.1:{dash.bound_port}"],
+                        api_port=c1.bound_port).send_once()
+        app = _get(dash, "/app/names.json")[0]
+        st.load_flow_rules([st.FlowRule(resource="sse", count=2)])
+        now = time_util.current_time_millis()
+        base = now - now % 1000 - 3000  # three already-complete seconds
+        for k in range(3):
+            engine.check_batch(_batch(engine, [("sse", "", None)] * 4),
+                               now_ms=base + k * 1000)
+        dash.stream_interval_s = 0.05
+        events = _read_sse_events(
+            f"http://127.0.0.1:{dash.bound_port}/telemetry/stream"
+            f"?app={app}&maxEvents=3")
+        assert [e for e, _ in events] == ["second"] * 3
+        stamps = [d["timestamp"] for _, d in events]
+        assert stamps == [base, base + 1000, base + 2000]
+        for _, d in events:
+            assert d["resources"]["sse"]["pass"] == 2
+            assert d["resources"]["sse"]["block"] == 2
+            assert d["resources"]["sse"]["blockByReason"] == {"FLOW": 2}
+        # maxEvents is a hard per-event bound, even when one upstream
+        # poll returns a larger batch
+        one = _read_sse_events(
+            f"http://127.0.0.1:{dash.bound_port}/telemetry/stream"
+            f"?app={app}&maxEvents=1")
+        assert len(one) == 1 and one[0][0] == "second"
+    finally:
+        c1.stop()
+
+
+def test_sse_stream_error_frames(dash):
+    """Read the first error frame by hand (the stream never completes
+    for an app with no machines, so bound the read manually)."""
+    import socket
+
+    dash.stream_interval_s = 0.05
+    conn = socket.create_connection(("127.0.0.1", dash.bound_port),
+                                    timeout=5)
+    try:
+        conn.sendall(b"GET /telemetry/stream?app=ghost HTTP/1.1\r\n"
+                     b"Host: x\r\n\r\n")
+        buf = b""
+        deadline = time.time() + 5
+        while b"event: error" not in buf and time.time() < deadline:
+            buf += conn.recv(4096)
+        assert b"200" in buf.split(b"\r\n", 1)[0]
+        assert b"event: error" in buf
+        payload = buf.split(b"event: error\ndata: ", 1)[1]
+        err = json.loads(payload.split(b"\n", 1)[0].decode())
+        assert "ghost" in err["error"]
+    finally:
+        conn.close()
+    # the consumer gauge decays back once clients disconnect
+    deadline = time.time() + 3
+    while dash.sse_clients and time.time() < deadline:
+        time.sleep(0.02)
+    assert dash.sse_clients == 0
+
+
+def test_telemetry_routes_fail_structured_when_machine_down(dash):
+    """Dashboard fetch routes surface upstream HTTP failures as the
+    structured Result envelope (success=false + msg), never a raised
+    exception mid-poll."""
+    # register a machine that is NOT serving, then hit every proxy route
+    _post(dash, "/registry/machine?app=deadapp&ip=127.0.0.1&port=1")
+    for path in ("/telemetry/summary.json?app=deadapp",
+                 "/telemetry/traces.json?app=deadapp",
+                 "/rollout/status.json?app=deadapp",
+                 "/v1/rules?app=deadapp&type=flow"):
+        url = f"http://127.0.0.1:{dash.bound_port}{path}"
+        try:
+            urllib.request.urlopen(url, timeout=5)
+            raise AssertionError(f"expected HTTP 502 for {path}")
+        except urllib.error.HTTPError as ex:
+            body = json.loads(ex.read().decode())
+            assert ex.code == 502
+            assert body["success"] is False
+            assert body["msg"]  # the failure is described, not swallowed
 
 
 def test_gateway_rules_through_dashboard(dash, engine):

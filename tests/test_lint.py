@@ -61,6 +61,70 @@ def test_no_bare_print_in_package():
         + ", ".join(offenders))
 
 
+def _code_lines(path: Path):
+    """(lineno, code) pairs with comments and (crudely) docstrings
+    stripped — the same skip logic the bare-print gate uses."""
+    in_doc = False
+    for lineno, line in enumerate(path.read_text().splitlines(), 1):
+        stripped = line.strip()
+        if stripped.count('"""') % 2 == 1 or stripped.count("'''") % 2 == 1:
+            in_doc = not in_doc
+            continue
+        if in_doc or stripped.startswith("#"):
+            continue
+        yield lineno, line.split("#", 1)[0]
+
+
+def test_no_wall_clock_in_device_ops():
+    """Device code (sentinel_tpu/ops/) must take ``now_ms`` as an
+    argument: kernels cannot call clocks under jit, and an ambient
+    ``time.time()``/``datetime.now()`` read in ops code either leaks a
+    trace-time constant into the compiled program (frozen forever) or
+    silently diverges host/device clocks. The module docstring of
+    ops/window.py states the contract; this pins it."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(")
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu" / "ops").rglob("*.py")):
+        for lineno, code in _code_lines(path):
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in device ops code (pass now_ms instead): "
+        + ", ".join(offenders))
+
+
+def test_exported_metric_names_registered_exactly_once():
+    """Every ``sentinel_tpu_*`` metric family must be declared exactly
+    once across the telemetry exporters — a name declared twice renders
+    duplicate ``# TYPE`` lines, which strict OpenMetrics parsers reject
+    (and which silently splits one series across two declarations)."""
+    import re
+
+    # Two declaration sites: builder calls (b.family/b.counter) and the
+    # _EVENT_FAMILIES-style tuple tables whose first element is the name.
+    decl = re.compile(
+        r"(?:b\.(?:family|counter)\(\s*|^\s*\()\"(sentinel_tpu_[a-z0-9_]+)\"")
+    seen = {}
+    dupes = []
+    for path in sorted((REPO / "sentinel_tpu" / "telemetry").rglob("*.py")):
+        for lineno, code in _code_lines(path):
+            for name in decl.findall(code):
+                where = f"{path.relative_to(REPO)}:{lineno}"
+                if name in seen:
+                    dupes.append(f"{name} ({seen[name]} and {where})")
+                else:
+                    seen[name] = where
+    assert seen, "no exported metric declarations found (regex rot?)"
+    assert not dupes, "metric family declared twice: " + ", ".join(dupes)
+    # and the declarations must actually cover the families the live
+    # exposition renders (catches emission helpers bypassing family())
+    assert "sentinel_tpu_pass" in seen
+    assert "sentinel_tpu_second_pass" in seen
+
+
 @pytest.mark.skipif(shutil.which("ruff") is None,
                     reason="ruff binary not in this image")
 def test_ruff_clean():

@@ -363,6 +363,33 @@ def test_step_timer_reports_p95(frozen_time):
     assert snap["enqueueP50Ms"] <= snap["enqueueP95Ms"] <= snap["enqueueP99Ms"]
 
 
+def test_step_timer_small_n_quantiles_are_exact(frozen_time):
+    """With fewer samples than the percentile resolution, quantiles are
+    exact order statistics (nearest-rank), never interpolated: p99 of 7
+    samples IS the max sample — an observed latency, not an invented
+    value ε below it."""
+    from sentinel_tpu.metrics import StepTimer
+
+    t = StepTimer(ring=128, sync_every=1)
+    samples = [3.0, 9.0, 1.0, 7.0, 5.0, 2.0, 100.0]  # 7 samples, one spike
+    for s in samples:
+        t.record("entry", 1, s, s)
+    snap = t.snapshot()["entry"]
+    # p99 and p95 of 7 samples = the max (ceil(.99*7)=7th order stat)
+    assert snap["stepP99Ms"] == 100.0
+    assert snap["stepP95Ms"] == 100.0
+    # p50 of 7 = the 4th order statistic (ceil(.5*7)=4) — exactly 5.0
+    assert snap["stepP50Ms"] == 5.0
+    # every reported quantile is an actually-observed sample
+    for q in ("stepP50Ms", "stepP95Ms", "stepP99Ms"):
+        assert snap[q] in samples
+    # single sample: every quantile is that sample
+    t2 = StepTimer(ring=8, sync_every=1)
+    t2.record("exit", 1, 4.25, 4.25)
+    snap2 = t2.snapshot()["exit"]
+    assert snap2["stepP50Ms"] == snap2["stepP99Ms"] == 4.25
+
+
 def test_profile_sync_every_configurable(frozen_time, monkeypatch):
     """`csp.sentinel.profile.syncEvery` seeds StepTimer's sampling
     cadence; invalid values fall back to the default loudly."""

@@ -1,0 +1,243 @@
+"""Cross-process spans (telemetry/spans.py + the cluster trace TLV):
+traceparent codec, wire compatibility with TLV-blind peers, the
+end-to-end engine -> token-server stitch, and the OTLP export.
+
+The load-bearing property is the CLUSTER test: one sampled entry's
+trace carries one trace id across the wire — the client ring holds the
+engine decision span, the token_request span, and the server-shipped
+token-service span; the server's own collector holds the same
+token-service span under the same trace id.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.cluster.constants import THRESHOLD_GLOBAL, TokenResultStatus
+from sentinel_tpu.telemetry import spans as SP
+
+
+def _rule(flow_id, count):
+    return st.FlowRule(
+        resource=f"res{flow_id}", count=count, cluster_mode=True,
+        cluster_config={"flowId": flow_id,
+                        "thresholdType": THRESHOLD_GLOBAL})
+
+
+# -- trace context / codec ---------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = SP.new_trace_context()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    parsed = SP.parse_traceparent(ctx.traceparent())
+    assert parsed == ctx
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id and child.span_id != ctx.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    "", "00-abc-def-01", "zz-" + "0" * 32 + "-" + "1" * 16 + "-01",
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",          # non-hex trace
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",          # all-zero trace
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",          # all-zero span
+    "00-" + "a" * 32 + "-" + "b" * 16,                  # missing flags
+])
+def test_traceparent_rejects_malformed(bad):
+    assert SP.parse_traceparent(bad) is None
+
+
+def test_trace_tlv_round_trip_and_wire_compat():
+    """The TLV rides after the entity; TLV-blind decoders (old peers)
+    read the same values, TLV-aware readers recover it exactly."""
+    base = codec.encode_flow_request(900, 2, True)
+    ctx = SP.new_trace_context()
+    tagged = codec.append_trace_tlv(base, ctx.traceparent())
+    # old decoder: identical result, trailing bytes ignored
+    assert codec.decode_flow_request(tagged) == \
+        codec.decode_flow_request(base) == (900, 2, True)
+    # new reader: exact recovery at the entity's fixed size
+    assert codec.read_trace_tlv(tagged, codec.FLOW_REQ_SIZE) \
+        == ctx.traceparent()
+    # absent / truncated / wrong-tag: None, never an exception
+    assert codec.read_trace_tlv(base, codec.FLOW_REQ_SIZE) is None
+    assert codec.read_trace_tlv(tagged[:-3], codec.FLOW_REQ_SIZE) is None
+    assert codec.read_trace_tlv(b"\x00\x00\x05abc", 0) is None
+    # param-flow entities are self-delimiting: offset helper finds the TLV
+    p = codec.encode_param_flow_request(7, 1, ["k", 3, True])
+    ptag = codec.append_trace_tlv(p, ctx.traceparent())
+    assert codec.decode_param_flow_request(ptag) == \
+        codec.decode_param_flow_request(p)
+    assert codec.read_trace_tlv(
+        ptag, codec.param_flow_request_size(ptag)) == ctx.traceparent()
+
+
+def test_span_info_round_trip():
+    s = codec.encode_span_info("ab" * 8, 1_700_000_000_123, 4567)
+    assert codec.decode_span_info(s) == ("ab" * 8, 1_700_000_000_123, 4567)
+    assert codec.decode_span_info("garbage") is None
+    assert codec.decode_span_info("a:b:c") is None
+
+
+# -- collector ---------------------------------------------------------------
+
+def test_span_collector_sampling_capacity_and_pagination():
+    col = SP.SpanCollector(sample_every=3, capacity=4)
+    hits = [col.sample() for _ in range(9)]
+    got = [h for h in hits if h is not None]
+    assert len(got) == 3  # every 3rd
+    for ctx in got:
+        col.record(SP.Span("s", ctx).finish(duration_us=10))
+    for k in range(6):
+        col.record(SP.Span(f"extra{k}", SP.new_trace_context()).finish(0))
+    snap = col.snapshot()
+    assert snap["recorded"] == 9 and len(snap["spans"]) == 4  # capacity
+    assert snap["spans"][0]["name"] == "extra5"  # newest first
+    page = col.snapshot(limit=2, offset=1)["spans"]
+    assert [s["name"] for s in page] == ["extra4", "extra3"]
+    disabled = SP.SpanCollector(sample_every=0)
+    assert disabled.sample() is None
+
+
+def test_otlp_export_shape():
+    col = SP.SpanCollector(sample_every=1)
+    ctx = col.sample()
+    root = SP.Span("root", ctx, attrs={"resource": "r", "count": 2,
+                                       "ok": True, "ratio": 0.5})
+    col.record(root.finish(duration_us=1500))
+    out = SP.to_otlp(col.snapshot()["spans"], service_name="app1")
+    scope = out["resourceSpans"][0]["scopeSpans"][0]
+    sp = scope["spans"][0]
+    assert sp["traceId"] == ctx.trace_id and sp["spanId"] == ctx.span_id
+    start = int(sp["startTimeUnixNano"])
+    assert int(sp["endTimeUnixNano"]) - start == 1_500_000
+    attrs = {a["key"]: a["value"] for a in sp["attributes"]}
+    assert attrs["resource"] == {"stringValue": "r"}
+    assert attrs["count"] == {"intValue": "2"}
+    assert attrs["ok"] == {"boolValue": True}
+    assert attrs["ratio"] == {"doubleValue": 0.5}
+    svc = {a["key"]: a["value"] for a in
+           out["resourceSpans"][0]["resource"]["attributes"]}
+    assert svc["service.name"] == {"stringValue": "app1"}
+    assert json.dumps(out)  # JSON-serializable end to end
+
+
+# -- cluster end-to-end ------------------------------------------------------
+
+def _connect_client(engine, server):
+    engine.cluster.set_to_client("127.0.0.1", server.bound_port)
+    deadline = time.time() + 3
+    while engine.cluster.client_if_active() is None \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert engine.cluster.client_if_active() is not None
+
+
+def test_cluster_trace_stitches_across_the_wire(engine, frozen_time):
+    """One sampled BLOCKED entry: the client ring holds engine decision
+    + token_request + the server-shipped token-service span under ONE
+    trace id; the server's own collector holds the same span id."""
+    engine.spans.sample_every = 1  # sample every cluster-checked entry
+    st.load_flow_rules([_rule(910, 0)])  # remote quota 0: always blocked
+
+    server_rules = ClusterFlowRuleManager()
+    server_rules.load_rules("default", [_rule(910, 0)])
+    service = DefaultTokenService(server_rules)
+    # Warm the acquire jit OUTSIDE the entry's deadline budget: the
+    # first-compile stall would otherwise time the request out and
+    # degrade this entry to the local check (a resilience behavior
+    # covered elsewhere).
+    service.request_token(910)
+    server = ClusterTokenServer(service, host="127.0.0.1", port=0).start()
+    try:
+        _connect_client(engine, server)
+        assert st.entry_ok("res910") is None  # remote BLOCKED pre-decides
+
+        snap = engine.spans.snapshot()
+        by_name = {s["name"]: s for s in snap["spans"]}
+        assert set(by_name) == {"sentinel.entry", "cluster.token_request",
+                                "cluster.token_service"}
+        root = by_name["sentinel.entry"]
+        reqsp = by_name["cluster.token_request"]
+        srvsp = by_name["cluster.token_service"]
+        # one shared trace id across all three hops
+        assert root["traceId"] == reqsp["traceId"] == srvsp["traceId"]
+        # parentage: entry -> token_request -> token_service
+        assert reqsp["parentSpanId"] == root["spanId"]
+        assert srvsp["parentSpanId"] == reqsp["spanId"]
+        # verdict attribution on the hops
+        assert root["attributes"]["resource"] == "res910"
+        assert root["attributes"]["blocked"] is True
+        assert root["attributes"]["preBlocked"] is True
+        assert reqsp["attributes"]["status"] \
+            == int(TokenResultStatus.BLOCKED)
+        # per-hop timings: the wire+queue hop can never be cheaper than
+        # the server-side step it contains
+        assert reqsp["durationUs"] >= srvsp["durationUs"] >= 0
+
+        # the SERVER recorded the same span under the same trace
+        srv_snap = service.spans.snapshot()
+        assert len(srv_snap["spans"]) == 1
+        assert srv_snap["spans"][0]["traceId"] == root["traceId"]
+        assert srv_snap["spans"][0]["spanId"] == srvsp["spanId"]
+        assert srv_snap["spans"][0]["attributes"]["flowId"] == 910
+
+        # grouped view: one trace with all three spans
+        traces = engine.spans.traces()
+        assert len(traces) == 1 and len(traces[0]["spans"]) == 3
+    finally:
+        server.stop()
+        engine.cluster.stop()
+
+
+def test_unsampled_entries_carry_no_trace(engine, frozen_time):
+    """sample_every=0 disables span work entirely — nothing recorded on
+    either side, requests still served."""
+    engine.spans.sample_every = 0
+    st.load_flow_rules([_rule(911, 100)])
+    server_rules = ClusterFlowRuleManager()
+    server_rules.load_rules("default", [_rule(911, 100)])
+    service = DefaultTokenService(server_rules)
+    server = ClusterTokenServer(service, host="127.0.0.1", port=0).start()
+    try:
+        _connect_client(engine, server)
+        h = st.entry_ok("res911")
+        assert h is not None
+        h.exit()
+        assert engine.spans.snapshot()["recorded"] == 0
+        assert service.spans.snapshot()["recorded"] == 0
+    finally:
+        server.stop()
+        engine.cluster.stop()
+
+
+def test_traces_command_serves_spans_and_otlp(engine, frozen_time):
+    """`traces?spans=true` adds the grouped span view; `format=otlp`
+    returns the OTLP-flavored JSON document."""
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    engine.spans.sample_every = 1
+    ctx = engine.spans.sample()
+    engine.spans.record(SP.Span("sentinel.entry", ctx,
+                                attrs={"resource": "r"}).finish(100))
+    center = CommandCenter(engine, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{center.bound_port}"
+        with urllib.request.urlopen(f"{base}/traces?spans=true",
+                                    timeout=5) as r:
+            out = json.loads(r.read().decode())
+        assert out["spanTraces"][0]["traceId"] == ctx.trace_id
+        assert out["spanSampling"]["recorded"] == 1
+        with urllib.request.urlopen(f"{base}/traces?format=otlp",
+                                    timeout=5) as r:
+            otlp = json.loads(r.read().decode())
+        got = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert got[0]["traceId"] == ctx.trace_id
+    finally:
+        center.stop()

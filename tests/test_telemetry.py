@@ -383,6 +383,108 @@ def test_metrics_endpoint_parses_as_openmetrics(engine):
     assert inf[0].value == 2
 
 
+# -- trace ring worker lifecycle ---------------------------------------------
+
+def test_trace_ring_stop_joins_worker_and_submit_is_noop(engine):
+    """stop() joins the worker thread; submit() after stop() is a silent
+    no-op (no worker resurrection, nothing queued, nothing recorded)."""
+    engine.traces.sample_every = 1
+    st.load_flow_rules([st.FlowRule(resource="lw", count=0)])
+    batch = _batch(engine, [("lw", "", None)] * 2)
+    dec = engine.check_batch(batch, now_ms=BASE_MS)
+    engine.traces.drain()
+    worker = engine.traces._worker
+    assert worker is not None and worker.is_alive()
+    engine.traces.stop()
+    assert not worker.is_alive()          # joined, not abandoned
+    assert engine.traces._worker is None
+    recorded_before = engine.traces.snapshot(limit=0)["recorded"]
+    engine.traces.submit(batch, dec, BASE_MS)   # after stop: no-op
+    assert engine.traces._worker is None        # no resurrection
+    assert engine.traces._queue.qsize() == 0    # nothing queued
+    engine.traces.drain()
+    assert engine.traces.snapshot(limit=0)["recorded"] == recorded_before
+    # start() re-arms; the worker respawns lazily on the next submit
+    engine.traces.start()
+    engine.traces.submit(batch, dec, BASE_MS)
+    engine.traces.drain()
+    assert engine.traces.snapshot(limit=0)["recorded"] > recorded_before
+
+
+def test_trace_ring_full_queue_drops_never_blocks(engine):
+    """A full hand-off queue DROPS the batch (counted) — the submit path
+    returns immediately even with the worker wedged mid-item."""
+    import time as _time
+
+    engine.traces.sample_every = 1
+    st.load_flow_rules([st.FlowRule(resource="fq", count=0)])
+    batch = _batch(engine, [("fq", "", None)])
+    dec = engine.check_batch(batch, now_ms=BASE_MS)
+    engine.traces.drain()
+    dropped0 = engine.traces.snapshot(limit=0)["droppedBatches"]
+    # Wedge the worker: hold the processing lock so nothing dequeues.
+    with engine.traces._proc_lock:
+        t0 = _time.perf_counter()
+        for _ in range(engine.traces._queue.maxsize + 5):
+            engine.traces.submit(batch, dec, BASE_MS)
+        elapsed = _time.perf_counter() - t0
+    assert elapsed < 1.0  # never blocked on the full queue
+    snap = engine.traces.snapshot(limit=0)
+    assert snap["droppedBatches"] == dropped0 + 5  # overflow counted
+    engine.traces.drain()  # queued ones still process fine afterwards
+
+
+# -- OpenMetrics escaping (hostile names) ------------------------------------
+
+def test_hostile_resource_names_round_trip_openmetrics(engine):
+    """Resource/origin names containing the three ABNF-escaped label
+    characters (backslash, double quote, newline) survive the full
+    pipeline: rule load -> device step -> /metrics text -> the
+    prometheus_client OpenMetrics parser, byte-exact."""
+    from prometheus_client.openmetrics import parser as om_parser
+
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    hostile = 'evil"res\\with\nnewline'
+    st.load_flow_rules([st.FlowRule(resource=hostile, count=1)])
+    engine.check_batch(_batch(engine, [(hostile, 'o"rig\\in\n', None)] * 3),
+                       now_ms=BASE_MS)
+    text = render_engine_metrics(engine)
+    families = {f.name: f
+                for f in om_parser.text_string_to_metric_families(text)}
+    got = [s for s in families["sentinel_tpu_block_reason"].samples
+           if s.labels.get("reason") == "FLOW"]
+    assert len(got) == 1
+    assert got[0].labels["resource"] == hostile  # byte-exact round trip
+    assert got[0].value == 2
+    passes = [s for s in families["sentinel_tpu_pass"].samples
+              if s.labels.get("resource") == hostile]
+    assert passes[0].value == 1
+
+
+def test_openmetrics_help_escaping_follows_abnf():
+    """HELP text escapes ONLY backslash and newline (a quote stays
+    verbatim — ``\\"`` is invalid there); label values escape all
+    three."""
+    from sentinel_tpu.telemetry.openmetrics import OpenMetricsBuilder
+
+    b = OpenMetricsBuilder()
+    b.family("h", "counter", 'has "quotes", a \\ and a\nnewline')
+    b.sample("h_total", {"x": 'v"\\\n'}, 1)
+    text = b.render()
+    help_line = [ln for ln in text.splitlines()
+                 if ln.startswith("# HELP")][0]
+    assert '\\"' not in help_line          # quotes NOT escaped in HELP
+    assert "\\\\" in help_line and "\\n" in help_line
+    sample_line = [ln for ln in text.splitlines()
+                   if ln.startswith("h_total")][0]
+    assert '\\"' in sample_line            # quotes ARE escaped in labels
+    from prometheus_client.openmetrics import parser as om_parser
+
+    fams = list(om_parser.text_string_to_metric_families(text))
+    assert fams[0].samples[0].labels["x"] == 'v"\\\n'
+
+
 # -- pod fold ----------------------------------------------------------------
 
 def test_pod_telemetry_counts_fold_device_axis(engine):
