@@ -25,11 +25,20 @@ from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenResult
 from sentinel_tpu.cluster.client import ClusterTokenClient
 from sentinel_tpu.cluster.server import ClusterTokenServer
-from sentinel_tpu.cluster.state import ClusterStateManager
+from sentinel_tpu.cluster.state import ClusterStateManager, EpochFence
+from sentinel_tpu.cluster.ha import (
+    ClusterHAManager,
+    ClusterMap,
+    ClusterServerSpec,
+    DegradedQuota,
+    FailoverTokenClient,
+)
 
 __all__ = [
-    "ClusterFlowEvent", "ClusterFlowRuleManager", "ClusterStateManager",
+    "ClusterFlowEvent", "ClusterFlowRuleManager", "ClusterHAManager",
+    "ClusterMap", "ClusterServerSpec", "ClusterStateManager",
     "ClusterTokenClient", "ClusterTokenServer", "DefaultTokenService",
+    "DegradedQuota", "EpochFence", "FailoverTokenClient",
     "MSG_FLOW", "MSG_PARAM_FLOW", "MSG_PING", "THRESHOLD_AVG_LOCAL",
     "THRESHOLD_GLOBAL", "TokenResult", "TokenResultStatus",
 ]

@@ -56,6 +56,73 @@ def parse_namespace_rules(text: str) -> Dict[str, List[FlowRule]]:
     return out
 
 
+class StandaloneHAParticipant:
+    """One seat of an HA token-server group (``--cluster-map``): the
+    cluster-map file decides which seat leads each epoch; this process
+    binds the token port only while it IS the leader, warm-starting from
+    the shared checkpoint, and otherwise stands by as a client watching
+    the map. Rules come from the same per-namespace rules file in every
+    seat, staged into the manager's persistent rule set so a promotion
+    serves the identical rule universe the old leader did."""
+
+    def __init__(self, map_path: str, machine_id: str,
+                 rules_path: str = None, checkpoint_path: str = None,
+                 refresh_ms: int = 3000, host: str = "0.0.0.0"):
+        from sentinel_tpu.cluster.ha import ClusterHAManager
+        from sentinel_tpu.cluster.state import ClusterStateManager
+        from sentinel_tpu.datasource.converters import cluster_map_from_json
+
+        self.state = ClusterStateManager()
+        self.ha = ClusterHAManager(state=self.state, machine_id=machine_id,
+                                   checkpoint_path=checkpoint_path,
+                                   server_host=host)
+        self._rules_source = None
+        if rules_path is not None:
+            self._rules_source = FileRefreshableDataSource(
+                rules_path, converter=parse_namespace_rules,
+                recommend_refresh_ms=refresh_ms)
+            self._rules_source.property.add_listener(
+                SimplePropertyListener(self._apply_rules))
+        self._map_source = FileRefreshableDataSource(
+            map_path, converter=cluster_map_from_json,
+            recommend_refresh_ms=refresh_ms)
+        self.ha.watch(self._map_source.property)
+
+    def _apply_rules(self, ns_rules: Dict[str, List[FlowRule]]) -> None:
+        mgr = self.state.server_rules()
+        for gone in set(mgr.namespaces()) - set(ns_rules):
+            if mgr.get_rules(gone):
+                mgr.load_rules(gone, [])
+        for ns, rules in ns_rules.items():
+            mgr.load_rules(ns, rules)
+
+    def start(self) -> "StandaloneHAParticipant":
+        # Rules land BEFORE the first map apply so a leader's very first
+        # bind already serves (and checkpoint-restores) the full rule
+        # set; both initial loads fail fast, same stance as the plain
+        # standalone server.
+        if self._rules_source is not None:
+            value = self._rules_source.load_config()
+            self._rules_source.property.update_value(value)
+            self._rules_source.start(initial_load=False)
+        value = self._map_source.load_config()
+        self._map_source.property.update_value(value)
+        self._map_source.start(initial_load=False)
+        return self
+
+    def refresh(self) -> None:
+        """One deterministic poll of both files (tests / ops)."""
+        if self._rules_source is not None:
+            self._rules_source.refresh(force=True)
+        self._map_source.refresh(force=True)
+
+    def stop(self) -> None:
+        self._map_source.close()
+        if self._rules_source is not None:
+            self._rules_source.close()
+        self.ha.stop()
+
+
 class StandaloneTokenServer:
     """TLV token server + file-fed per-namespace cluster rules."""
 
@@ -124,7 +191,37 @@ def main(argv=None) -> int:
     p.add_argument("--max-allowed-qps", type=float,
                    default=DEFAULT_MAX_ALLOWED_QPS,
                    help="per-namespace self-protection cap")
+    p.add_argument("--cluster-map", default=None,
+                   help="HA mode: cluster-map JSON file (epoch + ordered "
+                        "server seats); this process leads only while the "
+                        "map says so")
+    p.add_argument("--machine-id", default=None,
+                   help="this seat's machineId in the cluster map "
+                        "(default: csp.sentinel.cluster.ha.machine.id "
+                        "or hostname@pid)")
+    p.add_argument("--ha-checkpoint", default=None,
+                   help="shared window-checkpoint path for HA warm starts "
+                        "(default: csp.sentinel.cluster.ha.checkpoint.path)")
     args = p.parse_args(argv)
+
+    if args.cluster_map:
+        from sentinel_tpu.cluster.ha import default_machine_id
+
+        machine_id = args.machine_id or default_machine_id()
+        part = StandaloneHAParticipant(
+            map_path=args.cluster_map, machine_id=machine_id,
+            rules_path=args.rules, checkpoint_path=args.ha_checkpoint,
+            refresh_ms=args.refresh_ms, host=args.host)
+        part.start()
+        print(f"HA participant {machine_id} role="
+              f"{part.state.ha_stats()['roleName']} "
+              f"epoch={part.state.ha_stats()['epoch']}", flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            part.stop()
+        return 0
 
     srv = StandaloneTokenServer(
         port=args.port, host=args.host, rules_path=args.rules,

@@ -42,12 +42,20 @@ class ClusterTokenClient:
                  request_timeout_s: float = 2.0,
                  reconnect_interval_s: float = 2.0,
                  retry_policy: Optional[RetryPolicy] = None,
-                 health_gate=_CONFIG_GATE):
+                 health_gate=_CONFIG_GATE,
+                 epoch_fence=None,
+                 connect_timeout_s: float = 3.0):
         self.host = host
         self.port = port
         self.namespace = namespace
         self.request_timeout_s = request_timeout_s
         self.reconnect_interval_s = reconnect_interval_s
+        self.connect_timeout_s = connect_timeout_s
+        # Leadership-epoch fence (cluster/ha.py): responses stamped with
+        # an epoch BELOW the highest this fence has observed are from a
+        # deposed leader — rejected as FAIL so split-brain can never
+        # double-grant quota. None (default) disables fencing.
+        self.epoch_fence = epoch_fence
         # Backoff schedule for the reconnect loop: first delay is exactly
         # ``reconnect_interval_s`` (legacy cadence), repeated failures
         # back off with decorrelated jitter instead of hammering a dead
@@ -90,7 +98,8 @@ class ClusterTokenClient:
         with self._lock:
             if self._sock is not None:
                 return
-        sock = socket.create_connection((self.host, self.port), timeout=3)
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.connect_timeout_s)
         sock.settimeout(None)
         with self._lock:
             if self._sock is not None:  # raced with another connect
@@ -257,6 +266,8 @@ class ClusterTokenClient:
         resp = self._gated_call(MSG_FLOW, entity, timeout_s, gate_neutral)
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
+        if self._epoch_stale(resp.entity, codec.FLOW_RESP_SIZE):
+            return TokenResult(TokenResultStatus.FAIL)
         remaining, wait_ms = codec.decode_flow_response(resp.entity)
         span = (self._read_server_span(resp.entity, codec.FLOW_RESP_SIZE)
                 if trace is not None else None)
@@ -277,6 +288,21 @@ class ClusterTokenClient:
                                 gate_neutral)
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
+        if self._epoch_stale(resp.entity, 0):
+            return TokenResult(TokenResultStatus.FAIL)
         span = (self._read_server_span(resp.entity, 0)
                 if trace is not None else None)
         return TokenResult(resp.status, server_span=span)
+
+    def _epoch_stale(self, entity: bytes, offset: int) -> bool:
+        """True when the response's epoch TLV is below the fence's
+        high-water mark: a deposed leader replied, and honoring its
+        grant could double-spend quota the new leader is also granting.
+        Unstamped responses (pre-HA servers) pass through unfenced."""
+        fence = self.epoch_fence
+        if fence is None:
+            return False
+        epoch = codec.read_epoch_tlv(entity, offset)
+        if epoch is None:
+            return False
+        return not fence.observe(epoch)

@@ -112,8 +112,56 @@ class FrameReader:
 # timings without a second round trip.
 
 TLV_TRACE = 0x54
+# Leadership-epoch TLV (cluster/ha.py — the M5 epoch fence): responses
+# from an HA token server carry the leader's epoch as a second trailing
+# TLV, AFTER any span TLV so pre-HA clients' fixed-offset trace read
+# keeps working. Old peers ignore it (trailing bytes); new peers reject
+# responses whose epoch is below the highest they have ever observed,
+# so a deposed leader's replies can never double-grant quota.
+TLV_EPOCH = 0x45
 
 _TLV_HEAD = struct.Struct(">BH")
+_EPOCH_VALUE = struct.Struct(">q")
+
+
+def append_tlv(entity: bytes, tag: int, raw: bytes) -> bytes:
+    return entity + _TLV_HEAD.pack(tag, len(raw)) + raw
+
+
+def read_tlv(entity: bytes, offset: int, tag: int) -> Optional[bytes]:
+    """Scan the trailing TLV run starting at ``offset`` (= the entity's
+    fixed size) for ``tag``; None when absent or the run is garbled.
+    Unknown tags are skipped, so TLV order and future tags never break
+    a reader — the same lossy-by-design stance as the trace TLV."""
+    if offset < 0:
+        return None
+    while len(entity) >= offset + _TLV_HEAD.size:
+        t, n = _TLV_HEAD.unpack_from(entity, offset)
+        if len(entity) < offset + _TLV_HEAD.size + n:
+            return None
+        if t == tag:
+            return entity[offset + _TLV_HEAD.size:
+                          offset + _TLV_HEAD.size + n]
+        offset += _TLV_HEAD.size + n
+    return None
+
+
+def encode_epoch_value(epoch: int) -> bytes:
+    return _EPOCH_VALUE.pack(int(epoch))
+
+
+def append_epoch_tlv(entity: bytes, raw: bytes) -> bytes:
+    """Append an epoch TLV; ``raw`` is :func:`encode_epoch_value` output
+    (kept as bytes so the chaos suite's stale-epoch mutate seam can
+    replace it in flight)."""
+    return append_tlv(entity, TLV_EPOCH, raw)
+
+
+def read_epoch_tlv(entity: bytes, offset: int) -> Optional[int]:
+    raw = read_tlv(entity, offset, TLV_EPOCH)
+    if raw is None or len(raw) != _EPOCH_VALUE.size:
+        return None
+    return _EPOCH_VALUE.unpack(raw)[0]
 
 
 def append_trace_tlv(entity: bytes, value: str) -> bytes:

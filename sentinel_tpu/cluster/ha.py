@@ -1,0 +1,628 @@
+"""Cluster token-server high availability (ISSUE 5 tentpole; upstream
+analog: embedded-mode ``ClusterStateManager`` + the dashboard's cluster
+assign map — SURVEY.md §"sentinel-cluster").
+
+Four cooperating pieces close the single-token-server availability gap:
+
+* **Embedded mode-flipping** — :class:`ClusterHAManager` drives an
+  instance's CLIENT<->SERVER role from a :class:`ClusterMap` (pushed by
+  any datasource through the ``clusterMap`` converter in
+  ``datasource/converters.py``), draining the old role cleanly: an
+  outgoing leader publishes a final window checkpoint before its
+  listener closes.
+* **Epoch-fenced leadership** — every leadership term carries a
+  monotonic epoch (the map's, or minted above everything observed).
+  Servers stamp it into each token response as a trailing TLV old peers
+  ignore (``codec.TLV_EPOCH``); clients share one
+  :class:`~sentinel_tpu.cluster.state.EpochFence` and reject responses
+  below its high-water mark, so a deposed leader can never double-grant
+  quota (split-brain fencing).
+* **Client failover** — :class:`FailoverTokenClient` walks the map's
+  ordered server list (leader first) using the existing
+  ``RetryPolicy``/``HealthGate`` primitives per target; past the
+  ``csp.sentinel.cluster.ha.failover.deadline.ms`` budget with no
+  server reachable it enters **degraded-quota mode**: verdicts come
+  from :class:`DegradedQuota`, a per-client share of the global
+  threshold (sum of shares <= global threshold — proof in
+  docs/SEMANTICS.md), not full-local amnesty.
+* **State-preserving recovery** — a promoted leader warm-starts its
+  per-flow windows from the checkpoint the old leader published
+  (``core/checkpoint.py`` ``save_cluster_checkpoint`` — periodically
+  via :class:`~sentinel_tpu.core.checkpoint.CheckpointTimer` and on
+  graceful drain), bounding failover over-admission to the grants made
+  since the last publish.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from sentinel_tpu.cluster.state import (
+    CLUSTER_CLIENT,
+    CLUSTER_SERVER,
+    ClusterStateManager,
+    EpochFence,
+)
+from sentinel_tpu.cluster.token_service import TokenResult
+from sentinel_tpu.core.config import config
+from sentinel_tpu.utils import time_util
+
+
+class ClusterServerSpec(NamedTuple):
+    """One token-server seat in the cluster map."""
+
+    machine_id: str
+    host: str
+    port: int
+
+
+class ClusterMap(NamedTuple):
+    """Datasource-pushed leadership assignment (the ``clusterMap``
+    converter's output): WHO is the leader this epoch, the ordered
+    failover list, and the client membership that sizes the
+    degraded-quota share."""
+
+    epoch: int
+    servers: Tuple[ClusterServerSpec, ...]  # [0] = leader, rest standbys
+    clients: Tuple[str, ...] = ()           # client machine ids (share divisor)
+    namespace: str = "default"
+    request_timeout_ms: int = 2000
+
+    def leader(self) -> Optional[ClusterServerSpec]:
+        return self.servers[0] if self.servers else None
+
+    def server_for(self, machine_id: str) -> Optional[ClusterServerSpec]:
+        for s in self.servers:
+            if s.machine_id == machine_id:
+                return s
+        return None
+
+
+def default_machine_id() -> str:
+    """This instance's identity in cluster maps: the config override, or
+    ``hostname@pid`` (unique per process, the upstream machineId shape)."""
+    import os
+
+    cfg = config.cluster_ha_machine_id()
+    if cfg:
+        return cfg
+    return f"{socket.gethostname()}@{os.getpid()}"
+
+
+class DegradedQuota:
+    """Per-client share admission while no leader is reachable.
+
+    Each flow's share is ``global_threshold / divisor`` where ``divisor``
+    is the fleet's client count (from the cluster map, or the
+    ``csp.sentinel.cluster.ha.degraded.divisor`` config): with every
+    client running the same divisor >= the true client count, the sum of
+    all clients' degraded admissions per window is <= the global
+    threshold — bounded degradation instead of full-local amnesty
+    (docs/SEMANTICS.md "Degraded-quota bound").
+
+    Thresholds come from a callable (the engine's local copy of the
+    cluster rules — in the reference deployment model the same rule
+    object is pushed everywhere, so the local count IS the global
+    threshold) or a static ``{flowId: (threshold, intervalMs)}`` dict.
+    Admission reuses :class:`~sentinel_tpu.core.lease.LocalLease` — the
+    host-side mirror ring already proven against the device window math.
+    """
+
+    def __init__(self, divisor: Optional[int] = None,
+                 thresholds: Optional[Dict[int, Tuple[float, int]]] = None,
+                 thresholds_fn: Optional[Callable[[], Dict]] = None):
+        self.divisor = max(1, int(divisor if divisor is not None
+                                  else config.cluster_ha_degraded_divisor()))
+        self._static = thresholds
+        self._fn = thresholds_fn
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, tuple] = {}  # fid -> (share, interval, lease)
+        self.granted_count = 0
+        self.blocked_count = 0
+
+    def thresholds(self) -> Dict[int, Tuple[float, int]]:
+        if self._fn is not None:
+            return self._fn() or {}
+        return self._static or {}
+
+    def acquire(self, flow_id, count: int = 1,
+                now_ms: Optional[int] = None) -> Optional[TokenResult]:
+        """OK/BLOCKED against this client's share, or None when the flow
+        is unknown here (caller degrades to its local fallback)."""
+        from sentinel_tpu.cluster.constants import TokenResultStatus
+        from sentinel_tpu.core.lease import LocalLease
+
+        try:
+            fid = int(flow_id)
+        except (TypeError, ValueError):
+            return None
+        info = self.thresholds().get(fid)
+        if info is None:
+            return None
+        thr, interval_ms = float(info[0]), max(1, int(info[1]))
+        share = thr / self.divisor
+        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        with self._lock:
+            cached = self._buckets.get(fid)
+            if cached is None or cached[0] != share or cached[1] != interval_ms:
+                # One bucket spanning the whole interval: the provable
+                # per-window bound needs interval-aligned accounting, not
+                # a sliding approximation.
+                cached = (share, interval_ms,
+                          LocalLease([share], interval_ms, buckets=1))
+                self._buckets[fid] = cached
+            ok = cached[2].try_acquire(int(count), now)
+            if ok:
+                self.granted_count += 1
+            else:
+                self.blocked_count += 1
+        return TokenResult(TokenResultStatus.OK if ok
+                           else TokenResultStatus.BLOCKED)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"divisor": self.divisor,
+                    "grantedCount": self.granted_count,
+                    "blockedCount": self.blocked_count,
+                    "flows": len(self._buckets)}
+
+
+class FailoverTokenClient:
+    """Token client over an ORDERED server list (leader first).
+
+    One inner :class:`~sentinel_tpu.cluster.client.ClusterTokenClient`
+    per target, each with its own ``HealthGate`` breaker and a snappy
+    reconnect ``RetryPolicy`` (``csp.sentinel.cluster.ha.reconnect.ms``
+    base) so a standby promotion lands inside the failover deadline.
+    Every request goes to the first CONNECTED target in map order; a
+    FAIL (timeout, stale epoch, disconnect) walks to the next. With no
+    target connected, requests FAIL (local fallback) for at most
+    ``failover.deadline.ms`` after connectivity loss — the reconnectors'
+    race window — then the client enters degraded-quota mode and serves
+    per-client-share verdicts wire-free until any target reconnects.
+    """
+
+    serves_degraded = True  # keeps client_if_active() routing to us
+
+    def __init__(self, targets: List[Tuple[str, int]],
+                 namespace: str = "default",
+                 request_timeout_s: float = 2.0,
+                 failover_deadline_ms: Optional[int] = None,
+                 degraded: Optional[DegradedQuota] = None,
+                 epoch_fence: Optional[EpochFence] = None,
+                 reconnect_interval_s: Optional[float] = None,
+                 connect_timeout_s: float = 1.0):
+        from sentinel_tpu.cluster.client import ClusterTokenClient
+
+        if not targets:
+            raise ValueError("failover client needs at least one target")
+        self.namespace = namespace
+        self.fence = epoch_fence or EpochFence()
+        self.failover_deadline_ms = int(
+            failover_deadline_ms if failover_deadline_ms is not None
+            else config.cluster_ha_failover_deadline_ms())
+        if reconnect_interval_s is None:
+            reconnect_interval_s = config.cluster_ha_reconnect_ms() / 1000.0
+        self.degraded = degraded or DegradedQuota()
+        self._clients = [
+            ClusterTokenClient(host, port, namespace,
+                               request_timeout_s=request_timeout_s,
+                               reconnect_interval_s=reconnect_interval_s,
+                               epoch_fence=self.fence,
+                               connect_timeout_s=connect_timeout_s)
+            for host, port in targets]
+        self._lock = threading.Lock()
+        self._active_idx = 0
+        self.failover_count = 0
+        self.last_failover_ms = -1
+        # Degraded-mode accounting: _lost_at_ms marks total connectivity
+        # loss (-1 = connected recently); _degraded_since_ms marks the
+        # deadline expiring; degraded_total_ms accumulates closed spells.
+        self._lost_at_ms = -1
+        self._degraded_since_ms = -1
+        self.degraded_total_ms = 0
+        self.degraded_entry_count = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FailoverTokenClient":
+        for c in self._clients:
+            c.start()
+        return self
+
+    def stop(self) -> None:
+        for c in self._clients:
+            c.stop()
+        self._note_connected()  # close any open degraded spell
+
+    def is_connected(self) -> bool:
+        return any(c.is_connected() for c in self._clients)
+
+    @property
+    def health_gate(self):
+        """The ACTIVE target's breaker (resilience_stats surface)."""
+        return self._clients[self._active_idx].health_gate
+
+    @property
+    def targets(self) -> List[str]:
+        return [f"{c.host}:{c.port}" for c in self._clients]
+
+    # -- degraded-mode bookkeeping ----------------------------------------
+
+    def _note_connected(self) -> None:
+        with self._lock:
+            if self._degraded_since_ms >= 0:
+                self.degraded_total_ms += max(
+                    0, time_util.current_time_millis()
+                    - self._degraded_since_ms)
+            self._degraded_since_ms = -1
+            self._lost_at_ms = -1
+
+    def _degraded_now(self) -> bool:
+        """Advance the lost->degraded state machine; True once the
+        failover deadline has fully elapsed with no connection."""
+        now = time_util.current_time_millis()
+        with self._lock:
+            if self._degraded_since_ms >= 0:
+                return True
+            if self._lost_at_ms < 0:
+                self._lost_at_ms = now
+                return False
+            if now - self._lost_at_ms >= self.failover_deadline_ms:
+                self._degraded_since_ms = now
+                return True
+            return False
+
+    def is_degraded(self) -> bool:
+        return self._degraded_since_ms >= 0
+
+    def degraded_seconds(self) -> float:
+        total = self.degraded_total_ms
+        if self._degraded_since_ms >= 0:
+            total += max(0, time_util.current_time_millis()
+                         - self._degraded_since_ms)
+        return total / 1000.0
+
+    def failover_stats(self) -> dict:
+        return {
+            "failoverCount": self.failover_count,
+            "lastFailoverMs": self.last_failover_ms,
+            "degraded": self.is_degraded(),
+            "degradedEntries": self.degraded_entry_count,
+            "degradedSeconds": round(self.degraded_seconds(), 3),
+            "activeTarget": self.targets[self._active_idx],
+            "targets": self.targets,
+            "degradedQuota": self.degraded.snapshot(),
+        }
+
+    # -- requests ----------------------------------------------------------
+
+    def _note_failover(self, idx: int) -> None:
+        with self._lock:
+            if idx != self._active_idx:
+                self._active_idx = idx
+                self.failover_count += 1
+                self.last_failover_ms = time_util.current_time_millis()
+
+    def _request(self, fn, degraded_fn,
+                 timeout_s: Optional[float] = None) -> TokenResult:
+        from sentinel_tpu.cluster.constants import TokenResultStatus
+
+        # The caller's timeout is a budget for the WHOLE walk, not per
+        # target: each attempt gets only what remains, so one data-path
+        # entry never blocks N x its deadline budget when several
+        # targets are up but unresponsive during a transition.
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        for idx, c in enumerate(self._clients):
+            if not c.is_connected():
+                continue
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+            tr = fn(c, remaining)
+            if tr.status != TokenResultStatus.FAIL:
+                self._note_failover(idx)
+                self._note_connected()
+                return tr
+            # FAIL: breaker-open, timeout, garbage, or stale epoch —
+            # walk on to the next target in map order.
+        # No target produced a verdict. That includes the half-open case
+        # (connected to a partitioned leader): a round with zero
+        # verdicts advances the lost->degraded clock; any success resets
+        # it, so one transient timeout never reaches degraded mode — the
+        # full failover deadline must elapse verdict-free first.
+        if self._degraded_now():
+            self.degraded_entry_count += 1
+            result = degraded_fn()
+            if result is not None:
+                return result
+        return TokenResult(TokenResultStatus.FAIL)
+
+    def request_token(self, flow_id, count: int = 1,
+                      prioritized: bool = False,
+                      timeout_s: Optional[float] = None,
+                      gate_neutral: bool = False,
+                      trace=None) -> TokenResult:
+        return self._request(
+            lambda c, t: c.request_token(flow_id, count, prioritized,
+                                         timeout_s=t,
+                                         gate_neutral=gate_neutral,
+                                         trace=trace),
+            lambda: self.degraded.acquire(flow_id, count),
+            timeout_s=timeout_s)
+
+    def request_param_token(self, flow_id, count, params,
+                            timeout_s: Optional[float] = None,
+                            gate_neutral: bool = False,
+                            trace=None) -> TokenResult:
+        # Param-flow degraded verdicts are NOT share-partitioned (per-key
+        # global buckets have no local mirror): degraded mode returns
+        # None -> FAIL -> the rule's configured local fallback.
+        return self._request(
+            lambda c, t: c.request_param_token(flow_id, count, params,
+                                               timeout_s=t,
+                                               gate_neutral=gate_neutral,
+                                               trace=trace),
+            lambda: None,
+            timeout_s=timeout_s)
+
+
+class ClusterHAManager:
+    """Drives one instance's cluster role from datasource-pushed
+    :class:`ClusterMap`s (the embedded-mode ``ClusterStateManager``
+    pattern): ``apply_map`` flips CLIENT<->SERVER, epoch-fences each
+    term, publishes/restores window checkpoints across the handoff, and
+    ignores maps older than the one applied (a delayed datasource push
+    must not resurrect a deposed leader)."""
+
+    def __init__(self, engine=None, state: Optional[ClusterStateManager] = None,
+                 machine_id: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_period_s: Optional[float] = None,
+                 server_host: str = "0.0.0.0"):
+        self.engine = engine
+        self.state = state if state is not None else (
+            engine.cluster if engine is not None else ClusterStateManager())
+        self.machine_id = machine_id or default_machine_id()
+        self.checkpoint_path = (checkpoint_path
+                                or config.cluster_ha_checkpoint_path())
+        self.checkpoint_period_s = (
+            checkpoint_period_s if checkpoint_period_s is not None
+            else config.cluster_ha_checkpoint_period_ms() / 1000.0)
+        self.server_host = server_host
+        self.map: Optional[ClusterMap] = None
+        self.checkpoints_published = 0
+        self.rows_restored = 0
+        self._lock = threading.RLock()
+        self._ckpt_timer = None
+        # Failed-transition retry cadence (apply_map): the datasource
+        # property never re-fires an unchanged map, so retries are ours.
+        self.retry_delay_s = config.cluster_ha_reconnect_ms() / 1000.0
+        self._retry_timer = None
+        self.state.ha = self
+
+    # -- datasource wiring -------------------------------------------------
+
+    def watch(self, prop) -> None:
+        """Subscribe to a datasource property whose converter is
+        ``cluster_map_from_json`` (datasource/converters.py)."""
+        from sentinel_tpu.core.property import SimplePropertyListener
+
+        prop.add_listener(SimplePropertyListener(self.apply_map))
+
+    def apply_map(self, cmap: Optional[ClusterMap]) -> None:
+        if cmap is None:
+            return
+        from sentinel_tpu.log.record_log import record_log
+
+        with self._lock:
+            if self.map is not None and cmap.epoch < self.map.epoch:
+                record_log.warn(
+                    "ignoring stale cluster map epoch %d (< applied %d)",
+                    cmap.epoch, self.map.epoch)
+                return
+            # The wire is a map source too: responses stamped with a
+            # higher epoch prove a newer term exists, so a delayed map
+            # below the fence must not promote a leader the whole
+            # fleet's fences would reject.
+            if cmap.epoch < self.state.fence.highest_seen:
+                record_log.warn(
+                    "ignoring stale cluster map epoch %d (< observed %d)",
+                    cmap.epoch, self.state.fence.highest_seen)
+                return
+            leader = cmap.leader()
+            mine = cmap.server_for(self.machine_id)
+            try:
+                if leader is not None and mine is not None \
+                        and mine.machine_id == leader.machine_id:
+                    self._become_server(cmap, mine)
+                else:
+                    self._become_client(cmap)
+            except Exception as ex:  # noqa: BLE001 — transition must retry
+                # Do NOT commit the map: the datasource property caches
+                # its value and never re-fires for an unchanged map, so
+                # a swallowed transition failure (e.g. EADDRINUSE from a
+                # lingering listener) would otherwise strand this seat
+                # NOT_STARTED until a human bumps the epoch — in the
+                # subsystem built to survive exactly that. Retry on a
+                # timer instead; newer maps win via the epoch guards.
+                record_log.warn(
+                    "cluster map epoch %d transition failed: %r — "
+                    "retrying in %.1fs", cmap.epoch, ex, self.retry_delay_s)
+                self._schedule_retry(cmap)
+                return
+            self.map = cmap
+
+    def _schedule_retry(self, cmap: ClusterMap) -> None:
+        with self._lock:
+            if self._retry_timer is not None:
+                # Latest map wins: never leave a newer failed map
+                # unretried behind an older pending retry.
+                self._retry_timer.cancel()
+            t = threading.Timer(self.retry_delay_s, self._retry_apply,
+                                args=(cmap,))
+            t.daemon = True
+            self._retry_timer = t
+            t.start()
+
+    def _retry_apply(self, cmap: ClusterMap) -> None:
+        with self._lock:
+            self._retry_timer = None
+        self.apply_map(cmap)
+
+    # -- role transitions --------------------------------------------------
+
+    def _become_server(self, cmap: ClusterMap, me: ClusterServerSpec) -> None:
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.core import checkpoint as ckpt
+        from sentinel_tpu.log.record_log import record_log
+
+        srv = self.state.token_server
+        if srv is not None and self.state.mode == CLUSTER_SERVER \
+                and srv.epoch == cmap.epoch and not srv.crashed:
+            return  # already this term's leader — no churn
+        service = DefaultTokenService(rules=self.state.server_rules(),
+                                      epoch=cmap.epoch)
+        if srv is not None and self.checkpoint_path:
+            # In-process re-promotion (same seat, new term — including a
+            # crashed server's rebuild): the freshest window state lives
+            # in the OLD service, not on disk. Publish it BEFORE the
+            # restore below reads the file, or the new term would warm-
+            # start from the last periodic snapshot and re-admit every
+            # grant made since (the teardown publish inside set_to_server
+            # lands only after the restore already ran).
+            try:
+                ckpt.save_cluster_checkpoint(srv.service, self.checkpoint_path)
+                self.checkpoints_published += 1
+            except Exception as ex:  # noqa: BLE001 — best-effort pre-drain
+                record_log.warn("pre-promotion checkpoint failed: %r", ex)
+        if self.checkpoint_path:
+            try:
+                self.rows_restored += ckpt.restore_cluster_checkpoint(
+                    service, self.checkpoint_path)
+            except FileNotFoundError:
+                pass  # first leader of a fresh cluster: cold start
+            except ValueError as ex:
+                record_log.warn("cluster checkpoint not restored: %s", ex)
+        # Warm the acquire jit BEFORE binding the port: the width-1
+        # compile can outlast a client's request timeout (r5 measured),
+        # which would burn most of the failover deadline on the very
+        # first post-promotion token. A no-rule probe (flow None ->
+        # NO_RULE_EXISTS) compiles without consuming any flow's quota.
+        try:
+            service.request_tokens([(None, 0, False)])
+        except Exception as ex:  # noqa: BLE001 — warm-up is best-effort
+            record_log.warn("token-service warm-up failed: %r", ex)
+        # set_to_server tears the old role down first (on_server_teardown
+        # publishes the outgoing leader's final checkpoint).
+        self.state.set_to_server(host=self.server_host, port=me.port,
+                                 service=service, epoch=cmap.epoch)
+        if self.checkpoint_path:
+            self._ckpt_timer = ckpt.CheckpointTimer(
+                service, self.checkpoint_path,
+                period_s=self.checkpoint_period_s,
+                save=ckpt.save_cluster_checkpoint).start()
+
+    def _become_client(self, cmap: ClusterMap) -> None:
+        # No-churn guard (mirror of _become_server's): a map change that
+        # leaves this seat a client of the SAME server list must not
+        # tear down the live failover client — dropping its sockets
+        # mid-traffic fails in-flight requests fleet-wide and zeroes the
+        # failover/degraded counters the exporter publishes as
+        # monotonic _total series.
+        cur = self.state.token_client
+        if (self.state.mode == CLUSTER_CLIENT
+                and isinstance(cur, FailoverTokenClient)
+                and cur.targets == [f"{s.host}:{s.port}"
+                                    for s in cmap.servers]
+                and cur.namespace == cmap.namespace):
+            # The CURRENT map decides the divisor — falling back to the
+            # config default when it lists no clients, exactly as a
+            # freshly built client would (behavior must not depend on
+            # map-push history).
+            cur.degraded.divisor = max(1, len(cmap.clients)
+                                       if cmap.clients
+                                       else config.cluster_ha_degraded_divisor())
+            for inner in cur._clients:  # timeout is read per request
+                inner.request_timeout_s = max(cmap.request_timeout_ms,
+                                              1) / 1000.0
+            self.state.epoch = int(cmap.epoch)
+            self.state.fence.observe(cmap.epoch)
+            return
+        if self.engine is not None:
+            thresholds_fn = self.engine.cluster_degraded_thresholds
+        else:
+            # Engine-less participant (standalone HA seat): degraded
+            # shares come from the staged server rules it would serve
+            # with as leader — same rule objects, same thresholds.
+            thresholds_fn = self.state.server_rules().thresholds
+        divisor = len(cmap.clients) if cmap.clients else None
+        client = FailoverTokenClient(
+            [(s.host, s.port) for s in cmap.servers],
+            namespace=cmap.namespace,
+            request_timeout_s=max(cmap.request_timeout_ms, 1) / 1000.0,
+            degraded=DegradedQuota(divisor=divisor,
+                                   thresholds_fn=thresholds_fn),
+            epoch_fence=self.state.fence)
+        # set_client tears the old role down first (a deposed leader
+        # drains: on_server_teardown publishes its final checkpoint).
+        self.state.set_client(client)
+        self.state.epoch = int(cmap.epoch)
+        self.state.fence.observe(cmap.epoch)
+
+    # -- checkpoint plumbing -----------------------------------------------
+
+    def on_server_teardown(self, server) -> None:
+        """ClusterStateManager teardown hook: graceful drain publishes
+        the outgoing leader's final window checkpoint (a crashed server
+        already lost its listener — publishing its last state is still
+        correct and only tightens the successor's margin)."""
+        if self._ckpt_timer is not None:
+            self._ckpt_timer.stop()
+            self._ckpt_timer = None
+        if not self.checkpoint_path:
+            return
+        from sentinel_tpu.core import checkpoint as ckpt
+        from sentinel_tpu.log.record_log import record_log
+
+        try:
+            ckpt.save_cluster_checkpoint(server.service, self.checkpoint_path)
+            self.checkpoints_published += 1
+        except Exception as ex:  # noqa: BLE001 — drain is best-effort
+            record_log.warn("drain checkpoint failed: %r", ex)
+
+    def publish_checkpoint(self) -> None:
+        """One immediate checkpoint publish (ops / tests)."""
+        srv = self.state.token_server
+        if srv is not None and self.checkpoint_path:
+            from sentinel_tpu.core import checkpoint as ckpt
+
+            ckpt.save_cluster_checkpoint(srv.service, self.checkpoint_path)
+            self.checkpoints_published += 1
+
+    def stats(self) -> dict:
+        # Deliberately lock-free: apply_map holds self._lock across a
+        # whole promotion (restore I/O + jit warm-up + bind), and the
+        # /metrics scrape must not hang on it at exactly the moment
+        # operators are watching a failover. Plain attribute reads are
+        # atomic; a scrape racing a flip just sees the old values.
+        cmap = self.map
+        return {
+            "machineId": self.machine_id,
+            "mapEpoch": cmap.epoch if cmap else None,
+            "checkpointsPublished": self.checkpoints_published,
+            "rowsRestored": self.rows_restored,
+        }
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._retry_timer is not None:
+                self._retry_timer.cancel()
+                self._retry_timer = None
+        self.state.stop()

@@ -24,6 +24,32 @@ from sentinel_tpu.ops import window as W
 from sentinel_tpu.utils.shapes import round_up as _round_up
 
 
+def cluster_thresholds(rules) -> Dict[int, Tuple[float, int]]:
+    """flowId -> (raw threshold, windowIntervalMs) from flow rules that
+    carry a cluster ``flowId`` — THE single derivation of the
+    degraded-quota share base (cluster/ha.py). The SEMANTICS.md
+    sum-of-shares bound assumes every client computes the SAME share,
+    so engine-attached clients (engine ``_cluster_threshold_map``) and
+    engine-less standalone seats (:meth:`ClusterFlowRuleManager.thresholds`)
+    both go through this helper."""
+    out: Dict[int, Tuple[float, int]] = {}
+    for r in rules:
+        cc = getattr(r, "cluster_config", None) or {}
+        if cc.get("flowId") is None:
+            continue
+        try:
+            fid = int(cc["flowId"])
+        except (TypeError, ValueError):
+            continue
+        try:
+            interval = int(cc.get("windowIntervalMs",
+                                  CC.DEFAULT_WINDOW_INTERVAL_MS))
+        except (TypeError, ValueError):
+            interval = CC.DEFAULT_WINDOW_INTERVAL_MS
+        out[fid] = (float(r.count), interval)
+    return out
+
+
 class ClusterRuleTensors(NamedTuple):
     flow_id: jax.Array        # int64[CR]
     threshold: jax.Array      # f32[CR] raw count
@@ -131,6 +157,14 @@ class ClusterFlowRuleManager:
     def add_listener(self, fn) -> None:
         with self._lock:
             self._listeners.append(fn)
+
+    def thresholds(self) -> Dict[int, Tuple[float, int]]:
+        """flowId -> (raw threshold, windowIntervalMs) for every loaded
+        rule — the share base for cluster/ha.py's DegradedQuota when an
+        HA participant runs from the staged server rules (engine-less
+        standalone deployments)."""
+        with self._lock:
+            return cluster_thresholds(self._rule_of_flow_id.values())
 
     # -- compilation -------------------------------------------------------
 

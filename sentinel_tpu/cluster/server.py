@@ -40,10 +40,15 @@ class _Batcher:
     per acquire, measured r5). ``max_batch`` is a soft cap at group
     granularity: a drained group is never split across device calls."""
 
-    def __init__(self, service: DefaultTokenService, linger_s: float, max_batch: int):
+    def __init__(self, service: DefaultTokenService, linger_s: float, max_batch: int,
+                 crash_cb=None):
         self.service = service
         self.linger_s = linger_s
         self.max_batch = max_batch
+        # Leader-crash seam (resilience/faults.py "cluster.ha.leader.crash"):
+        # fired per drained batch; when armed, ``crash_cb`` hard-kills the
+        # owning server — the chaos suite's process-crash analog.
+        self.crash_cb = crash_cb
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -69,6 +74,20 @@ class _Batcher:
             except queue.Empty:
                 continue
             groups = [first]
+            try:
+                faults.fire("cluster.ha.leader.crash")
+            except OSError:
+                # The "process" dies mid-batch: fail the in-flight group
+                # fast (its handler replies FAIL an instant before the
+                # sockets close) and hard-stop the server off-thread.
+                # Requests granted but not yet checkpointed are exactly
+                # the over-admission margin failover is allowed.
+                first[1].set()
+                if self.crash_cb is not None:
+                    threading.Thread(target=self.crash_cb,
+                                     daemon=True).start()
+                self._stop.set()
+                return
             # Linger briefly so concurrent clients fold into one step.
             deadline = threading.Event()
             deadline.wait(self.linger_s)
@@ -125,8 +144,24 @@ class _Handler(socketserver.BaseRequestHandler):
     def _send(self, data: bytes) -> None:
         """Every reply write passes the ``cluster.server.frame`` fault
         point, so the chaos suite can corrupt/delay/kill server->client
-        bytes without a proxy."""
-        self.request.sendall(faults.mutate("cluster.server.frame", data))
+        bytes without a proxy — and the ``cluster.ha.halfopen`` seam,
+        whose garbage=b"" mode swallows replies with the connection left
+        up (a half-open socket the client must time out of)."""
+        data = faults.mutate("cluster.ha.halfopen",
+                             faults.mutate("cluster.server.frame", data))
+        if data:
+            self.request.sendall(data)
+
+    def _stamp_epoch(self, entity: bytes) -> bytes:
+        """Append the leader's epoch TLV (cluster/ha.py fencing) to a
+        token response entity; epoch 0 (pre-HA) keeps the wire format
+        byte-identical. The payload passes the ``cluster.ha.stale.epoch``
+        mutate seam so the chaos suite can replay a deposed epoch."""
+        epoch = self.server.token_server.service.epoch
+        if not epoch:
+            return entity
+        return codec.append_epoch_tlv(entity, faults.mutate(
+            "cluster.ha.stale.epoch", codec.encode_epoch_value(epoch)))
 
     def handle(self):
         server: "ClusterTokenServer" = self.server.token_server
@@ -193,6 +228,9 @@ class _Handler(socketserver.BaseRequestHandler):
                                         entity, codec.encode_span_info(
                                             sp["spanId"], sp["startMs"],
                                             sp["durationUs"]))
+                                # Epoch AFTER the span TLV: pre-HA clients
+                                # read the span at a fixed offset.
+                                entity = self._stamp_epoch(entity)
                                 replies.append(codec.encode_response(
                                     xid, MSG_FLOW, result.status, entity))
                         self._send(b"".join(replies))
@@ -242,6 +280,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 entity = codec.append_trace_tlv(
                     b"", codec.encode_span_info(
                         sp["spanId"], sp["startMs"], sp["durationUs"]))
+            entity = self._stamp_epoch(entity)
             self._send(codec.encode_response(
                 req.xid, MSG_PARAM_FLOW, result.status, entity))
         elif req.msg_type == MSG_ENTRY:
@@ -296,7 +335,9 @@ class ClusterTokenServer:
         self.service = service or DefaultTokenService()
         self.host = host
         self.port = port
-        self.batcher = _Batcher(self.service, batch_linger_s, max_batch)
+        self.batcher = _Batcher(self.service, batch_linger_s, max_batch,
+                                crash_cb=self._fault_crash)
+        self.crashed = False
         self._server: Optional[_ThreadingTCP] = None
         self._thread: Optional[threading.Thread] = None
         # Engine serving MSG_ENTRY/MSG_EXIT (the M4 slot-chain bridge).
@@ -368,6 +409,20 @@ class ClusterTokenServer:
             name="sentinel-token-server", daemon=True)
         self._thread.start()
         return self
+
+    @property
+    def epoch(self) -> int:
+        """Leadership epoch stamped into every token response (0 = no
+        stamp, the pre-HA wire format)."""
+        return self.service.epoch
+
+    def _fault_crash(self) -> None:
+        """Hard-kill for the ``cluster.ha.leader.crash`` fault point: the
+        process-crash analog — listener and connections close, no drain,
+        no checkpoint publish. ``crashed`` lets the HA layer distinguish
+        this from a graceful stop."""
+        self.crashed = True
+        self.stop()
 
     def stop(self) -> None:
         self.batcher.stop()

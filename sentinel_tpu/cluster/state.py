@@ -12,6 +12,39 @@ CLUSTER_NOT_STARTED = -1
 CLUSTER_CLIENT = 0
 CLUSTER_SERVER = 1
 
+_ROLE_NAMES = {CLUSTER_NOT_STARTED: "NOT_STARTED", CLUSTER_CLIENT: "CLIENT",
+               CLUSTER_SERVER: "SERVER"}
+
+
+class EpochFence:
+    """Monotonic leadership-epoch tracker (cluster/ha.py split-brain
+    fence): one per instance, shared by every token client the instance
+    runs AND consulted when the instance itself becomes a server, so no
+    role this process ever plays can fall behind an epoch it has already
+    observed. ``observe`` returns False for a stale epoch — the caller
+    must reject the response it rode in on."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.highest_seen = 0
+        self.stale_rejected_count = 0
+
+    def observe(self, epoch: int) -> bool:
+        epoch = int(epoch)
+        with self._lock:
+            if epoch < self.highest_seen:
+                self.stale_rejected_count += 1
+                return False
+            self.highest_seen = epoch
+            return True
+
+    def mint(self) -> int:
+        """Next epoch strictly above everything observed (manual server
+        flips with no datasource-assigned epoch)."""
+        with self._lock:
+            self.highest_seen += 1
+            return self.highest_seen
+
 
 class ClusterStateManager:
     def __init__(self):
@@ -31,6 +64,15 @@ class ClusterStateManager:
         # the service, not the rule set — reference rule managers are
         # namespace-keyed properties independent of the transport).
         self._server_rules = None
+        # HA plumbing (cluster/ha.py): the per-instance epoch fence every
+        # client this manager starts shares, the last leadership epoch
+        # this instance applied, a mode-flip counter for ops, and the
+        # optional ClusterHAManager driving this instance from a cluster
+        # map (set by ClusterHAManager.__init__).
+        self.fence = EpochFence()
+        self.epoch = 0
+        self.mode_flips = 0
+        self.ha = None
 
     def server_rules(self):
         from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
@@ -91,12 +133,31 @@ class ClusterStateManager:
             self.mode = CLUSTER_NOT_STARTED
             self.token_client = ClusterTokenClient(
                 host, port, namespace,
-                request_timeout_s=request_timeout_s).start()
+                request_timeout_s=request_timeout_s,
+                epoch_fence=self.fence).start()
             self.mode = CLUSTER_CLIENT
+            self.mode_flips += 1
+
+    def set_client(self, client) -> None:
+        """Flip to CLIENT with a pre-built token client (the HA layer's
+        FailoverTokenClient, or any object with the token-client
+        protocol). The client is started here; teardown semantics match
+        :meth:`set_to_client`."""
+        with self._lock:
+            self._teardown()
+            self.mode = CLUSTER_NOT_STARTED
+            self.token_client = client.start()
+            self.mode = CLUSTER_CLIENT
+            self.mode_flips += 1
 
     def set_to_server(self, host: str = "0.0.0.0", port: int = 0,
-                      service=None) -> "object":
+                      service=None, epoch: Optional[int] = None) -> "object":
         """Flip to SERVER: run the embedded token server; returns it.
+
+        ``epoch`` fences this leadership term (cluster/ha.py): None mints
+        the next epoch above everything this instance has observed
+        (manual flips); datasource-driven flips pass the cluster map's
+        epoch. epoch 0 keeps the pre-HA wire format (no epoch TLV).
 
         Failure semantics mirror :meth:`set_to_client`: a failed bind leaves
         the manager honestly NOT_STARTED, never claiming a dead role.
@@ -106,9 +167,16 @@ class ClusterStateManager:
         with self._lock:
             self._teardown()
             self.mode = CLUSTER_NOT_STARTED
+            if epoch is None:
+                epoch = self.fence.mint() if self.epoch or self.ha else 0
+            else:
+                self.fence.observe(epoch)
             self.token_server = ClusterTokenServer(
                 service=service, host=host, port=port).start()
+            self.token_server.service.epoch = int(epoch)
+            self.epoch = int(epoch)
             self.mode = CLUSTER_SERVER
+            self.mode_flips += 1
             return self.token_server
 
     def _teardown(self):
@@ -116,6 +184,13 @@ class ClusterStateManager:
             self.token_client.stop()
             self.token_client = None
         if self.token_server is not None:
+            # Graceful drain: give the HA layer a last chance to publish
+            # the outgoing leader's window checkpoint BEFORE the listener
+            # closes, so the successor warm-starts losing at most the
+            # in-flight batch (crashes skip this — that is the bounded
+            # over-admission margin the chaos suite asserts).
+            if self.ha is not None:
+                self.ha.on_server_teardown(self.token_server)
             self.token_server.stop()
             self.token_server = None
 
@@ -125,9 +200,55 @@ class ClusterStateManager:
             self.mode = CLUSTER_NOT_STARTED
 
     def client_if_active(self):
-        """The connected token client, or None (drives the fallback path)."""
-        with self._lock:
-            if (self.mode == CLUSTER_CLIENT and self.token_client is not None
-                    and self.token_client.is_connected()):
-                return self.token_client
+        """The connected token client, or None (drives the fallback path).
+
+        A client that ``serves_degraded`` (the HA FailoverTokenClient)
+        is active even while disconnected: it answers from its per-client
+        degraded-quota share instead of handing the engine full-local
+        amnesty, so it must stay on the cluster-check path.
+
+        Deliberately lock-free: this sits on the data path's per-entry
+        cluster check, and role flips hold ``_lock`` across slow work
+        (graceful-drain checkpoint fsyncs, listener binds) — the hot
+        path must not stall behind a failover. A torn read during a
+        flip at worst returns a stopping client (its request FAILs ->
+        local fallback, the same thing the flip causes anyway)."""
+        client = self.token_client
+        if self.mode == CLUSTER_CLIENT and client is not None \
+                and (client.is_connected()
+                     or getattr(client, "serves_degraded", False)):
+            return client
         return None
+
+    def ha_stats(self) -> dict:
+        """One ops view of the HA layer: role, leadership epoch, failover
+        and degraded-mode counters (resilience command + /metrics gauges).
+        Works for plain (non-HA) deployments too — counters just stay 0.
+
+        Lock-free for the same reason as :meth:`client_if_active`: the
+        resilience command and /metrics scrape must not hang on a role
+        flip's drain I/O at exactly the moment operators are watching a
+        failover; a racing scrape just reports the pre-flip values."""
+        mode = self.mode
+        srv, cli = self.token_server, self.token_client
+        epoch = self.epoch
+        flips = self.mode_flips
+        if srv is not None:
+            epoch = getattr(srv.service, "epoch", epoch)
+        out = {
+            "role": mode,
+            "roleName": _ROLE_NAMES.get(mode, str(mode)),
+            "epoch": int(max(epoch, self.fence.highest_seen)),
+            "modeFlips": flips,
+            "staleEpochRejected": self.fence.stale_rejected_count,
+            "failoverCount": 0,
+            "degraded": False,
+            "degradedEntries": 0,
+            "degradedSeconds": 0.0,
+        }
+        stats_fn = getattr(cli, "failover_stats", None)
+        if stats_fn is not None:
+            out.update(stats_fn())
+        if self.ha is not None:
+            out["manager"] = self.ha.stats()
+        return out

@@ -218,8 +218,13 @@ class DefaultTokenService:
 
     def __init__(self, rules: Optional[ClusterFlowRuleManager] = None,
                  max_allowed_qps: float = CC.DEFAULT_MAX_ALLOWED_QPS,
-                 max_occupy_ratio: float = CC.DEFAULT_MAX_OCCUPY_RATIO):
+                 max_occupy_ratio: float = CC.DEFAULT_MAX_OCCUPY_RATIO,
+                 epoch: int = 0):
         self.rules = rules or ClusterFlowRuleManager()
+        # Leadership epoch (cluster/ha.py): stamped into every response
+        # by the TCP frontend so deposed leaders' replies are fenced;
+        # 0 (default) keeps the pre-HA wire format byte-identical.
+        self.epoch = int(epoch)
         self.connections = ConnectionManager()
         self.limiter = GlobalRequestLimiter(max_allowed_qps)
         self.max_occupy_ratio = max_occupy_ratio

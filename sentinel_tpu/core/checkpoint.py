@@ -73,21 +73,65 @@ def _state_arrays(state):
 
 
 def _atomic_savez(path: str, header: dict, arrays: dict) -> None:
-    """Write header + arrays as one ``.npz`` via tmp-file + rename, so a
-    crash mid-write can never leave a truncated checkpoint at ``path``."""
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
-                               or ".", suffix=".ckpt.tmp")
+    """Write header + arrays as one ``.npz`` via tmp-file + fsync +
+    rename (+ directory fsync), so neither a crash mid-write NOR a power
+    loss after the rename can leave a truncated or unlinked checkpoint
+    at ``path`` — rename alone only orders the metadata, not the data
+    blocks, and a restore-after-power-cut of a non-fsync'd file is
+    exactly the truncated-file failure restore must never see."""
+    target_dir = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=target_dir, suffix=".ckpt.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, __header__=np.frombuffer(
                 json.dumps(header).encode("utf-8"), dtype=np.uint8), **arrays)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        try:
+            dfd = os.open(target_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # platforms/filesystems without directory fsync
     except BaseException:
         try:
             os.unlink(tmp)
         except OSError:
             pass
         raise
+
+
+def _load_npz(path: str):
+    """Load an ``.npz`` checkpoint defensively: every way a truncated,
+    byte-chopped, or otherwise corrupted file can fail inside numpy/zip
+    machinery surfaces as ONE clear ``ValueError`` naming the file,
+    never a zipfile/zlib/pickle traceback. A missing file still raises
+    ``FileNotFoundError`` (callers distinguish "no checkpoint yet").
+
+    Returns ``(header dict, {name: array})`` with every member fully
+    materialized (a chopped member fails HERE, not mid-restore)."""
+    import zipfile
+    import zlib
+
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            raw = z["__header__"]
+            header = json.loads(bytes(raw).decode("utf-8"))
+            if not isinstance(header, dict):
+                raise ValueError("header is not a JSON object")
+            arrays = {k: np.asarray(z[k]) for k in z.files
+                      if k != "__header__"}
+        return header, arrays
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, zlib.error, EOFError, OSError, KeyError,
+            UnicodeDecodeError, ValueError) as ex:
+        raise ValueError(
+            f"corrupted or truncated checkpoint {path!r}: {ex!r:.200}"
+        ) from ex
 
 
 def save_checkpoint(engine, path: str) -> None:
@@ -141,25 +185,23 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
             "allocated — it has served traffic or compiled rules); restore "
             "at boot, or pass force=True after quiescing the engine")
 
-    with np.load(path) as z:
-        header = json.loads(bytes(z["__header__"]).decode("utf-8"))
-        if header.get("version") != CHECKPOINT_VERSION:
-            raise ValueError(
-                f"unsupported checkpoint version {header.get('version')}")
-        if header["capacity"] != engine.capacity:
-            raise ValueError(
-                f"checkpoint capacity {header['capacity']} != engine "
-                f"capacity {engine.capacity}")
-        ck_spec = (header.get("w1_interval_ms", 1000),
-                   header.get("w1_sample_count",
-                              engine._spec1.buckets))
-        if ck_spec != (engine._spec1.interval_ms, engine._spec1.buckets):
-            raise ValueError(
-                f"checkpoint w1 geometry {ck_spec[0]}ms/{ck_spec[1]} buckets"
-                f" != engine {engine._spec1.interval_ms}ms/"
-                f"{engine._spec1.buckets}; retune with set_window_geometry"
-                " before restoring")
-        arrays = {k: z[k] for k in z.files if k != "__header__"}
+    header, arrays = _load_npz(path)
+    if header.get("version") != CHECKPOINT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint version {header.get('version')}")
+    if header.get("capacity") != engine.capacity:
+        raise ValueError(
+            f"checkpoint capacity {header.get('capacity')} != engine "
+            f"capacity {engine.capacity}")
+    ck_spec = (header.get("w1_interval_ms", 1000),
+               header.get("w1_sample_count",
+                          engine._spec1.buckets))
+    if ck_spec != (engine._spec1.interval_ms, engine._spec1.buckets):
+        raise ValueError(
+            f"checkpoint w1 geometry {ck_spec[0]}ms/{ck_spec[1]} buckets"
+            f" != engine {engine._spec1.interval_ms}ms/"
+            f"{engine._spec1.buckets}; retune with set_window_geometry"
+            " before restoring")
 
     # Validate BEFORE any mutation (shapes are derivable from capacity +
     # window constants, no compile needed): an incompatible or truncated
@@ -230,16 +272,19 @@ def restore_pod_checkpoint(like, path: str):
     import jax.numpy as jnp
 
     leaves, treedef = jax.tree.flatten(like)
-    with np.load(path) as z:
-        header = json.loads(bytes(z["__header__"]).decode("utf-8"))
-        if header.get("version") != CHECKPOINT_VERSION:
-            raise ValueError(
-                f"unsupported pod checkpoint version {header.get('version')}")
-        if header.get("n_leaves") != len(leaves):
-            raise ValueError(
-                f"pod checkpoint has {header.get('n_leaves')} leaves, "
-                f"template expects {len(leaves)}")
-        loaded = [z[f"leaf_{i}"] for i in range(len(leaves))]
+    header, arrays = _load_npz(path)
+    if header.get("version") != CHECKPOINT_VERSION:
+        raise ValueError(
+            f"unsupported pod checkpoint version {header.get('version')}")
+    if header.get("n_leaves") != len(leaves):
+        raise ValueError(
+            f"pod checkpoint has {header.get('n_leaves')} leaves, "
+            f"template expects {len(leaves)}")
+    try:
+        loaded = [arrays[f"leaf_{i}"] for i in range(len(leaves))]
+    except KeyError as ex:
+        raise ValueError(
+            f"corrupted pod checkpoint {path!r}: missing {ex}") from ex
     for i, (got, want) in enumerate(zip(loaded, leaves)):
         if tuple(got.shape) != tuple(want.shape) \
                 or np.dtype(got.dtype) != np.dtype(want.dtype):
@@ -250,16 +295,162 @@ def restore_pod_checkpoint(like, path: str):
     return jax.tree.unflatten(treedef, [jnp.asarray(x) for x in loaded])
 
 
+# ---------------------------------------------------------------------------
+# Cluster token-server window checkpoint (cluster/ha.py state-preserving
+# recovery): the leader snapshots its per-flow global sliding windows so a
+# successor warm-starts from them instead of handing the whole fleet a
+# fresh window of quota at failover. Rows are keyed by flowId (slot layout
+# is a compile artifact that differs across processes); a flow whose bucket
+# geometry changed starts cold, same stance as the service's own rule-push
+# carry-over. Param-flow buckets are NOT checkpointed: they are 1-second
+# QPS buckets, so skipping them bounds their over-admission to at most one
+# second of per-key quota (docs/SEMANTICS.md "Degraded-quota bound").
+# ---------------------------------------------------------------------------
+
+CLUSTER_CHECKPOINT_VERSION = 1
+
+
+def _peek_header_epoch(path: str) -> Optional[int]:
+    """The existing checkpoint's header epoch, or None when there is no
+    readable checkpoint (missing/corrupted files never block a save)."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            header = json.loads(bytes(z["__header__"]).decode("utf-8"))
+        return int(header.get("epoch", 0))
+    except Exception:  # noqa: BLE001 — any unreadable file: overwritable
+        return None
+
+
+def save_cluster_checkpoint(service, path: str) -> None:
+    """Atomically snapshot a ``DefaultTokenService``'s flow windows.
+
+    The shared file is epoch-fenced like the wire: a save from a service
+    whose epoch is BELOW the file's is refused, so a deposed leader's
+    still-running CheckpointTimer cannot clobber the successor's
+    published state (which would un-bound the failover over-admission
+    margin docs/SEMANTICS.md proves). The peek-and-replace is held under
+    an exclusive sidecar flock so two same-host writers cannot interleave
+    between the epoch check and the rename; filesystems without flock
+    fall back to the unlocked check. Epoch-0 services (pre-HA, no
+    fencing) keep last-writer-wins."""
+    import jax
+
+    # Snapshot first (service lock only) — never hold the file lock
+    # while waiting on the device.
+    with service._lock:
+        service._ensure_compiled()
+        state = jax.block_until_ready(service._state)
+        header = {
+            "version": CLUSTER_CHECKPOINT_VERSION,
+            "epoch": int(getattr(service, "epoch", 0)),
+            "flows": {str(fid): slot for fid, slot in service._slot_of.items()},
+        }
+        arrays = {
+            "counts": np.asarray(state.win.counts),
+            "starts": np.asarray(state.win.starts),
+            "bucket_ms": np.asarray(state.win.bucket_ms),
+        }
+
+    epoch = header["epoch"]
+    if not epoch:
+        _atomic_savez(path, header, arrays)
+        return
+    with open(path + ".lock", "a+b") as lk:
+        try:
+            import fcntl
+
+            fcntl.flock(lk, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass  # no flock here: keep the (unlocked) epoch check
+        try:
+            existing = _peek_header_epoch(path)
+            if existing is not None and existing > epoch:
+                raise ValueError(
+                    f"refusing to overwrite checkpoint {path!r} from epoch "
+                    f"{existing} with state from deposed epoch {epoch}")
+            _atomic_savez(path, header, arrays)
+        finally:
+            try:
+                import fcntl
+
+                fcntl.flock(lk, fcntl.LOCK_UN)
+            except (ImportError, OSError):
+                pass
+
+
+def restore_cluster_checkpoint(service, path: str) -> int:
+    """Warm-start ``service``'s flow windows from a leader's snapshot.
+
+    Grafts each surviving flowId's window row into the service's OWN
+    compiled layout; rows whose bucket geometry differs (rule edit
+    between leaders) or whose flowId is unknown here start cold. Returns
+    the number of rows restored. A corrupted/truncated file raises
+    ``ValueError`` before any service state is touched."""
+    import jax.numpy as jnp
+
+    header, arrays = _load_npz(path)
+    if header.get("version") != CLUSTER_CHECKPOINT_VERSION:
+        raise ValueError(
+            f"unsupported cluster checkpoint version {header.get('version')}")
+    for name, nd in (("counts", 3), ("starts", 2), ("bucket_ms", 1)):
+        got = arrays.get(name)
+        if got is None or got.ndim != nd:
+            raise ValueError(
+                f"corrupted or truncated checkpoint {path!r}: bad {name}")
+    old_counts, old_starts = arrays["counts"], arrays["starts"]
+    old_bucket = arrays["bucket_ms"]
+    flows = header.get("flows") or {}
+
+    from sentinel_tpu.cluster.rules import ClusterMetricState
+
+    restored = 0
+    with service._lock:
+        service._ensure_compiled()
+        win = service._state.win
+        counts = np.array(win.counts)
+        starts = np.array(win.starts)
+        new_bucket = np.asarray(win.bucket_ms)
+        for fid_str, old_slot in flows.items():
+            try:
+                fid, old_slot = int(fid_str), int(old_slot)
+            except (TypeError, ValueError):
+                continue
+            new_slot = service._slot_of.get(fid)
+            # old_slot must index EVERY old array (a corrupted file can
+            # carry inconsistent leading dims — never an IndexError out
+            # of a leader promotion).
+            if (new_slot is None
+                    or not 0 <= old_slot < min(old_counts.shape[0],
+                                               old_starts.shape[0],
+                                               old_bucket.shape[0])
+                    or old_counts.shape[1:] != counts.shape[1:]
+                    or old_starts.shape[1:] != starts.shape[1:]
+                    or old_bucket[old_slot] != new_bucket[new_slot]):
+                continue
+            counts[new_slot] = old_counts[old_slot]
+            starts[new_slot] = old_starts[old_slot]
+            restored += 1
+        service._state = ClusterMetricState(win=win._replace(
+            counts=jnp.asarray(counts), starts=jnp.asarray(starts)))
+    return restored
+
+
 class CheckpointTimer:
     """Optional low-Hz background checkpointer (off by default; SURVEY §5
-    'optionally checkpoint the stats tensor at low Hz')."""
+    'optionally checkpoint the stats tensor at low Hz').
 
-    def __init__(self, engine, path: str, period_s: float = 30.0):
+    ``save`` selects the snapshot function — :func:`save_checkpoint`
+    (default, ``target`` = engine) or :func:`save_cluster_checkpoint`
+    (``target`` = a token service; the HA leader's periodic publish)."""
+
+    def __init__(self, engine, path: str, period_s: float = 30.0,
+                 save=None):
         import threading
 
         self.engine = engine
         self.path = path
         self.period_s = period_s
+        self._save = save or save_checkpoint
         self._stop = threading.Event()
         self._thread: Optional[object] = None
 
@@ -282,7 +473,7 @@ class CheckpointTimer:
 
         while not self._stop.wait(self.period_s):
             try:
-                save_checkpoint(self.engine, self.path)
+                self._save(self.engine, self.path)
             except Exception as ex:
                 record_log.warn("checkpoint failed: %r", ex)
 

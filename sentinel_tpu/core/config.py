@@ -48,6 +48,16 @@ RESILIENCE_BREAKER_FAILURES = "csp.sentinel.resilience.breaker.failure.threshold
 RESILIENCE_BREAKER_OPEN_MS = "csp.sentinel.resilience.breaker.open.ms"
 RESILIENCE_BREAKER_PROBES = "csp.sentinel.resilience.breaker.half.open.probes"
 RESILIENCE_ENTRY_BUDGET_MS = "csp.sentinel.resilience.cluster.entry.budget.ms"
+# Cluster token-server HA (sentinel_tpu/cluster/ha.py — upstream analog:
+# embedded-mode ClusterStateManager; the keys follow the reference's
+# dotted naming). Every key here MUST be read through the accessors
+# below and documented in docs/OPERATIONS.md (pinned by test_lint).
+CLUSTER_HA_MACHINE_ID = "csp.sentinel.cluster.ha.machine.id"
+CLUSTER_HA_FAILOVER_DEADLINE_MS = "csp.sentinel.cluster.ha.failover.deadline.ms"
+CLUSTER_HA_RECONNECT_MS = "csp.sentinel.cluster.ha.reconnect.ms"
+CLUSTER_HA_DEGRADED_DIVISOR = "csp.sentinel.cluster.ha.degraded.divisor"
+CLUSTER_HA_CHECKPOINT_PATH = "csp.sentinel.cluster.ha.checkpoint.path"
+CLUSTER_HA_CHECKPOINT_PERIOD_MS = "csp.sentinel.cluster.ha.checkpoint.period.ms"
 # Telemetry layer (sentinel_tpu/telemetry/ — no reference twin).
 # profile.syncEvery: every Nth device dispatch blocks for a true
 # synchronous step wall (StepTimer sampling cadence; the rest record
@@ -84,6 +94,19 @@ DEFAULT_RESILIENCE_BREAKER_PROBES = 1
 # timeout, so a degraded token server costs the data path a bounded,
 # configured amount — never a socket timeout per cluster rule.
 DEFAULT_RESILIENCE_ENTRY_BUDGET_MS = 500
+# Failover must complete well inside the data path's patience (the 2s
+# request timeout): the client walks its server list and, past this
+# deadline with no leader reachable, enters degraded-quota mode.
+DEFAULT_CLUSTER_HA_FAILOVER_DEADLINE_MS = 3_000
+# Inner reconnect cadence of the failover client — snappier than the
+# plain client's 2s so a standby promotion lands inside the deadline.
+DEFAULT_CLUSTER_HA_RECONNECT_MS = 250
+# Degraded-quota share divisor when the cluster map lists no clients:
+# 1 = the full global threshold locally (single-client deployments).
+# Fleets MUST list clients in the map (or set this) for the
+# sum-of-shares <= global-threshold bound to hold (docs/SEMANTICS.md).
+DEFAULT_CLUSTER_HA_DEGRADED_DIVISOR = 1
+DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS = 5_000
 DEFAULT_PROFILE_SYNC_EVERY = 64
 DEFAULT_TELEMETRY_TRACE_SAMPLE_EVERY = 64
 DEFAULT_TELEMETRY_TRACE_CAPACITY = 256
@@ -201,6 +224,36 @@ class SentinelConfig:
 
     def heartbeat_interval_ms(self) -> int:
         return self.get_int(HEARTBEAT_INTERVAL_MS, DEFAULT_HEARTBEAT_INTERVAL_MS)
+
+    # Cluster HA accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.cluster.ha.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def cluster_ha_machine_id(self) -> Optional[str]:
+        return self.get(CLUSTER_HA_MACHINE_ID)
+
+    def cluster_ha_failover_deadline_ms(self) -> int:
+        v = self.get_int(CLUSTER_HA_FAILOVER_DEADLINE_MS,
+                         DEFAULT_CLUSTER_HA_FAILOVER_DEADLINE_MS)
+        return v if v > 0 else DEFAULT_CLUSTER_HA_FAILOVER_DEADLINE_MS
+
+    def cluster_ha_reconnect_ms(self) -> int:
+        v = self.get_int(CLUSTER_HA_RECONNECT_MS,
+                         DEFAULT_CLUSTER_HA_RECONNECT_MS)
+        return v if v > 0 else DEFAULT_CLUSTER_HA_RECONNECT_MS
+
+    def cluster_ha_degraded_divisor(self) -> int:
+        v = self.get_int(CLUSTER_HA_DEGRADED_DIVISOR,
+                         DEFAULT_CLUSTER_HA_DEGRADED_DIVISOR)
+        return v if v > 0 else DEFAULT_CLUSTER_HA_DEGRADED_DIVISOR
+
+    def cluster_ha_checkpoint_path(self) -> Optional[str]:
+        return self.get(CLUSTER_HA_CHECKPOINT_PATH)
+
+    def cluster_ha_checkpoint_period_ms(self) -> int:
+        v = self.get_int(CLUSTER_HA_CHECKPOINT_PERIOD_MS,
+                         DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS)
+        return v if v > 0 else DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS
 
     def log_dir(self) -> str:
         d = self.get(LOG_DIR)

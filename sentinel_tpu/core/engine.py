@@ -223,6 +223,11 @@ class SentinelEngine:
         self.rollout = RolloutManager(self)
         self._cluster_flow_info: Dict[str, list] = {}
         self._cluster_param_info: Dict[str, list] = {}
+        # flowId -> (threshold, windowIntervalMs) of the LOCAL copies of
+        # cluster-mode flow rules: the HA client's degraded-quota share
+        # base (cluster/ha.py — per-client share of the global threshold
+        # while no leader is reachable). Replaced wholesale on rule load.
+        self._cluster_thresholds: Dict[int, tuple] = {}
         self._pipeline = None
         # Entries that passed UNGUARDED because the pipeline could not
         # produce a verdict (collector death / cycle error). A silent
@@ -546,6 +551,7 @@ class SentinelEngine:
             if family == "flow":
                 rules = self.flow_rules.get_rules()
                 self._cluster_flow_info = self._cluster_info(rules)
+                self._cluster_thresholds = self._cluster_threshold_map(rules)
                 # origin_named is read on entry BEFORE compilation runs, so
                 # the named-origin map must be fresh at load time too (same
                 # classification helper as the compiler — no drift).
@@ -859,6 +865,22 @@ class SentinelEngine:
                     entry += (int(r.param_idx),)
                 info.setdefault(r.resource, []).append(entry)
         return info
+
+    @staticmethod
+    def _cluster_threshold_map(rules) -> Dict[int, tuple]:
+        """flowId -> (threshold, windowIntervalMs) from the local copies
+        of cluster-mode flow rules (the degraded-quota share base) —
+        the SAME derivation standalone HA seats use, so every client
+        computes the same share (the SEMANTICS.md bound needs that)."""
+        from sentinel_tpu.cluster.rules import cluster_thresholds
+
+        return cluster_thresholds(
+            r for r in rules if getattr(r, "cluster_mode", False))
+
+    def cluster_degraded_thresholds(self) -> Dict[int, tuple]:
+        """Current flowId -> (threshold, intervalMs) map for the HA
+        client's DegradedQuota (lock-free: replaced wholesale on load)."""
+        return self._cluster_thresholds
 
     def _refresh_signals(self, now_ms: int) -> None:
         """Fold the latest host OS sample into device state (≤ 1 Hz)."""
@@ -1465,6 +1487,10 @@ class SentinelEngine:
             # unified picture of everything currently between the live
             # ruleset and what traffic actually experiences.
             "rollout": self.rollout.guardrail_state(),
+            # Cluster HA (cluster/ha.py): current role, leadership epoch,
+            # failovers, degraded-quota spells — failover state without
+            # scraping /metrics.
+            "clusterHA": self.cluster.ha_stats(),
             "probes": {},
         }
         client = self.cluster.token_client

@@ -191,6 +191,86 @@ def authority_rules_to_json(rules: List[AuthorityRule]) -> str:
     return json.dumps([authority_rule_to_dict(r) for r in rules])
 
 
+# -- cluster map (cluster/ha.py — datasource-driven leader assignment) ------
+#
+# The HA analog of the reference's cluster-assign config: one JSON object
+# naming the leadership epoch, the ordered token-server seats (leader
+# first) and the client membership that sizes the degraded-quota share.
+#
+#     {"epoch": 3, "namespace": "default",
+#      "servers": [{"machineId": "node-a", "host": "10.0.0.1", "port": 18730},
+#                  {"machineId": "node-b", "host": "10.0.0.2", "port": 18730}],
+#      "clients": ["node-c", "node-d"],
+#      "leader": "node-a",            // optional; default servers[0]
+#      "requestTimeoutMs": 2000}      // optional
+#
+# Push it through any datasource with ``cluster_map_from_json`` as the
+# converter and hand the property to ``ClusterHAManager.watch``.
+
+
+def cluster_map_from_dict(d: dict) -> "object":
+    from sentinel_tpu.cluster.ha import ClusterMap, ClusterServerSpec
+
+    if not isinstance(d, dict):
+        raise ValueError("cluster map must be a JSON object")
+    try:
+        epoch = int(d.get("epoch", 0))
+    except (TypeError, ValueError):
+        raise ValueError(f"cluster map epoch {d.get('epoch')!r} not an int")
+    raw_servers = d.get("servers")
+    if not isinstance(raw_servers, list) or not raw_servers:
+        raise ValueError("cluster map needs a non-empty 'servers' list")
+    servers = []
+    for s in raw_servers:
+        if not isinstance(s, dict) or not s.get("machineId") \
+                or not s.get("host"):
+            raise ValueError(f"bad cluster map server entry: {s!r}")
+        try:
+            port = int(s["port"])
+        except (KeyError, TypeError, ValueError):
+            raise ValueError(f"bad cluster map server port in: {s!r}")
+        servers.append(ClusterServerSpec(str(s["machineId"]),
+                                         str(s["host"]), port))
+    leader = d.get("leader")
+    if leader:
+        ordered = [s for s in servers if s.machine_id == str(leader)]
+        if not ordered:
+            raise ValueError(
+                f"cluster map leader {leader!r} not in the servers list")
+        ordered += [s for s in servers if s.machine_id != str(leader)]
+        servers = ordered
+    raw_clients = d.get("clients") or []
+    if not isinstance(raw_clients, (list, tuple)):
+        # A bare string would iterate character-wise into a silently
+        # wrong degraded-quota divisor — reject like every other field.
+        raise ValueError(
+            f"cluster map 'clients' must be a list, got {raw_clients!r}")
+    clients = tuple(str(c) for c in raw_clients)
+    try:
+        timeout_ms = int(d.get("requestTimeoutMs", 2000))
+    except (TypeError, ValueError):
+        timeout_ms = 2000
+    return ClusterMap(epoch=epoch, servers=tuple(servers), clients=clients,
+                      namespace=str(d.get("namespace") or "default"),
+                      request_timeout_ms=max(1, timeout_ms))
+
+
+def cluster_map_from_json(source) -> "object":
+    data = json.loads(source) if isinstance(source, str) else source
+    return cluster_map_from_dict(data)
+
+
+def cluster_map_to_dict(m) -> dict:
+    return {
+        "epoch": m.epoch,
+        "namespace": m.namespace,
+        "servers": [{"machineId": s.machine_id, "host": s.host,
+                     "port": s.port} for s in m.servers],
+        "clients": list(m.clients),
+        "requestTimeoutMs": m.request_timeout_ms,
+    }
+
+
 # -- param flow -------------------------------------------------------------
 
 _CLASS_TYPES = {

@@ -37,6 +37,20 @@ FAULT_POINTS = (
     "cluster.server.frame",
     "datasource.read",
     "heartbeat.post",
+    # HA seams (cluster/ha.py — ISSUE 5):
+    # * leader.crash — fired by the token server's batcher before each
+    #   device step; an armed error hard-kills the server (listener +
+    #   connections closed, NO drain checkpoint), the process-crash
+    #   analog the failover chaos suite drives.
+    # * halfopen — mutate seam on every server reply write; garbage=b""
+    #   swallows replies while the connection stays up (a half-open
+    #   socket: the client must fail over on timeout, not hang).
+    # * stale.epoch — mutate seam on the epoch-TLV payload of each
+    #   response; arming garbage=encode_epoch_value(old) replays a
+    #   deposed leader's epoch so tests pin the client-side fence.
+    "cluster.ha.leader.crash",
+    "cluster.ha.halfopen",
+    "cluster.ha.stale.epoch",
 )
 
 

@@ -129,6 +129,31 @@ def render_engine_metrics(engine) -> str:
             b.sample("sentinel_tpu_probe_last_success_age_ms",
                      {"probe": probe}, age)
 
+    # -- cluster HA (cluster/ha.py) ---------------------------------------
+    ha = res_stats.get("clusterHA") or {}
+    b.family("sentinel_tpu_cluster_ha_role", "gauge",
+             "Cluster role of this instance: -1=not started 0=token "
+             "client 1=token server")
+    b.sample("sentinel_tpu_cluster_ha_role", None, ha.get("role", -1))
+    b.family("sentinel_tpu_cluster_ha_epoch", "gauge",
+             "Highest leadership epoch this instance has applied or "
+             "observed (0: pre-HA / never clustered)")
+    b.sample("sentinel_tpu_cluster_ha_epoch", None, ha.get("epoch", 0))
+    b.counter("sentinel_tpu_cluster_ha_failovers",
+              "Token-client failovers to a different server in the map "
+              "order", ha.get("failoverCount", 0))
+    b.counter("sentinel_tpu_cluster_ha_stale_epoch_rejected",
+              "Responses rejected by the epoch fence (deposed-leader "
+              "replies)", ha.get("staleEpochRejected", 0))
+    b.family("sentinel_tpu_cluster_ha_degraded", "gauge",
+             "1 while the token client serves per-client-share degraded "
+             "verdicts (no leader reachable)")
+    b.sample("sentinel_tpu_cluster_ha_degraded", None,
+             1 if ha.get("degraded") else 0)
+    b.counter("sentinel_tpu_cluster_ha_degraded_seconds",
+              "Cumulative seconds spent in degraded-quota mode",
+              ha.get("degradedSeconds", 0.0))
+
     # -- staged rollout guardrail ----------------------------------------
     guard = res_stats.get("rollout") or {}
     b.family("sentinel_tpu_rollout_active", "gauge",

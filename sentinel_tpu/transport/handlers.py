@@ -189,12 +189,15 @@ def cmd_system_status(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping("resilience", "degradation channels: fail-open, cluster "
-                               "fallbacks, breaker state, remote-loop health")
+                               "fallbacks, breaker state, cluster HA role/"
+                               "epoch/failovers, remote-loop health")
 def cmd_resilience(req: CommandRequest) -> CommandResponse:
     """Resilience snapshot (no reference twin — the reference surfaces
     none of its own remote clients' health): fail-open and cluster
     fallback counters, the token client's CLOSED/OPEN/HALF_OPEN gate,
-    and last-success ages for every registered remote loop."""
+    the cluster-HA block (current role, leadership epoch, failover
+    count, degraded-quota spells — cluster/ha.py), and last-success
+    ages for every registered remote loop."""
     return CommandResponse.of_success(req.engine.resilience_stats())
 
 
@@ -455,13 +458,17 @@ def cmd_set_switch(req: CommandRequest) -> CommandResponse:
 
 @command_mapping("getClusterMode", "cluster role of this instance")
 def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
-    """Reference: ``FetchClusterModeCommandHandler``."""
+    """Reference: ``FetchClusterModeCommandHandler`` — grown an ``ha``
+    block (cluster/ha.py): role, leadership epoch, failover and
+    degraded-mode counters, so the dashboard's HA panel reads one
+    endpoint per machine."""
     cs = req.engine.cluster
     return CommandResponse.of_success({
         "mode": cs.mode,
         "lastModified": cs.last_modified,
         "clientAvailable": cs.client_if_active() is not None,
         "serverRunning": cs.token_server is not None,
+        "ha": cs.ha_stats(),
     })
 
 

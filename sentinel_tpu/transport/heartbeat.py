@@ -99,7 +99,13 @@ class HeartbeatSender:
         try:
             faults.fire("heartbeat.post")
             if self._post(req):
-                self.last_success_ms = time_util.current_time_millis()
+                # Monotonic: the exported last-success stamp must never
+                # run backwards across a dashboard failover (rotating to
+                # a dashboard whose clock the frozen test clock — or a
+                # skewed host — reports earlier would otherwise make
+                # "age since last success" jump negative on scrapes).
+                self.last_success_ms = max(
+                    self.last_success_ms, time_util.current_time_millis())
                 return True
             self._idx += 1
             return False

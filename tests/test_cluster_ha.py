@@ -1,0 +1,950 @@
+"""Cluster token-server HA suite (ISSUE 5 tentpole): embedded-mode
+CLIENT<->SERVER flipping from datasource-pushed cluster maps, epoch-fenced
+leadership, ordered-list client failover with degraded-quota mode, and
+state-preserving (checkpoint warm-start) recovery.
+
+Determinism stance matches test_chaos.py: everything host-side runs on
+the frozen ``utils/time_util`` clock (window accounting, degraded-mode
+state machines, epoch fences), so quota math across a failover is exact;
+the socket scenarios necessarily use real time for connect/reconnect
+waits. Long wall-clock partition drills are marked ``slow`` and stay out
+of tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.constants import THRESHOLD_GLOBAL, TokenResultStatus
+from sentinel_tpu.cluster.ha import (
+    ClusterHAManager,
+    ClusterMap,
+    ClusterServerSpec,
+    DegradedQuota,
+    FailoverTokenClient,
+    default_machine_id,
+)
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.state import (
+    CLUSTER_CLIENT,
+    CLUSTER_SERVER,
+    ClusterStateManager,
+    EpochFence,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.core import checkpoint as ckpt
+from sentinel_tpu.datasource.converters import (
+    cluster_map_from_json,
+    cluster_map_to_dict,
+)
+from sentinel_tpu.resilience import FaultInjector, HealthGate
+from sentinel_tpu.utils import time_util
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+
+
+@pytest.fixture()
+def injector():
+    with FaultInjector(seed=SEED) as inj:
+        yield inj
+
+
+def _rule(flow_id, count, **cc):
+    return st.FlowRule(
+        resource=f"res-{flow_id}", count=count, cluster_mode=True,
+        cluster_config={"flowId": flow_id, "thresholdType": THRESHOLD_GLOBAL,
+                        **cc})
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait(pred, timeout_s: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _ok_with_retry(request, timeout_s: float = 15.0):
+    """First OK (rides out the token service's cold-jit compile on a
+    loaded CI box); returns (result, wall seconds to first OK)."""
+    t0 = time.monotonic()
+    r = request()
+    while r.status != TokenResultStatus.OK \
+            and time.monotonic() - t0 < timeout_s:
+        time.sleep(0.05)
+        r = request()
+    return r, time.monotonic() - t0
+
+
+# -- epoch fence (frozen clock, no sockets) -----------------------------------
+
+
+def test_epoch_fence_monotonic_observe_and_mint():
+    f = EpochFence()
+    assert f.observe(3) and f.highest_seen == 3
+    assert f.observe(3)              # equal epoch: same leader, fine
+    assert not f.observe(2)          # stale: rejected AND counted
+    assert f.stale_rejected_count == 1
+    assert f.highest_seen == 3       # a stale observation never lowers it
+    assert f.mint() == 4             # mint is strictly above everything seen
+    assert f.mint() == 5
+
+
+def test_manual_server_flip_epoch_semantics(frozen_time):
+    """Pre-HA manual flips keep epoch 0 (wire format byte-identical);
+    once an instance has seen an HA epoch, a manual re-flip mints ABOVE
+    it — this process can never restart a term it already observed."""
+    mgr = ClusterStateManager()
+    srv = mgr.set_to_server(host="127.0.0.1", port=0)
+    assert srv.epoch == 0 and mgr.epoch == 0      # legacy wire format
+    mgr.set_to_server(host="127.0.0.1", port=0, epoch=7)
+    assert mgr.token_server.epoch == 7
+    srv3 = mgr.set_to_server(host="127.0.0.1", port=0)   # manual, no epoch
+    assert srv3.epoch == 8                         # minted above 7
+    mgr.stop()
+
+
+def test_ha_stats_plain_deployment_zeroes(frozen_time):
+    """Non-HA deployments get the same ops shape with zeroed counters —
+    the resilience command never KeyErrors on a plain instance."""
+    stats = ClusterStateManager().ha_stats()
+    assert stats["roleName"] == "NOT_STARTED" and stats["role"] == -1
+    assert stats["epoch"] == 0 and stats["failoverCount"] == 0
+    assert stats["degraded"] is False and stats["staleEpochRejected"] == 0
+
+
+# -- epoch TLV codec ----------------------------------------------------------
+
+
+def test_epoch_tlv_round_trip_and_tag_scanning():
+    entity = codec.encode_flow_response(5, 0)
+    base = len(entity)
+    # span TLV first (PR 4 wire layout), epoch appended AFTER it
+    entity = codec.append_trace_tlv(entity, codec.encode_span_info(
+        "00f067aa0ba902b7", 1700000000000, 250))
+    entity = codec.append_epoch_tlv(entity, codec.encode_epoch_value(9))
+    assert codec.read_epoch_tlv(entity, base) == 9        # scans past span
+    assert codec.read_trace_tlv(entity, base) is not None  # span still reads
+    # absent / garbled runs are None, never an exception
+    assert codec.read_epoch_tlv(codec.encode_flow_response(5, 0), base) is None
+    assert codec.read_epoch_tlv(entity[:-3], base) is None  # truncated TLV
+    # a wrong-size epoch payload is ignored (future-proofing, not a crash)
+    bad = codec.append_tlv(codec.encode_flow_response(5, 0),
+                           codec.TLV_EPOCH, b"\x01")
+    assert codec.read_epoch_tlv(bad, base) is None
+
+
+# -- cluster map converter ----------------------------------------------------
+
+
+def test_cluster_map_converter_valid_and_leader_reorder():
+    m = cluster_map_from_json(json.dumps({
+        "epoch": 3, "namespace": "nsX",
+        "servers": [{"machineId": "a", "host": "10.0.0.1", "port": 18730},
+                    {"machineId": "b", "host": "10.0.0.2", "port": 18731}],
+        "clients": ["c", "d"], "leader": "b", "requestTimeoutMs": 1500}))
+    assert isinstance(m, ClusterMap) and m.epoch == 3
+    assert m.leader().machine_id == "b"            # leader field reorders
+    assert [s.machine_id for s in m.servers] == ["b", "a"]
+    assert m.clients == ("c", "d") and m.namespace == "nsX"
+    assert m.request_timeout_ms == 1500
+    assert m.server_for("a").port == 18730 and m.server_for("zz") is None
+    # round-trip through the writer shape
+    again = cluster_map_from_json(cluster_map_to_dict(m))
+    assert again.epoch == m.epoch and again.servers == m.servers
+
+
+def test_cluster_map_converter_rejects_malformed():
+    good_server = {"machineId": "a", "host": "h", "port": 1}
+    for bad in (
+        [1, 2],                                          # not an object
+        {"epoch": "x", "servers": [good_server]},        # non-int epoch
+        {"epoch": 1},                                    # no servers
+        {"epoch": 1, "servers": []},                     # empty servers
+        {"epoch": 1, "servers": [{"machineId": "a"}]},   # no host/port
+        {"epoch": 1, "servers": [{**good_server, "port": "nope"}]},
+        {"epoch": 1, "servers": [good_server], "leader": "ghost"},
+        # a bare string would iterate character-wise into a silently
+        # wrong degraded-quota divisor
+        {"epoch": 1, "servers": [good_server], "clients": "node-c"},
+    ):
+        with pytest.raises(ValueError):
+            cluster_map_from_json(json.dumps(bad))
+
+
+# -- degraded quota (frozen clock) --------------------------------------------
+
+
+def test_degraded_quota_share_bound_sum_leq_global(frozen_time):
+    """The SEMANTICS.md bound: N clients, divisor N — each admits at most
+    T/N per interval-aligned window, so the fleet total is <= T."""
+    T, N = 12.0, 4
+    clients = [DegradedQuota(divisor=N, thresholds={7: (T, 1000)})
+               for _ in range(N)]
+    total = 0
+    for q in clients:
+        grants = sum(1 for _ in range(10)
+                     if q.acquire(7).status == TokenResultStatus.OK)
+        assert grants == int(T / N)       # exactly the share, then BLOCKED
+        total += grants
+    assert total <= T
+    frozen_time.advance_time(1100)        # window rolls: shares refill
+    assert clients[0].acquire(7).status == TokenResultStatus.OK
+    snap = clients[0].snapshot()
+    assert snap["divisor"] == N and snap["grantedCount"] == 4
+    assert snap["blockedCount"] == 10 - 3 and snap["flows"] == 1
+
+
+def test_degraded_quota_unknown_flow_and_live_thresholds(frozen_time):
+    seen = {}
+    q = DegradedQuota(divisor=2, thresholds_fn=lambda: seen)
+    assert q.acquire(9) is None           # unknown flow -> caller falls back
+    assert q.acquire("junk") is None
+    seen[9] = (4.0, 1000)                 # rule push lands mid-degraded
+    assert q.acquire(9).status == TokenResultStatus.OK
+    assert q.acquire(9).status == TokenResultStatus.OK   # share = 4/2
+    assert q.acquire(9).status == TokenResultStatus.BLOCKED
+
+
+# -- wire fencing over TCP ----------------------------------------------------
+
+
+@pytest.fixture()
+def epoch_server(frozen_time):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(500, 1000)])
+    server = ClusterTokenServer(
+        DefaultTokenService(rules, epoch=5), host="127.0.0.1", port=0).start()
+    yield server
+    server.stop()
+
+
+def test_fenced_client_accepts_current_epoch(epoch_server):
+    fence = EpochFence()
+    client = ClusterTokenClient("127.0.0.1", epoch_server.bound_port,
+                                epoch_fence=fence, health_gate=None).start()
+    try:
+        assert _wait(client.is_connected)
+        r, _ = _ok_with_retry(lambda: client.request_token(500))
+        assert r.status == TokenResultStatus.OK
+        assert fence.highest_seen == 5     # epoch TLV observed
+    finally:
+        client.stop()
+
+
+def test_stale_epoch_replay_rejected(epoch_server, injector):
+    """Acceptance pin: a deposed leader's reply (epoch below the fence's
+    high-water mark) is rejected as FAIL — split-brain cannot
+    double-grant. The ``cluster.ha.stale.epoch`` seam replays epoch 4
+    against a client that has already observed epoch 5."""
+    fence = EpochFence()
+    client = ClusterTokenClient("127.0.0.1", epoch_server.bound_port,
+                                epoch_fence=fence, health_gate=None).start()
+    try:
+        assert _wait(client.is_connected)
+        r, _ = _ok_with_retry(lambda: client.request_token(500))
+        assert r.status == TokenResultStatus.OK and fence.highest_seen == 5
+        injector.arm("cluster.ha.stale.epoch", "garbage", times=1,
+                     garbage=codec.encode_epoch_value(4))
+        assert client.request_token(500).status == TokenResultStatus.FAIL
+        assert fence.stale_rejected_count == 1
+        assert fence.highest_seen == 5
+        # healed: the next (correctly stamped) response serves again
+        assert client.request_token(500).status == TokenResultStatus.OK
+    finally:
+        client.stop()
+
+
+def test_epoch_zero_keeps_pre_ha_wire_format(frozen_time):
+    """epoch 0 (every pre-HA deployment) stamps nothing: a fenced client
+    sees no TLV and its fence never advances — byte-identical wire."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [_rule(501, 1000)])
+    server = ClusterTokenServer(
+        DefaultTokenService(rules), host="127.0.0.1", port=0).start()
+    fence = EpochFence()
+    client = ClusterTokenClient("127.0.0.1", server.bound_port,
+                                epoch_fence=fence, health_gate=None).start()
+    try:
+        assert _wait(client.is_connected)
+        r, _ = _ok_with_retry(lambda: client.request_token(501))
+        assert r.status == TokenResultStatus.OK
+        assert fence.highest_seen == 0
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_halfopen_swallowed_reply_times_out_not_hangs(epoch_server, injector):
+    """The half-open seam: the server eats one reply with the connection
+    left up. The client must FAIL within its request timeout (and keep
+    the connection serviceable), never hang on the dead response."""
+    client = ClusterTokenClient("127.0.0.1", epoch_server.bound_port,
+                                request_timeout_s=0.4,
+                                health_gate=None).start()
+    try:
+        assert _wait(client.is_connected)
+        r, _ = _ok_with_retry(lambda: client.request_token(500))
+        assert r.status == TokenResultStatus.OK
+        injector.arm("cluster.ha.halfopen", "garbage", times=1, garbage=b"")
+        t0 = time.monotonic()
+        assert client.request_token(500).status == TokenResultStatus.FAIL
+        assert time.monotonic() - t0 < 2.0
+        assert client.is_connected()       # half-open, not disconnected
+        assert client.request_token(500).status == TokenResultStatus.OK
+    finally:
+        client.stop()
+
+
+# -- failover client ----------------------------------------------------------
+
+
+def test_failover_client_walks_to_standby(frozen_time):
+    """Leader dies -> the next verdict comes from the second target in
+    map order; failover_count and the active target record the walk."""
+    rules_a = ClusterFlowRuleManager()
+    rules_a.load_rules("default", [_rule(600, 1000)])
+    rules_b = ClusterFlowRuleManager()
+    rules_b.load_rules("default", [_rule(600, 1000)])
+    a = ClusterTokenServer(DefaultTokenService(rules_a, epoch=1),
+                           host="127.0.0.1", port=0).start()
+    b = ClusterTokenServer(DefaultTokenService(rules_b, epoch=1),
+                           host="127.0.0.1", port=0).start()
+    fc = FailoverTokenClient(
+        [("127.0.0.1", a.bound_port), ("127.0.0.1", b.bound_port)],
+        request_timeout_s=2.0, reconnect_interval_s=0.05,
+        failover_deadline_ms=60_000).start()
+    try:
+        assert _wait(fc.is_connected)
+        r, _ = _ok_with_retry(lambda: fc.request_token(600))
+        assert r.status == TokenResultStatus.OK and fc.failover_count == 0
+        # warm B's jit through the fence-shared wire path is not possible
+        # pre-failover (A answers first); warm its service directly so
+        # the post-failover request is not measuring a compile.
+        b.service.request_tokens([(None, 0, False)])
+        a.stop()
+        r, _ = _ok_with_retry(lambda: fc.request_token(600))
+        assert r.status == TokenResultStatus.OK
+        assert fc.failover_count == 1
+        assert fc.failover_stats()["activeTarget"].endswith(str(b.bound_port))
+        assert not fc.is_degraded()        # a standby answered: no spell
+    finally:
+        fc.stop()
+        a.stop()
+        b.stop()
+
+
+def test_degraded_mode_after_deadline_and_recovery(frozen_time):
+    """No target reachable: FAIL until the failover deadline elapses
+    verdict-free, then per-client-share verdicts (wire-free); the first
+    real verdict after reconnect closes the spell and the accounting
+    (entries, seconds) survives in failover_stats."""
+    port = _free_port()
+    fc = FailoverTokenClient(
+        [("127.0.0.1", port)], request_timeout_s=0.3,
+        reconnect_interval_s=0.05, failover_deadline_ms=1000,
+        degraded=DegradedQuota(divisor=2, thresholds={7: (10.0, 1000)}))
+    fc.start()
+    try:
+        # inside the deadline: FAIL (engine local fallback), not degraded
+        assert fc.request_token(7).status == TokenResultStatus.FAIL
+        assert not fc.is_degraded()
+        frozen_time.advance_time(1001)
+        got = [fc.request_token(7).status for _ in range(7)]
+        assert got.count(TokenResultStatus.OK) == 5        # share 10/2
+        assert got.count(TokenResultStatus.BLOCKED) == 2
+        assert fc.is_degraded() and fc.degraded_entry_count == 7
+        # param tokens have no local bucket mirror: degraded -> FAIL
+        assert fc.request_param_token(7, 1, ["k"]).status == \
+            TokenResultStatus.FAIL
+        # flows with no threshold here -> FAIL (local fallback), counted
+        assert fc.request_token(999).status == TokenResultStatus.FAIL
+        frozen_time.advance_time(2500)                     # spell runs on
+
+        # recovery: a server appears on the dead target's port
+        rules = ClusterFlowRuleManager()
+        rules.load_rules("default", [_rule(7, 1000)])
+        server = ClusterTokenServer(DefaultTokenService(rules, epoch=2),
+                                    host="127.0.0.1", port=port).start()
+        try:
+            assert _wait(fc.is_connected)
+            r, _ = _ok_with_retry(lambda: fc.request_token(7))
+            assert r.status == TokenResultStatus.OK
+            assert not fc.is_degraded()
+            stats = fc.failover_stats()
+            # spell opened when the deadline elapsed (t0+1001) and closed
+            # at the first real verdict (t0+3501): exactly 2.5 frozen s
+            assert stats["degradedSeconds"] == pytest.approx(2.5)
+            assert stats["degradedQuota"]["grantedCount"] == 5
+        finally:
+            server.stop()
+    finally:
+        fc.stop()
+
+
+def test_failover_walk_shares_one_timeout_budget():
+    """The caller's timeout bounds the WHOLE walk: with several
+    connected-but-unresponsive targets, one data-path entry must never
+    block N x its deadline budget — later targets get only the
+    remaining slice, and a spent budget stops the walk."""
+
+    from sentinel_tpu.cluster.token_service import TokenResult
+
+    class _Stub:
+        def __init__(self, fail_for_s=0.0):
+            self.fail_for_s = fail_for_s
+            self.seen_timeouts = []
+
+        def is_connected(self):
+            return True
+
+        def request_token(self, *a, timeout_s=None, **k):
+            self.seen_timeouts.append(timeout_s)
+            if self.fail_for_s:
+                time.sleep(self.fail_for_s)
+                return TokenResult(TokenResultStatus.FAIL)
+            return TokenResult(TokenResultStatus.OK)
+
+    fc = FailoverTokenClient([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                             failover_deadline_ms=60_000)
+    slow, fast = _Stub(fail_for_s=0.05), _Stub()
+    fc._clients = [slow, fast]
+
+    r = fc.request_token(5, timeout_s=0.2)
+    assert r.status == TokenResultStatus.OK
+    assert slow.seen_timeouts[0] == pytest.approx(0.2, abs=0.01)
+    assert 0 < fast.seen_timeouts[0] <= 0.16        # only the remainder
+
+    slow.seen_timeouts.clear()
+    fast.seen_timeouts.clear()
+    assert fc.request_token(5, timeout_s=0.03).status \
+        == TokenResultStatus.FAIL                   # budget died mid-walk
+    assert fast.seen_timeouts == []                 # second target skipped
+
+    # no caller budget: every target keeps its own configured timeout
+    slow.seen_timeouts.clear()
+    fc.request_token(5)
+    assert slow.seen_timeouts == [None]
+
+
+# -- HA manager: map-driven flips, drain, warm start --------------------------
+
+
+def _two_seat_setup(ck_path, rule):
+    """Two engine-less HA seats sharing a checkpoint path + rule set."""
+    seats = {}
+    for mid in ("A", "B"):
+        state = ClusterStateManager()
+        state.server_rules().load_rules("default", [rule])
+        seats[mid] = ClusterHAManager(
+            state=state, machine_id=mid, checkpoint_path=ck_path,
+            checkpoint_period_s=3600.0, server_host="127.0.0.1")
+    return seats
+
+
+def test_apply_map_graceful_flip_preserves_windows(frozen_time, tmp_path):
+    """Graceful leadership handoff: the deposed leader's drain checkpoint
+    hands the successor its windows, so TOTAL admissions across the flip
+    never exceed the global threshold (margin 0 for a graceful drain)."""
+    ck_path = str(tmp_path / "ha.npz")
+    seats = _two_seat_setup(ck_path, _rule(700, 6))
+    pa, pb = _free_port(), _free_port()
+    servers = (ClusterServerSpec("A", "127.0.0.1", pa),
+               ClusterServerSpec("B", "127.0.0.1", pb))
+    m1 = ClusterMap(epoch=1, servers=servers, clients=("X",))
+    m2 = ClusterMap(epoch=2, servers=servers[::-1], clients=("X",))
+    fc = FailoverTokenClient([("127.0.0.1", pa), ("127.0.0.1", pb)],
+                             request_timeout_s=2.0, reconnect_interval_s=0.05,
+                             failover_deadline_ms=60_000).start()
+    try:
+        seats["A"].apply_map(m1)
+        seats["B"].apply_map(m1)
+        assert seats["A"].state.mode == CLUSTER_SERVER
+        assert seats["B"].state.mode == CLUSTER_CLIENT
+        assert seats["A"].state.token_server.epoch == 1
+
+        assert _wait(fc.is_connected)
+        r, _ = _ok_with_retry(lambda: fc.request_token(700))
+        assert r.status == TokenResultStatus.OK
+        pre = 1 + sum(1 for _ in range(4)
+                      if fc.request_token(700).status == TokenResultStatus.OK)
+        assert pre == 5                                    # 1 left of 6
+
+        # graceful flip: deposed leader drains FIRST (publishes), then
+        # the successor warm-starts from the drained checkpoint.
+        seats["A"].apply_map(m2)
+        assert seats["A"].state.mode == CLUSTER_CLIENT
+        assert seats["A"].checkpoints_published >= 1
+        seats["B"].apply_map(m2)
+        assert seats["B"].state.mode == CLUSTER_SERVER
+        assert seats["B"].state.token_server.epoch == 2
+        assert seats["B"].rows_restored == 1
+
+        r, _ = _ok_with_retry(lambda: fc.request_token(700))
+        assert r.status == TokenResultStatus.OK            # the 6th token
+        post_block = [fc.request_token(700).status for _ in range(3)]
+        assert post_block.count(TokenResultStatus.BLOCKED) == 3
+        assert fc.failover_count == 1
+        assert fc.fence.highest_seen == 2
+        stats = seats["B"].state.ha_stats()
+        assert stats["roleName"] == "SERVER" and stats["epoch"] == 2
+        assert stats["modeFlips"] >= 2
+    finally:
+        fc.stop()
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+def test_stale_map_ignored(frozen_time, tmp_path):
+    """A delayed datasource push (epoch below the applied map) must not
+    resurrect a deposed leader."""
+    seats = _two_seat_setup(str(tmp_path / "ha.npz"), _rule(710, 5))
+    pa, pb = _free_port(), _free_port()
+    servers = (ClusterServerSpec("A", "127.0.0.1", pa),
+               ClusterServerSpec("B", "127.0.0.1", pb))
+    try:
+        seats["A"].apply_map(ClusterMap(epoch=2, servers=servers[::-1]))
+        assert seats["A"].state.mode == CLUSTER_CLIENT     # B leads
+        seats["A"].apply_map(ClusterMap(epoch=1, servers=servers))
+        assert seats["A"].state.mode == CLUSTER_CLIENT     # stale: ignored
+        assert seats["A"].map.epoch == 2
+    finally:
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+def test_in_process_repromotion_preserves_unpublished_grants(frozen_time,
+                                                             tmp_path):
+    """Same seat re-promoted for a new term (e.g. a standby reorder):
+    the freshest window state lives in the OLD in-process service, so
+    _become_server must publish it BEFORE restoring — warm-starting
+    from the last periodic snapshot would re-admit every grant made
+    since it (here: ALL of them, the periodic timer never fired)."""
+    T = 6
+    seats = _two_seat_setup(str(tmp_path / "reprom.npz"), _rule(730, T))
+    servers = (ClusterServerSpec("A", "127.0.0.1", _free_port()),
+               ClusterServerSpec("B", "127.0.0.1", _free_port()))
+    try:
+        seats["A"].apply_map(ClusterMap(epoch=1, servers=servers))
+        svc = seats["A"].state.token_server.service
+        for _ in range(4):
+            assert svc.request_token(730).status == TokenResultStatus.OK
+
+        seats["A"].apply_map(ClusterMap(epoch=2, servers=servers))
+        assert seats["A"].state.token_server.epoch == 2
+        assert seats["A"].rows_restored == 1
+        svc2 = seats["A"].state.token_server.service
+        got = [svc2.request_token(730).status for _ in range(3)]
+        assert got == [TokenResultStatus.OK, TokenResultStatus.OK,
+                       TokenResultStatus.BLOCKED]       # 4 carried + 2 = T
+    finally:
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+def test_same_target_map_change_reuses_live_client(frozen_time, tmp_path):
+    """A map change that leaves this seat a client of the SAME server
+    list must not tear down the live failover client: sockets stay up,
+    the monotonic failover/degraded counters survive, and only the
+    epoch/fence/divisor advance. A real topology change still rebuilds."""
+    seats = _two_seat_setup(str(tmp_path / "ha.npz"), _rule(740, 5))
+    servers = (ClusterServerSpec("A", "127.0.0.1", _free_port()),
+               ClusterServerSpec("B", "127.0.0.1", _free_port()))
+    try:
+        seats["B"].apply_map(ClusterMap(epoch=1, servers=servers,
+                                        clients=("X",)))
+        cur = seats["B"].state.token_client
+        cur.failover_count = 3                      # accumulated history
+        seats["B"].apply_map(ClusterMap(epoch=2, servers=servers,
+                                        clients=("X", "Y"),
+                                        request_timeout_ms=5000))
+        assert seats["B"].state.token_client is cur             # no churn
+        assert cur.failover_count == 3              # counters not zeroed
+        assert cur.degraded.divisor == 2            # membership tracked
+        assert all(c.request_timeout_s == 5.0       # timeout applied live
+                   for c in cur._clients)
+        assert seats["B"].state.epoch == 2
+        assert seats["B"].state.fence.highest_seen == 2
+
+        # clients list CLEARED: divisor falls back to the config default
+        # (1), exactly as a freshly built client would — no map-history
+        # dependence
+        seats["B"].apply_map(ClusterMap(epoch=3, servers=servers))
+        assert seats["B"].state.token_client is cur
+        assert cur.degraded.divisor == 1
+
+        seats["B"].apply_map(ClusterMap(epoch=4, servers=servers[:1]))
+        assert seats["B"].state.token_client is not cur         # rebuilt
+    finally:
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+def test_failed_promotion_retries_until_port_frees(frozen_time, tmp_path):
+    """A transition failure (EADDRINUSE from a lingering listener) must
+    NOT commit the map: the datasource property never re-fires an
+    unchanged value, so without the manager's own retry timer the seat
+    would sit NOT_STARTED forever — no leader, whole fleet degraded —
+    until a human bumps the epoch."""
+    seats = _two_seat_setup(str(tmp_path / "ha.npz"), _rule(760, 5))
+    port = _free_port()
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", port))
+    blocker.listen(1)
+    servers = (ClusterServerSpec("A", "127.0.0.1", port),)
+    try:
+        seats["A"].retry_delay_s = 0.05
+        seats["A"].apply_map(ClusterMap(epoch=1, servers=servers))
+        assert seats["A"].state.mode != CLUSTER_SERVER
+        assert seats["A"].map is None            # NOT committed: retried
+        blocker.close()
+        assert _wait(lambda: seats["A"].state.mode == CLUSTER_SERVER, 10.0)
+        assert seats["A"].map is not None and seats["A"].map.epoch == 1
+        assert seats["A"].state.token_server.epoch == 1
+    finally:
+        blocker.close()
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+def test_map_below_wire_observed_epoch_ignored(frozen_time, tmp_path):
+    """The wire is a map source too: once an epoch-5-stamped response
+    has been observed, a delayed epoch-4 map must not promote a leader
+    the whole fleet's fences would reject (and must not trip the
+    stale-epoch split-brain alarm doing so)."""
+    seats = _two_seat_setup(str(tmp_path / "ha.npz"), _rule(750, 5))
+    servers = (ClusterServerSpec("A", "127.0.0.1", _free_port()),)
+    try:
+        seats["A"].state.fence.observe(5)
+        seats["A"].apply_map(ClusterMap(epoch=4, servers=servers))
+        assert seats["A"].state.mode != CLUSTER_SERVER
+        assert seats["A"].map is None                   # never applied
+        assert seats["A"].state.fence.stale_rejected_count == 0
+
+        seats["A"].apply_map(ClusterMap(epoch=5, servers=servers))
+        assert seats["A"].state.mode == CLUSTER_SERVER  # current term: ok
+        assert seats["A"].state.token_server.epoch == 5
+    finally:
+        seats["A"].stop()
+
+
+def test_leader_crash_failover_acceptance(frozen_time, tmp_path, injector):
+    """THE acceptance scenario: traffic flowing, leader killed via the
+    ``cluster.ha.leader.crash`` fault point (hard kill — no drain), a
+    standby is promoted and serves within the configured failover
+    deadline, and total admissions across the handoff exceed the global
+    threshold by EXACTLY the grants made since the last checkpoint
+    publish (the asserted bound)."""
+    T = 10
+    ck_path = str(tmp_path / "crash.npz")
+    seats = _two_seat_setup(ck_path, _rule(720, T))
+    pa, pb = _free_port(), _free_port()
+    servers = (ClusterServerSpec("A", "127.0.0.1", pa),
+               ClusterServerSpec("B", "127.0.0.1", pb))
+    failover_deadline_ms = 20_000   # generous: includes the promotion jit
+    fc = FailoverTokenClient(
+        [("127.0.0.1", pa), ("127.0.0.1", pb)],
+        request_timeout_s=0.5, reconnect_interval_s=0.05,
+        failover_deadline_ms=failover_deadline_ms).start()
+    try:
+        seats["A"].apply_map(ClusterMap(epoch=1, servers=servers,
+                                        clients=("X",)))
+        assert _wait(fc.is_connected)
+        r, _ = _ok_with_retry(lambda: fc.request_token(720))
+        assert r.status == TokenResultStatus.OK
+
+        # 3 more grants, then the leader publishes its periodic checkpoint
+        for _ in range(3):
+            assert fc.request_token(720).status == TokenResultStatus.OK
+        seats["A"].publish_checkpoint()
+        checkpointed = 4
+        # ... and 2 grants AFTER the publish: the allowed over-admission
+        margin = 2
+        for _ in range(margin):
+            assert fc.request_token(720).status == TokenResultStatus.OK
+        pre_crash = checkpointed + margin
+
+        # kill the leader mid-traffic: the next drained batch dies, no
+        # drain checkpoint is published
+        injector.arm("cluster.ha.leader.crash", "error", times=1)
+        assert fc.request_token(720).status == TokenResultStatus.FAIL
+        assert _wait(lambda: seats["A"].state.token_server.crashed, 5.0)
+        published_before = seats["A"].checkpoints_published
+
+        # the map controller promotes the standby (epoch 2)
+        t_promote = time.monotonic()
+        seats["B"].apply_map(ClusterMap(epoch=2, servers=servers[::-1],
+                                        clients=("X",)))
+        assert seats["B"].state.mode == CLUSTER_SERVER
+        assert seats["B"].rows_restored == 1               # warm start
+        r, _ = _ok_with_retry(lambda: fc.request_token(720))
+        elapsed_ms = (time.monotonic() - t_promote) * 1000
+        assert r.status == TokenResultStatus.OK, "standby never served"
+        assert elapsed_ms < failover_deadline_ms, (
+            f"failover took {elapsed_ms:.0f}ms "
+            f"(deadline {failover_deadline_ms}ms)")
+        assert fc.failover_count == 1
+        assert seats["A"].checkpoints_published == published_before
+
+        # bounded over-admission: the successor restored the checkpoint,
+        # so it grants exactly T - checkpointed more — total across the
+        # handoff is T + margin, NOT T + a fresh window
+        post = 1
+        while fc.request_token(720).status == TokenResultStatus.OK:
+            post += 1
+            assert post <= T, "over-admission unbounded"
+        assert post == T - checkpointed
+        assert pre_crash + post == T + margin
+        # and the epoch fence carried the new term
+        assert fc.fence.highest_seen == 2
+        stats = seats["B"].state.ha_stats()
+        assert stats["epoch"] == 2 and stats["manager"]["rowsRestored"] == 1
+    finally:
+        fc.stop()
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+# -- standalone HA participant (python -m sentinel_tpu.cluster) ---------------
+
+
+def test_standalone_ha_participant_file_map_flip(tmp_path, frozen_time):
+    from sentinel_tpu.cluster.__main__ import StandaloneHAParticipant
+
+    port = _free_port()
+    map_path = tmp_path / "map.json"
+    rules_path = tmp_path / "rules.json"
+    rules_path.write_text(json.dumps({
+        "default": [{"resource": "r", "count": 4, "clusterMode": True,
+                     "clusterConfig": {"flowId": 800, "thresholdType": 1}}]}))
+    map_path.write_text(json.dumps({
+        "epoch": 1,
+        "servers": [{"machineId": "A", "host": "127.0.0.1", "port": port},
+                    {"machineId": "B", "host": "127.0.0.1",
+                     "port": _free_port()}]}))
+    part = StandaloneHAParticipant(
+        map_path=str(map_path), machine_id="A", rules_path=str(rules_path),
+        checkpoint_path=str(tmp_path / "ck.npz"), refresh_ms=3_600_000,
+        host="127.0.0.1")
+    part.start()
+    try:
+        stats = part.state.ha_stats()
+        assert stats["roleName"] == "SERVER" and stats["epoch"] == 1
+        client = ClusterTokenClient("127.0.0.1", port,
+                                    health_gate=None).start()
+        try:
+            assert _wait(client.is_connected)
+            r, _ = _ok_with_retry(lambda: client.request_token(800))
+            assert r.status == TokenResultStatus.OK        # rules staged
+        finally:
+            client.stop()
+
+        # the map file demotes this seat; the poll applies it
+        map_path.write_text(json.dumps({
+            "epoch": 2, "leader": "B",
+            "servers": [{"machineId": "A", "host": "127.0.0.1", "port": port},
+                        {"machineId": "B", "host": "127.0.0.1",
+                         "port": _free_port()}]}))
+        part.refresh()
+        stats = part.state.ha_stats()
+        assert stats["roleName"] == "CLIENT" and stats["epoch"] == 2
+    finally:
+        part.stop()
+
+
+def test_default_machine_id_shape():
+    import os
+
+    assert default_machine_id().endswith(f"@{os.getpid()}")
+
+
+# -- ops surfaces: resilience_stats, command, /metrics gauges -----------------
+
+
+def test_resilience_stats_and_exporter_carry_ha_block(engine, frozen_time):
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    st.load_flow_rules([st.FlowRule(
+        resource="shared", count=50, cluster_mode=True,
+        cluster_config={"flowId": 900, "thresholdType": THRESHOLD_GLOBAL,
+                        "windowIntervalMs": 2000})])
+    # the degraded-share base tracks the LOCAL copies of cluster rules
+    assert engine.cluster_degraded_thresholds() == {900: (50.0, 2000)}
+
+    ha = engine.resilience_stats()["clusterHA"]
+    assert ha["roleName"] == "NOT_STARTED" and ha["failoverCount"] == 0
+    text = render_engine_metrics(engine)
+    assert "sentinel_tpu_cluster_ha_role -1" in text
+    assert "sentinel_tpu_cluster_ha_epoch 0" in text
+    assert "sentinel_tpu_cluster_ha_failovers_total 0" in text
+    assert "sentinel_tpu_cluster_ha_stale_epoch_rejected_total 0" in text
+    assert "sentinel_tpu_cluster_ha_degraded 0" in text
+    assert "sentinel_tpu_cluster_ha_degraded_seconds_total 0" in text
+
+
+def test_get_cluster_mode_command_includes_ha(engine, frozen_time):
+    import urllib.request
+
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    center = CommandCenter(engine, port=0)
+    center.start()
+    try:
+        url = f"http://127.0.0.1:{center.bound_port}/getClusterMode"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = json.loads(r.read().decode())
+        assert body["ha"]["roleName"] == "NOT_STARTED"
+        assert body["ha"]["epoch"] == 0
+        url = f"http://127.0.0.1:{center.bound_port}/resilience"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = json.loads(r.read().decode())
+        assert "clusterHA" in body and body["clusterHA"]["degraded"] is False
+    finally:
+        center.stop()
+
+
+# -- heartbeat under leader churn (satellite) ---------------------------------
+
+
+def test_heartbeat_last_success_monotonic_across_failover(frozen_time,
+                                                          injector):
+    """``last_success_ms`` is exported through the resilience probe
+    registry and must be monotonic: rotating to a dashboard behind a
+    skewed clock (or a frozen test clock) must never move it backwards —
+    scrape-side 'age since success' math would go negative."""
+    from sentinel_tpu.resilience import (
+        RetryPolicy,
+        health_snapshot,
+        register_probe,
+    )
+    from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+    class Beat(HeartbeatSender):
+        def _post(self, req) -> bool:
+            return True
+
+    hb = Beat(dashboards=["d1:80", "d2:80"], interval_ms=100, api_port=1,
+              retry_policy=RetryPolicy(base_ms=100, max_ms=1600,
+                                       multiplier=2.0, jitter="none"))
+    assert hb.send_once()
+    t0 = time_util.current_time_millis()
+    assert hb.last_success_ms == t0
+
+    # failover to the second dashboard while the observed clock runs
+    # BACKWARDS (skewed host): success must not regress the stamp
+    injector.arm("heartbeat.post", "error", times=1)
+    assert not hb.send_once()              # d1 fails -> rotate to d2
+    assert hb._idx == 1
+    frozen_time.freeze_time(t0 - 5_000)
+    assert hb.send_once()                  # d2 succeeds, clock skewed back
+    assert hb.last_success_ms == t0        # monotonic: unchanged
+    frozen_time.freeze_time(t0 + 1_000)
+    assert hb.send_once()
+    assert hb.last_success_ms == t0 + 1_000
+
+    # exported: the probe registry serves the same stamp
+    probe_off = register_probe("heartbeat", hb.health)
+    try:
+        snap = health_snapshot()
+        assert snap["heartbeat"]["lastSuccessMs"] == t0 + 1_000
+    finally:
+        probe_off()
+
+
+def test_heartbeat_full_rotation_backoff_resets_on_success(frozen_time,
+                                                           injector):
+    """Leader-churn cadence: repeated full rotations back off, ONE
+    success restores the healthy cadence and zeroes the failure count —
+    a promoted dashboard does not inherit the backoff."""
+    from sentinel_tpu.resilience import RetryPolicy
+    from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+    class Beat(HeartbeatSender):
+        def _post(self, req) -> bool:
+            return True
+
+    hb = Beat(dashboards=["d1:80", "d2:80"], interval_ms=100, api_port=1,
+              retry_policy=RetryPolicy(base_ms=100, max_ms=1600,
+                                       multiplier=2.0, jitter="none"))
+    injector.arm("heartbeat.post", "error", times=8)
+    waits = [hb._next_wait_ms(hb.send_once()) for _ in range(8)]
+    assert waits == [100, 100, 100, 200, 100, 400, 100, 800]
+    assert hb.consecutive_failures == 8
+    assert hb._next_wait_ms(hb.send_once()) == 100     # success: cadence back
+    assert hb.consecutive_failures == 0
+    # the backoff SESSION reset too: a fresh outage starts at base again
+    injector.arm("heartbeat.post", "error", times=4)
+    waits = [hb._next_wait_ms(hb.send_once()) for _ in range(4)]
+    assert waits == [100, 100, 100, 200]
+
+
+# -- extended partition drill (slow: excluded from tier-1) --------------------
+
+
+@pytest.mark.slow
+def test_extended_partition_multiple_degraded_spells():
+    """Real-clock drill: two full lost->degraded->recovered spells, with
+    the cumulative degraded_seconds accounting surviving both."""
+    time_util.unfreeze_time()
+    port = _free_port()
+    fc = FailoverTokenClient(
+        [("127.0.0.1", port)], request_timeout_s=0.2,
+        reconnect_interval_s=0.05, failover_deadline_ms=300,
+        degraded=DegradedQuota(divisor=1, thresholds={7: (1000.0, 1000)}))
+    fc.start()
+    try:
+        spells = 0
+        for _ in range(2):
+            deadline = time.monotonic() + 10
+            while not fc.is_degraded() and time.monotonic() < deadline:
+                fc.request_token(7)
+                time.sleep(0.05)
+            assert fc.is_degraded()
+            assert fc.request_token(7).status == TokenResultStatus.OK
+            spells += 1
+
+            rules = ClusterFlowRuleManager()
+            rules.load_rules("default", [_rule(7, 1000)])
+            svc = DefaultTokenService(rules, epoch=spells)
+            svc.request_tokens([(None, 0, False)])  # pre-warm the jit: a
+            # cold compile outlasts the 0.2s request timeout, and a FAILed
+            # wire request would be answered by the degraded share —
+            # masking the spell-close this test asserts
+            server = ClusterTokenServer(svc, host="127.0.0.1",
+                                        port=port).start()
+            try:
+                assert _wait(fc.is_connected, 10.0)
+                # a WIRE verdict (not a degraded-share one) closes the
+                # spell; loop until it lands
+                assert _wait(
+                    lambda: fc.request_token(7).status ==
+                    TokenResultStatus.OK and not fc.is_degraded(), 10.0)
+            finally:
+                server.stop()
+            # the stopped server's handler socket lives in its handler
+            # thread: force the client-side drop (the next partition)
+            fc._clients[0]._drop_connection()
+            assert _wait(lambda: not fc.is_connected(), 10.0)
+        assert fc.degraded_entry_count >= 2
+        assert fc.degraded_seconds() > 0.0
+    finally:
+        fc.stop()

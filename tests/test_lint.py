@@ -125,6 +125,38 @@ def test_exported_metric_names_registered_exactly_once():
     assert "sentinel_tpu_second_pass" in seen
 
 
+def test_cluster_ha_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.cluster.ha.*`` config key must (a) be defined
+    and read ONLY in core/config.py — the rest of the package goes
+    through the ``SentinelConfig`` accessors, so defaults/validation
+    live in exactly one place — and (b) appear in docs/OPERATIONS.md,
+    so the failover-drill runbook can never silently drift from the
+    knobs the code actually reads."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.cluster\.ha\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.cluster.ha.* literals outside core/config.py "
+        "(use the SentinelConfig cluster_ha_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no cluster HA config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "cluster HA config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
 @pytest.mark.skipif(shutil.which("ruff") is None,
                     reason="ruff binary not in this image")
 def test_ruff_clean():
