@@ -16,7 +16,11 @@ the printed number is honest end-to-end wall time.
 The reference repo publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 the ratio to the 1M checks/sec north-star target: 1.0 = target met.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
+AND persists the same record to a per-PR artifact (``BENCH_6.json`` by
+default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
+perf trajectory across PRs (ROADMAP item 3a). The artifact is written
+progressively — whatever sections completed survive a kill.
 """
 
 from __future__ import annotations
@@ -540,6 +544,26 @@ def _reexec_cpu(reason: str) -> None:
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
+def _write_artifact(record: dict) -> None:
+    """Persist the bench record as the per-PR trajectory artifact
+    (``BENCH_<n>.json``): one JSON object, same shape as the printed
+    line. Best-effort — an unwritable CWD must not kill the record."""
+    import os
+
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_6.json")
+    try:
+        # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
+        # mid-dump must truncate the TMP file, never the last complete
+        # artifact the earlier persist() calls already secured.
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def main() -> None:
     import os
     import signal
@@ -565,6 +589,7 @@ def main() -> None:
         }
         out = dict(out)
         out["killed_by_signal"] = signal.Signals(signum).name
+        _write_artifact(out)
         print("\n" + json.dumps(out))
         sys.stdout.flush()
         os._exit(0)
@@ -656,6 +681,7 @@ def main() -> None:
                     json.dump(out, f)
             except OSError:
                 pass
+            _write_artifact(out)
             print(json.dumps(out))
             sys.stdout.flush()
         os._exit(0)
@@ -700,12 +726,15 @@ def main() -> None:
     def persist(partial: dict) -> None:
         """Crash-safe partial record: if the tunnel (or the driver's
         timeout) kills us mid-latency-section, the completed sections
-        survive on disk AND a JSON line is still printable from them."""
+        survive on disk AND a JSON line is still printable from them.
+        The per-PR artifact rides the same cadence, so BENCH_<n>.json
+        always holds the most complete record this run produced."""
         try:
             with open("bench_partial.json", "w") as f:
                 json.dump(partial, f)
         except OSError:
             pass
+        _write_artifact(partial)
 
     persist(out)
     # A TPU throughput number in hand must NOT be discarded because a

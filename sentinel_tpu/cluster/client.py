@@ -100,7 +100,16 @@ class ClusterTokenClient:
                 return
         sock = socket.create_connection((self.host, self.port),
                                         timeout=self.connect_timeout_s)
-        sock.settimeout(None)
+        # Bounded I/O timeout, derived from the request timeout (was
+        # ``settimeout(None)``): with an unbounded socket, a server that
+        # stops READING mid-reply leaves ``sendall`` parked forever
+        # holding ``_send_lock`` — every later request on this client
+        # hangs behind it with no path to the reconnector. Bounded, the
+        # stalled write raises and drops the connection like any other
+        # wire failure. The read side treats a timeout as an idle tick
+        # (no traffic != failure — see ``_read_loop``), so a quiet but
+        # healthy connection is never torn down by this.
+        sock.settimeout(self._io_timeout_s())
         with self._lock:
             if self._sock is not None:  # raced with another connect
                 sock.close()
@@ -128,6 +137,14 @@ class ClusterTokenClient:
                 pass
             delay_s = session.next_delay_ms() / 1000.0
 
+    def _io_timeout_s(self) -> float:
+        """Socket send/recv bound: twice the request timeout (a write
+        that cannot progress for 2x the longest any caller would wait on
+        its reply is a dead peer, not a slow one), floored so a
+        pathologically small request timeout can't busy-spin the
+        reader."""
+        return max(self.request_timeout_s * 2, 0.2)
+
     def is_connected(self) -> bool:
         with self._lock:
             return self._sock is not None
@@ -149,7 +166,13 @@ class ClusterTokenClient:
         reader = codec.FrameReader()
         try:
             while True:
-                data = sock.recv(65536)
+                try:
+                    data = sock.recv(65536)
+                except socket.timeout:
+                    # Idle tick on the bounded-I/O socket: no traffic
+                    # for the timeout window is normal on a quiet
+                    # connection — only a real error drops it.
+                    continue
                 if not data:
                     break
                 for body in reader.feed(data):
@@ -271,7 +294,12 @@ class ClusterTokenClient:
         remaining, wait_ms = codec.decode_flow_response(resp.entity)
         span = (self._read_server_span(resp.entity, codec.FLOW_RESP_SIZE)
                 if trace is not None else None)
-        if resp.status == TokenResultStatus.SHOULD_WAIT:
+        if resp.status in (TokenResultStatus.SHOULD_WAIT,
+                           TokenResultStatus.OVERLOADED):
+            # OVERLOADED is a shed, not a verdict: waitMs carries the
+            # server's retry-after hint. It reaches the caller as-is —
+            # the failover client backs the target off, the engine
+            # degrades the entry to its local lease/fallback path.
             return TokenResult(resp.status, wait_ms=wait_ms,
                                server_span=span)
         return TokenResult(resp.status, remaining=remaining,

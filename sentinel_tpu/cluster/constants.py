@@ -41,6 +41,16 @@ class TokenResultStatus(enum.IntEnum):
     NO_RULE_EXISTS = 3
     NO_REF_RULE_EXISTS = 4
     NOT_AVAILABLE = 5
+    # TPU extension (no reference twin): the server SHED this request
+    # before it reached the device step — admission queue full / over
+    # watermark / deadline expired in queue. Distinct from BLOCKED (a
+    # quota verdict) and FAIL (no verdict at all): the server is alive
+    # but saturated, the verdict is "not now", and the flow-response
+    # waitMs field carries a retry-after hint. Clients back the target
+    # off without tripping the breaker and serve the entry from the
+    # local lease/fallback path. A stock reference client treats the
+    # unknown status as its fallbackToLocal path — same degradation.
+    OVERLOADED = 6
 
 
 class ClusterFlowEvent(enum.IntEnum):

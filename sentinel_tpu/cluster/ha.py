@@ -218,6 +218,14 @@ class FailoverTokenClient:
         self._active_idx = 0
         self.failover_count = 0
         self.last_failover_ms = -1
+        # Overload backoff (ISSUE 6): a target that replied OVERLOADED
+        # is skipped for its retry-after window instead of being walked
+        # into again on every entry — and an overloaded reply is NOT a
+        # failure toward the lost->degraded clock (the server is alive,
+        # just saturated; hammering it with failover traffic is exactly
+        # the collapse amplification this layer exists to prevent).
+        self._backoff_until_ms = [0] * len(targets)
+        self.overloaded_count = 0
         # Degraded-mode accounting: _lost_at_ms marks total connectivity
         # loss (-1 = connected recently); _degraded_since_ms marks the
         # deadline expiring; degraded_total_ms accumulates closed spells.
@@ -287,6 +295,7 @@ class FailoverTokenClient:
         return total / 1000.0
 
     def failover_stats(self) -> dict:
+        now = time_util.current_time_millis()
         return {
             "failoverCount": self.failover_count,
             "lastFailoverMs": self.last_failover_ms,
@@ -296,6 +305,9 @@ class FailoverTokenClient:
             "activeTarget": self.targets[self._active_idx],
             "targets": self.targets,
             "degradedQuota": self.degraded.snapshot(),
+            "overloadedCount": self.overloaded_count,
+            "targetsBackedOff": sum(
+                1 for t in self._backoff_until_ms if t > now),
         }
 
     # -- requests ----------------------------------------------------------
@@ -307,6 +319,14 @@ class FailoverTokenClient:
                 self.failover_count += 1
                 self.last_failover_ms = time_util.current_time_millis()
 
+    def _note_overload(self, idx: int, retry_after_ms: int) -> None:
+        backoff = max(int(retry_after_ms),
+                      config.overload_client_backoff_ms())
+        with self._lock:
+            self.overloaded_count += 1
+            self._backoff_until_ms[idx] = (
+                time_util.current_time_millis() + backoff)
+
     def _request(self, fn, degraded_fn,
                  timeout_s: Optional[float] = None) -> TokenResult:
         from sentinel_tpu.cluster.constants import TokenResultStatus
@@ -317,8 +337,15 @@ class FailoverTokenClient:
         # targets are up but unresponsive during a transition.
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
+        now_ms = time_util.current_time_millis()
+        overload_hint = backed_off = None
         for idx, c in enumerate(self._clients):
             if not c.is_connected():
+                continue
+            if self._backoff_until_ms[idx] > now_ms:
+                # Inside this target's overload-backoff window: skip it
+                # without touching the wire (the retry-after contract).
+                backed_off = self._backoff_until_ms[idx] - now_ms
                 continue
             remaining = None
             if deadline is not None:
@@ -326,12 +353,33 @@ class FailoverTokenClient:
                 if remaining <= 0:
                     break
             tr = fn(c, remaining)
+            if tr.status == TokenResultStatus.OVERLOADED:
+                # First-class overload: back this target off for the
+                # server's retry-after hint and walk on. NOT a failure
+                # toward failover/degraded — the reply itself proves the
+                # server is alive.
+                self._note_overload(idx, tr.wait_ms)
+                overload_hint = tr.wait_ms
+                continue
             if tr.status != TokenResultStatus.FAIL:
                 self._note_failover(idx)
                 self._note_connected()
                 return tr
             # FAIL: breaker-open, timeout, garbage, or stale epoch —
             # walk on to the next target in map order.
+        if overload_hint is not None or backed_off is not None:
+            # Every reachable target is shedding (or still inside its
+            # backoff window): report OVERLOADED so the engine degrades
+            # this entry to the local lease/fallback path. A fresh
+            # OVERLOADED reply resets the lost->degraded clock (the
+            # fleet is reachable); a backoff-only round leaves the clock
+            # alone — no new evidence either way.
+            if overload_hint is not None:
+                self._note_connected()
+            return TokenResult(
+                TokenResultStatus.OVERLOADED,
+                wait_ms=int(overload_hint if overload_hint is not None
+                            else backed_off))
         # No target produced a verdict. That includes the half-open case
         # (connected to a partitioned leader): a round with zero
         # verdicts advances the lost->degraded clock; any success resets

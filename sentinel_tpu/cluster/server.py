@@ -27,7 +27,22 @@ from sentinel_tpu.cluster.constants import (
     TokenResultStatus,
 )
 from sentinel_tpu.cluster.token_service import DefaultTokenService
-from sentinel_tpu.resilience import faults
+from sentinel_tpu.core.config import config
+from sentinel_tpu.resilience import DeadlineBudget, faults
+
+
+def pad_width(n_flat: int) -> int:
+    """Device batch width for ``n_flat`` requests: exact below 64 (fast
+    compiles; padding the first 1-request acquire to 16 measurably
+    outlasted the client's 2s request timeout — r5), then a coarse
+    ladder (256, 1024, 4096, +4096...) bounding jit specializations
+    against client-controlled burst sizes."""
+    if n_flat <= 64:
+        return n_flat
+    width = 256
+    while width < n_flat:
+        width = width * 4 if width < 4096 else width + 4096
+    return width
 
 
 class _Batcher:
@@ -38,10 +53,23 @@ class _Batcher:
     request — at 512-request bursts the per-request Event alloc/wait
     overhead was the loopback throughput ceiling (~100µs of host work
     per acquire, measured r5). ``max_batch`` is a soft cap at group
-    granularity: a drained group is never split across device calls."""
+    granularity: a drained group is never split across device calls.
+
+    Overload-safe admission (ISSUE 6): the queue is BOUNDED at
+    ``max_queue_groups`` and every group carries a ``DeadlineBudget``.
+    Submissions over the watermark (or against a full queue) are shed
+    immediately — ``box["shed_retry_after_ms"]`` instead of results, the
+    frontend replies OVERLOADED — and the drain loop sheds groups whose
+    deadline expired while queued BEFORE spending a device step on them.
+    Shedding happens strictly before ``request_tokens``: a shed request
+    is never half-admitted (docs/SEMANTICS.md "Shed-before-admission").
+    """
 
     def __init__(self, service: DefaultTokenService, linger_s: float, max_batch: int,
-                 crash_cb=None):
+                 crash_cb=None, max_queue_groups: Optional[int] = None,
+                 watermark_pct: Optional[int] = None,
+                 deadline_ms: Optional[int] = None,
+                 retry_after_ms: Optional[int] = None):
         self.service = service
         self.linger_s = linger_s
         self.max_batch = max_batch
@@ -49,17 +77,79 @@ class _Batcher:
         # fired per drained batch; when armed, ``crash_cb`` hard-kills the
         # owning server — the chaos suite's process-crash analog.
         self.crash_cb = crash_cb
-        self._queue: "queue.Queue" = queue.Queue()
+        self.max_queue_groups = int(
+            max_queue_groups if max_queue_groups is not None
+            else config.overload_queue_max_groups())
+        pct = int(watermark_pct if watermark_pct is not None
+                  else config.overload_queue_watermark_pct())
+        self.watermark_groups = max(1, self.max_queue_groups * pct // 100)
+        self.deadline_ms = int(deadline_ms if deadline_ms is not None
+                               else config.overload_deadline_ms())
+        self.retry_after_ms = int(retry_after_ms if retry_after_ms is not None
+                                  else config.overload_retry_after_ms())
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.max_queue_groups)
+        self._stats_lock = threading.Lock()
+        self.admitted_groups = 0
+        self.shed_watermark = 0
+        self.shed_queue_full = 0
+        self.shed_deadline_expired = 0
+        self.shed_requests = 0
+        self.queue_depth_max = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def submit_many(self, requests):
+    def _shed(self, box: dict, done: threading.Event, n_requests: int,
+              cause: str) -> None:
+        with self._stats_lock:
+            setattr(self, cause, getattr(self, cause) + 1)
+            self.shed_requests += n_requests
+        box["shed_retry_after_ms"] = self.retry_after_ms
+        done.set()
+
+    def submit_many(self, requests, budget: Optional[DeadlineBudget] = None):
         """One group: ``(done_event, box)``; ``box["results"]`` carries
-        one TokenResult per request (absent on a failed device call)."""
+        one TokenResult per request (absent on a failed device call), or
+        ``box["shed_retry_after_ms"]`` when the group was shed instead of
+        admitted. ``budget`` is the group's remaining deadline (defaults
+        to the configured overload deadline)."""
         done = threading.Event()
         box = {}
-        self._queue.put((list(requests), done, box))
+        reqs = list(requests)
+        if budget is None:
+            budget = DeadlineBudget(self.deadline_ms)
+        # Watermark shed: past the high-water mark the queue is already
+        # deeper than a healthy drain can clear inside a deadline, so an
+        # explicit "not now" beats silently joining the backlog.
+        if self._queue.qsize() >= self.watermark_groups:
+            self._shed(box, done, len(reqs), "shed_watermark")
+            return done, box
+        try:
+            self._queue.put_nowait((reqs, done, box, budget))
+        except queue.Full:
+            self._shed(box, done, len(reqs), "shed_queue_full")
+            return done, box
+        with self._stats_lock:
+            self.admitted_groups += 1
+            depth = self._queue.qsize()
+            if depth > self.queue_depth_max:
+                self.queue_depth_max = depth
         return done, box
+
+    def overload_stats(self) -> dict:
+        """Lock-free read (the /metrics scrape path): counters are plain
+        ints, a racing scrape just sees a near-instant snapshot."""
+        return {
+            "queueDepth": self._queue.qsize(),
+            "queueDepthMax": self.queue_depth_max,
+            "queueLimitGroups": self.max_queue_groups,
+            "watermarkGroups": self.watermark_groups,
+            "admittedGroups": self.admitted_groups,
+            "shedWatermark": self.shed_watermark,
+            "shedQueueFull": self.shed_queue_full,
+            "shedDeadlineExpired": self.shed_deadline_expired,
+            "shedRequests": self.shed_requests,
+            "deadlineMs": self.deadline_ms,
+        }
 
     def start(self):
         self._thread = threading.Thread(
@@ -99,25 +189,36 @@ class _Batcher:
                     break
                 groups.append(g)
                 n += len(g[0])
+            # Deadline-aware shed BEFORE the device step: a group whose
+            # budget expired while queued is dead weight — its client
+            # already timed out — and spending a device step on it only
+            # delays the still-live groups behind it. Shed here is also
+            # the half-admission proof point: expiry is checked strictly
+            # before request_tokens, so no shed request ever holds a
+            # granted token (docs/SEMANTICS.md "Shed-before-admission").
+            live = []
+            for g in groups:
+                if g[3].expired:
+                    self._shed(g[2], g[1], len(g[0]),
+                               "shed_deadline_expired")
+                else:
+                    live.append(g)
+            groups = live
+            if not groups:
+                continue
             flat = [r for g in groups for r in g[0]]
             # Bound jit specializations: request_tokens jits per batch
             # LENGTH, and group granularity makes lengths client-
             # controlled — unpadded, a client sending varying burst
             # sizes would drive unbounded recompilation (and stall all
-            # token traffic per new width). Small batches (<= 64) keep
-            # their EXACT width: their compiles are fast, and padding
-            # the first 1-request acquire to 16 measurably outlasted the
-            # client's 2s request timeout (r5 review — compile stall on
-            # the very first token). Larger bursts pad to a coarse
-            # ladder; padding rows carry a None flow id -> slot -1 ->
-            # NO_RULE_EXISTS, then get sliced off.
+            # token traffic per new width). pad_width keeps small
+            # batches (<= 64) at their EXACT width (their compiles are
+            # fast; padding the first 1-request acquire to 16 measurably
+            # outlasted the client's 2s request timeout — r5 review),
+            # larger bursts ride a coarse ladder; padding rows carry a
+            # None flow id -> slot -1 -> NO_RULE_EXISTS, get sliced off.
             n_flat = len(flat)
-            if n_flat <= 64:
-                width = n_flat
-            else:
-                width = 256
-                while width < n_flat:
-                    width = width * 4 if width < 4096 else width + 4096
+            width = pad_width(n_flat)
             try:
                 results = self.service.request_tokens(
                     flat + [(None, 0, False)] * (width - n_flat))[:n_flat]
@@ -125,11 +226,11 @@ class _Batcher:
                 from sentinel_tpu.log.record_log import record_log
 
                 record_log.warn("token batch failed: %r", ex)
-                for _reqs, done, _box in groups:
+                for _reqs, done, _box, _budget in groups:
                     done.set()  # empty box -> handler replies FAIL
                 continue
             off = 0
-            for reqs, done, box in groups:
+            for reqs, done, box, _budget in groups:
                 box["results"] = results[off:off + len(reqs)]
                 off += len(reqs)
                 done.set()
@@ -176,7 +277,10 @@ class _Handler(socketserver.BaseRequestHandler):
         # ids make a stale exit a harmless BAD_REQUEST instead. The map
         # stays per-connection so one peer can never exit another's.
         self._remote_entries = {}
-        self.request.settimeout(300)
+        # Configurable idle timeout (was a flat 300s): a silent peer
+        # holds a handler thread + its remote-entry map for at most this
+        # long before the connection is reaped.
+        self.request.settimeout(server.idle_timeout_s)
         try:
             while True:
                 data = self.request.recv(65536)
@@ -197,7 +301,15 @@ class _Handler(socketserver.BaseRequestHandler):
 
                         j = i
                         burst = []
-                        while j < len(reqs) and reqs[j].msg_type == MSG_FLOW:
+                        # Per-connection concurrency cap: a pipelined
+                        # burst larger than conn.max.burst is split into
+                        # sequential groups (each awaited before the
+                        # next is read), so one connection can occupy at
+                        # most one bounded group in the admission queue
+                        # — TCP backpressure does the rest.
+                        while (j < len(reqs)
+                               and reqs[j].msg_type == MSG_FLOW
+                               and len(burst) < server.conn_max_burst):
                             # Optional trailing trace TLV (spans): a
                             # traced request becomes a 4-tuple the token
                             # service records a server span for.
@@ -211,12 +323,32 @@ class _Handler(socketserver.BaseRequestHandler):
                             j += 1
                         done, box = server.batcher.submit_many(
                             [r for _, r in burst])
-                        done.wait(timeout=5 + len(burst) * 0.01)
+                        # Wait at least the group's deadline budget: a
+                        # shorter wait would reply FAIL while the group
+                        # is still live in the queue, and the drain
+                        # could then commit its tokens AFTER the reply —
+                        # the half-admission window SEMANTICS.md's
+                        # deadline-shed bound promises stays closed.
+                        done.wait(timeout=max(
+                            5, server.batcher.deadline_ms / 1000 + 1)
+                            + len(burst) * 0.01)
                         results = box.get("results")
+                        shed_retry = box.get("shed_retry_after_ms")
                         replies = []
                         for k, (xid, _r) in enumerate(burst):
                             result = results[k] if results else None
-                            if result is None:
+                            if shed_retry is not None:
+                                # Admission-queue shed: explicit
+                                # OVERLOADED with a retry-after hint in
+                                # the waitMs field — never a silent
+                                # queue or a hung socket.
+                                replies.append(codec.encode_response(
+                                    xid, MSG_FLOW,
+                                    TokenResultStatus.OVERLOADED,
+                                    self._stamp_epoch(
+                                        codec.encode_flow_response(
+                                            0, shed_retry))))
+                            elif result is None:
                                 replies.append(codec.encode_response(
                                     xid, MSG_FLOW, TokenResultStatus.FAIL))
                             else:
@@ -323,6 +455,13 @@ class _Handler(socketserver.BaseRequestHandler):
 class _ThreadingTCP(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    # Connection-storm headroom: the socketserver default backlog of 5
+    # refuses/falls over under a fleet-wide reconnect (e.g. right after
+    # a leader promotion — exactly when every client dials at once).
+    # Accepted connections are cheap (one parked thread each until the
+    # idle timeout reaps them); the admission QUEUE is what stays
+    # bounded.
+    request_queue_size = 256
 
 
 class ClusterTokenServer:
@@ -331,12 +470,25 @@ class ClusterTokenServer:
     def __init__(self, service: Optional[DefaultTokenService] = None,
                  host: str = "0.0.0.0", port: int = 0,
                  batch_linger_s: float = 0.0005, max_batch: int = 256,
-                 engine=None):
+                 engine=None, max_queue_groups: Optional[int] = None,
+                 watermark_pct: Optional[int] = None,
+                 deadline_ms: Optional[int] = None,
+                 idle_timeout_s: Optional[int] = None,
+                 conn_max_burst: Optional[int] = None):
         self.service = service or DefaultTokenService()
         self.host = host
         self.port = port
+        self.idle_timeout_s = int(
+            idle_timeout_s if idle_timeout_s is not None
+            else config.overload_idle_timeout_s())
+        self.conn_max_burst = int(
+            conn_max_burst if conn_max_burst is not None
+            else config.overload_conn_max_burst())
         self.batcher = _Batcher(self.service, batch_linger_s, max_batch,
-                                crash_cb=self._fault_crash)
+                                crash_cb=self._fault_crash,
+                                max_queue_groups=max_queue_groups,
+                                watermark_pct=watermark_pct,
+                                deadline_ms=deadline_ms)
         self.crashed = False
         self._server: Optional[_ThreadingTCP] = None
         self._thread: Optional[threading.Thread] = None
@@ -415,6 +567,15 @@ class ClusterTokenServer:
         """Leadership epoch stamped into every token response (0 = no
         stamp, the pre-HA wire format)."""
         return self.service.epoch
+
+    def overload_stats(self) -> dict:
+        """Frontend overload snapshot: admission-queue depth/bounds and
+        shed counters (the ``sentinel_tpu_overload_*`` gauges' source)."""
+        return {
+            **self.batcher.overload_stats(),
+            "idleTimeoutS": self.idle_timeout_s,
+            "connMaxBurst": self.conn_max_burst,
+        }
 
     def _fault_crash(self) -> None:
         """Hard-kill for the ``cluster.ha.leader.crash`` fault point: the

@@ -245,6 +245,8 @@ class ClusterStateManager:
             "degraded": False,
             "degradedEntries": 0,
             "degradedSeconds": 0.0,
+            "overloadedCount": 0,
+            "targetsBackedOff": 0,
         }
         stats_fn = getattr(cli, "failover_stats", None)
         if stats_fn is not None:
@@ -252,3 +254,12 @@ class ClusterStateManager:
         if self.ha is not None:
             out["manager"] = self.ha.stats()
         return out
+
+    def overload_stats(self) -> Optional[dict]:
+        """The embedded token server's frontend overload snapshot
+        (queue depth/bounds, shed counters), or None when this instance
+        is not currently a server. Lock-free like :meth:`ha_stats`."""
+        srv = self.token_server
+        if srv is None:
+            return None
+        return srv.overload_stats()

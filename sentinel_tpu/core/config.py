@@ -78,6 +78,19 @@ TELEMETRY_TIMESERIES_HISTORY = \
 # bounds the host-side span ring on each side.
 TELEMETRY_SPANS_SAMPLE_EVERY = "csp.sentinel.telemetry.spans.sampleEvery"
 TELEMETRY_SPANS_CAPACITY = "csp.sentinel.telemetry.spans.capacity"
+# Overload protection for the serving frontends (cluster server.py TLV
+# frontend, envoy_rls, command plane — no reference twin: the reference's
+# Netty server rides the JVM's unbounded executor queues). Every key MUST
+# be read through the accessors below and documented in
+# docs/OPERATIONS.md "Overload & backpressure" (pinned by test_lint).
+OVERLOAD_QUEUE_MAX_GROUPS = "csp.sentinel.overload.queue.max.groups"
+OVERLOAD_QUEUE_WATERMARK_PCT = "csp.sentinel.overload.queue.watermark.pct"
+OVERLOAD_DEADLINE_MS = "csp.sentinel.overload.deadline.ms"
+OVERLOAD_RETRY_AFTER_MS = "csp.sentinel.overload.retry.after.ms"
+OVERLOAD_CONN_MAX_BURST = "csp.sentinel.overload.conn.max.burst"
+OVERLOAD_IDLE_TIMEOUT_S = "csp.sentinel.overload.idle.timeout.s"
+OVERLOAD_RLS_MAX_CONCURRENT = "csp.sentinel.overload.rls.max.concurrent"
+OVERLOAD_CLIENT_BACKOFF_MS = "csp.sentinel.overload.client.backoff.ms"
 
 DEFAULT_CHARSET = "utf-8"
 DEFAULT_SINGLE_METRIC_FILE_SIZE = 50 * 1024 * 1024
@@ -118,6 +131,21 @@ DEFAULT_TELEMETRY_TIMESERIES_SECONDS = 128
 DEFAULT_TELEMETRY_TIMESERIES_HISTORY = 1024
 DEFAULT_TELEMETRY_SPANS_SAMPLE_EVERY = 64
 DEFAULT_TELEMETRY_SPANS_CAPACITY = 256
+# Overload defaults. The queue bound is in GROUPS (one pipelined client
+# burst = one group); at the 1024-request per-connection burst cap that
+# is a worst case of ~524k queued requests — the point is bounding queue
+# WAIT (each group drains in one linger tick), not memory. The watermark
+# sheds before the hard bound so admission degrades gradually; the
+# deadline matches the default client request timeout (2s) — a group
+# older than that is dead weight the client already gave up on.
+DEFAULT_OVERLOAD_QUEUE_MAX_GROUPS = 512
+DEFAULT_OVERLOAD_QUEUE_WATERMARK_PCT = 80
+DEFAULT_OVERLOAD_DEADLINE_MS = 2_000
+DEFAULT_OVERLOAD_RETRY_AFTER_MS = 100
+DEFAULT_OVERLOAD_CONN_MAX_BURST = 1024
+DEFAULT_OVERLOAD_IDLE_TIMEOUT_S = 300
+DEFAULT_OVERLOAD_RLS_MAX_CONCURRENT = 64
+DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS = 250
 
 
 def _env_key(key: str) -> str:
@@ -254,6 +282,49 @@ class SentinelConfig:
         v = self.get_int(CLUSTER_HA_CHECKPOINT_PERIOD_MS,
                          DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS)
         return v if v > 0 else DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS
+
+    # Overload accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.overload.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def overload_queue_max_groups(self) -> int:
+        v = self.get_int(OVERLOAD_QUEUE_MAX_GROUPS,
+                         DEFAULT_OVERLOAD_QUEUE_MAX_GROUPS)
+        return v if v > 0 else DEFAULT_OVERLOAD_QUEUE_MAX_GROUPS
+
+    def overload_queue_watermark_pct(self) -> int:
+        v = self.get_int(OVERLOAD_QUEUE_WATERMARK_PCT,
+                         DEFAULT_OVERLOAD_QUEUE_WATERMARK_PCT)
+        return min(v, 100) if v > 0 else DEFAULT_OVERLOAD_QUEUE_WATERMARK_PCT
+
+    def overload_deadline_ms(self) -> int:
+        v = self.get_int(OVERLOAD_DEADLINE_MS, DEFAULT_OVERLOAD_DEADLINE_MS)
+        return v if v > 0 else DEFAULT_OVERLOAD_DEADLINE_MS
+
+    def overload_retry_after_ms(self) -> int:
+        v = self.get_int(OVERLOAD_RETRY_AFTER_MS,
+                         DEFAULT_OVERLOAD_RETRY_AFTER_MS)
+        return v if v > 0 else DEFAULT_OVERLOAD_RETRY_AFTER_MS
+
+    def overload_conn_max_burst(self) -> int:
+        v = self.get_int(OVERLOAD_CONN_MAX_BURST,
+                         DEFAULT_OVERLOAD_CONN_MAX_BURST)
+        return v if v > 0 else DEFAULT_OVERLOAD_CONN_MAX_BURST
+
+    def overload_idle_timeout_s(self) -> int:
+        v = self.get_int(OVERLOAD_IDLE_TIMEOUT_S,
+                         DEFAULT_OVERLOAD_IDLE_TIMEOUT_S)
+        return v if v > 0 else DEFAULT_OVERLOAD_IDLE_TIMEOUT_S
+
+    def overload_rls_max_concurrent(self) -> int:
+        v = self.get_int(OVERLOAD_RLS_MAX_CONCURRENT,
+                         DEFAULT_OVERLOAD_RLS_MAX_CONCURRENT)
+        return v if v > 0 else DEFAULT_OVERLOAD_RLS_MAX_CONCURRENT
+
+    def overload_client_backoff_ms(self) -> int:
+        v = self.get_int(OVERLOAD_CLIENT_BACKOFF_MS,
+                         DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS)
+        return v if v > 0 else DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS
 
     def log_dir(self) -> str:
         d = self.get(LOG_DIR)

@@ -244,6 +244,10 @@ class SentinelEngine:
         # plus unbounded SHOULD_WAIT sleeps).
         self.cluster_fallback_count = 0
         self.cluster_budget_exhausted_count = 0
+        # Overload sheds (ISSUE 6): entries whose cluster check came back
+        # OVERLOADED (the token server shed before admission) and were
+        # served via the local lease/fallback path instead.
+        self.cluster_overload_count = 0
         from sentinel_tpu.core.config import (
             DEFAULT_RESILIENCE_ENTRY_BUDGET_MS, RESILIENCE_ENTRY_BUDGET_MS)
 
@@ -1165,6 +1169,17 @@ class SentinelEngine:
                 continue
             if tr.status == TokenResultStatus.BLOCKED:
                 return False, True
+            if tr.status == TokenResultStatus.OVERLOADED:
+                # Server shed this acquire before admission: degrade to
+                # the local lease/fallback path IMMEDIATELY — no retry,
+                # no sleep (the retry-after hint governs the failover
+                # client's target backoff, not the data path: callers
+                # get bounded latency, never a queued wait).
+                self.cluster_overload_count += 1
+                if fallback:
+                    all_ok = False
+                    self._note_cluster_fallback()
+                continue
             if fallback:  # FAIL / NO_RULE / TOO_MANY_REQUEST -> local check
                 all_ok = False
                 self._note_cluster_fallback()
@@ -1186,6 +1201,12 @@ class SentinelEngine:
                 continue
             if tr.status == TokenResultStatus.BLOCKED:
                 return False, True
+            if tr.status == TokenResultStatus.OVERLOADED:
+                self.cluster_overload_count += 1
+                if fallback:
+                    all_ok = False
+                    self._note_cluster_fallback()
+                continue
             if fallback:
                 all_ok = False
                 self._note_cluster_fallback()
@@ -1480,8 +1501,13 @@ class SentinelEngine:
             "failOpenCount": self.fail_open_count,
             "clusterFallbackCount": self.cluster_fallback_count,
             "clusterBudgetExhaustedCount": self.cluster_budget_exhausted_count,
+            "clusterOverloadCount": self.cluster_overload_count,
             "clusterEntryBudgetMs": self.cluster_entry_budget_ms,
             "tokenClientBreaker": None,
+            # Frontend overload (ISSUE 6): the embedded token server's
+            # admission-queue depth/bounds and shed counters, None while
+            # this instance is not a server.
+            "overload": self.cluster.overload_stats(),
             # Staged-rollout guardrail beside the degradation channels:
             # active candidate set, stage, and windows-to-abort — one
             # unified picture of everything currently between the live

@@ -10,19 +10,42 @@ grpcio is present.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 from sentinel_tpu.cluster.constants import TokenResultStatus
 from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.core.config import config
 from sentinel_tpu.envoy_rls.rule import EnvoyRlsRuleManager, descriptor_flow_id
 
 
 class SentinelEnvoyRlsService:
     def __init__(self, rule_manager: Optional[EnvoyRlsRuleManager] = None,
-                 token_service: Optional[DefaultTokenService] = None):
+                 token_service: Optional[DefaultTokenService] = None,
+                 max_concurrent: Optional[int] = None):
         self.rules = rule_manager or EnvoyRlsRuleManager()
         self.token_service = token_service or DefaultTokenService(
             self.rules.cluster_rules)
+        # Overload shed gate (ISSUE 6): the gRPC executor is a fixed
+        # worker pool, but nothing bounded how many in-flight
+        # ShouldRateLimit calls could pile onto the shared token
+        # service's device lock. Past ``max_concurrent``, calls shed
+        # IMMEDIATELY with CODE_UNKNOWN (Envoy's failure-mode path:
+        # fail-open by default, deny with failure_mode_deny) instead of
+        # queueing — a limiter in the request path must bound its own
+        # tail latency or it becomes the outage.
+        self.max_concurrent = int(
+            max_concurrent if max_concurrent is not None
+            else config.overload_rls_max_concurrent())
+        self._gate = threading.BoundedSemaphore(self.max_concurrent)
+        self._stats_lock = threading.Lock()
+        self.shed_count = 0
+        self.served_count = 0
+
+    def overload_stats(self) -> dict:
+        return {"maxConcurrent": self.max_concurrent,
+                "shedCount": self.shed_count,
+                "servedCount": self.served_count}
 
     def should_rate_limit(
         self,
@@ -34,24 +57,36 @@ class SentinelEnvoyRlsService:
 
         Codes are the RLS proto's: 1 = OK, 2 = OVER_LIMIT. Descriptors with
         no matching rule pass (reference behavior: unknown descriptor = OK).
+        Over the concurrency gate the whole answer is 0 = UNKNOWN (shed):
+        no descriptor touched the token service, no token was granted.
         """
         from sentinel_tpu.envoy_rls import proto
 
-        hits = max(1, int(hits_addend))
-        statuses: List[Tuple[int, int]] = []
-        overall = proto.CODE_OK
-        requests = [(descriptor_flow_id(domain, list(entries)), hits, False)
-                    for entries in descriptors]
-        results = self.token_service.request_tokens(requests)
-        for result in results:
-            if result.status == TokenResultStatus.OK:
-                statuses.append((proto.CODE_OK, result.remaining))
-            elif result.status == TokenResultStatus.NO_RULE_EXISTS:
-                statuses.append((proto.CODE_OK, 0))
-            else:
-                statuses.append((proto.CODE_OVER_LIMIT, 0))
-                overall = proto.CODE_OVER_LIMIT
-        return overall, statuses
+        if not self._gate.acquire(blocking=False):
+            with self._stats_lock:
+                self.shed_count += 1
+            return proto.CODE_UNKNOWN, [
+                (proto.CODE_UNKNOWN, 0) for _ in descriptors]
+        try:
+            hits = max(1, int(hits_addend))
+            statuses: List[Tuple[int, int]] = []
+            overall = proto.CODE_OK
+            requests = [(descriptor_flow_id(domain, list(entries)), hits,
+                         False) for entries in descriptors]
+            results = self.token_service.request_tokens(requests)
+            for result in results:
+                if result.status == TokenResultStatus.OK:
+                    statuses.append((proto.CODE_OK, result.remaining))
+                elif result.status == TokenResultStatus.NO_RULE_EXISTS:
+                    statuses.append((proto.CODE_OK, 0))
+                else:
+                    statuses.append((proto.CODE_OVER_LIMIT, 0))
+                    overall = proto.CODE_OVER_LIMIT
+            with self._stats_lock:
+                self.served_count += 1
+            return overall, statuses
+        finally:
+            self._gate.release()
 
     # -- gRPC transport ----------------------------------------------------
 
@@ -82,11 +117,24 @@ class SentinelEnvoyRlsService:
 
         return self._grpc_body(request, proto.RateLimitResponseV3)
 
-    def serve_grpc(self, address: str = "0.0.0.0:10245", max_workers: int = 8):
+    def serve_grpc(self, address: str = "0.0.0.0:10245",
+                   max_workers: Optional[int] = None):
         """Start a gRPC server exposing RateLimitService under BOTH the
         v2 service name (the reference's surface) and the v3 one
-        (current Envoy's); returns it."""
+        (current Envoy's); returns it. The worker pool SIZES ABOVE the
+        shed gate (was a fixed 8): the gate must be the binding limit,
+        so the overflow workers exist precisely to run the immediate
+        CODE_UNKNOWN shed — a pool <= the gate would instead park excess
+        RPCs in the executor's unbounded internal queue with no
+        deadline, the exact collapse mode the gate closes."""
         import concurrent.futures
+
+        if max_workers is None:
+            # No independent cap: clamping the pool below the gate would
+            # silently reintroduce executor-queue collapse for large
+            # gate configs; the operator sizes thread count via the
+            # rls.max.concurrent key itself.
+            max_workers = self.max_concurrent + 8
 
         import grpc
 

@@ -154,6 +154,54 @@ def render_engine_metrics(engine) -> str:
               "Cumulative seconds spent in degraded-quota mode",
               ha.get("degradedSeconds", 0.0))
 
+    # -- frontend overload (bounded ingestion — ISSUE 6) ------------------
+    # Server-side families render -1 / nothing while this instance is
+    # not a token server, so one scrape config fits every role.
+    ov = res_stats.get("overload")
+    b.counter("sentinel_tpu_overload_client_shed",
+              "Entries whose cluster acquire came back OVERLOADED and "
+              "were served via the local lease/fallback path",
+              res_stats.get("clusterOverloadCount", 0))
+    b.counter("sentinel_tpu_overload_client_responses",
+              "OVERLOADED responses the failover token client observed "
+              "(each opens a per-target retry-after backoff window)",
+              ha.get("overloadedCount", 0))
+    b.family("sentinel_tpu_overload_targets_backed_off", "gauge",
+             "Token-server targets currently inside an overload-backoff "
+             "window")
+    b.sample("sentinel_tpu_overload_targets_backed_off", None,
+             ha.get("targetsBackedOff", 0))
+    b.family("sentinel_tpu_overload_queue_depth", "gauge",
+             "Token-server admission queue depth in groups (-1: not a "
+             "server)")
+    b.sample("sentinel_tpu_overload_queue_depth", None,
+             ov["queueDepth"] if ov else -1)
+    b.family("sentinel_tpu_overload_queue_limit", "gauge",
+             "Configured admission queue bound in groups (-1: not a "
+             "server)")
+    b.sample("sentinel_tpu_overload_queue_limit", None,
+             ov["queueLimitGroups"] if ov else -1)
+    b.family("sentinel_tpu_overload_queue_depth_max", "gauge",
+             "High-water mark of the admission queue since server start "
+             "(-1: not a server)")
+    b.sample("sentinel_tpu_overload_queue_depth_max", None,
+             ov["queueDepthMax"] if ov else -1)
+    b.family("sentinel_tpu_overload_shed", "counter",
+             "Request groups shed by the token-server frontend, by cause "
+             "(watermark / queue_full / deadline_expired)")
+    if ov:
+        for cause, key in (("watermark", "shedWatermark"),
+                           ("queue_full", "shedQueueFull"),
+                           ("deadline_expired", "shedDeadlineExpired")):
+            b.sample("sentinel_tpu_overload_shed_total", {"cause": cause},
+                     ov[key])
+    b.family("sentinel_tpu_overload_shed_requests", "counter",
+             "Individual requests inside shed groups (every one received "
+             "an explicit OVERLOADED reply)")
+    if ov:
+        b.sample("sentinel_tpu_overload_shed_requests_total", None,
+                 ov["shedRequests"])
+
     # -- staged rollout guardrail ----------------------------------------
     guard = res_stats.get("rollout") or {}
     b.family("sentinel_tpu_rollout_active", "gauge",

@@ -469,6 +469,14 @@ def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
         "clientAvailable": cs.client_if_active() is not None,
         "serverRunning": cs.token_server is not None,
         "ha": cs.ha_stats(),
+        # Frontend overload (ISSUE 6): the embedded token server's
+        # queue/shed snapshot (None while not a server) — the
+        # dashboard's Overload panel reads this per machine — plus the
+        # engine-side count of entries a shed degraded to the local
+        # lease/fallback path.
+        "overload": cs.overload_stats(),
+        "clusterOverloadCount": getattr(
+            req.engine, "cluster_overload_count", 0),
     })
 
 

@@ -157,6 +157,59 @@ def test_cluster_ha_config_keys_accessor_only_and_documented():
         + ", ".join(undocumented))
 
 
+def test_no_unbounded_queues_in_serving_paths():
+    """Serving-path code (the TLV token server, command plane, Envoy
+    RLS, dashboard) must never hold an unbounded ``queue.Queue()``: an
+    unbounded admission queue converts overload into unbounded latency
+    and memory — the queue-collapse failure mode ISSUE 6 closed. Every
+    queue on a request path needs an explicit ``maxsize`` (and a shed
+    story for when it fills)."""
+    import re
+
+    pattern = re.compile(r"queue\.Queue\(\s*\)")
+    offenders = []
+    for sub in ("cluster", "transport", "envoy_rls", "dashboard"):
+        for path in sorted((REPO / "sentinel_tpu" / sub).rglob("*.py")):
+            for lineno, code in _code_lines(path):
+                if pattern.search(code):
+                    offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "unbounded queue.Queue() in a serving path (pass maxsize= and "
+        "shed on full): " + ", ".join(offenders))
+
+
+def test_overload_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.overload.*`` config key must (a) be defined
+    and read ONLY in core/config.py — the rest of the package goes
+    through the ``SentinelConfig`` accessors — and (b) appear in
+    docs/OPERATIONS.md, so the overload runbook can never silently
+    drift from the knobs the code actually reads (same rule shape as
+    the cluster-HA gate above)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.overload\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.overload.* literals outside core/config.py "
+        "(use the SentinelConfig overload_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no overload config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "overload config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
 @pytest.mark.skipif(shutil.which("ruff") is None,
                     reason="ruff binary not in this image")
 def test_ruff_clean():
