@@ -90,6 +90,7 @@ class _Batcher:
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.max_queue_groups)
         self._stats_lock = threading.Lock()
         self.admitted_groups = 0
+        self.admitted_requests = 0
         self.shed_watermark = 0
         self.shed_queue_full = 0
         self.shed_deadline_expired = 0
@@ -130,10 +131,19 @@ class _Batcher:
             return done, box
         with self._stats_lock:
             self.admitted_groups += 1
+            self.admitted_requests += len(reqs)
             depth = self._queue.qsize()
             if depth > self.queue_depth_max:
                 self.queue_depth_max = depth
         return done, box
+
+    def shed_rate(self) -> float:
+        """Cumulative shed fraction: shed requests over everything that
+        reached admission. The SLO engine's health score consumes the
+        DELTA of the underlying counters between evaluations; this ratio
+        is the ops-glance form (ISSUE 7)."""
+        denom = self.shed_requests + self.admitted_requests
+        return self.shed_requests / float(denom) if denom else 0.0
 
     def overload_stats(self) -> dict:
         """Lock-free read (the /metrics scrape path): counters are plain
@@ -144,6 +154,8 @@ class _Batcher:
             "queueLimitGroups": self.max_queue_groups,
             "watermarkGroups": self.watermark_groups,
             "admittedGroups": self.admitted_groups,
+            "admittedRequests": self.admitted_requests,
+            "shedRate": self.shed_rate(),
             "shedWatermark": self.shed_watermark,
             "shedQueueFull": self.shed_queue_full,
             "shedDeadlineExpired": self.shed_deadline_expired,

@@ -91,6 +91,21 @@ OVERLOAD_CONN_MAX_BURST = "csp.sentinel.overload.conn.max.burst"
 OVERLOAD_IDLE_TIMEOUT_S = "csp.sentinel.overload.idle.timeout.s"
 OVERLOAD_RLS_MAX_CONCURRENT = "csp.sentinel.overload.rls.max.concurrent"
 OVERLOAD_CLIENT_BACKOFF_MS = "csp.sentinel.overload.client.backoff.ms"
+# SLO engine + alerting (sentinel_tpu/slo/ — no reference twin: the
+# reference surfaces raw stats and leaves judgement to external
+# monitoring). Every key here MUST be read through the accessors below
+# and documented in docs/OPERATIONS.md "SLOs & alerting" (pinned by
+# test_lint). csp.sentinel.slo.* tunes evaluation; csp.sentinel.alert.*
+# tunes the alert store + webhook fan-out.
+SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
+SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
+SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
+SLO_BASELINE_MIN_EVENTS = "csp.sentinel.slo.baseline.min.events"
+SLO_ROLLOUT_ABORT = "csp.sentinel.slo.rollout.abort"
+ALERT_HISTORY_CAPACITY = "csp.sentinel.alert.history.capacity"
+ALERT_WEBHOOK_URLS = "csp.sentinel.alert.webhook.urls"
+ALERT_WEBHOOK_TIMEOUT_MS = "csp.sentinel.alert.webhook.timeout.ms"
+ALERT_WEBHOOK_RETRIES = "csp.sentinel.alert.webhook.retries"
 
 DEFAULT_CHARSET = "utf-8"
 DEFAULT_SINGLE_METRIC_FILE_SIZE = 50 * 1024 * 1024
@@ -146,6 +161,18 @@ DEFAULT_OVERLOAD_CONN_MAX_BURST = 1024
 DEFAULT_OVERLOAD_IDLE_TIMEOUT_S = 300
 DEFAULT_OVERLOAD_RLS_MAX_CONCURRENT = 64
 DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS = 250
+# SLO defaults. alpha=0.2 ≈ a ~5-second effective memory on the EWMA
+# baseline mean (fast enough to track diurnal drift, slow enough that a
+# one-second spike cannot hide itself); z>=4 on a per-second signal
+# keeps the false-positive rate negligible; 30 warmup seconds of traffic
+# before a resource's baseline may vote.
+DEFAULT_SLO_BASELINE_ALPHA = 0.2
+DEFAULT_SLO_BASELINE_ZSCORE = 4.0
+DEFAULT_SLO_BASELINE_WARMUP_SECONDS = 30
+DEFAULT_SLO_BASELINE_MIN_EVENTS = 10
+DEFAULT_ALERT_HISTORY_CAPACITY = 256
+DEFAULT_ALERT_WEBHOOK_TIMEOUT_MS = 2_000
+DEFAULT_ALERT_WEBHOOK_RETRIES = 3
 
 
 def _env_key(key: str) -> str:
@@ -221,6 +248,13 @@ class SentinelConfig:
         v = self.get(key)
         try:
             return int(v) if v is not None else default
+        except ValueError:
+            return default
+
+    def get_float(self, key: str, default: float) -> float:
+        v = self.get(key)
+        try:
+            return float(v) if v is not None else default
         except ValueError:
             return default
 
@@ -325,6 +359,50 @@ class SentinelConfig:
         v = self.get_int(OVERLOAD_CLIENT_BACKOFF_MS,
                          DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS)
         return v if v > 0 else DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS
+
+    # SLO / alerting accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.slo.* and csp.sentinel.alert.* keys — test_lint
+    # forbids reading the literals anywhere else in the package).
+
+    def slo_baseline_alpha(self) -> float:
+        v = self.get_float(SLO_BASELINE_ALPHA, DEFAULT_SLO_BASELINE_ALPHA)
+        return v if 0.0 < v < 1.0 else DEFAULT_SLO_BASELINE_ALPHA
+
+    def slo_baseline_zscore(self) -> float:
+        v = self.get_float(SLO_BASELINE_ZSCORE, DEFAULT_SLO_BASELINE_ZSCORE)
+        return v if v > 0 else DEFAULT_SLO_BASELINE_ZSCORE
+
+    def slo_baseline_warmup_seconds(self) -> int:
+        v = self.get_int(SLO_BASELINE_WARMUP_SECONDS,
+                         DEFAULT_SLO_BASELINE_WARMUP_SECONDS)
+        return v if v >= 0 else DEFAULT_SLO_BASELINE_WARMUP_SECONDS
+
+    def slo_baseline_min_events(self) -> int:
+        v = self.get_int(SLO_BASELINE_MIN_EVENTS,
+                         DEFAULT_SLO_BASELINE_MIN_EVENTS)
+        return v if v >= 0 else DEFAULT_SLO_BASELINE_MIN_EVENTS
+
+    def slo_rollout_abort(self) -> bool:
+        return (self.get(SLO_ROLLOUT_ABORT) or "true").lower() != "false"
+
+    def alert_history_capacity(self) -> int:
+        v = self.get_int(ALERT_HISTORY_CAPACITY,
+                         DEFAULT_ALERT_HISTORY_CAPACITY)
+        return v if v > 0 else DEFAULT_ALERT_HISTORY_CAPACITY
+
+    def alert_webhook_urls(self) -> list:
+        raw = self.get(ALERT_WEBHOOK_URLS) or ""
+        return [u.strip() for u in raw.split(",") if u.strip()]
+
+    def alert_webhook_timeout_ms(self) -> int:
+        v = self.get_int(ALERT_WEBHOOK_TIMEOUT_MS,
+                         DEFAULT_ALERT_WEBHOOK_TIMEOUT_MS)
+        return v if v > 0 else DEFAULT_ALERT_WEBHOOK_TIMEOUT_MS
+
+    def alert_webhook_retries(self) -> int:
+        v = self.get_int(ALERT_WEBHOOK_RETRIES,
+                         DEFAULT_ALERT_WEBHOOK_RETRIES)
+        return v if v >= 0 else DEFAULT_ALERT_WEBHOOK_RETRIES
 
     def log_dir(self) -> str:
         d = self.get(LOG_DIR)

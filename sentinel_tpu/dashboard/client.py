@@ -134,6 +134,19 @@ class SentinelApiClient:
             params["limit"] = limit
         return json.loads(self.get(ip, port, "timeseries", params))
 
+    def fetch_alerts(self, ip: str, port: int,
+                     since_seq: Optional[int] = None,
+                     limit: Optional[int] = None) -> Dict:
+        """SLO/anomaly alerts (``alerts`` command): active set + the
+        seq-numbered transition log after ``since_seq`` (the SSE pump's
+        cursor)."""
+        params: Dict = {}
+        if since_seq is not None:
+            params["sinceSeq"] = since_seq
+        if limit is not None:
+            params["limit"] = limit
+        return json.loads(self.get(ip, port, "alerts", params))
+
     def fetch_explain(self, ip: str, port: int,
                       resource: Optional[str] = None,
                       index: int = 0) -> Dict:

@@ -31,9 +31,13 @@ set as a small JSON API plus one static page:
   * ``GET  /telemetry/traces.json?app=``      sampled decision traces
     (both proxy the machines' ``telemetry`` / ``traces`` commands)
   * ``GET  /telemetry/stream?app=``           Server-Sent Events: one
-    ``event: second`` per new complete flight-recorder second (proxies
-    the machines' ``timeseries`` command on a ~1s cadence; fetch
-    failures surface as ``event: error`` frames, the stream stays up)
+    ``event: second`` per new complete flight-recorder second plus one
+    ``event: alert`` per SLO/anomaly alert transition (proxies the
+    machines' ``timeseries`` + ``alerts`` commands on a ~1s cadence;
+    fetch failures surface as ``event: error`` frames, the stream stays
+    up; ``Last-Event-ID`` resumes both cursors after a reconnect)
+  * ``GET  /alerts.json?app=``                SLO/anomaly alerts: active
+    set + transition log (proxies the machines' ``alerts`` command)
   * ``POST /rollout/command?app=&op=``        stage/canary/promote/abort/tick
     (no reference twin — proxies the engines' ``rollout`` command)
   * ``POST /cluster/assign?app=&ip=&port=``   token-server assignment
@@ -477,6 +481,12 @@ class _Handler(BaseHTTPRequestHandler):
                                   OPENMETRICS_CONTENT_TYPE)
             if path == "/telemetry/stream":
                 return self._sse_stream(d, q)
+            if path == "/alerts.json":
+                m = d._first_healthy(q.get("app", ""))
+                since = q.get("sinceSeq")
+                return self._ok(d.api.fetch_alerts(
+                    m.ip, m.port,
+                    since_seq=int(since) if since else None))
             if path in ("/telemetry/summary.json", "/telemetry/traces.json"):
                 kind = "traces" if path.endswith("traces.json") else "summary"
                 limit = q.get("limit")
@@ -511,16 +521,36 @@ class _Handler(BaseHTTPRequestHandler):
         """``/telemetry/stream``: Server-Sent Events pushing each new
         complete flight-recorder second of the app's first healthy
         machine (``event: second``, data = the `timeseries` command's
-        per-second JSON). A failed upstream fetch emits ``event: error``
-        with a structured body and the stream keeps polling — a machine
-        restart mid-stream degrades to error frames, not a dropped
-        connection. ``maxEvents=`` closes the stream after N second
-        events (bounded consumption for tests/tools)."""
+        per-second JSON) plus each SLO/anomaly alert transition
+        (``event: alert``, data = one `alerts` command event). A failed
+        upstream fetch emits ``event: error`` with a structured body and
+        the stream keeps polling — a machine restart mid-stream degrades
+        to error frames, not a dropped connection. ``maxEvents=`` closes
+        the stream after N data frames (second + alert — bounded
+        consumption for tests/tools).
+
+        Resume: every data frame carries ``id: <secondStamp>:<alertSeq>``
+        (both cursors, whatever the frame type). A reconnecting
+        EventSource replays its ``Last-Event-ID`` header here, and the
+        stream resumes from BOTH cursors — the missed complete seconds
+        replay from the machine's bounded host history and the missed
+        alert transitions from its bounded event log, instead of being
+        silently lost across a reconnect."""
         app = q.get("app", "")
         try:
             max_events = int(q.get("maxEvents", "0") or 0)
         except ValueError:
             return self._fail("bad request: maxEvents")
+        cursor = None   # newest streamed second stamp (ms)
+        alert_seq = 0   # newest streamed alert transition seq
+        last_id = (self.headers.get("Last-Event-ID") or "").strip()
+        if last_id:
+            sec_part, _, seq_part = last_id.partition(":")
+            try:
+                cursor = int(sec_part) if int(sec_part) > 0 else None
+                alert_seq = max(0, int(seq_part or "0"))
+            except ValueError:
+                cursor, alert_seq = None, 0  # foreign id: fresh stream
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream; charset=utf-8")
         self.send_header("Cache-Control", "no-cache")
@@ -529,13 +559,12 @@ class _Handler(BaseHTTPRequestHandler):
 
         def emit(event: str, payload) -> None:
             self.wfile.write(
-                f"event: {event}\ndata: {json.dumps(payload)}\n\n"
-                .encode("utf-8"))
+                f"id: {cursor or 0}:{alert_seq}\nevent: {event}\n"
+                f"data: {json.dumps(payload)}\n\n".encode("utf-8"))
             self.wfile.flush()
 
         with d._sse_lock:
             d.sse_clients += 1
-        cursor = None
         sent = 0
         try:
             # stop() nulls _server; without this check a connected
@@ -547,15 +576,24 @@ class _Handler(BaseHTTPRequestHandler):
                     m = d._first_healthy(app)
                     # First poll: only the newest 60 (a fresh consumer
                     # wants recent context, not the whole history).
-                    # Cursor polls: EVERYTHING after the cursor — a
-                    # capped catch-up would silently skip the seconds
-                    # beyond the cap while the cursor jumped past them.
+                    # Cursor polls (including a Last-Event-ID resume):
+                    # EVERYTHING after the cursor — a capped catch-up
+                    # would silently skip the seconds beyond the cap
+                    # while the cursor jumped past them.
                     out = d.api.fetch_timeseries(
                         m.ip, m.port, since_ms=cursor,
                         limit=60 if cursor is None else 1_000_000)
                     for sec in out.get("seconds", []):
                         cursor = max(cursor or 0, int(sec["timestamp"]))
                         emit("second", sec)
+                        sent += 1
+                        if max_events and sent >= max_events:
+                            return
+                    alerts = d.api.fetch_alerts(m.ip, m.port,
+                                                since_seq=alert_seq)
+                    for ev in alerts.get("events", []):
+                        alert_seq = max(alert_seq, int(ev["seq"]))
+                        emit("alert", ev)
                         sent += 1
                         if max_events and sent >= max_events:
                             return

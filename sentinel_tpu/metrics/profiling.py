@@ -43,6 +43,16 @@ def _pct(values, q: float) -> float:
     return float(vals[idx])
 
 
+# Cumulative step-duration histogram geometry (log2 edges, ms, + +Inf
+# overflow): sub-ms resolution at the bottom because a routed CPU/TPU
+# step is tens of µs to tens of ms; the top edge clears any cold-compile
+# outlier a sampled dispatch can observe.
+STEP_DURATION_EDGES_MS = (
+    0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+    1024.0, 2048.0, 4096.0)
+NUM_STEP_DURATION_BUCKETS = len(STEP_DURATION_EDGES_MS) + 1
+
+
 class StepTimer:
     """Lock-guarded rolling timing stats for device step dispatches."""
 
@@ -54,6 +64,16 @@ class StepTimer:
         self._entries: Dict[str, int] = {}
         self._enqueue: Dict[str, list] = {}
         self._sync: Dict[str, list] = {}
+        # CUMULATIVE per-kind histogram of the sampled synchronous step
+        # walls. The rolling rings above answer "what did recent steps
+        # look like" (post-hoc, cleared on reset); these counters are
+        # monotone for the engine's lifetime so scrapers — and step-
+        # latency SLO burn rates over them — can rate() the series
+        # (`sentinel_tpu_step_duration_*`). Deliberately NOT cleared by
+        # reset(): a profile-command reset must never make a counter
+        # family go backwards mid-scrape.
+        self._duration_hist: Dict[str, list] = {}
+        self._duration_sum_ms: Dict[str, float] = {}
 
     def record(self, kind: str, batch_n: int, enqueue_ms: float,
                sync_ms: Optional[float] = None) -> None:
@@ -67,6 +87,30 @@ class StepTimer:
                 sbuf = self._sync.setdefault(kind, [])
                 sbuf.append(sync_ms)
                 del sbuf[:-self._ring]
+                hist = self._duration_hist.setdefault(
+                    kind, [0] * NUM_STEP_DURATION_BUCKETS)
+                b = 0
+                while b < len(STEP_DURATION_EDGES_MS) \
+                        and sync_ms > STEP_DURATION_EDGES_MS[b]:
+                    b += 1
+                hist[b] += 1
+                self._duration_sum_ms[kind] = \
+                    self._duration_sum_ms.get(kind, 0.0) + sync_ms
+
+    def duration_histogram(self) -> Dict[str, Dict]:
+        """Cumulative sampled-step-wall histogram per kind:
+        ``{kind: {"buckets": [per-bucket counts], "sumMs": float,
+        "count": int}}`` indexed like :data:`STEP_DURATION_EDGES_MS`
+        plus the +Inf overflow."""
+        with self._lock:
+            return {
+                kind: {
+                    "buckets": list(hist),
+                    "sumMs": self._duration_sum_ms.get(kind, 0.0),
+                    "count": sum(hist),
+                }
+                for kind, hist in self._duration_hist.items()
+            }
 
     def should_sync(self, kind: str) -> bool:
         """True on the sampled dispatches that should block and measure."""

@@ -396,6 +396,34 @@ class RolloutManager:
         cand = self.active_set()
         if cand is None or cand.stage not in ACTIVE_STAGES:
             return {"active": None}
+        # SLO breach gate (sentinel_tpu/slo/): an active PAGE-severity
+        # burn alert on a resource the candidate touches aborts
+        # IMMEDIATELY — no streak. The block-rate-delta guardrail below
+        # compares candidate vs live on the same traffic; this one
+        # catches the live world burning its error budget WHILE a canary
+        # is enforcing (whatever the cause, a rollout must not ride
+        # through a page). Opt out via csp.sentinel.slo.rollout.abort.
+        slo = getattr(self.engine, "slo", None)
+        if slo is not None and slo.rollout_abort_enabled:
+            # Judgement only advances on reads (the spill ride) — a tick
+            # driven from a cron with no scraper attached must refresh
+            # itself, or a live page never transitions to active (and a
+            # long-resolved one never transitions out).
+            self.engine.slo_refresh(now_ms=now)
+            touched = {r.resource for fam, rules in cand.rules.items()
+                       if fam != "system" for r in rules}
+            breaches = slo.abort_signal(touched or None)
+            if breaches:
+                worst = breaches[0]
+                reason = (f"slo: {worst['objective']} burning at "
+                          f"{worst['burnLong']}x over "
+                          f"{worst['windowLongS']}s")
+                if len(breaches) > 1:
+                    reason += f" (+{len(breaches) - 1} more)"
+                self.abort(cand.name, reason=reason)
+                return {"active": cand.name, "stage": cand.stage,
+                        "status": "aborted", "timestamp": now,
+                        "sloBreaches": breaches}
         counts = self.engine.shadow_counts()
         if counts is None:
             return {"active": cand.name, "status": "no-device-state"}

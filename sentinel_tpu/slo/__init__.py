@@ -1,0 +1,36 @@
+"""SLO engine: burn-rate alerting, anomaly baselines, health scoring.
+
+The flight recorder (PRs 3-4) gave the system exact per-second senses;
+this package gives it judgement. Declarative per-resource objectives
+(:mod:`objectives`) are evaluated every COMPLETE second from the exact
+``telemetry/timeseries.py`` series with SRE-style multi-window burn-rate
+rules; resources with no explicit objective get a rolling EWMA baseline
+(:mod:`baseline`) with z-score breach detection; both roll up into a
+composite health score per resource and per instance (:mod:`manager`).
+Alert transitions fan out to webhooks (:mod:`webhook`), the ``alerts``
+ops command, ``sentinel_tpu_slo_*``/``sentinel_tpu_alert_*`` gauges,
+the dashboard's ``/alerts.json`` + SSE ``event: alert`` frames, and the
+rollout guardrail's auto-abort signal.
+
+Everything here is host-side arithmetic over seconds the device already
+folded once per second — SLO evaluation adds ZERO per-step device work
+(pinned by the A/B guard in tests/test_slo.py).
+"""
+
+from sentinel_tpu.slo.baseline import EwmaBaseline
+from sentinel_tpu.slo.manager import SloManager
+from sentinel_tpu.slo.objectives import (
+    BurnWindow,
+    DEFAULT_BURN_WINDOWS,
+    SloObjective,
+)
+from sentinel_tpu.slo.webhook import AlertWebhook
+
+__all__ = [
+    "AlertWebhook",
+    "BurnWindow",
+    "DEFAULT_BURN_WINDOWS",
+    "EwmaBaseline",
+    "SloManager",
+    "SloObjective",
+]

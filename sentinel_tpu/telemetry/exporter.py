@@ -251,6 +251,22 @@ def render_engine_metrics(engine) -> str:
                 b.sample("sentinel_tpu_enqueue_ms",
                          {"kind": kind, "quantile": f"0.{q}"}, v)
 
+    # -- step duration (continuous, SLO-targetable) ------------------------
+    # Cumulative histogram of the sampled synchronous step walls: unlike
+    # the rolling sentinel_tpu_step_ms quantile gauges above (post-hoc,
+    # cleared on profile reset), these counters are monotone for the
+    # engine's lifetime, so a scraper can rate() them and a step-latency
+    # SLO can burn against them.
+    from sentinel_tpu.metrics.profiling import STEP_DURATION_EDGES_MS
+
+    b.family("sentinel_tpu_step_duration_ms", "histogram",
+             "Sampled synchronous device step wall time (ms, log2 "
+             "buckets, cumulative since engine start)")
+    for kind, row in sorted(engine.step_timer.duration_histogram().items()):
+        b.histogram("sentinel_tpu_step_duration_ms", {"kind": kind},
+                    [float(e) for e in STEP_DURATION_EDGES_MS],
+                    [float(x) for x in row["buckets"]], row["sumMs"])
+
     # -- flight recorder (per-second series) ------------------------------
     # The LAST complete second per resource as gauges: scrapers that
     # cannot ingest the `timeseries` command still get a per-second
@@ -281,6 +297,78 @@ def render_engine_metrics(engine) -> str:
              "Complete seconds retained in the host-side history")
     b.sample("sentinel_tpu_timeseries_retained_seconds", None,
              ts["retainedSeconds"])
+
+    # -- SLO engine + alerting (sentinel_tpu/slo/) ------------------------
+    # The timeseries_view read above already refreshed judgement (spill
+    # feeds the SLO manager and re-evaluates burn rules), so these render
+    # current through the newest complete second.
+    slo = engine.slo
+    slo_status = slo.status()
+    health = slo_status["health"]
+    b.family("sentinel_tpu_slo_objectives", "gauge",
+             "Configured SLO objectives")
+    b.sample("sentinel_tpu_slo_objectives", None,
+             len(slo_status["objectives"]))
+    b.family("sentinel_tpu_slo_burn_rate", "gauge",
+             "Multi-window burn rate per (objective, window side): "
+             "error rate over the window divided by the error budget; "
+             ">= the rule's threshold on BOTH sides fires the alert")
+    for key, snap in sorted(slo_status["burn"].items()):
+        for rule in snap["rules"]:
+            labels = {"objective": key, "resource": snap["resource"],
+                      "sli": snap["sli"], "severity": rule["severity"]}
+            b.sample("sentinel_tpu_slo_burn_rate",
+                     {**labels, "window": f"{rule['longSeconds']}s"},
+                     round(rule["burnLong"], 6))
+            b.sample("sentinel_tpu_slo_burn_rate",
+                     {**labels, "window": f"{rule['shortSeconds']}s"},
+                     round(rule["burnShort"], 6))
+    b.family("sentinel_tpu_slo_baseline_zscore", "gauge",
+             "Latest z-score of each objective-less resource's signal "
+             "against its own EWMA baseline")
+    for res, signals in sorted(slo_status["baselines"].items()):
+        for sig, snap in sorted(signals.items()):
+            if snap["warmedUp"]:
+                b.sample("sentinel_tpu_slo_baseline_zscore",
+                         {"resource": res, "signal": sig}, snap["lastZ"])
+    b.family("sentinel_tpu_slo_health_score", "gauge",
+             "Composite health per resource (100 = healthy; page -40, "
+             "ticket -20, anomaly -15 per active alert)")
+    for res, score in sorted(health["resources"].items()):
+        b.sample("sentinel_tpu_slo_health_score", {"resource": res}, score)
+    b.family("sentinel_tpu_slo_instance_health", "gauge",
+             "Composite instance health: worst resource score minus the "
+             "overload shed-rate penalty")
+    b.sample("sentinel_tpu_slo_instance_health", None, health["instance"])
+    b.family("sentinel_tpu_slo_shed_rate", "gauge",
+             "Token-server admission shed fraction since the previous "
+             "evaluation (health-score input; 0 while not a server)")
+    b.sample("sentinel_tpu_slo_shed_rate", None, health["shedRate"])
+    alerts = slo.alerts_snapshot(limit=0)
+    by_sev: Dict[str, int] = {}
+    for a in alerts["active"]:
+        by_sev[a["severity"]] = by_sev.get(a["severity"], 0) + 1
+    b.family("sentinel_tpu_alert_active", "gauge",
+             "Currently firing alerts per severity")
+    for sev in ("page", "ticket", "anomaly"):
+        b.sample("sentinel_tpu_alert_active", {"severity": sev},
+                 by_sev.get(sev, 0))
+    b.counter("sentinel_tpu_alert_fired",
+              "Alert fire transitions since engine start",
+              alerts["counters"]["fired"])
+    b.counter("sentinel_tpu_alert_resolved",
+              "Alert resolve transitions since engine start",
+              alerts["counters"]["resolved"])
+    wh = alerts["webhook"]
+    b.counter("sentinel_tpu_alert_webhook_delivered",
+              "Alert events delivered to a webhook endpoint (2xx)",
+              wh["delivered"])
+    b.counter("sentinel_tpu_alert_webhook_failed",
+              "Alert events that exhausted their webhook retry budget",
+              wh["failed"])
+    b.counter("sentinel_tpu_alert_webhook_dropped",
+              "Alert events dropped from the full webhook queue",
+              wh["dropped"])
 
     # -- span sampling health --------------------------------------------
     ssnap = engine.spans.snapshot(limit=0)

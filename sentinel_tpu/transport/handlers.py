@@ -383,6 +383,60 @@ def cmd_explain(req: CommandRequest) -> CommandResponse:
     return CommandResponse.of_success(out)
 
 
+@command_mapping("alerts", "active SLO/anomaly alerts + transition log")
+def cmd_alerts(req: CommandRequest) -> CommandResponse:
+    """The SLO engine's alert store (sentinel_tpu/slo/): active alerts
+    plus the seq-numbered fired/resolved transition log. ``sinceSeq=``
+    returns only transitions strictly after the cursor (the dashboard
+    SSE pump's resume point); ``resource=`` filters both lists;
+    ``limit=`` caps the returned transitions (newest kept). Reading
+    refreshes judgement first (fold + spill + evaluate), so the answer
+    is current through the newest complete second."""
+    try:
+        since = int(req.get_param("sinceSeq", "0"))
+        limit = req.get_param("limit")
+        limit_n = int(limit) if limit is not None else None
+    except ValueError:
+        return CommandResponse.of_failure("invalid parameter: sinceSeq/limit")
+    req.engine.slo_refresh()
+    return CommandResponse.of_success(req.engine.slo.alerts_snapshot(
+        since_seq=since, resource=req.get_param("resource"), limit=limit_n))
+
+
+@command_mapping("slo", "SLO objectives, burn rates, baselines, health")
+def cmd_slo(req: CommandRequest) -> CommandResponse:
+    """SLO control + status plane (sentinel_tpu/slo/ — no reference
+    twin). ``op`` selects the action:
+
+      * ``status`` (default) — objectives + per-rule burn snapshot +
+        anomaly baselines + health scores (refreshes first)
+      * ``get``  — the configured objectives as JSON (round-trips
+        through the ``sloRules`` converter schema)
+      * ``set``  — load objectives wholesale: JSON array in
+        ``data=``/body (the same wholesale semantics every rule family
+        uses; a datasource-bound deployment hot-reloads through the
+        ``sloRules`` converter instead)
+    """
+    slo = req.engine.slo
+    op = req.get_param("op", "status")
+    try:
+        if op == "status":
+            req.engine.slo_refresh()
+            return CommandResponse.of_success(slo.status())
+        if op == "get":
+            return CommandResponse.of_success(
+                [CV.slo_objective_to_dict(o) for o in slo.objectives()])
+        if op == "set":
+            data = req.get_param("data") or req.body
+            objectives = CV.slo_objectives_from_json(data or "[]")
+            slo.load_objectives(objectives)
+            return CommandResponse.of_success(
+                {"loaded": len(objectives)})
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("metrics", "Prometheus/OpenMetrics exposition")
 def cmd_metrics(req: CommandRequest) -> CommandResponse:
     """``GET /metrics``: the whole engine — attribution counters, RT

@@ -123,6 +123,16 @@ def test_exported_metric_names_registered_exactly_once():
     # exposition renders (catches emission helpers bypassing family())
     assert "sentinel_tpu_pass" in seen
     assert "sentinel_tpu_second_pass" in seen
+    # the SLO engine's families (ISSUE 7): every sentinel_tpu_slo_* /
+    # sentinel_tpu_alert_* family the exposition renders is declared
+    # exactly once (the dupe gate above), and the load-bearing ones exist
+    for name in ("sentinel_tpu_slo_burn_rate",
+                 "sentinel_tpu_slo_health_score",
+                 "sentinel_tpu_slo_instance_health",
+                 "sentinel_tpu_alert_active",
+                 "sentinel_tpu_alert_fired",
+                 "sentinel_tpu_step_duration_ms"):
+        assert name in seen, f"{name} not declared in the exporters"
 
 
 def test_cluster_ha_config_keys_accessor_only_and_documented():
@@ -207,6 +217,39 @@ def test_overload_config_keys_accessor_only_and_documented():
     undocumented = sorted(k for k in keys if k not in ops)
     assert not undocumented, (
         "overload config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_slo_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.slo.*`` / ``csp.sentinel.alert.*`` config
+    key must (a) be defined and read ONLY in core/config.py — the rest
+    of the package goes through the ``SentinelConfig`` accessors — and
+    (b) appear in docs/OPERATIONS.md "SLOs & alerting", so the runbook
+    can never silently drift from the knobs the code actually reads
+    (same rule shape as the cluster-HA and overload gates above)."""
+    import re
+
+    pattern = re.compile(
+        r"[\"']csp\.sentinel\.(?:slo|alert)\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.slo.* / csp.sentinel.alert.* literals outside "
+        "core/config.py (use the SentinelConfig slo_* / alert_* "
+        "accessors): " + ", ".join(offenders))
+    assert keys, "no SLO/alert config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "SLO/alert config keys missing from docs/OPERATIONS.md: "
         + ", ".join(undocumented))
 
 

@@ -1,0 +1,720 @@
+"""SLO engine (sentinel_tpu/slo/): burn-rate + EWMA/z-score math pinned
+bit-exactly against a numpy oracle over randomized series, end-to-end
+alert propagation (recorder second -> breach -> `alerts` command +
+webhook + SSE frame), SSE Last-Event-ID resume, the rollout SLO-abort
+gate, health scoring, the continuous step-duration histogram, and the
+zero-per-step-device-work A/B guard.
+
+The load-bearing property is DIFFERENTIAL (the timeseries-oracle
+stance): every burn rate, EWMA mean/variance, z-score, and firing
+decision the manager produces must EXACTLY equal a brute-force numpy
+reimplementation run over the same series.
+"""
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import converters as CV
+from sentinel_tpu.slo.manager import SloManager
+from sentinel_tpu.slo.objectives import BurnWindow, SloObjective
+from sentinel_tpu.slo.webhook import AlertWebhook
+from sentinel_tpu.telemetry.attribution import (
+    NUM_RT_BUCKETS,
+    RT_BUCKET_EDGES_MS,
+)
+from sentinel_tpu.utils import time_util
+
+BASE_MS = 1_700_000_000_000
+_EDGES = np.asarray(RT_BUCKET_EDGES_MS, np.int64)
+
+
+def _http(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# numpy oracle: the brute-force reimplementation of every formula
+# ---------------------------------------------------------------------------
+
+def _oracle_bad_total(obj, cell):
+    if obj.sli == "availability":
+        bad = int(cell.get("block", 0))
+        return bad, bad + int(cell.get("pass", 0))
+    buckets = np.asarray(cell.get("rtBuckets") or [0] * NUM_RT_BUCKETS,
+                         np.int64)
+    total = int(buckets.sum())
+    edge = int(_EDGES[np.searchsorted(_EDGES, obj.latency_ms)]) \
+        if obj.latency_ms <= int(_EDGES[-1]) else int(_EDGES[-1])
+    good = int(buckets[: int(np.sum(_EDGES <= edge))].sum())
+    return total - good, total
+
+
+def _oracle_burn(series, end_ms, window_s, budget):
+    """series: np.int64[N, 3] of (stamp, bad, total)."""
+    if series.size == 0:
+        return 0.0, 0, 0
+    m = (series[:, 0] >= end_ms - window_s * 1000) & (series[:, 0] < end_ms)
+    bad = int(series[m, 1].sum())
+    total = int(series[m, 2].sum())
+    burn = (bad / float(total)) / budget if total > 0 else 0.0
+    return burn, bad, total
+
+
+def _oracle_quantile(buckets, q):
+    """numpy reimplementation of attribution.histogram_quantile (same
+    float64 operation order, so results are bit-identical)."""
+    total = float(sum(int(b) for b in buckets))
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for b in range(len(buckets)):
+        prev = cum
+        cum += float(int(buckets[b]))
+        if cum >= target and buckets[b] > 0:
+            if b >= len(RT_BUCKET_EDGES_MS):
+                return float(RT_BUCKET_EDGES_MS[-1])
+            lo = 0.0 if b == 0 else float(RT_BUCKET_EDGES_MS[b - 1])
+            hi = float(RT_BUCKET_EDGES_MS[b])
+            return lo + (hi - lo) * (target - prev) / float(int(buckets[b]))
+    return float(RT_BUCKET_EDGES_MS[-1])
+
+
+class _OracleEwma:
+    """The West-recursion EWMA, reimplemented on numpy float64."""
+
+    def __init__(self, alpha, zthr, warmup):
+        self.alpha = np.float64(alpha)
+        self.zthr = np.float64(zthr)
+        self.warmup = warmup
+        self.mean = np.float64(0.0)
+        self.var = np.float64(0.0)
+        self.n = 0
+        self.z = np.float64(0.0)
+
+    def update(self, x):
+        x = np.float64(x)
+        if self.n >= self.warmup and self.var > 0.0:
+            self.z = (x - self.mean) / np.sqrt(self.var)
+        else:
+            self.z = np.float64(0.0)
+        breached = bool(self.z >= self.zthr)
+        diff = x - self.mean
+        incr = self.alpha * diff
+        self.mean = self.mean + incr
+        self.var = (np.float64(1.0) - self.alpha) * (self.var + diff * incr)
+        self.n += 1
+        return breached
+
+
+def _rand_buckets(rng, n):
+    buckets = np.zeros(NUM_RT_BUCKETS, np.int64)
+    for _ in range(n):
+        rt = int(rng.integers(1, 5000))
+        buckets[int(np.sum(rt > _EDGES))] += 1
+    return buckets
+
+
+@pytest.mark.parametrize("seed", [3, 11, 42])
+def test_burn_and_ewma_match_numpy_oracle(seed):
+    """Every evaluated second of a randomized gappy series: burn rates,
+    firing decisions, active-alert sets, EWMA mean/var/z, and anomaly
+    state all EXACTLY equal the oracle (availability + latency SLIs,
+    calm/storm phases, a deterministic anomaly spike)."""
+    rng = np.random.default_rng(seed)
+    slo = SloManager()
+    avail = SloObjective(resource="api", objective=0.95, min_events=5,
+                         windows=(BurnWindow(30, 5, 3.0, "page"),
+                                  BurnWindow(120, 30, 1.5, "ticket")))
+    lat = SloObjective(resource="api", sli="latency", objective=0.9,
+                       latency_ms=8, min_events=5, name="api-rt",
+                       windows=(BurnWindow(20, 4, 2.0, "page"),))
+    slo.load_objectives([avail, lat])
+    objs = {"api:availability": avail, "api-rt": lat}
+    series = {k: [] for k in objs}           # oracle (stamp, bad, total)
+    ewma = {}                                 # oracle baselines for "free"
+    anomaly_active = {}                       # oracle anomaly alert state
+    fired_burn = fired_anomaly = 0
+
+    stamp = BASE_MS
+    for k in range(400):
+        stamp += 1000 * int(rng.integers(1, 3))  # idle gaps are implicit
+        storm = 150 <= k < 200
+        cells = {}
+        total = int(rng.integers(0, 30))
+        if total:
+            block = int(rng.binomial(total, 0.4 if storm else 0.02))
+            cells["api"] = {
+                "pass": total - block, "block": block,
+                "rtBuckets": _rand_buckets(
+                    rng, int(rng.integers(0, 20))).tolist(),
+            }
+        ftotal = 30 if k == 350 else int(rng.integers(5, 40))
+        fblock = ftotal if k == 350 else int(rng.binomial(ftotal, 0.05))
+        cells["free"] = {
+            "pass": ftotal - fblock, "block": fblock,
+            "rtBuckets": _rand_buckets(
+                rng, int(rng.integers(1, 15))).tolist(),
+        }
+        slo.ingest(stamp, cells)
+        end = stamp + 1000
+        slo.evaluate(end)
+
+        # -- oracle bookkeeping --------------------------------------------
+        for key, obj in objs.items():
+            cell = cells.get(obj.resource)
+            if cell:
+                bad, tot = _oracle_bad_total(obj, cell)
+                if tot > 0 or bad > 0:
+                    series[key].append((stamp, bad, tot))
+        for sig, x, events in (
+            ("blockRate",
+             np.float64(fblock) / np.float64(ftotal), ftotal),
+            ("rtP99Ms",
+             _oracle_quantile(cells["free"]["rtBuckets"], 0.99),
+             int(sum(cells["free"]["rtBuckets"]))),
+        ):
+            if events <= 0:
+                continue
+            bl = ewma.setdefault(sig, _OracleEwma(
+                slo.baseline_alpha, slo.baseline_zscore,
+                slo.baseline_warmup))
+            breach = bl.update(x) and events >= slo.baseline_min_events
+            was = anomaly_active.get(sig, False)
+            anomaly_active[sig] = breach
+            if breach and not was:
+                fired_anomaly += 1
+
+        # -- differential assertions ---------------------------------------
+        status = slo.status()
+        oracle_firing = set()
+        for key, obj in objs.items():
+            arr = (np.asarray(series[key], np.int64)
+                   if series[key] else np.zeros((0, 3), np.int64))
+            got_rules = status["burn"][key]["rules"]
+            for i, w in enumerate(obj.windows):
+                burn_l, _bad, tot_l = _oracle_burn(
+                    arr, end, w.long_s, obj.budget)
+                burn_s, _, _ = _oracle_burn(arr, end, w.short_s, obj.budget)
+                firing = (tot_l >= obj.min_events
+                          and burn_l >= w.burn and burn_s >= w.burn)
+                got = got_rules[i]
+                assert got["burnLong"] == burn_l, (k, key, i)
+                assert got["burnShort"] == burn_s, (k, key, i)
+                assert got["totalLong"] == tot_l, (k, key, i)
+                assert got["firing"] == firing, (k, key, i)
+                if firing:
+                    oracle_firing.add((key, w.long_s, w.short_s))
+                    fired_burn += 1
+        got_active = {(a["objective"], a["windowLongS"], a["windowShortS"])
+                      for a in slo.alerts_snapshot()["active"]
+                      if a["kind"] == "burn_rate"}
+        assert got_active == oracle_firing, k
+        got_anomaly = {a["signal"]
+                       for a in slo.alerts_snapshot()["active"]
+                       if a["kind"] == "anomaly"}
+        assert got_anomaly == {s for s, on in anomaly_active.items()
+                               if on}, k
+        for sig, bl in ewma.items():
+            got_bl = slo._baselines["free"][sig]
+            assert got_bl.mean == float(bl.mean), (k, sig)
+            assert got_bl.var == float(bl.var), (k, sig)
+            assert got_bl.last_z == float(bl.z), (k, sig)
+
+    # the run must actually exercise both alert machineries
+    assert fired_burn > 0, "storm phase never fired a burn alert"
+    assert fired_anomaly > 0, "spike second never fired an anomaly"
+
+
+def test_engine_burn_matches_recorder_oracle(engine):
+    """Through the REAL pipeline: a randomized device stream's recorded
+    seconds (the flight recorder spill) drive the same burn numbers the
+    oracle computes from the served `timeseries` view."""
+    from tests.test_timeseries import _run_randomized_stream
+
+    obj = SloObjective(resource="tsA", objective=0.9, min_events=1,
+                       windows=(BurnWindow(10, 3, 1.0, "page"),))
+    engine.slo.load_objectives([obj])
+    oracle, end_now = _run_randomized_stream(engine, seed=23)
+    final_now = end_now + 2500
+    view = engine.timeseries_view(now_ms=final_now)  # spills + evaluates
+    end = final_now - final_now % 1000
+    arr = np.asarray(
+        [(s["timestamp"],
+          s["resources"]["tsA"]["block"],
+          s["resources"]["tsA"]["pass"] + s["resources"]["tsA"]["block"])
+         for s in view["seconds"] if "tsA" in s["resources"]], np.int64)
+    burn_l, _, tot_l = _oracle_burn(arr, end, 10, obj.budget)
+    burn_s, _, _ = _oracle_burn(arr, end, 3, obj.budget)
+    got = engine.slo.status()["burn"]["tsA:availability"]["rules"][0]
+    assert got["burnLong"] == burn_l
+    assert got["burnShort"] == burn_s
+    assert got["totalLong"] == tot_l
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: breach -> alerts command + /metrics + webhook + SSE frame
+# ---------------------------------------------------------------------------
+
+class _Hook(BaseHTTPRequestHandler):
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(n))
+        code = self.server.responses.pop(0) if self.server.responses else 200
+        if 200 <= code < 300:
+            self.server.received.append(body)
+        self.send_response(code)
+        self.end_headers()
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+def _hook_server(responses=None):
+    srv = HTTPServer(("127.0.0.1", 0), _Hook)
+    srv.received = []
+    srv.responses = list(responses or [])
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _drive_breach(engine, resource="drill", seconds=6, per_sec=6):
+    """Flow-limit a resource to 1 QPS, drive per_sec entries/s for
+    `seconds` seconds, refresh judgement past the last complete second.
+    Returns the stream-end clock."""
+    from tests.test_telemetry import _batch
+
+    st.load_flow_rules([st.FlowRule(resource=resource, count=1)])
+    now = BASE_MS
+    for _ in range(seconds):
+        engine.check_batch(_batch(engine, [(resource, "", None)] * per_sec),
+                           now_ms=now)
+        now += 1000
+    time_util.freeze_time(now)  # wall-clock readers see the stream end
+    engine.slo_refresh(now_ms=now)
+    return now
+
+
+def test_alert_fires_end_to_end(engine):
+    """One induced breach propagates everywhere: the `alerts` command
+    (over HTTP), the OpenMetrics families, and the webhook (with a
+    failed first attempt retried)."""
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    hook = _hook_server(responses=[503, 200])  # first attempt fails
+    engine.slo.webhook = AlertWebhook(
+        urls=[f"http://127.0.0.1:{hook.server_port}/hook"],
+        timeout_ms=2000, retries=2)
+    engine.slo.load_objectives([SloObjective(
+        resource="drill", objective=0.9, min_events=1,
+        windows=(BurnWindow(10, 2, 2.0, "page"),))])
+    _drive_breach(engine)
+    center = CommandCenter(engine, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{center.bound_port}"
+        out = _http(f"{base}/alerts")
+        assert len(out["active"]) == 1
+        alert = out["active"][0]
+        assert alert["kind"] == "burn_rate" and alert["severity"] == "page"
+        assert alert["resource"] == "drill"
+        assert out["events"][-1]["type"] == "fired"
+        assert out["health"]["resources"]["drill"] == 60
+        # sinceSeq cursor: strictly-after
+        assert _http(f"{base}/alerts?sinceSeq={out['nextSeq']}")["events"] \
+            == []
+        # resource filter
+        assert _http(f"{base}/alerts?resource=nope")["active"] == []
+        # /metrics families
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert 'sentinel_tpu_alert_active{severity="page"} 1' in text
+        assert 'sentinel_tpu_slo_health_score{resource="drill"} 60' in text
+        assert "sentinel_tpu_slo_burn_rate{" in text
+        # `slo` command status view
+        status = _http(f"{base}/slo")
+        assert status["activeAlerts"] == 1
+        rule = status["burn"]["drill:availability"]["rules"][0]
+        assert rule["firing"] is True
+        # webhook delivered after the 503 retry
+        deadline = time.time() + 5
+        while not hook.received and time.time() < deadline:
+            time.sleep(0.02)
+        assert hook.received, "webhook never delivered"
+        ev = hook.received[0]
+        assert ev["type"] == "fired"
+        assert ev["alert"]["resource"] == "drill"
+        deadline = time.time() + 5
+        while engine.slo.webhook.stats()["delivered"] < 1 \
+                and time.time() < deadline:
+            time.sleep(0.02)  # counter lands after the response round-trip
+        assert engine.slo.webhook.stats()["delivered"] == 1
+    finally:
+        center.stop()
+        hook.shutdown()
+
+
+def _read_sse(url, headers=None):
+    """(event, data, id) frames until the server closes the stream."""
+    req = urllib.request.Request(url, headers=headers or {})
+    frames = []
+    with urllib.request.urlopen(req, timeout=10) as r:
+        event = eid = None
+        for raw in r:
+            line = raw.decode("utf-8").rstrip("\n")
+            if line.startswith("id: "):
+                eid = line[len("id: "):]
+            elif line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: ") and event is not None:
+                frames.append((event, json.loads(line[len("data: "):]), eid))
+                event = None
+    return frames
+
+
+@pytest.fixture()
+def dash(monkeypatch):
+    from sentinel_tpu.dashboard.server import DashboardServer
+
+    # heartbeats must register a dialable address, not the container IP
+    monkeypatch.setenv("CSP_SENTINEL_HEARTBEAT_CLIENT_IP", "127.0.0.1")
+    d = DashboardServer(port=0).start(fetch=False)
+    d.stream_interval_s = 0.05
+    yield d
+    d.stop()
+
+
+def _register(engine, dash):
+    from sentinel_tpu.transport.command_center import CommandCenter
+    from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+    center = CommandCenter(engine, port=0).start()
+    HeartbeatSender(dashboards=[f"127.0.0.1:{dash.bound_port}"],
+                    api_port=center.bound_port).send_once()
+    app = _http(f"http://127.0.0.1:{dash.bound_port}/app/names.json")["data"][0]
+    return center, app
+
+
+def test_alert_reaches_dashboard_sse_and_alerts_json(engine, dash):
+    """The SSE stream carries the breach as an `event: alert` frame
+    beside the second frames, and /alerts.json proxies the machine's
+    alert store."""
+    engine.slo.load_objectives([SloObjective(
+        resource="drill", objective=0.9, min_events=1,
+        windows=(BurnWindow(10, 2, 2.0, "page"),))])
+    _drive_breach(engine)
+    center, app = _register(engine, dash)
+    try:
+        base = f"http://127.0.0.1:{dash.bound_port}"
+        out = _http(f"{base}/alerts.json?app={app}")["data"]
+        assert out["active"][0]["resource"] == "drill"
+        assert out["health"]["instance"] == 60
+        # 6 complete seconds + 1 fired-alert transition = 7 data frames
+        frames = _read_sse(f"{base}/telemetry/stream?app={app}&maxEvents=7")
+        kinds = [e for e, _, _ in frames]
+        assert kinds.count("second") == 6
+        assert kinds.count("alert") == 1
+        alert_frame = next(d for e, d, _ in frames if e == "alert")
+        assert alert_frame["type"] == "fired"
+        assert alert_frame["alert"]["resource"] == "drill"
+        # every data frame carries a resumable compound id
+        assert all(eid and ":" in eid for _, _, eid in frames)
+    finally:
+        center.stop()
+
+
+def test_sse_last_event_id_resumes_missed_seconds(engine, dash):
+    """A reconnecting consumer replays the complete seconds (and alert
+    transitions) it missed from the bounded history instead of losing
+    them: the second stream starts strictly after the presented id and
+    serves everything retained since."""
+    from tests.test_telemetry import _batch
+
+    st.load_flow_rules([st.FlowRule(resource="sse", count=2)])
+    now = BASE_MS
+    for _ in range(5):
+        engine.check_batch(_batch(engine, [("sse", "", None)] * 4),
+                           now_ms=now)
+        now += 1000
+    time_util.freeze_time(now)
+    engine.slo_refresh(now_ms=now)
+    center, app = _register(engine, dash)
+    try:
+        base = f"http://127.0.0.1:{dash.bound_port}"
+        first = _read_sse(f"{base}/telemetry/stream?app={app}&maxEvents=2")
+        assert [e for e, _, _ in first] == ["second", "second"]
+        assert [d["timestamp"] for _, d, _ in first] == \
+            [BASE_MS, BASE_MS + 1000]
+        last_id = first[-1][2]
+        # reconnect presenting the last id: the remaining 3 seconds
+        # replay, nothing repeats, nothing is skipped
+        resumed = _read_sse(f"{base}/telemetry/stream?app={app}&maxEvents=3",
+                            headers={"Last-Event-ID": last_id})
+        assert [d["timestamp"] for _, d, _ in resumed] == \
+            [BASE_MS + 2000, BASE_MS + 3000, BASE_MS + 4000]
+        # a garbage id degrades to a fresh stream, not an error
+        fresh = _read_sse(f"{base}/telemetry/stream?app={app}&maxEvents=1",
+                          headers={"Last-Event-ID": "bogus"})
+        assert fresh[0][1]["timestamp"] == BASE_MS
+    finally:
+        center.stop()
+
+
+# ---------------------------------------------------------------------------
+# rollout gate, health, config plumbing, step-duration histogram, A/B
+# ---------------------------------------------------------------------------
+
+def test_slo_breach_aborts_rollout(engine):
+    """An active page-severity burn alert on a resource the candidate
+    touches aborts the rollout on the next guardrail tick — no streak;
+    the kill switch disables the gate."""
+    engine.slo.load_objectives([SloObjective(
+        resource="drill", objective=0.9, min_events=1,
+        windows=(BurnWindow(10, 2, 2.0, "page"),))])
+    cand_rules = {"flow": [{"resource": "drill", "count": 50}]}
+    engine.rollout.load_candidate("cand", cand_rules, stage="shadow")
+    now = _drive_breach(engine)
+    out = engine.rollout.tick(now_ms=now)
+    assert out["status"] == "aborted"
+    assert out["sloBreaches"][0]["resource"] == "drill"
+    assert engine.rollout.active_name is None
+    ended = engine.rollout._sets["cand"]
+    assert ended.stage == "aborted" and "slo:" in ended.ended_reason
+    # an untouched resource does not abort the candidate
+    engine.rollout.load_candidate("other", {"flow": [
+        {"resource": "unrelated", "count": 5}]}, stage="shadow")
+    out = engine.rollout.tick(now_ms=now)
+    assert out.get("status") != "aborted"
+    engine.rollout.abort("other")
+    # kill switch off: breach is reported by `alerts` but never aborts
+    engine.slo.rollout_abort_enabled = False
+    engine.rollout.load_candidate("cand2", cand_rules, stage="shadow")
+    out = engine.rollout.tick(now_ms=now)
+    assert out.get("status") != "aborted"
+    assert engine.rollout.active_name == "cand2"
+
+
+def test_health_scores_compose():
+    """Deterministic score math: page -40, ticket -20, anomaly -15 per
+    active alert, instance = worst resource minus the capped shed
+    penalty."""
+    slo = SloManager()
+    with slo._lock:
+        slo._transition("p", True, 0, {
+            "key": "p", "kind": "burn_rate", "severity": "page",
+            "resource": "a"})
+        slo._transition("t", True, 0, {
+            "key": "t", "kind": "burn_rate", "severity": "ticket",
+            "resource": "a"})
+        slo._transition("z", True, 0, {
+            "key": "z", "kind": "anomaly", "severity": "anomaly",
+            "resource": "b", "signal": "blockRate"})
+    h = slo.health_scores()
+    assert h["resources"] == {"a": 40, "b": 85}
+    assert h["instance"] == 40
+    slo.shed_rate = 0.25
+    h = slo.health_scores()
+    assert h["shedPenalty"] == 25 and h["instance"] == 15
+    slo.shed_rate = 0.9  # penalty caps at 50
+    assert slo.health_scores()["shedPenalty"] == 50
+    # resolving the page alert restores its weight
+    with slo._lock:
+        slo._transition("p", False, 1, {})
+    slo.shed_rate = 0.0
+    assert slo.health_scores()["resources"]["a"] == 80
+    snap = slo.alerts_snapshot()
+    assert snap["counters"] == {"fired": 3, "resolved": 1}
+    assert [e["type"] for e in snap["events"]] == \
+        ["fired", "fired", "fired", "resolved"]
+
+
+def test_batcher_exposes_shed_rate():
+    """The overload batcher's shed-rate (ISSUE 7): cumulative shed
+    fraction + the admitted-requests counter the SLO health delta
+    consumes."""
+    from sentinel_tpu.cluster.server import _Batcher
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+    b = _Batcher(DefaultTokenService(), linger_s=0.001, max_batch=64,
+                 max_queue_groups=2, watermark_pct=100, deadline_ms=1000)
+    assert b.shed_rate() == 0.0
+    b.submit_many([object()] * 3)   # admitted (queued, never drained)
+    b.submit_many([object()] * 2)
+    b.submit_many([object()] * 5)   # queue full (maxsize 2): shed
+    stats = b.overload_stats()
+    assert stats["admittedRequests"] == 5
+    assert stats["shedRequests"] == 5
+    assert b.shed_rate() == 0.5
+    assert stats["shedRate"] == 0.5
+
+
+def test_slo_converter_roundtrip_and_validation():
+    objs = CV.slo_objectives_from_json(json.dumps([
+        {"resource": "a", "objective": 0.999},
+        {"resource": "a", "sli": "latency", "objective": 0.99,
+         "latencyMs": 5, "name": "a-rt",
+         "windows": [{"longSeconds": 30, "shortSeconds": 5,
+                      "burnRate": 2, "severity": "ticket"}]},
+    ]))
+    assert objs[0].windows[0].long_s == 60  # defaults applied
+    assert objs[0].windows[1].severity == "ticket"
+    d = CV.slo_objective_to_dict(objs[1])
+    assert d["latencyMs"] == 5
+    assert d["effectiveLatencyMs"] == 8  # snapped UP to the bucket edge
+    # round trip is stable
+    again = CV.slo_objectives_from_json(
+        CV.slo_objectives_to_json(objs))
+    assert again == objs
+    for bad in (
+        [{"resource": "", "objective": 0.9}],                 # no resource
+        [{"resource": "r", "objective": 1.0}],                # no budget
+        [{"resource": "r", "sli": "weird"}],                  # unknown SLI
+        [{"resource": "r", "windows": []}],                   # no windows
+        [{"resource": "r", "windows": [                       # short > long
+            {"longSeconds": 5, "shortSeconds": 9, "burnRate": 1}]}],
+        [{"resource": "r", "windows": [                       # bad severity
+            {"longSeconds": 9, "shortSeconds": 5, "burnRate": 1,
+             "severity": "nope"}]}],
+        {"resource": "r"},                                    # not a list
+    ):
+        with pytest.raises(ValueError):
+            CV.slo_objectives_from_json(json.dumps(bad))
+    # duplicate keys rejected at load
+    slo = SloManager()
+    with pytest.raises(ValueError):
+        slo.load_objectives(CV.slo_objectives_from_json(json.dumps(
+            [{"resource": "r"}, {"resource": "r"}])))
+
+
+def test_slo_command_set_get_roundtrip(engine):
+    from sentinel_tpu.transport.command_center import CommandCenter
+
+    center = CommandCenter(engine, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{center.bound_port}"
+        payload = json.dumps([{"resource": "cmd", "objective": 0.95}])
+        out = _http(f"{base}/slo?op=set&data=" +
+                    urllib.parse.quote(payload))
+        assert out == {"loaded": 1}
+        got = _http(f"{base}/slo?op=get")
+        assert got[0]["resource"] == "cmd"
+        assert got[0]["objective"] == 0.95
+        status = _http(f"{base}/slo")
+        assert len(status["objectives"]) == 1
+    finally:
+        center.stop()
+
+
+def test_step_duration_histogram_is_continuous(engine):
+    """The cumulative step-duration histogram: counts every sampled
+    sync step, renders as an OpenMetrics histogram, and survives a
+    profile reset (monotone — SLO burn math may rate() it)."""
+    from tests.test_telemetry import _batch
+
+    engine.step_timer.sync_every = 1  # sample every dispatch
+    for k in range(4):
+        engine._run_entry_batch(_batch(engine, [("sd", "", None)]))
+    hist = engine.step_timer.duration_histogram()
+    assert hist["entry"]["count"] == 4
+    assert sum(hist["entry"]["buckets"]) == 4
+    assert hist["entry"]["sumMs"] > 0
+    # renders beside (not instead of) the rolling quantile gauges
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    text = render_engine_metrics(engine)
+    assert 'sentinel_tpu_step_duration_ms_bucket{kind="entry",le="+Inf"}' \
+        in text
+    assert 'sentinel_tpu_step_duration_ms_count{kind="entry"} 4' in text
+    # a profile reset clears the rolling rings but NOT the histogram
+    engine.step_timer.snapshot(reset=True)
+    assert engine.step_timer.duration_histogram()["entry"]["count"] == 4
+
+
+def test_slo_evaluation_adds_no_device_work():
+    """A/B guard: the same stream with and without objectives dispatches
+    the SAME number of device programs — judgement is host arithmetic
+    riding the once-per-second fold."""
+    from tests.test_telemetry import _batch
+
+    def run(with_objectives):
+        from sentinel_tpu.core.context import replace_context
+
+        replace_context(None)
+        eng = st.reset(capacity=256)
+        if with_objectives:
+            eng.slo.load_objectives([SloObjective(
+                resource="ab", objective=0.9, min_events=1,
+                windows=(BurnWindow(10, 2, 2.0, "page"),))])
+        st.load_flow_rules([st.FlowRule(resource="ab", count=2)])
+        now = BASE_MS
+        for _ in range(5):
+            time_util.freeze_time(now)  # device + refresh share the clock
+            eng._run_entry_batch(_batch(eng, [("ab", "", None)] * 4))
+            eng.slo_refresh(now_ms=now)  # judge every second
+            now += 1000
+        time_util.freeze_time(now)
+        eng.slo_refresh(now_ms=now)  # complete the final second
+        dispatches = {k: v["dispatches"]
+                      for k, v in eng.step_timer.snapshot().items()}
+        fired = eng.slo.alerts_snapshot()["counters"]["fired"]
+        return dispatches, fired
+
+    time_util.freeze_time(BASE_MS)
+    try:
+        base_dispatches, base_fired = run(False)
+        slo_dispatches, slo_fired = run(True)
+    finally:
+        time_util.unfreeze_time()
+        st.reset(capacity=512)
+    assert base_fired == 0
+    assert slo_fired > 0, "the A/B run never exercised evaluation"
+    assert slo_dispatches == base_dispatches
+
+
+def test_recording_disabled_slo_still_safe():
+    """With the flight recorder off (timeseries.seconds=0) the SLO
+    engine sees nothing and every surface stays empty — never an
+    error."""
+    from sentinel_tpu.core.config import config
+
+    config.set("csp.sentinel.telemetry.timeseries.seconds", "0")
+    try:
+        from sentinel_tpu.core.context import replace_context
+
+        replace_context(None)
+        eng = st.reset(capacity=256)
+        eng.slo.load_objectives([SloObjective(resource="x")])
+        st.load_flow_rules([st.FlowRule(resource="x", count=1)])
+        from tests.test_telemetry import _batch
+
+        eng.check_batch(_batch(eng, [("x", "", None)] * 4), now_ms=BASE_MS)
+        eng.slo_refresh(now_ms=BASE_MS + 5000)
+        snap = eng.slo.alerts_snapshot()
+        assert snap["active"] == [] and snap["events"] == []
+        assert eng.slo.status()["burn"]["x:availability"]["rules"][0][
+            "totalLong"] == 0
+    finally:
+        config.set("csp.sentinel.telemetry.timeseries.seconds",
+                   str(128))
+        st.reset(capacity=512)
+
+
+def test_webhook_bounded_queue_drops_oldest():
+    from sentinel_tpu.slo.webhook import QUEUE_CAPACITY
+
+    wh = AlertWebhook(urls=["http://127.0.0.1:1/nothing"], retries=0,
+                      timeout_ms=50)
+    # pin a never-started worker stand-in so the queue actually fills
+    wh._thread = threading.Thread(target=lambda: None)
+    for i in range(QUEUE_CAPACITY + 5):
+        wh.submit({"seq": i})
+    assert wh.stats()["queued"] == QUEUE_CAPACITY
+    assert wh.stats()["dropped"] == 5
