@@ -16,8 +16,15 @@ the printed number is honest end-to-end wall time.
 The reference repo publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 the ratio to the 1M checks/sec north-star target: 1.0 = target met.
 
+Section 2b — pipelined steady state (ISSUE 8): ``device_pipelined`` at t1
+measures width-1 ping-pong (queue pathology, ~258ms/op in BENCH_7 against
+a ~3ms step); the ``pipeline_steady`` phase saturates the collector with
+16 producer threads and reports what the async double buffer is FOR —
+sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
+and the queue-wait vs device-wait split.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_6.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_8.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -326,6 +333,71 @@ def bench_entry_overhead() -> dict:
     return out
 
 
+def bench_pipeline_steady() -> dict:
+    """Saturated steady-state pipelined admission (ISSUE 8 acceptance):
+    16 producer threads drive a degrade-ruled resource (per-entry device
+    verdicts — the lease cannot serve it) through the async collector.
+    ``max_batch`` is kept below the producer count so one cycle never
+    swallows every waiter: while cycle N computes, the freshly resolved
+    producers of cycle N−1 refill the queue and cycle N+1 stages —
+    double buffering engaged, reported as the achieved in-flight depth.
+
+    Reported beside the rate: the queue-wait vs device-wait split
+    (StepTimer), the mean batch width, and the buffer-pool reuse ratio
+    (a pool miss per cycle would mean the staging path still
+    allocates)."""
+    import sentinel_tpu as st
+
+    eng = st.get_engine()
+    st.load_degrade_rules([st.DegradeRule(
+        resource="pl_steady", count=1e6, grade=0, time_window=10)])
+    eng.warmup((1, 8, 64))
+    eng.start_pipeline(max_batch=16, linger_s=0.0002)
+    n_threads = 16
+    stop = threading.Event()
+    counts = [0] * n_threads
+    barrier = threading.Barrier(n_threads + 1)
+
+    def worker(tid: int):
+        barrier.wait()
+        n = 0
+        while not stop.is_set():
+            h = st.entry_ok("pl_steady")
+            n += 1
+            if h:
+                h.exit()
+        counts[tid] = n
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    time.sleep(3.0)
+    stop.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stats = eng.pipeline_stats()
+    eng.stop_pipeline()
+    st.load_degrade_rules([])  # leave the engine clean for later sections
+    cycles = max(stats["cycles"], 1)
+    return {"pipeline_steady": {
+        "entries_per_sec": round(sum(counts) / wall, 1),
+        "threads": n_threads,
+        "inflight_depth_max": stats["inflightDepthMax"],
+        "mean_inflight_depth": stats["meanInflightDepth"],
+        "cycles": stats["cycles"],
+        "mean_batch": round(stats["batched"] / cycles, 2),
+        "queue_wait_p50_ms": stats["queueWaitP50Ms"],
+        "device_wait_p50_ms": stats["deviceWaitP50Ms"],
+        "pool_reuse_ratio": round(
+            stats["poolReused"]
+            / max(stats["poolReused"] + stats["poolAllocated"], 1), 3),
+    }}
+
+
 def _fused_entry_throughput(rules_builder, batch_builder, capacity=4096,
                             batch_n=4096, scan_steps=8, budget_s=30.0,
                             iters_max=15, iters_min=2) -> float:
@@ -550,7 +622,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_6.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_8.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -746,6 +818,8 @@ def main() -> None:
         out.update(bench_token_service())
         persist(out)
         out["entry_overhead"] = bench_entry_overhead()
+        persist(out)
+        out.update(bench_pipeline_steady())
         persist(out)
         # BASELINE per-config sections (eval configs #2/#3 + the shim
         # loopback transport): each is individually guarded so one

@@ -35,6 +35,9 @@ class EntryBatch(NamedTuple):
                              # a remote token server for this request
     pre_blocked: jax.Array   # bool[N] a remote token server already rejected
                              # this request; commit block stats, skip slots
+    pre_reason: jax.Array    # int32[N] BlockReason a pre_blocked entry was
+                             # rejected WITH (host lease / remote verdict) —
+                             # drives block attribution; FLOW when unset
     pre_passed: jax.Array    # bool[N] already admitted host-side (token
                              # lease) or remotely; commit PASS, skip slots
     param_hash: jax.Array   # uint32[N, MAX_PARAMS] hot-param value hashes
@@ -102,6 +105,9 @@ def make_entry_batch_np(n: int):
         entry_in=np.zeros(n, bool),
         skip_cluster=np.zeros(n, bool),
         pre_blocked=np.zeros(n, bool),
+        # BlockReason.FLOW: the historical attribution of pre-decided
+        # rejections (remote token-server verdicts ARE flow rules).
+        pre_reason=np.full(n, 1, np.int32),
         pre_passed=np.zeros(n, bool),
         param_hash=np.zeros((n, MAX_PARAMS), np.uint32),
         param_present=np.zeros((n, MAX_PARAMS), bool),
@@ -121,3 +127,64 @@ def make_exit_batch_np(n: int):
         param_hash=np.zeros((n, MAX_PARAMS), np.uint32),
         param_present=np.zeros((n, MAX_PARAMS), bool),
     )
+
+
+# Per-field padding defaults (the value every row must carry before a
+# staging pass writes the live rows): row = -1 marks padding, origin_id
+# -3 is "unresolved", everything else zeroes. One table shared by the
+# allocators above and the pool reset below so they cannot drift.
+_ENTRY_FILL = {"cluster_row": -1, "dn_row": -1, "origin_row": -1,
+               "origin_id": -3, "pre_reason": 1}
+_EXIT_FILL = {"cluster_row": -1, "dn_row": -1, "origin_row": -1}
+
+
+class BatchBufferPool:
+    """Recycled host staging buffers for the pipelined admission path.
+
+    The collector loop stages one micro-batch per cycle; allocating a
+    fresh ``make_*_batch_np`` dict each time costs ~14 numpy allocations
+    per cycle on the hot path and (worse) lets the allocator fragment
+    under sustained load. The pool hands out per-(kind, ladder-width)
+    buffers and takes them back once the cycle that used them has been
+    harvested — with JAX's async dispatch a buffer may still back an
+    in-flight device transfer until then, so release is tied to harvest,
+    never to dispatch.
+
+    ``release`` re-fills every field with its padding default, so
+    ``acquire`` returns a buffer indistinguishable from a fresh
+    allocation (stale rows beyond the new cycle's fill count would
+    otherwise leak the previous cycle's entries into the step).
+    """
+
+    __slots__ = ("_free", "allocated", "reused")
+
+    def __init__(self, prealloc_widths: "tuple" = (),
+                 prealloc_kinds: "tuple" = ("entry", "exit")):
+        # Collector-thread-only by design (acquire/release both run on
+        # the pipeline loop or under its stop path): no lock needed.
+        self._free = {}
+        self.allocated = 0
+        self.reused = 0
+        for w in prealloc_widths:
+            for kind in prealloc_kinds:
+                self.release(kind, self._fresh(kind, int(w)))
+
+    @staticmethod
+    def _fresh(kind: str, width: int):
+        return (make_entry_batch_np(width) if kind == "entry"
+                else make_exit_batch_np(width))
+
+    def acquire(self, kind: str, width: int):
+        stack = self._free.get((kind, width))
+        if stack:
+            self.reused += 1
+            return stack.pop()
+        self.allocated += 1
+        return self._fresh(kind, width)
+
+    def release(self, kind: str, buf) -> None:
+        fills = _ENTRY_FILL if kind == "entry" else _EXIT_FILL
+        for name, arr in buf.items():
+            arr.fill(fills.get(name, 0))
+        width = buf["cluster_row"].shape[0]
+        self._free.setdefault((kind, width), []).append(buf)

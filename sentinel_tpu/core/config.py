@@ -97,6 +97,18 @@ OVERLOAD_CLIENT_BACKOFF_MS = "csp.sentinel.overload.client.backoff.ms"
 # and documented in docs/OPERATIONS.md "SLOs & alerting" (pinned by
 # test_lint). csp.sentinel.slo.* tunes evaluation; csp.sentinel.alert.*
 # tunes the alert store + webhook fan-out.
+# Pipelined admission (core/pipeline.py — no reference twin: the
+# reference has no device to overlap with). Every key here MUST be read
+# through the accessors below and documented in docs/OPERATIONS.md
+# "Pipelined admission tuning" (pinned by test_lint).
+# inflight.depth: entry cycles allowed in flight on the device stream at
+# once (1 = the old synchronous ping-pong, 2 = double buffering);
+# linger.us: how long a cycle waits to fold late-arriving concurrent
+# callers in; pool.widths: comma-separated ladder widths to pre-allocate
+# staging buffers for (empty = every ladder width up to max_batch).
+PIPELINE_INFLIGHT_DEPTH = "csp.sentinel.pipeline.inflight.depth"
+PIPELINE_LINGER_US = "csp.sentinel.pipeline.linger.us"
+PIPELINE_POOL_WIDTHS = "csp.sentinel.pipeline.pool.widths"
 SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
 SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
 SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
@@ -161,6 +173,12 @@ DEFAULT_OVERLOAD_CONN_MAX_BURST = 1024
 DEFAULT_OVERLOAD_IDLE_TIMEOUT_S = 300
 DEFAULT_OVERLOAD_RLS_MAX_CONCURRENT = 64
 DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS = 250
+# Pipeline defaults. Depth 2 = classic double buffering: stage N+1 and
+# harvest N-1 while N computes; deeper only helps when the device step
+# is much longer than host staging (remote-tunnel TPU). 100µs linger
+# matches the historical collector default.
+DEFAULT_PIPELINE_INFLIGHT_DEPTH = 2
+DEFAULT_PIPELINE_LINGER_US = 100
 # SLO defaults. alpha=0.2 ≈ a ~5-second effective memory on the EWMA
 # baseline mean (fast enough to track diurnal drift, slow enough that a
 # one-second spike cannot hide itself); z>=4 on a per-second signal
@@ -359,6 +377,37 @@ class SentinelConfig:
         v = self.get_int(OVERLOAD_CLIENT_BACKOFF_MS,
                          DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS)
         return v if v > 0 else DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS
+
+    # Pipeline accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.pipeline.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def pipeline_inflight_depth(self) -> int:
+        v = self.get_int(PIPELINE_INFLIGHT_DEPTH,
+                         DEFAULT_PIPELINE_INFLIGHT_DEPTH)
+        return v if v > 0 else DEFAULT_PIPELINE_INFLIGHT_DEPTH
+
+    def pipeline_linger_us(self) -> int:
+        v = self.get_int(PIPELINE_LINGER_US, DEFAULT_PIPELINE_LINGER_US)
+        return v if v >= 0 else DEFAULT_PIPELINE_LINGER_US
+
+    def pipeline_pool_widths(self) -> tuple:
+        """Parsed ladder widths to pre-allocate staging buffers for;
+        () = caller default (every ladder width up to its max batch).
+        Malformed entries are dropped rather than killing boot."""
+        raw = self.get(PIPELINE_POOL_WIDTHS) or ""
+        out = []
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                w = int(part)
+            except ValueError:
+                continue
+            if w > 0:
+                out.append(w)
+        return tuple(out)
 
     # SLO / alerting accessors (the ONLY sanctioned readers of the
     # csp.sentinel.slo.* and csp.sentinel.alert.* keys — test_lint

@@ -229,6 +229,22 @@ class SentinelEngine:
         # while no leader is reachable). Replaced wholesale on rule load.
         self._cluster_thresholds: Dict[int, tuple] = {}
         self._pipeline = None
+        # Cumulative pipelined-admission counters across pipeline
+        # start/stop generations (the live Pipeline object dies with
+        # stop_pipeline; scrapers need monotone counters).
+        self._pipeline_totals = {
+            "cycles": 0, "batched": 0, "harvests": 0, "failOpenCycles": 0,
+            "inflightDepthMax": 0, "poolAllocated": 0, "poolReused": 0,
+        }
+        # Guards the totals fold + the retiring hand-off so scrapes
+        # during stop_pipeline() never see the monotone counters dip
+        # (and concurrent stops can never double-fold). Deliberately
+        # NOT the engine lock: stats reads must not stall behind a
+        # dispatch-held compile.
+        self._pipeline_stats_lock = threading.Lock()
+        # A pipeline between "unhooked from admission" and "counters
+        # folded" — pipeline_stats() keeps reading its live counters.
+        self._retiring_pipeline = None
         # Entries that passed UNGUARDED because the pipeline could not
         # produce a verdict (collector death / cycle error). A silent
         # fail-open is an invisible protection outage — count it and log
@@ -993,12 +1009,18 @@ class SentinelEngine:
                    and not self._spi.device_checkers())
         if lease is not None and not prioritized and fast_ok:
             now = time_util.current_time_millis()
-            passed = lease.try_acquire(count, now)
+            # admit() returns a BlockReason int (0 = pass): plain leases
+            # run the DEFAULT window ring; widened leases (warm-up flow
+            # rules, single-param resources — ROADMAP 3c) also mirror the
+            # warm-up bucket and the per-value param token buckets, and
+            # attribute blocks to the right family.
+            block_reason = lease.admit(count, now, params)
             self._ensure_committer().add_entry(
-                cluster_row, dn_row, origin_row, entry_in, count, passed)
-            if not passed:
+                cluster_row, dn_row, origin_row, entry_in, count,
+                block_reason == 0, block_reason)
+            if block_reason:
                 ctx_mod.auto_exit_context()
-                ex = exception_for_reason(int(C.BlockReason.FLOW), resource)
+                ex = exception_for_reason(block_reason, resource)
                 from sentinel_tpu.log.record_log import log_block
 
                 log_block(resource, type(ex).__name__, ctx.origin, count, now)
@@ -1068,8 +1090,9 @@ class SentinelEngine:
             time.sleep(wait_us / 1e6)
         if lease is not None:
             # Occupy grants land in the bucket after the wait — recording
-            # post-sleep stamps them there.
-            lease.add(count, time_util.current_time_millis())
+            # post-sleep stamps them there. params keep a widened lease's
+            # per-value buckets honest for device-path passes.
+            lease.add(count, time_util.current_time_millis(), params)
 
         handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
                              origin_row, entry_in, count, params)
@@ -1305,24 +1328,90 @@ class SentinelEngine:
                 raise DeviceDispatchError(
                     f"exit dispatch failed: {ex!r:.200}") from ex
 
+    def harvest_decisions(self, dec: Decisions) -> Tuple[np.ndarray,
+                                                         np.ndarray]:
+        """Materialize a previously dispatched cycle's verdicts (the
+        pipeline's harvest phase). Runs WITHOUT the engine lock — the
+        arrays belong to an already-enqueued step, so blocking here never
+        stalls a concurrent dispatch. An async compute failure surfaces
+        HERE (not at dispatch) under JAX's deferred execution: drop the
+        state cold exactly like a dispatch-time failure — the catcher
+        fails its tickets open and the next dispatch rebuilds."""
+        try:
+            return np.asarray(dec.reason), np.asarray(dec.wait_us)
+        except Exception as ex:  # noqa: BLE001 — backend/tunnel death
+            with self._lock:
+                self._state = None
+            raise DeviceDispatchError(
+                f"harvest failed: {ex!r:.200}") from ex
+
     # -- pipelined mode ----------------------------------------------------
 
     def start_pipeline(self, max_batch: int = 2048,
-                       linger_s: float = 0.0001) -> "object":
+                       linger_s: Optional[float] = None,
+                       inflight_depth: Optional[int] = None) -> "object":
         """Switch to micro-batched admission (``core/pipeline.py``):
-        concurrent entries fold into one device step per cycle."""
+        concurrent entries fold into one device step per cycle, with up
+        to ``inflight_depth`` cycles overlapped on the device stream.
+        ``linger_s``/``inflight_depth`` default to the
+        ``csp.sentinel.pipeline.*`` config keys."""
         from sentinel_tpu.core.pipeline import Pipeline
 
         with self._lock:
             if self._pipeline is None:
                 self._ensure_compiled()  # compile before the loop starts
-                self._pipeline = Pipeline(self, max_batch, linger_s).start()
+                self._pipeline = Pipeline(
+                    self, max_batch, linger_s,
+                    inflight_depth=inflight_depth).start()
             return self._pipeline
 
     def stop_pipeline(self) -> None:
-        pipeline, self._pipeline = self._pipeline, None
-        if pipeline is not None:
-            pipeline.stop()
+        with self._pipeline_stats_lock:
+            pipeline, self._pipeline = self._pipeline, None
+            if pipeline is None:
+                return  # a concurrent stop owns (or already folded) it
+            self._retiring_pipeline = pipeline
+        pipeline.stop()  # may drain for seconds — counters stay readable
+        with self._pipeline_stats_lock:
+            s = pipeline.stats()
+            t = self._pipeline_totals
+            for k in ("cycles", "batched", "harvests", "failOpenCycles",
+                      "poolAllocated", "poolReused"):
+                t[k] += s[k]
+            t["inflightDepthMax"] = max(t["inflightDepthMax"],
+                                        s["inflightDepthMax"])
+            self._retiring_pipeline = None
+
+    def pipeline_stats(self) -> Dict:
+        """One ops view of pipelined admission: monotone cycle/entry
+        counters across pipeline generations (a stopping pipeline keeps
+        reporting through the retiring hand-off — no counter dip), the
+        live in-flight depth, and the queue-wait vs device-wait split
+        from the StepTimer. Never touches the engine lock."""
+        with self._pipeline_stats_lock:
+            t = dict(self._pipeline_totals)
+            p = self._pipeline or self._retiring_pipeline
+            live = p.stats() if p is not None else None
+            active = self._pipeline is not None
+        out = {
+            "active": active,
+            "cycles": t["cycles"] + (live["cycles"] if live else 0),
+            "batched": t["batched"] + (live["batched"] if live else 0),
+            "harvests": t["harvests"] + (live["harvests"] if live else 0),
+            "failOpenCycles": t["failOpenCycles"]
+            + (live["failOpenCycles"] if live else 0),
+            "inflightDepth": live["inflightDepth"] if live else 0,
+            "inflightDepthMax": max(
+                t["inflightDepthMax"],
+                live["inflightDepthMax"] if live else 0),
+            "configuredDepth": live["configuredDepth"] if live else 0,
+            "poolAllocated": t["poolAllocated"]
+            + (live["poolAllocated"] if live else 0),
+            "poolReused": t["poolReused"]
+            + (live["poolReused"] if live else 0),
+        }
+        out.update(self.step_timer.pipeline_snapshot())
+        return out
 
     def _do_exit(self, handle: EntryHandle, count: int) -> None:
         ctx = handle.context
@@ -1629,6 +1718,9 @@ class SentinelEngine:
             },
             "blockBySlot": slot_out,
             "stepTimer": self.step_timer.snapshot(),
+            # Pipelined-admission health (dashboard "Pipeline" line +
+            # JSON parity with the sentinel_tpu_pipeline_* gauges).
+            "pipeline": self.pipeline_stats(),
             # snapshot(limit=0): the counter fields without the traces.
             "traceSampling": {
                 k: v for k, v in self.traces.snapshot(limit=0).items()

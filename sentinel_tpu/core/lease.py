@@ -2,9 +2,8 @@
 
 SURVEY.md §7 hard part #1: a synchronous device dispatch costs ~10-100µs
 (65ms+ through a remote tunnel), which no per-request path can hide. For
-the narrow-but-dominant case — a resource guarded ONLY by local
-QPS/DEFAULT flow rules — admission arithmetic is a handful of integer
-ops, so the host runs it directly against a mirrored sliding window
+the dominant traffic classes, admission arithmetic is a handful of
+integer/float ops, so the host runs it directly against mirrored state
 ("the quota is leased from the device view") and streams the decided
 outcomes to the device as pre-decided statistic commits
 (``EntryBatch.pre_passed`` / ``pre_blocked``) from a background
@@ -15,18 +14,29 @@ statistics, the ops plane, and every other rule family.
 
 Eligibility is conservative; anything else takes the device path:
 
-  * every flow rule on the resource: QPS grade, DEFAULT behavior, DIRECT
-    strategy, ``limit_app`` default, local (no cluster mode);
-  * no degrade / authority / param-flow rules on the resource;
+  * every flow rule on the resource: QPS grade, DIRECT strategy,
+    ``limit_app`` default, local (no cluster mode), and behavior either
+    DEFAULT or WARM_UP (the ``WarmUpController`` bucket is mirrored
+    host-side — ROADMAP 3c; rate-limiter pacing keeps the device path,
+    its waits need the step's leaky-bucket prefix machinery);
+  * param-flow rules: at most ONE rule on the resource, QPS grade,
+    DEFAULT behavior, local — mirrored as exact per-value windowed
+    token buckets (tighter than the device's cold-tier CMS, which only
+    over-estimates);
+  * no degrade / authority rules on the resource;
   * no system rules active, no SPI host slots or device checkers.
 
 Exactness: the mirror ring reproduces the device's DEFAULT math
 (``window_sum × 1000/interval + count ≤ threshold``) under one lock, so
 process-local admission is serially exact — tighter than the device
-path's documented within-micro-batch approximation. Device-resident
-stats converge within one committer flush (default 2ms); entries
-admitted by OTHER processes of a cluster are not leased (cluster-mode
-rules are ineligible), so no cross-process quota is bypassed.
+path's documented within-micro-batch approximation. Widened leases
+(warm-up / param) run the same float32 arithmetic the compiled step
+uses, checked family-by-family in the device chain's order (param-flow
+before flow), so verdicts match the device path bit for bit on serial
+traffic (tests/test_lease.py oracle parity). Device-resident stats
+converge within one committer flush (default 2ms); entries admitted by
+OTHER processes of a cluster are not leased (cluster-mode rules are
+ineligible), so no cross-process quota is bypassed.
 """
 
 from __future__ import annotations
@@ -35,15 +45,21 @@ import collections
 import threading
 from typing import Deque, Dict, List, Optional
 
+import numpy as np
+
 from sentinel_tpu.core import constants as C
 from sentinel_tpu.core.batch import (
     BATCH_WIDTHS,
     EntryBatch,
     ExitBatch,
+    MAX_PARAMS,
     make_entry_batch_np,
     make_exit_batch_np,
 )
 from sentinel_tpu.native import load_lease_ext
+
+_FLOW_REASON = int(C.BlockReason.FLOW)
+_PARAM_REASON = int(C.BlockReason.PARAM_FLOW)
 
 # Resolved ONCE at module import (a one-time `make` + import, ~1s when
 # the .so isn't prebuilt): LocalLease objects are constructed by
@@ -80,7 +96,7 @@ class LocalLease:
                  "_counts", "_starts", "_lock", "_ring")
 
     def __init__(self, thresholds: List[float], interval_ms: int,
-                 buckets: int):
+                 buckets: int, use_native: bool = True):
         self.thresholds = thresholds  # every rule must admit (AND)
         self.interval_ms = interval_ms
         self.buckets = buckets
@@ -88,8 +104,11 @@ class LocalLease:
         self._counts = [0] * buckets
         self._starts = [-1] * buckets
         self._lock = threading.Lock()
+        # use_native=False: WideLease runs the Python ring (the C ring
+        # only knows the plain-threshold compare) — don't build a
+        # C-side ring just to throw it away on every rule push.
         self._ring = (_LEASE_EXT.LeaseRing(thresholds, interval_ms, buckets)
-                      if _LEASE_EXT is not None else None)
+                      if use_native and _LEASE_EXT is not None else None)
 
     def _rotate(self, now_ms: int) -> int:
         """Lazy bucket reset (caller holds the lock); returns current idx.
@@ -129,9 +148,20 @@ class LocalLease:
             self._counts[idx] += count
             return True
 
-    def add(self, count: int, now_ms: int) -> None:
+    def admit(self, count: int, now_ms: int, params=()) -> int:
+        """The engine fast path's entry point: BlockReason int (0 = pass).
+        Plain leases only ever block on FLOW; widened leases override
+        with the full family chain."""
+        ring = self._ring
+        if ring is not None:
+            return 0 if ring.try_acquire(count, now_ms) else _FLOW_REASON
+        return 0 if self.try_acquire(count, now_ms) else _FLOW_REASON
+
+    def add(self, count: int, now_ms: int, params=()) -> None:
         """Record a DEVICE-decided pass so the mirror tracks the window in
-        every mode (pipeline / prioritized / occupy-granted entries)."""
+        every mode (pipeline / prioritized / occupy-granted entries).
+        ``params`` is consumed by widened leases (param-bucket mirror);
+        plain leases ignore it."""
         ring = self._ring
         if ring is not None:
             ring.add(count, now_ms)
@@ -179,6 +209,277 @@ class LocalLease:
             return self._used()
 
 
+class _WarmUpMirror:
+    """Host mirror of one WARM_UP flow rule's token bucket, in the same
+    float32 arithmetic as the compiled step (``models/flow.py``):
+    ``_sync_warmup`` refills once per second against the previous
+    bucket's pass count, and admission compares the window's usage to
+    the warning-zone throttled QPS. State starts exactly like the
+    device's (stored=0, lastFilled=0 → first sync refills to maxToken =
+    fully cold), and — like the device, which re-creates FlowState on
+    every flow push — resets on every lease-table rebuild."""
+
+    __slots__ = ("threshold", "warning_token", "max_token", "slope",
+                 "stored", "last_filled_ms", "warm_up_period_sec")
+
+    def __init__(self, count: float, warm_up_period_sec: int):
+        # Same derivation as compile_flow_rules (Guava SmoothWarmingUp):
+        # float64 params cast to float32 tensors.
+        cnt = max(count, 1e-9)
+        cold = C.COLD_FACTOR
+        wt = (warm_up_period_sec * cnt) / (cold - 1)
+        mt = wt + 2.0 * warm_up_period_sec * cnt / (1 + cold)
+        self.threshold = np.float32(count)
+        self.warning_token = np.float32(wt)
+        self.max_token = np.float32(mt)
+        self.slope = np.float32((cold - 1.0) / cnt / max(mt - wt, 1e-9))
+        self.stored = np.float32(0.0)
+        self.last_filled_ms = 0
+        self.warm_up_period_sec = warm_up_period_sec
+
+    def sync(self, now_ms: int, prev_bucket_pass: int) -> None:
+        now_sec = now_ms // 1000 * 1000
+        if now_sec <= self.last_filled_ms:
+            return
+        prev = np.float32(prev_bucket_pass)
+        elapsed_s = np.float32(now_sec - self.last_filled_ms) \
+            / np.float32(1000.0)
+        stored = self.stored
+        refill = stored + elapsed_s * self.threshold
+        below = stored < self.warning_token
+        above = stored > self.warning_token
+        low_qps = prev < self.threshold / np.float32(C.COLD_FACTOR)
+        new = refill if (below or (above and low_qps)) else stored
+        new = min(new, self.max_token)
+        new = max(new - prev, np.float32(0.0))
+        self.stored = np.float32(new)
+        self.last_filled_ms = now_sec
+
+    def effective_threshold(self) -> np.float32:
+        stored = self.stored
+        wtok = self.warning_token
+        if stored >= wtok:
+            return np.float32(1.0) / (
+                (stored - wtok) * self.slope
+                + np.float32(1.0) / max(self.threshold, np.float32(1e-9)))
+        return self.threshold
+
+
+# A key whose bucket has been idle this many windows is provably full
+# again (refill clamps at max_count within ceil(max/thr)+1 windows), so
+# evicting it is EXACT — the next request sees a fresh full bucket
+# either way. The cap bounds the mirror's memory under key churn.
+_PARAM_MAX_KEYS = 4096
+
+
+class _ParamLeaseMirror:
+    """Host mirror of ONE param-flow rule (QPS/DEFAULT): exact per-value
+    windowed token buckets in the device's float32 math
+    (``models/param_flow.py`` ``passDefaultLocalCheck`` analog). The
+    mirror is exact for every value (a dict has no slot collisions), so
+    it sits between the device's two tiers: identical to the hot-tier
+    owner bucket, tighter than the cold-tier CMS (which only
+    over-estimates usage and so under-admits).
+
+    Like the device — where a window-boundary crossing rolls the bucket
+    for BLOCKED requests too — the roll happens for every applicable
+    request, and tokens are consumed at param-check time even when a
+    later family blocks the entry (the reference chain's ParamFlowSlot
+    runs before FlowSlot)."""
+
+    __slots__ = ("param_idx", "threshold", "burst", "duration_ms", "items",
+                 "buckets")
+
+    def __init__(self, rule):
+        from sentinel_tpu.utils.param_hash import hash_param
+
+        self.param_idx = int(rule.param_idx)
+        self.threshold = np.float32(rule.count)
+        self.burst = np.float32(rule.burst_count)
+        self.duration_ms = max(int(rule.duration_in_sec) * 1000, 1)
+        # Per-value exception thresholds (exact hash match, max wins —
+        # the device takes the max over matched item slots).
+        self.items: Dict[int, np.float32] = {}
+        for item in rule.items[:8]:
+            h = hash_param(item.object)
+            prev = self.items.get(h)
+            c = np.float32(item.count)
+            self.items[h] = c if prev is None or c > prev else prev
+        self.buckets: Dict[int, list] = {}
+
+    def check_commit(self, count: int, now_ms: int,
+                     params) -> Optional[bool]:
+        """None = rule not applicable (no such argument); True = admitted
+        (token consumed); False = blocked (bucket rolled, not consumed)."""
+        if self.param_idx >= len(params):
+            return None
+        h = params[self.param_idx]
+        thr = self.items.get(h, self.threshold)
+        max_count = thr + self.burst
+        acq = np.float32(count)
+        ent = self.buckets.get(h)
+        if ent is None:
+            # Fresh key: full bucket (host-exact; the device's CMS
+            # estimate is 0 for a first-seen value too).
+            ok = bool(thr > 0) and bool(acq <= max_count)
+            if ok:
+                if len(self.buckets) >= _PARAM_MAX_KEYS:
+                    self._evict(now_ms)
+                self.buckets[h] = [np.float32(max_count - acq), now_ms]
+            return ok
+        tokens, filled = ent
+        windows = max((now_ms - filled) // self.duration_ms, 0)
+        avail = min(tokens + np.float32(windows) * thr, max_count)
+        ok = bool(thr > 0) and bool(acq <= avail)
+        # Window roll commits for blocked requests too (device
+        # ``touch``/``need_stamp`` are gated on applicability, not
+        # admission); consumption only on admission (``ok`` implies
+        # ``avail - acq >= 0`` exactly in IEEE float32).
+        ent[0] = np.float32(avail - acq) if ok else np.float32(avail)
+        if windows >= 1:
+            ent[1] = now_ms
+        return ok
+
+    def consume(self, count: int, now_ms: int, params) -> None:
+        """Mirror a DEVICE-decided pass: roll the value's bucket window
+        and consume unconditionally (the device already admitted it, so
+        the mirror must reflect the spend — clamped at zero like the
+        device's own jnp.maximum). Keeps mixed traffic (prioritized /
+        pipeline-mode entries on a param-leased resource) from earning
+        an independent second quota out of the host mirror."""
+        if self.param_idx >= len(params):
+            return
+        h = params[self.param_idx]
+        thr = self.items.get(h, self.threshold)
+        max_count = thr + self.burst
+        ent = self.buckets.get(h)
+        if ent is None:
+            if len(self.buckets) >= _PARAM_MAX_KEYS:
+                self._evict(now_ms)
+            self.buckets[h] = [
+                np.float32(max(max_count - np.float32(count), 0.0)), now_ms]
+            return
+        tokens, filled = ent
+        windows = max((now_ms - filled) // self.duration_ms, 0)
+        avail = min(tokens + np.float32(windows) * thr, max_count)
+        ent[0] = np.float32(max(avail - np.float32(count), 0.0))
+        if windows >= 1:
+            ent[1] = now_ms
+
+    def _evict(self, now_ms: int) -> None:
+        """Drop provably-full (long-idle) buckets; exact — see cap note."""
+        full_after = self.duration_ms * (
+            2 + int(float(self.burst) / max(float(self.threshold), 1e-9)))
+        stale = [h for h, (_t, filled) in self.buckets.items()
+                 if now_ms - filled >= full_after]
+        for h in stale:
+            del self.buckets[h]
+        if len(self.buckets) >= _PARAM_MAX_KEYS:
+            # Every key is hot: drop the oldest-stamped quarter. Evicted
+            # hot keys restart with a full bucket — a bounded, logged
+            # over-admission (≤ one window per evicted key), preferred
+            # over unbounded host memory.
+            from sentinel_tpu.log.record_log import record_log
+
+            oldest = sorted(self.buckets.items(), key=lambda kv: kv[1][1])
+            for h, _ in oldest[:_PARAM_MAX_KEYS // 4]:
+                del self.buckets[h]
+            record_log.warn(
+                "param lease mirror evicted %d hot keys (cap %d)",
+                len(oldest) // 4, _PARAM_MAX_KEYS)
+
+
+class WideLease(LocalLease):
+    """Widened host lease: DEFAULT + WARM_UP flow rules and at most one
+    QPS/DEFAULT param-flow rule, admitted in the device chain's order
+    (param-flow before flow) with the step's own float32 arithmetic.
+
+    Always runs the pure-Python ring — the C extension only knows the
+    plain-threshold compare, and these resources' per-entry budget is
+    dominated by the float32 mirror math anyway (a handful of numpy
+    scalar ops, still ~100x cheaper than a device dispatch)."""
+
+    __slots__ = ("warm", "param", "_thr32", "_qps_scale")
+
+    def __init__(self, thresholds: List[float], warm_specs: List[tuple],
+                 param_rule, interval_ms: int, buckets: int):
+        super().__init__(thresholds, interval_ms, buckets, use_native=False)
+        self.warm = [_WarmUpMirror(count, period)
+                     for count, period in warm_specs]
+        self.param = (_ParamLeaseMirror(param_rule)
+                      if param_rule is not None else None)
+        self._thr32 = [np.float32(t) for t in thresholds]
+        self._qps_scale = np.float32(1000.0 / interval_ms)
+
+    def admit(self, count: int, now_ms: int, params=()) -> int:
+        with self._lock:
+            idx = self._rotate(now_ms)
+            # Device chain order: param-flow verdicts (and their token
+            # consumption) land before the flow family sees the entry.
+            if self.param is not None:
+                param_ok = self.param.check_commit(count, now_ms, params)
+            else:
+                param_ok = None
+            # Warm-up sync runs on every step regardless of earlier-
+            # family verdicts (check_flow always syncs), keyed on the
+            # PREVIOUS bucket's pass count like the device gather.
+            if self.warm:
+                prev = self._counts[(idx - 1) % self.buckets]
+                for w in self.warm:
+                    w.sync(now_ms, prev)
+            if param_ok is False:
+                return _PARAM_REASON
+            used = np.float32(sum(self._counts)) * self._qps_scale
+            acq = np.float32(count)
+            for thr in self._thr32:
+                if used + acq > thr:
+                    return _FLOW_REASON
+            for w in self.warm:
+                if used + acq > w.effective_threshold():
+                    return _FLOW_REASON
+            self._counts[idx] += count
+            return 0
+
+    def add(self, count: int, now_ms: int, params=()) -> None:
+        """A device-decided pass updates the window ring AND the param
+        mirror: the device path runs beside the lease for prioritized
+        entries and the pipeline mode, and an un-mirrored device pass
+        would let the same value spend its quota twice (once per side)."""
+        with self._lock:
+            idx = self._rotate(now_ms)
+            self._counts[idx] += count
+            if self.param is not None and params:
+                self.param.consume(count, now_ms, params)
+
+
+def _default_leaseable(r) -> bool:
+    return (r.grade == C.FLOW_GRADE_QPS
+            and r.control_behavior == C.CONTROL_BEHAVIOR_DEFAULT
+            and r.strategy == C.FLOW_STRATEGY_DIRECT
+            and r.limit_app == C.LIMIT_APP_DEFAULT
+            and not r.cluster_mode)
+
+
+def _warmup_leaseable(r) -> bool:
+    return (r.grade == C.FLOW_GRADE_QPS
+            and r.control_behavior == C.CONTROL_BEHAVIOR_WARM_UP
+            and r.strategy == C.FLOW_STRATEGY_DIRECT
+            and r.limit_app == C.LIMIT_APP_DEFAULT
+            and not r.cluster_mode
+            and r.warm_up_period_sec > 0)
+
+
+def _param_leaseable(rules) -> bool:
+    if len(rules) != 1:
+        return False
+    r = rules[0]
+    return (r.grade == C.PARAM_FLOW_GRADE_QPS
+            and r.control_behavior == C.CONTROL_BEHAVIOR_DEFAULT
+            and not r.cluster_mode
+            and r.duration_in_sec >= 1
+            and 0 <= r.param_idx < MAX_PARAMS)
+
+
 def build_lease_table(engine):
     """Recompute the fast-path state from the engine's CURRENT rules
     (called under the engine lock on every rule push / geometry change).
@@ -210,40 +511,49 @@ def build_lease_table(engine):
     ruled = {}
     for r in flow_rules:
         ruled.setdefault(r.resource, []).append(r)
+    param_by_res = {}
+    for r in engine.param_rules.get_rules():
+        param_by_res.setdefault(r.resource, []).append(r)
     # A resource another rule RELATEs/CHAINs to must stay on the device
     # path: its window feeds that rule's check, and leased commits land
     # with up to one flush of lag.
     refs = {r.ref_resource for r in flow_rules if r.ref_resource}
     blocked_resources = set()
-    for mgr in (engine.degrade_rules, engine.authority_rules,
-                engine.param_rules):
+    for mgr in (engine.degrade_rules, engine.authority_rules):
         for r in mgr.get_rules():
             blocked_resources.add(r.resource)
-    guarded = set(ruled) | refs | blocked_resources
+    guarded = set(ruled) | set(param_by_res) | refs | blocked_resources
     spec = engine._spec1
     out = {}
-    for resource, rules in ruled.items():
+    for resource in set(ruled) | set(param_by_res):
         if resource in blocked_resources or resource in refs:
             continue
-        ok = all(
-            r.grade == C.FLOW_GRADE_QPS
-            and r.control_behavior == C.CONTROL_BEHAVIOR_DEFAULT
-            and r.strategy == C.FLOW_STRATEGY_DIRECT
-            and r.limit_app == C.LIMIT_APP_DEFAULT
-            and not r.cluster_mode
-            for r in rules
-        )
-        if ok:
-            out[resource] = LocalLease([float(r.count) for r in rules],
-                                       spec.interval_ms, spec.buckets)
+        frules = ruled.get(resource, ())
+        prules = param_by_res.get(resource, ())
+        defaults = [float(r.count) for r in frules if _default_leaseable(r)]
+        warms = [(float(r.count), int(r.warm_up_period_sec))
+                 for r in frules if _warmup_leaseable(r)]
+        if len(defaults) + len(warms) != len(frules):
+            continue  # some flow rule needs the device path
+        if prules and not _param_leaseable(prules):
+            continue
+        if warms or prules:
+            out[resource] = WideLease(defaults, warms,
+                                      prules[0] if prules else None,
+                                      spec.interval_ms, spec.buckets)
+        elif defaults:
+            out[resource] = LocalLease(defaults, spec.interval_ms,
+                                       spec.buckets)
     return out, guarded, True
 
 
 def _entry_batch_from(chunk: List[tuple]) -> EntryBatch:
-    """(cluster_row, dn_row, origin_row, entry_in, count, passed) tuples →
-    a pre-decided EntryBatch (the ONE fill site both committers share)."""
+    """(cluster_row, dn_row, origin_row, entry_in, count, passed,
+    block_reason) tuples → a pre-decided EntryBatch (the ONE fill site
+    both committers share). ``block_reason`` names the rejecting family
+    for blocked entries (attribution channel); ignored for passes."""
     buf = make_entry_batch_np(_ladder_width(len(chunk)))
-    for i, (cr, dr, orow, ein, cnt, passed) in enumerate(chunk):
+    for i, (cr, dr, orow, ein, cnt, passed, reason) in enumerate(chunk):
         buf["cluster_row"][i] = cr
         buf["dn_row"][i] = dr
         buf["origin_row"][i] = orow
@@ -251,6 +561,8 @@ def _entry_batch_from(chunk: List[tuple]) -> EntryBatch:
         buf["count"][i] = cnt
         buf["pre_passed"][i] = passed
         buf["pre_blocked"][i] = not passed
+        if not passed and reason:
+            buf["pre_reason"][i] = reason
     return EntryBatch(**buf)
 
 
@@ -279,9 +591,11 @@ class SyncCommitter:
         self.engine = engine
 
     def add_entry(self, cluster_row: int, dn_row: int, origin_row: int,
-                  entry_in: bool, count: int, passed: bool) -> None:
+                  entry_in: bool, count: int, passed: bool,
+                  block_reason: int = _FLOW_REASON) -> None:
         self.engine._run_entry_batch(_entry_batch_from(
-            [(cluster_row, dn_row, origin_row, entry_in, count, passed)]))
+            [(cluster_row, dn_row, origin_row, entry_in, count, passed,
+              block_reason)]))
 
     def add_exit(self, cluster_row: int, dn_row: int, origin_row: int,
                  entry_in: bool, count: int, rt_ms: int, success: bool,
@@ -372,9 +686,11 @@ class StatsCommitter:
             record_log.warn("final committer drain failed: %r", ex)
 
     def add_entry(self, cluster_row: int, dn_row: int, origin_row: int,
-                  entry_in: bool, count: int, passed: bool) -> None:
+                  entry_in: bool, count: int, passed: bool,
+                  block_reason: int = _FLOW_REASON) -> None:
         self._entries.append(
-            (cluster_row, dn_row, origin_row, entry_in, count, passed))
+            (cluster_row, dn_row, origin_row, entry_in, count, passed,
+             block_reason))
         # Every append arms the wake (the flusher then lingers linger_s to
         # accumulate a micro-batch). A count-based "only the first append
         # wakes" scheme is racy without the per-append lock: two
@@ -400,7 +716,7 @@ class StatsCommitter:
         takes: flushing there would deadlock)."""
         items = self._entries.copy()  # GIL-atomic snapshot (C-level copy)
         out: Dict[int, int] = {}
-        for (cr, _dr, _orow, _ein, cnt, passed) in items:
+        for (cr, _dr, _orow, _ein, cnt, passed, _reason) in items:
             if passed:
                 out[cr] = out.get(cr, 0) + cnt
         return out

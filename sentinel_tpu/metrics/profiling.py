@@ -74,6 +74,17 @@ class StepTimer:
         # family go backwards mid-scrape.
         self._duration_hist: Dict[str, list] = {}
         self._duration_sum_ms: Dict[str, float] = {}
+        # Pipelined-admission decomposition (ISSUE 8): per harvested
+        # cycle, the queue wait (oldest ticket submit -> dispatch) and
+        # the device wait (harvest blocking on the materialized
+        # verdicts), plus the in-flight depth observed at harvest. The
+        # split answers the question BENCH_7's t1 pathology raised:
+        # is a slow pipelined op queue wait (host serialization) or
+        # device wait (step wall)?
+        self._pl_queue: list = []
+        self._pl_device: list = []
+        self._pl_depth_sum = 0
+        self._pl_cycles = 0
 
     def record(self, kind: str, batch_n: int, enqueue_ms: float,
                sync_ms: Optional[float] = None) -> None:
@@ -96,6 +107,37 @@ class StepTimer:
                 hist[b] += 1
                 self._duration_sum_ms[kind] = \
                     self._duration_sum_ms.get(kind, 0.0) + sync_ms
+
+    def record_pipeline(self, depth: int, queue_wait_ms: float,
+                        device_wait_ms: float) -> None:
+        """Record one harvested pipeline cycle's wait decomposition."""
+        with self._lock:
+            self._pl_cycles += 1
+            self._pl_depth_sum += depth
+            self._pl_queue.append(queue_wait_ms)
+            del self._pl_queue[:-self._ring]
+            self._pl_device.append(device_wait_ms)
+            del self._pl_device[:-self._ring]
+
+    def pipeline_snapshot(self) -> Dict[str, float]:
+        """Queue-wait vs device-wait split + mean achieved in-flight
+        depth over recorded harvests (empty-safe zeros)."""
+        with self._lock:
+            out: Dict[str, float] = {
+                "harvestedCycles": self._pl_cycles,
+                "meanInflightDepth": round(
+                    self._pl_depth_sum / self._pl_cycles, 3)
+                if self._pl_cycles else 0.0,
+            }
+            for name, ring in (("queueWait", self._pl_queue),
+                               ("deviceWait", self._pl_device)):
+                if ring:
+                    out[f"{name}P50Ms"] = round(_pct(ring, 50), 3)
+                    out[f"{name}P95Ms"] = round(_pct(ring, 95), 3)
+                else:
+                    out[f"{name}P50Ms"] = 0.0
+                    out[f"{name}P95Ms"] = 0.0
+            return out
 
     def duration_histogram(self) -> Dict[str, Dict]:
         """Cumulative sampled-step-wall histogram per kind:

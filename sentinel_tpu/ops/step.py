@@ -587,7 +587,10 @@ def entry_step(
     # skip every local slot. Their rule identity lives on the token
     # server — rule_slot stays -1 ("remote/unknown").
     blocked = valid & batch.pre_blocked
-    reason = jnp.where(blocked, C.BlockReason.FLOW, reason)
+    # pre_reason carries the rejecting family (host lease blocks name
+    # PARAM_FLOW vs FLOW; remote verdicts stay FLOW) so block
+    # attribution lands in the right channel.
+    reason = jnp.where(blocked, batch.pre_reason, reason)
     # Host-leased admissions (core/lease.py) arrive pre-PASSED: commit
     # their statistics, skip every slot. Their counts join the window via
     # this step's commit, so slot-checked peers in the SAME batch see them

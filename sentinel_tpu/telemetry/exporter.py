@@ -251,6 +251,49 @@ def render_engine_metrics(engine) -> str:
                 b.sample("sentinel_tpu_enqueue_ms",
                          {"kind": kind, "quantile": f"0.{q}"}, v)
 
+    # -- pipelined admission (core/pipeline.py — ISSUE 8) ------------------
+    # Cycle/entry counters are monotone across pipeline start/stop
+    # generations (engine._pipeline_totals); depth + wait splits answer
+    # "is pipelined latency queue wait or device wait" at a glance.
+    pl = engine.pipeline_stats()
+    b.family("sentinel_tpu_pipeline_active", "gauge",
+             "1 while the micro-batch collector owns admission")
+    b.sample("sentinel_tpu_pipeline_active", None, 1 if pl["active"] else 0)
+    b.family("sentinel_tpu_pipeline_inflight_depth", "gauge",
+             "Entry cycles currently in flight on the device stream")
+    b.sample("sentinel_tpu_pipeline_inflight_depth", None,
+             pl["inflightDepth"])
+    b.family("sentinel_tpu_pipeline_inflight_depth_max", "gauge",
+             "High-water mark of overlapped entry cycles since engine "
+             "start (2+ = double buffering engaged)")
+    b.sample("sentinel_tpu_pipeline_inflight_depth_max", None,
+             pl["inflightDepthMax"])
+    b.counter("sentinel_tpu_pipeline_cycles",
+              "Dispatched pipelined entry cycles", pl["cycles"])
+    b.counter("sentinel_tpu_pipeline_entries",
+              "Entries batched through the pipeline", pl["batched"])
+    b.counter("sentinel_tpu_pipeline_fail_open_cycles",
+              "Pipeline cycles whose tickets failed open (dispatch or "
+              "harvest death)", pl["failOpenCycles"])
+    b.counter("sentinel_tpu_pipeline_pool_allocated",
+              "Staging buffers the pipeline pool allocated fresh",
+              pl["poolAllocated"])
+    b.counter("sentinel_tpu_pipeline_pool_reused",
+              "Staging-buffer acquisitions served from the pool",
+              pl["poolReused"])
+    b.family("sentinel_tpu_pipeline_queue_wait_ms", "gauge",
+             "Oldest-ticket submit-to-dispatch wait per harvested cycle "
+             "(rolling percentiles, ms)")
+    for q in ("50", "95"):
+        b.sample("sentinel_tpu_pipeline_queue_wait_ms",
+                 {"quantile": f"0.{q}"}, pl[f"queueWaitP{q}Ms"])
+    b.family("sentinel_tpu_pipeline_device_wait_ms", "gauge",
+             "Harvest block on the materialized verdicts per cycle "
+             "(rolling percentiles, ms)")
+    for q in ("50", "95"):
+        b.sample("sentinel_tpu_pipeline_device_wait_ms",
+                 {"quantile": f"0.{q}"}, pl[f"deviceWaitP{q}Ms"])
+
     # -- step duration (continuous, SLO-targetable) ------------------------
     # Cumulative histogram of the sampled synchronous step walls: unlike
     # the rolling sentinel_tpu_step_ms quantile gauges above (post-hoc,

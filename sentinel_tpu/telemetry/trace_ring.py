@@ -76,6 +76,16 @@ class DecisionTraceBuffer:
 
     # -- dispatch-path side (cheap; may run under the engine lock) --------
 
+    @staticmethod
+    def _snap(col):
+        """Host-mutable columns must be snapshotted at submit: the
+        pipelined path stages batches in RECYCLED pool buffers
+        (core/batch.py) that are re-filled with a later cycle's entries
+        once harvested — by the time the worker runs, the original
+        arrays may hold someone else's rows. jax Arrays are immutable
+        (and decisions always are), so only numpy needs the copy."""
+        return col.copy() if isinstance(col, np.ndarray) else col
+
     def submit(self, batch, decisions, now_ms: int) -> None:
         """Queue one dispatched batch's verdicts for async sampling.
         Never blocks: a full hand-off queue drops the batch (counted),
@@ -83,6 +93,14 @@ class DecisionTraceBuffer:
         if self.sample_every <= 0 or self._stopped:
             return
         self._ensure_worker()
+        # Only the four columns _process reads are retained — snapshot
+        # them (µs for a ≤2048-row batch) so the batch's backing
+        # buffers can be recycled the moment its cycle harvests.
+        batch = batch._replace(
+            cluster_row=self._snap(batch.cluster_row),
+            origin_row=self._snap(batch.origin_row),
+            count=self._snap(batch.count),
+            entry_in=self._snap(batch.entry_in))
         try:
             self._queue.put_nowait((batch, decisions, int(now_ms)))
         except queue.Full:

@@ -464,7 +464,11 @@ def cmd_leases(req: CommandRequest) -> CommandResponse:
                  "usageQps": round(lease.usage(now), 2),
                  # which admission ring serves this lease: the C
                  # extension (native/lease_ext.c) or the Python fallback
-                 "nativeRing": lease._ring is not None}
+                 "nativeRing": lease._ring is not None,
+                 # widened-lease coverage (ROADMAP 3c): mirrored warm-up
+                 # rule count + whether a param rule is host-admitted
+                 "warmupRules": len(getattr(lease, "warm", ()) or ()),
+                 "paramLease": getattr(lease, "param", None) is not None}
            for res, lease in sorted(eng._leases.items())}
     return CommandResponse.of_success({
         # configured vs EFFECTIVE: system rules / SPI registrations turn

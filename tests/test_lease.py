@@ -23,8 +23,10 @@ def test_simple_qps_rule_is_leased(engine):
 
 def test_ineligible_shapes_stay_on_device_path(engine):
     st.load_flow_rules([
-        st.FlowRule(resource="warm", count=5,
-                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP),
+        st.FlowRule(resource="wrl", count=5,
+                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER),
+        st.FlowRule(resource="rlim", count=5,
+                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER),
         st.FlowRule(resource="thr", count=5, grade=C.FLOW_GRADE_THREAD),
         st.FlowRule(resource="orig", count=5, limit_app="appA"),
         st.FlowRule(resource="clus", count=5, cluster_mode=True,
@@ -33,10 +35,14 @@ def test_ineligible_shapes_stay_on_device_path(engine):
                     strategy=C.FLOW_STRATEGY_RELATE, ref_resource="ref"),
         st.FlowRule(resource="ref", count=5),  # RELATE target
         st.FlowRule(resource="ok", count=5),
+        # WARM_UP is leaseable since ISSUE 8 (ROADMAP 3c)
+        st.FlowRule(resource="warm", count=5,
+                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP),
     ])
-    for r in ("warm", "thr", "orig", "clus", "rel", "ref"):
+    for r in ("wrl", "rlim", "thr", "orig", "clus", "rel", "ref"):
         assert not _leased(engine, r), r
     assert _leased(engine, "ok")
+    assert _leased(engine, "warm")
 
 
 def test_other_rule_families_disable_lease(engine):
@@ -47,7 +53,25 @@ def test_other_rule_families_disable_lease(engine):
                                           time_window=5)])
     assert not _leased(engine, "d")
     assert _leased(engine, "p")
+    # ONE QPS/DEFAULT param rule is leaseable since ISSUE 8; shapes the
+    # host mirror cannot serve still force the device path:
     st.load_param_flow_rules([st.ParamFlowRule("p", param_idx=0, count=5)])
+    assert _leased(engine, "p")
+    st.load_param_flow_rules([  # two rules on one resource
+        st.ParamFlowRule("p", param_idx=0, count=5),
+        st.ParamFlowRule("p", param_idx=1, count=9),
+    ])
+    assert not _leased(engine, "p")
+    st.load_param_flow_rules([st.ParamFlowRule(  # THREAD grade
+        "p", param_idx=0, count=5, grade=C.PARAM_FLOW_GRADE_THREAD)])
+    assert not _leased(engine, "p")
+    st.load_param_flow_rules([st.ParamFlowRule(  # per-value pacing
+        "p", param_idx=0, count=5,
+        control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER)])
+    assert not _leased(engine, "p")
+    st.load_param_flow_rules([st.ParamFlowRule(  # cluster mode
+        "p", param_idx=0, count=5, cluster_mode=True,
+        cluster_config={"flowId": 9})])
     assert not _leased(engine, "p")
 
 
@@ -453,6 +477,206 @@ def test_warmup_precompiles_ladder_widths(engine, frozen_time):
     push_s = _time.perf_counter() - t0
     assert engine._leases["wu"].thresholds == [20.0]
     assert push_s < 2.0, f"rule push stalled {push_s:.1f}s behind a compile"
+
+
+# -- widened leases: warm-up + single-param (ISSUE 8 / ROADMAP 3c) ----------
+
+
+def _device_twin(rules=None, param_rules=None, capacity=256):
+    """A second engine with the lease forced OFF: the device-path oracle
+    the widened host mirrors must match verdict for verdict."""
+    from sentinel_tpu.core.engine import SentinelEngine
+
+    eng = SentinelEngine(capacity)
+    eng.lease_enabled = False
+    eng._rebuild_leases()
+    if rules:
+        eng.flow_rules.load_rules(rules)
+    if param_rules:
+        eng.param_rules.load_rules(param_rules)
+    return eng
+
+
+def _device_verdict(eng, resource, count=1, value=None):
+    """One width-1 device-path entry (+ exit on pass) on the twin."""
+    import numpy as np
+
+    from sentinel_tpu.core.batch import (
+        EntryBatch, ExitBatch, make_entry_batch_np, make_exit_batch_np)
+    from sentinel_tpu.utils.param_hash import hash_param
+
+    reg = eng.registry
+    cr, dr, _orow, _oid = reg.resolve_entry(
+        resource, "twin_ctx", "", reg.entrance_row("twin_ctx"), 0)
+    buf = make_entry_batch_np(1)
+    buf["cluster_row"][0] = cr
+    buf["dn_row"][0] = dr
+    buf["count"][0] = count
+    if value is not None:
+        buf["param_hash"][0, 0] = hash_param(value)
+        buf["param_present"][0, 0] = True
+    dec = eng._run_entry_batch(EntryBatch(**buf))
+    passed = int(np.asarray(dec.reason)[0]) == 0
+    if passed:
+        xb = make_exit_batch_np(1)
+        xb["cluster_row"][0] = cr
+        xb["dn_row"][0] = dr
+        xb["count"][0] = count
+        xb["success"][0] = True
+        eng._run_exit_batch(ExitBatch(**xb))
+    return passed
+
+
+def test_warmup_rule_is_leased_and_matches_device(engine, frozen_time):
+    """Oracle parity: the host warm-up mirror must reproduce the device
+    WarmUpController verdict for verdict across the cold throttle, the
+    ramp, and the warm plateau (same float32 math, same 1 Hz sync)."""
+    from sentinel_tpu.core.lease import WideLease
+    from sentinel_tpu.utils import time_util
+
+    rule = st.FlowRule(resource="w", count=30,
+                       control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                       warm_up_period_sec=4)
+    st.load_flow_rules([rule])
+    assert isinstance(engine._leases["w"], WideLease)
+    twin = _device_twin(rules=[rule])
+    for sec in range(7):  # cold second, 4s ramp, 2 warm-plateau seconds
+        for i in range(30):
+            if i:
+                time_util.advance_time(33)
+            got = bool(st.entry_ok("w"))
+            want = _device_verdict(twin, "w")
+            assert got == want, (sec, i)
+        time_util.advance_time(1000 - 29 * 33)
+
+
+def test_warmup_lease_cold_start_throttles(engine, frozen_time):
+    """The whole point of WARM_UP: a cold resource admits well below its
+    threshold in the first window (warning-zone QPS ≈ count/coldFactor),
+    never the full count."""
+    st.load_flow_rules([st.FlowRule(
+        resource="cold", count=90,
+        control_behavior=C.CONTROL_BEHAVIOR_WARM_UP, warm_up_period_sec=10)])
+    admitted = sum(1 for _ in range(90) if st.entry_ok("cold"))
+    assert 0 < admitted < 90
+    assert admitted <= 90 / C.COLD_FACTOR + 1
+
+
+@pytest.mark.parametrize("seed", [3, 17])
+def test_single_param_rule_is_leased_and_matches_device(engine,
+                                                        frozen_time, seed):
+    """Oracle parity for the param mirror: per-value windowed token
+    buckets (burst included) must match the device verdicts over a
+    randomized multi-value stream with idle gaps and window rolls."""
+    import random
+
+    from sentinel_tpu.core.lease import WideLease
+    from sentinel_tpu.utils import time_util
+
+    rule = st.ParamFlowRule("pp", param_idx=0, count=3, burst_count=1)
+    st.load_param_flow_rules([rule])
+    assert isinstance(engine._leases["pp"], WideLease)
+    twin = _device_twin(param_rules=[rule])
+    rng = random.Random(seed)
+    for step in range(160):
+        time_util.advance_time(rng.choice([0, 50, 200, 1000]))
+        v = rng.choice(["a", "b", "c"])
+        got = bool(st.entry_ok("pp", args=[v]))
+        want = _device_verdict(twin, "pp", value=v)
+        assert got == want, (seed, step, v)
+
+
+def test_param_lease_block_raises_param_flow_exception(engine, frozen_time):
+    st.load_param_flow_rules([st.ParamFlowRule("px", param_idx=0, count=2)])
+    assert st.entry_ok("px", args=["k"]) is not None
+    assert st.entry_ok("px", args=["k"]) is not None
+    with pytest.raises(st.ParamFlowException):
+        st.entry("px", args=["k"])
+    # a DIFFERENT value has its own bucket
+    assert st.entry_ok("px", args=["other"]) is not None
+    # no value argument at all: the rule does not apply — always pass
+    assert st.entry_ok("px") is not None
+
+
+def test_param_lease_blocks_attribute_to_param_flow_channel(engine,
+                                                            frozen_time):
+    """A host param block must land in the PARAM_FLOW attribution
+    channel on device (pre_reason), not the historical FLOW bucket —
+    operators chase the right rule family."""
+    from sentinel_tpu.telemetry.attribution import ATTR_REASON_NAMES
+
+    st.load_param_flow_rules([st.ParamFlowRule("pa", param_idx=0, count=1)])
+    assert st.entry_ok("pa", args=["k"]) is not None
+    assert st.entry_ok("pa", args=["k"]) is None  # host PARAM_FLOW block
+    counts = engine.telemetry_counts()
+    row = engine.registry.get_cluster_row("pa")
+    param_ch = ATTR_REASON_NAMES.index("PARAM_FLOW")
+    flow_ch = ATTR_REASON_NAMES.index("FLOW")
+    assert counts["blockByReason"][param_ch, row] == 1
+    assert counts["blockByReason"][flow_ch, row] == 0
+
+
+def test_device_path_pass_consumes_param_mirror(engine, frozen_time):
+    """Mixed traffic must not double the per-value quota: a PRIORITIZED
+    entry takes the device path, and its pass must consume the host
+    param mirror too (lease.add with params)."""
+    st.load_param_flow_rules([st.ParamFlowRule("pm", param_idx=0, count=3)])
+    assert "pm" in engine._leases
+    # 2 leased + 1 device-path (prioritized) = the full quota of 3
+    assert st.entry_ok("pm", args=["v"]) is not None
+    assert st.entry_ok("pm", args=["v"]) is not None
+    h = engine.entry("pm", args=["v"], prioritized=True)  # device path
+    assert h is not None
+    # 4th must block HOST-side: the mirror saw the device-path pass
+    assert st.entry_ok("pm", args=["v"]) is None
+
+
+def test_param_lease_items_override_threshold(engine, frozen_time):
+    from sentinel_tpu.models.param_flow import ParamFlowItem
+
+    st.load_param_flow_rules([st.ParamFlowRule(
+        "pi", param_idx=0, count=1,
+        items=[ParamFlowItem(object="vip", count=4)])])
+    assert sum(1 for _ in range(6) if st.entry_ok("pi", args=["vip"])) == 4
+    assert sum(1 for _ in range(3) if st.entry_ok("pi", args=["reg"])) == 1
+
+
+def test_flow_and_param_rules_lease_together(engine, frozen_time):
+    """A resource guarded by a DEFAULT flow rule AND one param rule is
+    fully host-admitted, with the device chain's family order: the
+    param verdict (and its token consumption) lands before flow."""
+    st.load_flow_rules([st.FlowRule(resource="fp", count=4)])
+    st.load_param_flow_rules([st.ParamFlowRule("fp", param_idx=0, count=2)])
+    assert _leased(engine, "fp")
+    # value quota (2) bites first, then the flow quota (4) caps the rest
+    got = [bool(st.entry_ok("fp", args=["v"])) for _ in range(3)]
+    assert got == [True, True, False]
+    with pytest.raises(st.ParamFlowException):
+        st.entry("fp", args=["v"])
+    assert st.entry_ok("fp", args=["w"]) is not None  # 3rd flow pass
+    assert st.entry_ok("fp", args=["x"]) is not None  # 4th flow pass
+    with pytest.raises(st.FlowException):  # flow quota exhausted
+        st.entry("fp", args=["y"])
+
+
+def test_leases_command_reports_widened_coverage(engine, frozen_time):
+    import json
+
+    from sentinel_tpu.transport.command_center import CommandRequest
+    from sentinel_tpu.transport.handlers import cmd_leases
+
+    st.load_flow_rules([
+        st.FlowRule(resource="plain", count=10),
+        st.FlowRule(resource="wz", count=10,
+                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                    warm_up_period_sec=5),
+    ])
+    st.load_param_flow_rules([st.ParamFlowRule("wz", param_idx=0, count=3)])
+    out = json.loads(cmd_leases(CommandRequest(engine=engine)).result)
+    plain = out["resources"]["plain"]
+    assert plain["warmupRules"] == 0 and plain["paramLease"] is False
+    wz = out["resources"]["wz"]
+    assert wz["warmupRules"] == 1 and wz["paramLease"] is True
 
 
 def test_rule_push_does_not_wait_on_device_dispatch(engine, frozen_time):

@@ -133,6 +133,17 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_alert_fired",
                  "sentinel_tpu_step_duration_ms"):
         assert name in seen, f"{name} not declared in the exporters"
+    # pipelined-admission families (ISSUE 8): declared exactly once (the
+    # dupe gate above) and the load-bearing ones exist
+    for name in ("sentinel_tpu_pipeline_active",
+                 "sentinel_tpu_pipeline_inflight_depth",
+                 "sentinel_tpu_pipeline_inflight_depth_max",
+                 "sentinel_tpu_pipeline_cycles",
+                 "sentinel_tpu_pipeline_entries",
+                 "sentinel_tpu_pipeline_fail_open_cycles",
+                 "sentinel_tpu_pipeline_queue_wait_ms",
+                 "sentinel_tpu_pipeline_device_wait_ms"):
+        assert name in seen, f"{name} not declared in the exporters"
 
 
 def test_cluster_ha_config_keys_accessor_only_and_documented():
@@ -217,6 +228,57 @@ def test_overload_config_keys_accessor_only_and_documented():
     undocumented = sorted(k for k in keys if k not in ops)
     assert not undocumented, (
         "overload config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_pipeline_cycle_path_never_allocates_staging_buffers():
+    """The pipeline's cycle path must stage into the recycled
+    ``BatchBufferPool`` (core/batch.py), never allocate: a
+    ``make_entry_batch_np``/``make_exit_batch_np`` call inside
+    core/pipeline.py re-introduces the per-cycle allocation ISSUE 8
+    removed (and, with async dispatch, risks mutating a buffer a live
+    transfer still reads)."""
+    import re
+
+    pattern = re.compile(r"\bmake_(?:entry|exit)_batch_np\s*\(")
+    path = REPO / "sentinel_tpu" / "core" / "pipeline.py"
+    offenders = [f"{path.relative_to(REPO)}:{lineno}"
+                 for lineno, code in _code_lines(path)
+                 if pattern.search(code)]
+    assert not offenders, (
+        "staging-buffer allocation in the pipeline cycle path (acquire "
+        "from BatchBufferPool instead): " + ", ".join(offenders))
+
+
+def test_pipeline_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.pipeline.*`` config key must (a) be defined
+    and read ONLY in core/config.py — the rest of the package goes
+    through the ``SentinelConfig`` accessors — and (b) appear in
+    docs/OPERATIONS.md "Pipelined admission tuning", so the runbook can
+    never silently drift from the knobs the code actually reads (same
+    rule shape as the cluster-HA / overload / SLO gates)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.pipeline\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.pipeline.* literals outside core/config.py "
+        "(use the SentinelConfig pipeline_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no pipeline config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "pipeline config keys missing from docs/OPERATIONS.md: "
         + ", ".join(undocumented))
 
 

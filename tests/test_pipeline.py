@@ -156,3 +156,356 @@ def test_exit_device_failure_never_breaks_caller(engine, frozen_time):
     engine._exit_jit = dying_jit
     h.exit()                            # must not raise
     assert engine.fail_open_count >= 1
+
+
+# -- async double buffering (ISSUE 8) ----------------------------------------
+
+
+def _ticket_fields(engine, resource, count=1, context="t_ctx"):
+    """The fields dict _submit_entry builds, for direct ticket injection
+    (lets tests saturate the collector without blocking callers)."""
+    reg = engine.registry
+    cr, dr, orow, oid = reg.resolve_entry(
+        resource, context, "", reg.entrance_row(context), 0)
+    return dict(cluster_row=cr, dn_row=dr, origin_row=orow, origin_id=oid,
+                origin_named=False, context_id=reg.context_id(context),
+                count=count, prioritized=False, entry_in=False,
+                skip_cluster=False, pre_blocked=False, params=())
+
+
+def test_pipeline_overlaps_cycles_to_configured_depth(engine, frozen_time):
+    """With the queue continuously non-empty (100 tickets, max_batch 8)
+    the collector must dispatch cycle N+1 while N is still in flight —
+    the double buffer engaged — and every verdict must stay exact."""
+    st.load_flow_rules([st.FlowRule(resource="deep", count=50)])
+    engine.warmup((1, 8))
+    pipe = engine.start_pipeline(max_batch=8, linger_s=0.0)
+    try:
+        tickets = [pipe.submit_entry(_ticket_fields(engine, "deep"))
+                   for _ in range(100)]
+        for t in tickets:
+            assert t.done.wait(10.0), "ticket never resolved"
+        reasons = [t.reason for t in tickets]
+        # quota 50: exactly 50 pass, 50 flow-block, in FIFO order
+        assert reasons[:50] == [0] * 50
+        assert all(r == int(C.BlockReason.FLOW) for r in reasons[50:])
+        assert pipe.max_inflight >= 2, "double buffer never engaged"
+        assert pipe.stats()["poolAllocated"] <= len(
+            pipe.pool._free) + pipe.inflight_depth + 2
+    finally:
+        engine.stop_pipeline()
+
+
+def test_pipeline_buffer_pool_recycles(engine, frozen_time):
+    """Steady-state cycles must be allocation-free: after the first few
+    cycles warm the pool, every acquire is a reuse."""
+    st.load_flow_rules([st.FlowRule(resource="pool", count=1e9)])
+    engine.warmup((1, 8))
+    pipe = engine.start_pipeline(max_batch=8, linger_s=0.0)
+    try:
+        for _ in range(6):  # warm: distinct widths allocate once
+            assert st.entry_ok("pool")
+        before = pipe.pool.allocated
+        for _ in range(40):
+            assert st.entry_ok("pool")
+        assert pipe.pool.allocated == before, \
+            "steady-state cycle allocated a fresh staging buffer"
+        assert pipe.pool.reused > 0
+    finally:
+        engine.stop_pipeline()
+
+
+def _run_stream(engine, ops, poison_resource=None):
+    """Drive a deterministic entry/exit stream; returns the verdict list.
+
+    Verdicts: "pass"/exception-class-name per entry op. ``poison``
+    arms a one-shot dispatch failure on the first batch that carries
+    ``poison_resource``'s row (same trigger in sync and pipelined mode,
+    so fail-open parity is comparable)."""
+    import numpy as np
+
+    from sentinel_tpu.utils import time_util
+
+    verdicts = []
+    open_handles = {}
+    armed = {"on": poison_resource is not None}
+    if armed["on"]:
+        prow = engine.registry.cluster_row(poison_resource)
+        orig_jit = engine._entry_jit
+
+        def poisoned(state, rules, batch, now, **kw):
+            if armed["on"] and bool(np.any(
+                    np.asarray(batch.cluster_row) == prow)):
+                armed["on"] = False
+                raise RuntimeError("injected mid-stream dispatch failure")
+            return orig_jit(state, rules, batch, now, **kw)
+
+        engine._entry_jit = poisoned
+    try:
+        for op in ops:
+            if op[0] == "advance":
+                time_util.advance_time(op[1])
+            elif op[0] == "entry":
+                _, key, res, count = op
+                try:
+                    h = st.entry(res, count=count)
+                    verdicts.append("pass")
+                    open_handles[key] = h
+                except st.BlockException as ex:
+                    verdicts.append(type(ex).__name__)
+            elif op[0] == "exit":
+                h = open_handles.pop(op[1], None)
+                if h is not None:
+                    h.exit()
+        for h in open_handles.values():
+            h.exit()
+    finally:
+        if poison_resource is not None:
+            engine._entry_jit = orig_jit
+    return verdicts
+
+
+def _stream_ops(seed: int, n: int = 90):
+    """Randomized mixed entry/exit stream: three resources (QPS quota,
+    THREAD gauge, rate-limited device-path), mixed acquire counts,
+    random holds and time advances."""
+    import random
+
+    rng = random.Random(seed)
+    ops = []
+    live = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.15:
+            ops.append(("advance", rng.choice([1, 40, 300, 1000])))
+        if live and rng.random() < 0.4:
+            k = live.pop(rng.randrange(len(live)))
+            ops.append(("exit", k))
+        res = rng.choice(["sa", "sa", "st_thread", "sr"])
+        ops.append(("entry", i, res, rng.choice([1, 1, 2, 3])))
+        live.append(i)
+    return ops
+
+
+def _stream_rules():
+    return [
+        st.FlowRule(resource="sa", count=25),
+        st.FlowRule(resource="st_thread", count=3,
+                    grade=C.FLOW_GRADE_THREAD),
+        st.FlowRule(resource="sr", count=40,
+                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                    max_queueing_time_ms=0),
+    ]
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_async_pipeline_matches_sync_differential(seed, frozen_time):
+    """ISSUE 8 correctness oracle: the async double-buffered path must
+    produce BIT-IDENTICAL verdicts to the synchronous path over a
+    randomized mixed entry/exit stream (mixed acquire counts exercise
+    the fixpoint regime; the rate-limiter rule keeps a device-only
+    resource in the mix)."""
+    from sentinel_tpu.core.context import replace_context
+    from sentinel_tpu.utils import time_util
+
+    ops = _stream_ops(seed)
+
+    time_util.freeze_time(1_700_000_000_000)  # identical epoch per run:
+    replace_context(None)                     # bucket alignment matters
+    engine = st.reset(capacity=512)
+    st.load_flow_rules(_stream_rules())
+    want = _run_stream(engine, ops)
+
+    time_util.freeze_time(1_700_000_000_000)
+    replace_context(None)
+    engine = st.reset(capacity=512)
+    st.load_flow_rules(_stream_rules())
+    engine.start_pipeline(linger_s=0.0005)
+    try:
+        got = _run_stream(engine, ops)
+    finally:
+        engine.stop_pipeline()
+    assert got == want
+
+
+def test_async_pipeline_mid_stream_fault_parity(frozen_time):
+    """A dispatch death mid-stream must fail open IDENTICALLY in both
+    modes (the poisoned entries pass unguarded, the engine restarts
+    cold, protection resumes) and lose no exit tickets — the THREAD
+    gauge lands back at zero after the stream drains."""
+    from sentinel_tpu.core.context import replace_context
+
+    ops = _stream_ops(31, n=60)
+
+    from sentinel_tpu.utils import time_util
+
+    results = []
+    for pipelined in (False, True):
+        time_util.freeze_time(1_700_000_000_000)  # identical epoch
+        replace_context(None)
+        engine = st.reset(capacity=512)
+        st.load_flow_rules(_stream_rules())
+        # resolve the poison row up front (resolve_entry allocates it)
+        with st.entry("sr"):
+            pass
+        if pipelined:
+            engine.start_pipeline(linger_s=0.0005)
+        try:
+            verdicts = _run_stream(engine, ops, poison_resource="sr")
+        finally:
+            if pipelined:
+                engine.stop_pipeline()
+        assert engine.fail_open_count >= 1, "fault never fired"
+        engine._flush_committer()
+        snap = engine.node_snapshot()
+        # no lost exits: every gauge drained (cold restart zeroes, and
+        # post-fault exits commit against the rebuilt state)
+        for res in ("sa", "st_thread", "sr"):
+            assert snap.get(res, {}).get("curThreadNum", 0) == 0, res
+        results.append(verdicts)
+    assert results[0] == results[1]
+
+
+def test_harvest_failure_fails_tickets_open_and_recovers(engine,
+                                                         frozen_time):
+    """An async compute death surfaces at HARVEST under deferred
+    execution: the cycle's tickets must fail open (callers pass
+    unguarded) and the next cycle must recover on cold state."""
+    st.load_flow_rules([st.FlowRule(resource="hv", count=0)])  # blocks all
+    engine.warmup((1,))
+    engine.start_pipeline(linger_s=0.0)
+    orig = engine.harvest_decisions
+    fired = {"n": 0}
+
+    def dying_harvest(dec):
+        if fired["n"] == 0:
+            fired["n"] = 1
+            from sentinel_tpu.core.engine import DeviceDispatchError
+            with engine._lock:
+                engine._state = None
+            raise DeviceDispatchError("injected harvest death")
+        return orig(dec)
+
+    engine.harvest_decisions = dying_harvest
+    try:
+        before = engine.fail_open_count
+        with st.entry("hv"):  # blocked by count=0 — unless failed open
+            pass
+        assert engine.fail_open_count > before
+        assert engine._pipeline.fail_open_cycles == 1
+        # recovery: harvest healthy again, the count=0 rule enforces
+        engine.harvest_decisions = orig
+        assert st.entry_ok("hv") is None
+    finally:
+        engine.harvest_decisions = orig
+        engine.stop_pipeline()
+
+
+def test_stop_timeout_refuses_inline_drain(engine, frozen_time,
+                                           monkeypatch):
+    """The stop() race fix: when the collector outlives the join budget,
+    stop() must NOT run the inline drain (two threads cycling one
+    engine state = double-drain) — it logs loudly and leaves the
+    straggler to the live collector."""
+    import threading as th
+    import time as _time
+
+    from sentinel_tpu.log.record_log import record_log as rl_obj
+
+    st.load_flow_rules([st.FlowRule(resource="hang", count=1e9)])
+    engine.warmup((1,))
+    pipe = engine.start_pipeline(linger_s=0.0)
+    pipe.join_timeout_s = 0.2
+    release = th.Event()
+    entered = th.Event()
+    orig_cycle = pipe._cycle
+
+    def hanging_cycle(items):
+        entered.set()
+        release.wait(10.0)
+        orig_cycle(items)
+
+    pipe._cycle = hanging_cycle
+    warnings = []
+    monkeypatch.setattr(rl_obj, "warn",
+                        lambda msg, *a: warnings.append(msg % a if a else msg))
+    ticket = pipe.submit_entry(_ticket_fields(engine, "hang"))
+    assert entered.wait(5.0), "collector never picked the ticket up"
+    t0 = _time.perf_counter()
+    engine.stop_pipeline()           # join times out; must refuse drain
+    assert _time.perf_counter() - t0 < 5.0
+    assert any("refusing inline drain" in w for w in warnings), warnings
+    assert not ticket.done.is_set()  # nothing double-drained it
+    release.set()                    # collector finishes; straggler lands
+    assert ticket.done.wait(10.0)
+    assert ticket.reason == 0
+
+
+def test_shutdown_with_cycles_in_flight_resolves_every_ticket(
+        engine, frozen_time):
+    """ISSUE 8 satellite: stop() racing live in-flight cycles must leave
+    every submitted ticket resolved (verdict or -2 fail-open), the
+    in-flight deque empty, and run no harvest after returning."""
+    import time as _time
+
+    st.load_flow_rules([st.FlowRule(resource="sfl", count=1e9)])
+    engine.warmup((1, 8))
+    pipe = engine.start_pipeline(max_batch=8, linger_s=0.0)
+    tickets = [pipe.submit_entry(_ticket_fields(engine, "sfl"))
+               for _ in range(64)]
+    engine.stop_pipeline()           # races the collector mid-stream
+    for t in tickets:
+        assert t.done.is_set(), "ticket unresolved after stop()"
+        assert t.reason == 0 or t.reason == -2
+    assert pipe.inflight_depth_now() == 0
+    assert pipe._thread is None
+    harvests = pipe.harvests
+    _time.sleep(0.05)
+    assert pipe.harvests == harvests, "harvest ran after stop() returned"
+
+
+def test_shutdown_midstream_concurrency_gauge_drains(engine, frozen_time):
+    """Callers racing stop_pipeline() must end with a zero THREAD gauge:
+    entries resolve (pipeline or sync fallback) and exits commit."""
+    st.load_flow_rules([st.FlowRule(resource="sg", count=1e9)])
+    engine.warmup((1, 8))
+    engine.start_pipeline(max_batch=8, linger_s=0.0005)
+    stop_at = 40
+
+    def worker():
+        for _ in range(stop_at):
+            h = st.entry_ok("sg")
+            if h:
+                h.exit()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    engine.stop_pipeline()           # mid-stream
+    for t in threads:
+        t.join()
+    engine._flush_committer()
+    assert engine.node_snapshot()["sg"]["curThreadNum"] == 0
+
+
+def test_pipeline_stats_and_exporter_families(engine, frozen_time):
+    """pipeline_stats() counters are monotone across pipeline
+    generations and the sentinel_tpu_pipeline_* families render."""
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    st.load_flow_rules([st.FlowRule(resource="ps", count=1e9)])
+    engine.start_pipeline(linger_s=0.0)
+    for _ in range(5):
+        assert st.entry_ok("ps")
+    engine.stop_pipeline()
+    first = engine.pipeline_stats()
+    assert first["cycles"] >= 1 and not first["active"]
+    engine.start_pipeline(linger_s=0.0)
+    assert st.entry_ok("ps")
+    second = engine.pipeline_stats()
+    assert second["active"] and second["cycles"] > first["cycles"]
+    engine.stop_pipeline()
+    text = render_engine_metrics(engine)
+    assert "sentinel_tpu_pipeline_cycles_total" in text
+    assert "sentinel_tpu_pipeline_inflight_depth_max" in text
+    assert "sentinel_tpu_pipeline_queue_wait_ms" in text
