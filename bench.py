@@ -398,6 +398,67 @@ def bench_pipeline_steady() -> dict:
     }}
 
 
+def bench_adaptive_loop() -> dict:
+    """Adaptive-loop evaluation overhead riding the once-per-second fold
+    (ISSUE 10): A/B the SAME driven stream with the loop disabled vs
+    enabled-but-steady (targets loaded, senses folding every second, no
+    proposal fires). Reported: wall cost of one per-second judgement
+    refresh (slo_refresh — the fold ride that now also carries the
+    adaptive tick) in both modes, the delta the loop adds, and the
+    dispatch-count guard (per-step device programs MUST be identical:
+    sensing is host arithmetic, like the PR 7 SLO guard)."""
+    import sentinel_tpu as st
+    from sentinel_tpu.adaptive.controller import AdaptiveTarget
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+
+    def run(with_adaptive: bool) -> dict:
+        from sentinel_tpu.core.config import config as _cfg
+
+        _cfg.set("csp.sentinel.adaptive.interval.seconds", "1")
+        eng = st.reset(capacity=4096)
+        st.load_flow_rules([st.FlowRule(resource="adb", count=1e9)])
+        if with_adaptive:
+            eng.adaptive.load_targets([AdaptiveTarget(
+                resource="adb", max_block_rate=0.5)])
+            eng.adaptive.enable()
+        reg = eng.registry
+        buf = make_entry_batch_np(256)
+        buf["cluster_row"][:] = reg.cluster_row("adb")
+        buf["dn_row"][:] = -1
+        buf["count"][:] = 1
+        batch = EntryBatch(**{k: np.asarray(v) for k, v in buf.items()})
+        now = int(time.time() * 1000)
+        eng.check_batch(batch, now_ms=now)  # warm compiles
+        eng.slo_refresh(now_ms=now)
+        refresh_walls = []
+        for sec in range(1, 31):  # 30 simulated seconds
+            now += 1000
+            eng.check_batch(batch, now_ms=now)
+            t0 = time.perf_counter()
+            eng.slo_refresh(now_ms=now)
+            refresh_walls.append((time.perf_counter() - t0) * 1e3)
+        dispatches = {k: v["dispatches"]
+                      for k, v in eng.step_timer.snapshot().items()}
+        ticked = len(eng.adaptive.status()["senses"]) if with_adaptive \
+            else 0
+        return {"refresh_p50_ms": round(float(np.median(refresh_walls)), 4),
+                "refresh_mean_ms": round(float(np.mean(refresh_walls)), 4),
+                "dispatches": dispatches, "sensed": ticked}
+
+    base = run(False)
+    loop = run(True)
+    st.reset(capacity=4096)
+    guard_ok = loop["dispatches"] == base["dispatches"]
+    return {"adaptive_loop": {
+        "refresh_p50_ms_base": base["refresh_p50_ms"],
+        "refresh_p50_ms_adaptive": loop["refresh_p50_ms"],
+        "tick_overhead_mean_ms": round(
+            loop["refresh_mean_ms"] - base["refresh_mean_ms"], 4),
+        "sensed_resources": loop["sensed"],
+        "dispatch_guard_equal": guard_ok,
+    }}
+
+
 def _fused_entry_throughput(rules_builder, batch_builder, capacity=4096,
                             batch_n=4096, scan_steps=8, budget_s=30.0,
                             iters_max=15, iters_min=2) -> float:
@@ -622,7 +683,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_8.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_9.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -820,6 +881,8 @@ def main() -> None:
         out["entry_overhead"] = bench_entry_overhead()
         persist(out)
         out.update(bench_pipeline_steady())
+        persist(out)
+        out.update(bench_adaptive_loop())
         persist(out)
         # BASELINE per-config sections (eval configs #2/#3 + the shim
         # loopback transport): each is individually guarded so one

@@ -14,6 +14,10 @@ public final class TokenResultStatus {
     public static final int NO_RULE_EXISTS = 3;
     public static final int NO_REF_RULE_EXISTS = 4;
     public static final int NOT_AVAILABLE = 5;
+    /** TPU wire extension (not upstream): the token server shed this
+     * request before admission (bounded-queue overload protection).
+     * Clients that predate it treat 6 as unknown -> fallbackToLocal. */
+    public static final int OVERLOADED = 6;
 
     private TokenResultStatus() {
     }

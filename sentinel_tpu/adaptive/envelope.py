@@ -1,0 +1,200 @@
+"""Hard safety envelope for autonomous rule actuation.
+
+"Designing Scalable Rate Limiting Systems" (PAPERS.md) warns that
+adaptive limiters without bounded actuation oscillate; this module is
+the bound. Every invariant lives here, first-class and separately
+testable, so the controller/policy layer (``controller.py``) can be
+swapped for a learned model without re-litigating safety:
+
+* **Floor/ceiling clamps** — a proposed threshold never leaves the
+  target's ``[floor, ceiling]`` band, whatever the policy says.
+* **Bounded step size** — one actuation moves a threshold by at most
+  ``step_pct`` of its current value (with a 1.0 absolute minimum so
+  small integer-ish thresholds can still move at all).
+* **Per-resource cooldown** — after a promoted change, the resource is
+  untouchable for ``cooldown_ms``: the new setting's effect must show
+  up in the flight recorder before it may be re-judged.
+* **Hysteresis (no flapping across the target)** — a proposal that
+  REVERSES the direction of the previous promoted change is rejected
+  for ``flip_cooldown_ms`` (2x the plain cooldown by default): one
+  boundary-straddling sense can never ping-pong a threshold.
+* **Global freeze** (:class:`FreezeGate`) — stale or faulted telemetry,
+  a manual ops freeze, or the post-abort backoff window turn the whole
+  loop read-only: a controller must never actuate on senses it cannot
+  trust, and never re-propose into the blast crater of an abort.
+
+The envelope never talks to the engine or the rollout manager — it is
+pure host arithmetic over explicit inputs, which is what makes the
+invariants testable in isolation (tests/test_adaptive.py drives every
+clause without a device).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+# EnvelopeDecision.reason values (stable strings — the decision log and
+# the ops command surface them verbatim).
+REASON_OK = "ok"
+REASON_FLOOR = "floor"
+REASON_CEILING = "ceiling"
+REASON_STEP = "step"
+REASON_COOLDOWN = "cooldown"
+REASON_FLIP = "hysteresis"
+REASON_NOOP = "no-op"
+
+# FreezeGate reasons, in precedence order (manual beats everything:
+# an operator's freeze must not be re-labelled by a coincident fault).
+FREEZE_MANUAL = "manual"
+FREEZE_DISABLED = "recorder-disabled"
+FREEZE_STALE = "telemetry-stale"
+FREEZE_FAULTED = "telemetry-faulted"
+FREEZE_BACKOFF = "abort-backoff"
+
+
+@dataclass(frozen=True)
+class EnvelopeDecision:
+    """Outcome of one :meth:`SafetyEnvelope.admit` call.
+
+    ``allowed`` — the (possibly clamped) proposal may proceed;
+    ``value`` — the threshold to actually stage (== ``current`` when
+    rejected); ``clamped`` — a clamp changed the policy's ask;
+    ``reason`` — which clause decided (one of the REASON_* constants).
+    """
+
+    allowed: bool
+    value: float
+    clamped: bool
+    reason: str
+
+
+class SafetyEnvelope:
+    """Clamp + cooldown + hysteresis state for one adaptive loop."""
+
+    def __init__(self, step_pct: float, cooldown_ms: int,
+                 flip_cooldown_ms: Optional[int] = None):
+        self.step_pct = float(step_pct)
+        self.cooldown_ms = int(cooldown_ms)
+        # Direction flips wait out a longer window than same-direction
+        # refinement: crossing the target is where oscillation lives.
+        self.flip_cooldown_ms = (int(flip_cooldown_ms)
+                                 if flip_cooldown_ms is not None
+                                 else 2 * int(cooldown_ms))
+        self._lock = threading.Lock()
+        # resource -> (last promoted actuation ms, direction +1/-1)
+        self._last: Dict[str, Tuple[int, int]] = {}
+
+    def admit(self, resource: str, current: float, proposed: float,
+              floor: float, ceiling: float, now_ms: int) -> EnvelopeDecision:
+        """Run one proposal through every clause. Order matters and is
+        part of the contract: cooldown/hysteresis (is actuation allowed
+        AT ALL right now?) before clamps (how far may it go?), so a
+        rejected resource never reports a misleading clamp reason."""
+        with self._lock:
+            last = self._last.get(resource)
+        direction = 1 if proposed > current else -1
+        if last is not None:
+            last_ms, last_dir = last
+            if now_ms - last_ms < self.cooldown_ms:
+                return EnvelopeDecision(False, current, False, REASON_COOLDOWN)
+            if direction != last_dir \
+                    and now_ms - last_ms < self.flip_cooldown_ms:
+                return EnvelopeDecision(False, current, False, REASON_FLIP)
+        if not floor <= current <= ceiling:
+            # The LIVE value sits outside the band (an operator put it
+            # there — e.g. an emergency clamp below the target's floor).
+            # Admitting anything would either invert the ask's direction
+            # (a congestion DECREASE clamped up to the floor is a limit
+            # INCREASE) or stage a value the band forbids; both are
+            # wrong, so the envelope refuses until the operator
+            # reconciles the rule with the target (docs/OPERATIONS.md
+            # "How to pin a resource static").
+            return EnvelopeDecision(
+                False, current, True,
+                REASON_FLOOR if current < floor else REASON_CEILING)
+        value, clamped, reason = proposed, False, REASON_OK
+        # Bounded step first, band second: the band is the HARD invariant
+        # (a floor/ceiling is never exceeded even when the step allows it).
+        max_step = max(abs(current) * self.step_pct, 1.0)
+        if abs(value - current) > max_step:
+            value = current + max_step * direction
+            clamped, reason = True, REASON_STEP
+        if value < floor:
+            value, clamped, reason = floor, True, REASON_FLOOR
+        elif value > ceiling:
+            value, clamped, reason = ceiling, True, REASON_CEILING
+        if value == current:
+            # Fully clamped back to where we already are (pinned at a
+            # band edge, typically): not an actuation.
+            return EnvelopeDecision(False, current, True, REASON_NOOP)
+        return EnvelopeDecision(True, value, clamped, reason)
+
+    def record_actuation(self, resource: str, current: float,
+                         promoted: float, now_ms: int) -> None:
+        """Stamp a PROMOTED change (cooldown + flip guard input).
+        Proposals that die in shadow/canary don't stamp — the post-abort
+        backoff (FreezeGate) covers that quiet period instead."""
+        direction = 1 if promoted > current else -1
+        with self._lock:
+            self._last[resource] = (int(now_ms), direction)
+
+    def cooldown_state(self, now_ms: int) -> Dict[str, Dict]:
+        """Ops view: per-resource cooldown remaining."""
+        with self._lock:
+            items = dict(self._last)
+        out = {}
+        for res, (last_ms, direction) in items.items():
+            remaining = max(0, self.cooldown_ms - (now_ms - last_ms))
+            if remaining > 0:
+                out[res] = {"remainingMs": remaining,
+                            "direction": direction}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._last.clear()
+
+
+@dataclass(frozen=True)
+class FreezeState:
+    frozen: bool
+    reason: Optional[str]  # FREEZE_* constant, None when thawed
+
+
+class FreezeGate:
+    """Global actuation freeze: pure predicate over explicit inputs.
+
+    The loop feeds it what it observed this tick; the gate only decides.
+    Keeping it stateless (beyond nothing at all) means every clause is a
+    one-line truth-table test.
+    """
+
+    def __init__(self, stale_after_ms: int):
+        self.stale_after_ms = int(stale_after_ms)
+
+    def evaluate(self, now_ms: int, *,
+                 manual_frozen: bool,
+                 recorder_enabled: bool,
+                 last_second_ms: int,
+                 fault_delta: int,
+                 backoff_until_ms: int) -> FreezeState:
+        """Precedence: manual > recorder-disabled > stale > faulted >
+        backoff. ``last_second_ms`` is the newest COMPLETE second the
+        flight recorder spilled (<= 0 means none yet — stale by
+        definition); ``fault_delta`` counts fail-open / cluster-fallback
+        events since the previous tick (any > 0 means the telemetry this
+        tick judged may be missing the traffic that mattered most)."""
+        if manual_frozen:
+            return FreezeState(True, FREEZE_MANUAL)
+        if not recorder_enabled:
+            return FreezeState(True, FREEZE_DISABLED)
+        if last_second_ms <= 0 \
+                or now_ms - last_second_ms > self.stale_after_ms:
+            return FreezeState(True, FREEZE_STALE)
+        if fault_delta > 0:
+            return FreezeState(True, FREEZE_FAULTED)
+        if now_ms < backoff_until_ms:
+            return FreezeState(True, FREEZE_BACKOFF)
+        return FreezeState(False, None)

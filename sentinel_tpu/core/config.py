@@ -109,6 +109,25 @@ OVERLOAD_CLIENT_BACKOFF_MS = "csp.sentinel.overload.client.backoff.ms"
 PIPELINE_INFLIGHT_DEPTH = "csp.sentinel.pipeline.inflight.depth"
 PIPELINE_LINGER_US = "csp.sentinel.pipeline.linger.us"
 PIPELINE_POOL_WIDTHS = "csp.sentinel.pipeline.pool.widths"
+# Closed-loop adaptive limiting (sentinel_tpu/adaptive/ — no reference
+# twin: the reference's rules are static until pushed). Every key MUST
+# be read through the accessors below and documented in
+# docs/OPERATIONS.md "Adaptive limiting" (pinned by test_lint).
+# enabled: autonomous actuation is OPT-IN — the loop senses nothing and
+# proposes nothing until this is true (or `adaptive op=enable`).
+ADAPTIVE_ENABLED = "csp.sentinel.adaptive.enabled"
+ADAPTIVE_INTERVAL_SECONDS = "csp.sentinel.adaptive.interval.seconds"
+ADAPTIVE_STEP_PCT = "csp.sentinel.adaptive.step.pct"
+ADAPTIVE_INCREASE_PCT = "csp.sentinel.adaptive.increase.pct"
+ADAPTIVE_DECREASE_PCT = "csp.sentinel.adaptive.decrease.pct"
+ADAPTIVE_HYSTERESIS_PCT = "csp.sentinel.adaptive.hysteresis.pct"
+ADAPTIVE_COOLDOWN_SECONDS = "csp.sentinel.adaptive.cooldown.seconds"
+ADAPTIVE_FREEZE_STALE_SECONDS = "csp.sentinel.adaptive.freeze.stale.seconds"
+ADAPTIVE_ABORT_BACKOFF_SECONDS = "csp.sentinel.adaptive.abort.backoff.seconds"
+ADAPTIVE_SHADOW_SECONDS = "csp.sentinel.adaptive.shadow.seconds"
+ADAPTIVE_CANARY_SECONDS = "csp.sentinel.adaptive.canary.seconds"
+ADAPTIVE_CANARY_BPS = "csp.sentinel.adaptive.canary.bps"
+ADAPTIVE_HISTORY_CAPACITY = "csp.sentinel.adaptive.history.capacity"
 SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
 SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
 SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
@@ -191,6 +210,27 @@ DEFAULT_SLO_BASELINE_MIN_EVENTS = 10
 DEFAULT_ALERT_HISTORY_CAPACITY = 256
 DEFAULT_ALERT_WEBHOOK_TIMEOUT_MS = 2_000
 DEFAULT_ALERT_WEBHOOK_RETRIES = 3
+# Adaptive-limiting defaults. The loop evaluates once per interval on
+# the once-per-second fold ride; one actuation moves a threshold at
+# most step.pct of its current value; cooldown keeps a promoted change
+# untouchable long enough for the flight recorder to show its effect
+# (and the flip guard holds 2x that across the target — the
+# no-oscillation invariant, docs/SEMANTICS.md "Actuation safety
+# envelope"); freeze.stale.seconds is how old the newest complete
+# recorded second may be before the loop refuses to trust its senses;
+# abort.backoff.seconds is the quiet period after ANY auto-abort.
+DEFAULT_ADAPTIVE_INTERVAL_SECONDS = 5
+DEFAULT_ADAPTIVE_STEP_PCT = 0.25
+DEFAULT_ADAPTIVE_INCREASE_PCT = 0.10
+DEFAULT_ADAPTIVE_DECREASE_PCT = 0.30
+DEFAULT_ADAPTIVE_HYSTERESIS_PCT = 0.10
+DEFAULT_ADAPTIVE_COOLDOWN_SECONDS = 30
+DEFAULT_ADAPTIVE_FREEZE_STALE_SECONDS = 5
+DEFAULT_ADAPTIVE_ABORT_BACKOFF_SECONDS = 120
+DEFAULT_ADAPTIVE_SHADOW_SECONDS = 5
+DEFAULT_ADAPTIVE_CANARY_SECONDS = 5
+DEFAULT_ADAPTIVE_CANARY_BPS = 1_000
+DEFAULT_ADAPTIVE_HISTORY_CAPACITY = 256
 
 
 def _env_key(key: str) -> str:
@@ -452,6 +492,71 @@ class SentinelConfig:
         v = self.get_int(ALERT_WEBHOOK_RETRIES,
                          DEFAULT_ALERT_WEBHOOK_RETRIES)
         return v if v >= 0 else DEFAULT_ALERT_WEBHOOK_RETRIES
+
+    # Adaptive-limiting accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.adaptive.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def adaptive_enabled(self) -> bool:
+        return (self.get(ADAPTIVE_ENABLED) or "false").lower() == "true"
+
+    def adaptive_interval_seconds(self) -> int:
+        v = self.get_int(ADAPTIVE_INTERVAL_SECONDS,
+                         DEFAULT_ADAPTIVE_INTERVAL_SECONDS)
+        return v if v > 0 else DEFAULT_ADAPTIVE_INTERVAL_SECONDS
+
+    def adaptive_step_pct(self) -> float:
+        v = self.get_float(ADAPTIVE_STEP_PCT, DEFAULT_ADAPTIVE_STEP_PCT)
+        return v if 0.0 < v <= 1.0 else DEFAULT_ADAPTIVE_STEP_PCT
+
+    def adaptive_increase_pct(self) -> float:
+        v = self.get_float(ADAPTIVE_INCREASE_PCT,
+                           DEFAULT_ADAPTIVE_INCREASE_PCT)
+        return v if v > 0.0 else DEFAULT_ADAPTIVE_INCREASE_PCT
+
+    def adaptive_decrease_pct(self) -> float:
+        v = self.get_float(ADAPTIVE_DECREASE_PCT,
+                           DEFAULT_ADAPTIVE_DECREASE_PCT)
+        return v if 0.0 < v < 1.0 else DEFAULT_ADAPTIVE_DECREASE_PCT
+
+    def adaptive_hysteresis_pct(self) -> float:
+        v = self.get_float(ADAPTIVE_HYSTERESIS_PCT,
+                           DEFAULT_ADAPTIVE_HYSTERESIS_PCT)
+        return v if v >= 0.0 else DEFAULT_ADAPTIVE_HYSTERESIS_PCT
+
+    def adaptive_cooldown_seconds(self) -> int:
+        v = self.get_int(ADAPTIVE_COOLDOWN_SECONDS,
+                         DEFAULT_ADAPTIVE_COOLDOWN_SECONDS)
+        return v if v >= 0 else DEFAULT_ADAPTIVE_COOLDOWN_SECONDS
+
+    def adaptive_freeze_stale_seconds(self) -> int:
+        v = self.get_int(ADAPTIVE_FREEZE_STALE_SECONDS,
+                         DEFAULT_ADAPTIVE_FREEZE_STALE_SECONDS)
+        return v if v > 0 else DEFAULT_ADAPTIVE_FREEZE_STALE_SECONDS
+
+    def adaptive_abort_backoff_seconds(self) -> int:
+        v = self.get_int(ADAPTIVE_ABORT_BACKOFF_SECONDS,
+                         DEFAULT_ADAPTIVE_ABORT_BACKOFF_SECONDS)
+        return v if v >= 0 else DEFAULT_ADAPTIVE_ABORT_BACKOFF_SECONDS
+
+    def adaptive_shadow_seconds(self) -> int:
+        v = self.get_int(ADAPTIVE_SHADOW_SECONDS,
+                         DEFAULT_ADAPTIVE_SHADOW_SECONDS)
+        return v if v >= 0 else DEFAULT_ADAPTIVE_SHADOW_SECONDS
+
+    def adaptive_canary_seconds(self) -> int:
+        v = self.get_int(ADAPTIVE_CANARY_SECONDS,
+                         DEFAULT_ADAPTIVE_CANARY_SECONDS)
+        return v if v >= 0 else DEFAULT_ADAPTIVE_CANARY_SECONDS
+
+    def adaptive_canary_bps(self) -> int:
+        v = self.get_int(ADAPTIVE_CANARY_BPS, DEFAULT_ADAPTIVE_CANARY_BPS)
+        return v if 0 < v <= 10_000 else DEFAULT_ADAPTIVE_CANARY_BPS
+
+    def adaptive_history_capacity(self) -> int:
+        v = self.get_int(ADAPTIVE_HISTORY_CAPACITY,
+                         DEFAULT_ADAPTIVE_HISTORY_CAPACITY)
+        return v if v > 0 else DEFAULT_ADAPTIVE_HISTORY_CAPACITY
 
     def log_dir(self) -> str:
         d = self.get(LOG_DIR)

@@ -332,6 +332,14 @@ class SentinelEngine:
         from sentinel_tpu.slo.manager import SloManager
 
         self.slo = SloManager(self)
+        # Closed-loop adaptive limiting (sentinel_tpu/adaptive/): the
+        # acting half of the loop the SLO engine senses for. Constructed
+        # AFTER rollout (it registers a lifecycle listener) and slo (its
+        # senses read judgement); ticks ride _spill_flight, so the loop
+        # adds zero per-step device work and no background thread.
+        from sentinel_tpu.adaptive.loop import AdaptiveLoop
+
+        self.adaptive = AdaptiveLoop(self)
         # Token-lease fast path (core/lease.py): host-admitted resources +
         # the async stats committer. Rebuilt on every rule push.
         self.lease_enabled = (
@@ -1615,6 +1623,11 @@ class SentinelEngine:
             # failovers, degraded-quota spells — failover state without
             # scraping /metrics.
             "clusterHA": self.cluster.ha_stats(),
+            # Closed-loop adaptive limiting (sentinel_tpu/adaptive/):
+            # enabled/frozen state, in-flight candidate, and the
+            # proposal/promotion/abort counters — what the loop is doing
+            # to the rules, beside what the rules are doing to traffic.
+            "adaptive": self.adaptive.guardrail_state(),
             "probes": {},
         }
         client = self.cluster.token_client
@@ -1780,6 +1793,15 @@ class SentinelEngine:
         # on EVERY spill (even with no fresh seconds: idle decay must
         # resolve alerts without requiring new traffic).
         self.slo.evaluate(now)
+        # The adaptive loop rides the same cadence, AFTER judgement is
+        # current (its freeze gate and proposal alert-gate read it).
+        # Interval-gated + reentry-safe inside; getattr: _spill_flight
+        # is reachable from AdaptiveLoop's own tick during construction
+        # of later engine fields in exotic subclassing, and from the
+        # loop's judgement refresh (which must not recurse).
+        adaptive = getattr(self, "adaptive", None)
+        if adaptive is not None:
+            adaptive.on_spill(now)
 
     def slo_refresh(self, now_ms: Optional[int] = None) -> None:
         """Bring SLO judgement current: land leased commits, fold + spill

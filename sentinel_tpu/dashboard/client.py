@@ -147,6 +147,18 @@ class SentinelApiClient:
             params["limit"] = limit
         return json.loads(self.get(ip, port, "alerts", params))
 
+    def fetch_adaptive(self, ip: str, port: int, op: str = "status",
+                       since_seq: Optional[int] = None,
+                       limit: Optional[int] = None) -> Dict:
+        """Adaptive-loop state (``adaptive`` command): status (default)
+        or the seq-cursored decision log (``op="history"``)."""
+        params: Dict = {"op": op}
+        if since_seq is not None:
+            params["sinceSeq"] = since_seq
+        if limit is not None:
+            params["limit"] = limit
+        return json.loads(self.get(ip, port, "adaptive", params))
+
     def fetch_explain(self, ip: str, port: int,
                       resource: Optional[str] = None,
                       index: int = 0) -> Dict:

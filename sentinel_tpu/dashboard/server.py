@@ -36,6 +36,8 @@ set as a small JSON API plus one static page:
     machines' ``timeseries`` + ``alerts`` commands on a ~1s cadence;
     fetch failures surface as ``event: error`` frames, the stream stays
     up; ``Last-Event-ID`` resumes both cursors after a reconnect)
+  * ``GET  /adaptive.json?app=``              adaptive-loop state: enabled/
+    frozen, in-flight candidate, targets, senses, decision counters
   * ``GET  /alerts.json?app=``                SLO/anomaly alerts: active
     set + transition log (proxies the machines' ``alerts`` command)
   * ``POST /rollout/command?app=&op=``        stage/canary/promote/abort/tick
@@ -237,6 +239,19 @@ class DashboardServer:
         the first healthy machine — like the V1 rule read path."""
         m = self._first_healthy(app)
         return self.api.fetch_rollout(m.ip, m.port, op)
+
+    def get_adaptive(self, app: str, op: str = "status",
+                     since_seq: Optional[int] = None,
+                     limit: Optional[int] = None):
+        """Adaptive-loop read path (``adaptive`` command status or
+        history) from the first healthy machine — the Adaptive panel's
+        source. Read-only: enable/freeze/set go through the machines'
+        command plane directly (the runbook's drill)."""
+        if op not in ("status", "history"):
+            raise ValueError(f"unsupported adaptive op {op!r}")
+        m = self._first_healthy(app)
+        return self.api.fetch_adaptive(m.ip, m.port, op=op,
+                                       since_seq=since_seq, limit=limit)
 
     def get_telemetry(self, app: str, kind: str = "summary",
                       limit: Optional[int] = None):
@@ -481,6 +496,13 @@ class _Handler(BaseHTTPRequestHandler):
                                   OPENMETRICS_CONTENT_TYPE)
             if path == "/telemetry/stream":
                 return self._sse_stream(d, q)
+            if path == "/adaptive.json":
+                since = q.get("sinceSeq")
+                limit = q.get("limit")
+                return self._ok(d.get_adaptive(
+                    q.get("app", ""), op=q.get("op", "status"),
+                    since_seq=int(since) if since else None,
+                    limit=int(limit) if limit else None))
             if path == "/alerts.json":
                 m = d._first_healthy(q.get("app", ""))
                 since = q.get("sinceSeq")

@@ -351,6 +351,55 @@ def slo_objectives_to_json(objectives) -> str:
     return json.dumps([slo_objective_to_dict(o) for o in objectives])
 
 
+# -- adaptive targets (sentinel_tpu/adaptive/ — closed-loop limiting) -------
+#
+# The ``adaptiveTargets`` converter: one JSON array of target objects,
+# pushed through any datasource with ``adaptive_targets_from_json`` as
+# the converter and ``engine.adaptive.load_targets`` as the sink (the
+# ``adaptive`` command's ``op=set`` shares the schema). Absent fields
+# take the dataclass defaults (docs/OPERATIONS.md "Adaptive limiting"):
+#
+#     [{"resource": "getUser", "maxBlockRate": 0.05, "rtP99Ms": 250,
+#       "floor": 50, "ceiling": 5000, "minEntries": 32}]
+
+
+def adaptive_target_from_dict(d: dict) -> "object":
+    from sentinel_tpu.adaptive.controller import (
+        DEFAULT_MIN_ENTRIES, AdaptiveTarget)
+
+    if not isinstance(d, dict):
+        raise ValueError(f"adaptive target must be a JSON object, got {d!r}")
+    defaults = AdaptiveTarget(resource="_")
+    return AdaptiveTarget(
+        resource=str(d.get("resource", "")),
+        max_block_rate=float(d.get("maxBlockRate",
+                                   defaults.max_block_rate)),
+        rt_p99_ms=float(d.get("rtP99Ms", defaults.rt_p99_ms)),
+        floor=float(d.get("floor", defaults.floor)),
+        ceiling=float(d.get("ceiling", defaults.ceiling)),
+        min_entries=int(d.get("minEntries", DEFAULT_MIN_ENTRIES)),
+    ).validate()
+
+
+def adaptive_target_to_dict(t) -> dict:
+    return {
+        "resource": t.resource,
+        "maxBlockRate": t.max_block_rate,
+        "rtP99Ms": t.rt_p99_ms,
+        "floor": t.floor,
+        "ceiling": t.ceiling,
+        "minEntries": t.min_entries,
+    }
+
+
+def adaptive_targets_from_json(source) -> List["object"]:
+    return [adaptive_target_from_dict(d) for d in _loads(source)]
+
+
+def adaptive_targets_to_json(targets) -> str:
+    return json.dumps([adaptive_target_to_dict(t) for t in targets])
+
+
 # -- param flow -------------------------------------------------------------
 
 _CLASS_TYPES = {

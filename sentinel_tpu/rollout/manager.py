@@ -126,6 +126,12 @@ class RolloutManager:
         self._breach_streak = 0
         self._last_sample = None  # np.int64[NUM_SHADOW_COUNTERS] totals
         self._history: deque = deque(maxlen=60)
+        # Lifecycle listeners: fn(event, candidate, reason) fired on
+        # every promote ("promoted") and abort ("aborted") — the
+        # adaptive loop's channel for endings it didn't drive itself.
+        # Fired under the engine config lock: listeners must be
+        # lock-light and NEVER call back into this manager.
+        self._listeners: List = []
 
     @staticmethod
     def _cfg_float(cfg, key: str, default: float) -> float:
@@ -143,6 +149,25 @@ class RolloutManager:
 
     def active_set(self) -> Optional[CandidateSet]:
         return self._sets.get(self._active) if self._active else None
+
+    def candidate(self, name: Optional[str]) -> Optional[CandidateSet]:
+        """Any known candidate set by name, active or ended (the
+        adaptive loop reads ended stages/reasons through this)."""
+        return self._sets.get(name) if name else None
+
+    def add_lifecycle_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def _fire(self, event: str, cand: CandidateSet,
+              reason: Optional[str]) -> None:
+        for fn in self._listeners:
+            try:
+                fn(event, cand, reason)
+            except Exception as ex:  # noqa: BLE001 — a buggy listener
+                # must not break promote/abort (the rule plane).
+                from sentinel_tpu.log.record_log import record_log
+
+                record_log.warn("rollout lifecycle listener failed: %r", ex)
 
     def device_active(self) -> bool:
         """True while a candidate is installed on device (shadow/canary) —
@@ -256,6 +281,7 @@ class RolloutManager:
             self.promotion_epoch += 1
             self._reset_guardrail()
             self._notify()
+            self._fire("promoted", cand, None)
             return {"promoted": name, "epoch": self.promotion_epoch,
                     "rulesLoaded": loaded}
 
@@ -270,6 +296,7 @@ class RolloutManager:
             self._active = None
             self._reset_guardrail()
             self._notify()
+            self._fire("aborted", cand, reason)
             return {"aborted": cand.name, "reason": reason}
 
     def _require_active(self, name: Optional[str]) -> CandidateSet:

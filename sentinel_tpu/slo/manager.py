@@ -410,6 +410,16 @@ class SloManager:
                     and a["severity"] == SEVERITY_PAGE
                     and (resources is None or a["resource"] in resources)]
 
+    def active_alerts_on(self, resources: Set[str]) -> List[Dict]:
+        """EVERY active alert (any kind, any severity) touching the
+        given resources — the adaptive loop's proposal gate. Unlike
+        :meth:`abort_signal`, anomalies DO vote here: a proposal has no
+        canary blast shield yet, so any sign the resource is behaving
+        unusually is reason enough not to start retuning it."""
+        with self._lock:
+            return [dict(a) for a in self._active.values()
+                    if a["resource"] in resources]
+
     def stop(self) -> None:
         self.webhook.stop()
 

@@ -413,6 +413,39 @@ def render_engine_metrics(engine) -> str:
               "Alert events dropped from the full webhook queue",
               wh["dropped"])
 
+    # -- closed-loop adaptive limiting (sentinel_tpu/adaptive/) ----------
+    ad = engine.adaptive.guardrail_state()
+    b.family("sentinel_tpu_adaptive_enabled", "gauge",
+             "1 while the adaptive loop may propose rule retunes")
+    b.sample("sentinel_tpu_adaptive_enabled", None,
+             1 if ad["enabled"] else 0)
+    b.family("sentinel_tpu_adaptive_frozen", "gauge",
+             "1 while the safety envelope holds the loop read-only "
+             "(manual freeze, stale/faulted telemetry, abort backoff)")
+    b.sample("sentinel_tpu_adaptive_frozen", None, 1 if ad["frozen"] else 0)
+    b.counter("sentinel_tpu_adaptive_proposals",
+              "Per-resource rule retunes proposed into a rollout "
+              "candidate since engine start",
+              ad["proposals"])
+    b.counter("sentinel_tpu_adaptive_promotions",
+              "Adaptive candidates promoted into the live rules "
+              "(always through the rollout manager)",
+              ad["promotions"])
+    b.counter("sentinel_tpu_adaptive_aborts",
+              "Adaptive candidates aborted (guardrail, SLO breach, "
+              "freeze, or operator) — each starts the backoff window",
+              ad["aborts"])
+    b.counter("sentinel_tpu_adaptive_clamped",
+              "Policy asks the envelope clamped (step/floor/ceiling) "
+              "or rejected as band-edge no-ops",
+              ad["clamped"])
+    b.family("sentinel_tpu_adaptive_target_delta", "gauge",
+             "Latest sensed block rate minus the target per adaptive "
+             "resource (positive = still blocking above target)")
+    for res, delta in sorted(engine.adaptive.target_deltas().items()):
+        b.sample("sentinel_tpu_adaptive_target_delta",
+                 {"resource": res}, delta)
+
     # -- span sampling health --------------------------------------------
     ssnap = engine.spans.snapshot(limit=0)
     b.counter("sentinel_tpu_spans_seen",

@@ -437,6 +437,65 @@ def cmd_slo(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(str(ex))
 
 
+@command_mapping("adaptive", "closed-loop adaptive limiting: status, "
+                             "enable/freeze, targets, decision log")
+def cmd_adaptive(req: CommandRequest) -> CommandResponse:
+    """Control + status plane of the adaptive loop (sentinel_tpu/
+    adaptive/ — no reference twin). ``op`` selects the action:
+
+      * ``status`` (default) — enabled/frozen state, in-flight
+        candidate, targets, latest senses, cooldowns, counters
+      * ``enable`` / ``disable`` — flip autonomous actuation (disable
+        aborts any in-flight adaptive candidate through the rollout
+        manager)
+      * ``freeze`` / ``unfreeze`` — manual global freeze (+ optional
+        ``reason=``); freeze also aborts any in-flight candidate
+      * ``history`` — seq-cursored decision log: ``sinceSeq=`` returns
+        only entries strictly after the cursor, ``limit=`` caps them
+      * ``get`` / ``set`` — the adaptive targets as JSON (``set`` loads
+        wholesale from ``data=``/body, the ``adaptiveTargets``
+        converter schema — a datasource-bound deployment hot-reloads
+        through that converter instead)
+      * ``tick`` — force one loop evaluation now (drills / tests; the
+        loop normally rides the once-per-second fold at its configured
+        interval)
+    """
+    loop = req.engine.adaptive
+    op = req.get_param("op", "status")
+    try:
+        if op == "status":
+            return CommandResponse.of_success(loop.status())
+        if op == "enable":
+            return CommandResponse.of_success(loop.enable())
+        if op == "disable":
+            return CommandResponse.of_success(loop.disable())
+        if op == "freeze":
+            return CommandResponse.of_success(
+                loop.freeze(reason=req.get_param("reason", "ops")))
+        if op == "unfreeze":
+            return CommandResponse.of_success(loop.unfreeze())
+        if op == "history":
+            since = int(req.get_param("sinceSeq", "0"))
+            limit = req.get_param("limit")
+            return CommandResponse.of_success(loop.history(
+                since_seq=since,
+                limit=int(limit) if limit is not None else None))
+        if op == "get":
+            return CommandResponse.of_success(
+                [CV.adaptive_target_to_dict(t)
+                 for t in loop.controller.targets()])
+        if op == "set":
+            data = req.get_param("data") or req.body
+            targets = CV.adaptive_targets_from_json(data or "[]")
+            loop.load_targets(targets)
+            return CommandResponse.of_success({"loaded": len(targets)})
+        if op == "tick":
+            return CommandResponse.of_success(loop.tick(force=True))
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("metrics", "Prometheus/OpenMetrics exposition")
 def cmd_metrics(req: CommandRequest) -> CommandResponse:
     """``GET /metrics``: the whole engine — attribution counters, RT

@@ -1,0 +1,736 @@
+"""Closed-loop adaptive limiting (sentinel_tpu/adaptive/): envelope
+invariants in isolation, the AIMD policy, converters, the end-to-end
+closed loop (propose -> shadow -> canary -> promote restoring the SLO
+target within bounded steps), the mirror test (guardrail breach ->
+auto-abort restores last-known-good verdict-for-verdict, zero direct
+rule mutations), chaos coverage under FaultInjector (stale telemetry,
+token-server death mid-loop, SLO page mid-canary), the no-oscillation
+property under a step-load change, the ops command, the exporter
+families, and the zero-per-step-device-work A/B guard.
+
+Every engine test runs on a frozen clock: the loop's cadence, soaks,
+cooldowns, and the guardrail windows are all driven explicitly, so the
+suite is deterministic and the "bounded steps" claims are exact."""
+
+import json
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.adaptive.controller import (
+    AdaptiveTarget,
+    AimdPolicy,
+    ResourceSense,
+)
+from sentinel_tpu.adaptive.envelope import (
+    FreezeGate,
+    SafetyEnvelope,
+)
+from sentinel_tpu.core import constants as C
+from sentinel_tpu.datasource import converters as CV
+from sentinel_tpu.utils import time_util
+
+BASE_MS = 1_700_000_000_000
+
+
+@pytest.fixture()
+def engine(frozen_time):
+    """Fresh engine with drill-speed adaptive knobs: 2s cadence/soaks,
+    4s cooldown, x2 steps — every stage transition fits in a few driven
+    seconds. Config restores to defaults on teardown."""
+    from sentinel_tpu.core.config import config
+    from sentinel_tpu.core.context import replace_context
+
+    for k, v in {
+        "csp.sentinel.adaptive.interval.seconds": "2",
+        "csp.sentinel.adaptive.shadow.seconds": "2",
+        "csp.sentinel.adaptive.canary.seconds": "2",
+        "csp.sentinel.adaptive.cooldown.seconds": "4",
+        "csp.sentinel.adaptive.abort.backoff.seconds": "30",
+        "csp.sentinel.adaptive.step.pct": "1.0",
+        "csp.sentinel.adaptive.increase.pct": "1.0",
+        "csp.sentinel.adaptive.freeze.stale.seconds": "5",
+    }.items():
+        config.set(k, v)
+    replace_context(None)
+    eng = st.reset(capacity=512)
+    eng.rollout.min_window_entries = 8
+    yield eng
+    replace_context(None)
+    config.reset_for_tests()
+    st.reset(capacity=512)
+
+
+def _spy_load_rules(eng):
+    """Record every live flow-rule application with its call stack; the
+    zero-direct-mutation assertions read it."""
+    import traceback
+
+    calls = []
+    orig = eng.flow_rules.load_rules
+
+    def spy(rules):
+        calls.append("".join(traceback.format_stack()))
+        return orig(rules)
+
+    eng.flow_rules.load_rules = spy
+    return calls
+
+
+def _drive(eng, resource, per_sec, seconds, now, rt_ms=None):
+    """Drive per_sec-entry batches for N seconds from `now`; optionally
+    complete each second's passed entries with the given RT. Returns
+    the stream-end clock (frozen there)."""
+    from tests.test_telemetry import _batch, _exit_batch
+
+    for _ in range(seconds):
+        time_util.freeze_time(now)
+        dec = eng.check_batch(
+            _batch(eng, [(resource, "", None)] * per_sec), now_ms=now)
+        if rt_ms is not None:
+            passed = int((np.asarray(dec.reason)
+                          == C.BlockReason.PASS).sum())
+            if passed:
+                eng.complete_batch(
+                    _exit_batch(eng, [(resource, "", None)] * passed,
+                                [rt_ms] * passed),
+                    now_ms=now + 900)
+        now += 1000
+    time_util.freeze_time(now)
+    return now
+
+
+def _tick(eng, now):
+    time_util.freeze_time(now)
+    return eng.adaptive.tick(now_ms=now, force=True)
+
+
+def _count_of(eng, resource):
+    return [r.count for r in eng.flow_rules.get_rules()
+            if r.resource == resource][0]
+
+
+# ---------------------------------------------------------------------------
+# envelope invariants, in isolation (no engine, no device)
+# ---------------------------------------------------------------------------
+
+def test_envelope_band_and_step_clamps():
+    env = SafetyEnvelope(step_pct=0.25, cooldown_ms=0)
+    # Step clamp: 100 -> 200 asked, 25% max step -> 125, clamped.
+    d = env.admit("r", 100.0, 200.0, floor=1.0, ceiling=1000.0, now_ms=0)
+    assert d.allowed and d.clamped and d.value == 125.0 and d.reason == "step"
+    # Band beats step: ceiling 110 wins over the 125 the step allows.
+    d = env.admit("r", 100.0, 200.0, floor=1.0, ceiling=110.0, now_ms=0)
+    assert d.allowed and d.clamped and d.value == 110.0
+    assert d.reason == "ceiling"
+    # Floor clamp on a decrease.
+    d = env.admit("r", 100.0, 10.0, floor=90.0, ceiling=1000.0, now_ms=0)
+    assert d.allowed and d.value == 90.0 and d.reason == "floor"
+    # Small thresholds keep an absolute minimum step of 1.0.
+    d = env.admit("r", 2.0, 10.0, floor=1.0, ceiling=100.0, now_ms=0)
+    assert d.value == 3.0  # 2 + max(2*0.25, 1.0)
+    # Fully pinned at the band edge: not an actuation.
+    d = env.admit("r", 110.0, 200.0, floor=1.0, ceiling=110.0, now_ms=0)
+    assert not d.allowed and d.clamped and d.reason == "no-op"
+    assert d.value == 110.0
+    # LIVE value outside the band (operator emergency clamp below the
+    # floor): NOTHING is admitted — clamping a congestion DECREASE up
+    # to the floor would invert it into a 50x limit increase.
+    d = env.admit("r", 1.0, 0.7, floor=50.0, ceiling=1000.0, now_ms=0)
+    assert not d.allowed and d.clamped and d.reason == "floor"
+    assert d.value == 1.0
+    d = env.admit("r", 1.0, 2.0, floor=50.0, ceiling=1000.0, now_ms=0)
+    assert not d.allowed and d.reason == "floor"  # increases too
+    d = env.admit("r", 2000.0, 2500.0, floor=50.0, ceiling=1000.0, now_ms=0)
+    assert not d.allowed and d.reason == "ceiling"
+
+
+def test_envelope_cooldown_and_flip_hysteresis():
+    env = SafetyEnvelope(step_pct=1.0, cooldown_ms=10_000)
+    env.record_actuation("r", 100.0, 150.0, now_ms=0)  # direction +1
+    # Inside the cooldown: any proposal is rejected.
+    d = env.admit("r", 150.0, 200.0, 1.0, 1000.0, now_ms=5_000)
+    assert not d.allowed and d.reason == "cooldown"
+    # Past the cooldown, same direction proceeds...
+    d = env.admit("r", 150.0, 200.0, 1.0, 1000.0, now_ms=12_000)
+    assert d.allowed
+    # ...but a direction FLIP waits out 2x the cooldown.
+    d = env.admit("r", 150.0, 100.0, 1.0, 1000.0, now_ms=12_000)
+    assert not d.allowed and d.reason == "hysteresis"
+    d = env.admit("r", 150.0, 100.0, 1.0, 1000.0, now_ms=21_000)
+    assert d.allowed
+    # Other resources are unaffected throughout.
+    assert env.admit("q", 10.0, 12.0, 1.0, 100.0, now_ms=1).allowed
+    # Ops view reports remaining cooldown.
+    assert "r" in env.cooldown_state(now_ms=4_000)
+    assert env.cooldown_state(now_ms=60_000) == {}
+
+
+def test_freeze_gate_truth_table():
+    gate = FreezeGate(stale_after_ms=5_000)
+
+    def ev(**kw):
+        base = dict(manual_frozen=False, recorder_enabled=True,
+                    last_second_ms=99_000, fault_delta=0,
+                    backoff_until_ms=0)
+        base.update(kw)
+        return gate.evaluate(100_000, **base)
+
+    assert not ev().frozen
+    assert ev(manual_frozen=True).reason == "manual"
+    assert ev(recorder_enabled=False).reason == "recorder-disabled"
+    assert ev(last_second_ms=90_000).reason == "telemetry-stale"
+    assert ev(last_second_ms=0).reason == "telemetry-stale"
+    assert ev(fault_delta=1).reason == "telemetry-faulted"
+    assert ev(backoff_until_ms=100_001).reason == "abort-backoff"
+    # Precedence: manual wins over every other cause.
+    assert ev(manual_frozen=True, last_second_ms=0,
+              fault_delta=5).reason == "manual"
+    # Boundary: exactly stale_after old is NOT stale; backoff expiry is
+    # exclusive (now == until -> thawed).
+    assert not ev(last_second_ms=95_000).frozen
+    assert not ev(backoff_until_ms=100_000).frozen
+
+
+def test_aimd_policy_increase_decrease_deadband():
+    pol = AimdPolicy(increase_pct=0.5, decrease_pct=0.3, hysteresis_pct=0.1)
+    target = AdaptiveTarget(resource="r", max_block_rate=0.10,
+                            rt_p99_ms=100.0, min_entries=10)
+
+    def sense(block_rate, rt=50.0, entries=100, completions=50):
+        blocked = int(entries * block_rate)
+        return ResourceSense(
+            resource="r", seconds=2, passed=entries - blocked,
+            blocked=blocked, completions=completions,
+            block_rate=block_rate, rt_p99_ms=rt)
+
+    # Blocking above target + band with healthy RT -> increase.
+    assert pol.propose(sense(0.30), target, 100.0) == 150.0
+    # Inside the deadband (0.10 + 0.01): no proposal either direction.
+    assert pol.propose(sense(0.105), target, 100.0) is None
+    assert pol.propose(sense(0.0), target, 100.0) is None
+    # RT breach -> multiplicative decrease, even while block rate says
+    # increase (congestion wins).
+    assert pol.propose(sense(0.30, rt=200.0), target, 100.0) == 70.0
+    # RT inside ITS deadband (100 * 1.1) does not trigger decrease.
+    assert pol.propose(sense(0.0, rt=105.0), target, 100.0) is None
+    # Quiet windows don't vote.
+    assert pol.propose(sense(0.5, entries=5), target, 100.0) is None
+    # No RT target -> RT never votes.
+    avail_only = AdaptiveTarget(resource="r", max_block_rate=0.10)
+    assert pol.propose(sense(0.0, rt=9_999.0), avail_only, 100.0) is None
+
+
+def test_adaptive_target_converter_roundtrip_and_validation():
+    t = CV.adaptive_target_from_dict({
+        "resource": "getUser", "maxBlockRate": 0.05, "rtP99Ms": 250,
+        "floor": 50, "ceiling": 5000, "minEntries": 16})
+    d = CV.adaptive_target_to_dict(t)
+    assert CV.adaptive_target_from_dict(d) == t
+    assert json.loads(CV.adaptive_targets_to_json([t]))[0] == d
+    # Defaults fill absent fields.
+    t2 = CV.adaptive_target_from_dict({"resource": "x"})
+    assert t2.max_block_rate == 0.05 and t2.floor == 1.0
+    for bad in (
+        {"resource": ""},                                # no resource
+        {"resource": "x", "maxBlockRate": 1.5},          # rate >= 1
+        {"resource": "x", "floor": 0},                   # floor <= 0
+        {"resource": "x", "floor": 10, "ceiling": 5},    # inverted band
+        {"resource": "x", "rtP99Ms": -1},                # negative RT
+        {"resource": "x", "minEntries": -1},
+        "not-a-dict",
+    ):
+        with pytest.raises(ValueError):
+            CV.adaptive_target_from_dict(bad)
+    with pytest.raises(ValueError):  # duplicate resources reject at load
+        from sentinel_tpu.adaptive.controller import AdaptiveController
+        AdaptiveController(AimdPolicy(0.1, 0.3, 0.1)).load_targets(
+            [AdaptiveTarget(resource="x"), AdaptiveTarget(resource="x")])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end closed loop (the acceptance differential)
+# ---------------------------------------------------------------------------
+
+def test_e2e_closed_loop_restores_target_within_bounded_steps(engine):
+    """Scripted load shift: demand 16/s against a count=4 QPS rule
+    (block rate 0.75). The loop must propose, shadow, canary, and
+    promote retuned rule sets until the block rate is back at/below the
+    0.05 target — and every live-rule write must pass through
+    RolloutManager.promote (zero direct mutations)."""
+    eng = engine
+    calls = _spy_load_rules(eng)
+    st.load_flow_rules([st.FlowRule(resource="ad", count=4)])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="ad", max_block_rate=0.05, floor=1.0, ceiling=64.0,
+        min_entries=8)])
+    eng.adaptive.enable()
+    now = BASE_MS
+    promotions_seen = []
+    # 40 driven seconds is far more than 2 full rollout cycles need;
+    # the loop must converge well inside it.
+    for _ in range(40):
+        now = _drive(eng, "ad", 16, 1, now)
+        _tick(eng, now)
+        if eng.adaptive.promotion_count > len(promotions_seen):
+            promotions_seen.append(_count_of(eng, "ad"))
+        sense = eng.adaptive.status()["senses"].get("ad")
+        if promotions_seen and sense and sense["blockRate"] <= 0.05 \
+                and eng.adaptive.status()["inflight"] is None:
+            break
+    # Converged: 4 -> 8 -> 16 admits the full 16/s demand.
+    assert promotions_seen == [8.0, 16.0]
+    sense = eng.adaptive.status()["senses"]["ad"]
+    assert sense["blockRate"] <= 0.05
+    assert _count_of(eng, "ad") == 16.0
+    # The decision log tells the whole story in order.
+    kinds = [e["kind"] for e in eng.adaptive.history()["events"]]
+    assert kinds.count("propose") >= 2
+    assert kinds.count("canary") >= 2
+    assert kinds.count("promote") == 2
+    # Every live-rule application came from RolloutManager.promote.
+    assert len(calls) >= 3  # initial load + 2 promotions
+    for stack in calls[1:]:
+        assert "rollout/manager.py" in stack and "in promote" in stack, \
+            "live rules written outside RolloutManager.promote"
+    # target_delta gauge went to <= 0 (no work left).
+    assert eng.adaptive.target_deltas()["ad"] <= 0.0
+
+
+def test_mirror_guardrail_abort_restores_last_known_good(engine):
+    """The mirror differential: an RT-target-driven DECREASE candidate
+    blocks more than live, breaches the block-rate-delta guardrail, and
+    auto-aborts — live verdicts must equal the retained last-known-good
+    rule set verdict-for-verdict, with zero non-rollout rule writes."""
+    eng = engine
+    calls = _spy_load_rules(eng)
+    eng.rollout.abort_windows = 2
+    st.load_flow_rules([st.FlowRule(resource="mir", count=8)])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="mir", max_block_rate=0.5, rt_p99_ms=1.0,
+        floor=1.0, ceiling=64.0, min_entries=8)])
+    eng.adaptive.enable()
+    lkg = eng.adaptive.last_known_good()
+    assert lkg["flow"] == eng.flow_rules.get_rules()
+    # Demand 8/s passes fully on live (count=8) but RT p99 ~ 50ms
+    # breaches the absurd 1ms target -> the policy proposes 8 -> 5.6.
+    now = _drive(eng, "mir", 8, 3, BASE_MS, rt_ms=50)
+    out = _tick(eng, now)
+    assert out["status"] == "proposed"
+    name = out["candidate"]
+    # Shadow ticks: baseline, then two breached windows -> auto-abort.
+    statuses = []
+    for _ in range(4):
+        now = _drive(eng, "mir", 8, 1, now, rt_ms=50)
+        statuses.append(_tick(eng, now)["status"])
+        if statuses[-1] == "aborted":
+            break
+    assert "aborted" in statuses
+    cand = eng.rollout.candidate(name)
+    assert cand.stage == "aborted" and "guardrail" in cand.ended_reason
+    # Books: abort counted, backoff armed, LKG verified intact.
+    assert eng.adaptive.abort_count == 1
+    abort_ev = [e for e in eng.adaptive.history()["events"]
+                if e["kind"] == "abort"][0]
+    assert abort_ev["lkgIntact"] is True
+    # Live rules ARE the last-known-good set, field for field...
+    assert eng.flow_rules.get_rules() == lkg["flow"]
+    # ...and verdict-for-verdict: 12 demand against the restored
+    # count=8 admits exactly 8 (the LKG threshold, not the candidate's).
+    from tests.test_telemetry import _batch
+
+    dec = eng.check_batch(_batch(eng, [("mir", "", None)] * 12),
+                          now_ms=now)
+    reasons = np.asarray(dec.reason)
+    assert int((reasons == C.BlockReason.PASS).sum()) == 8
+    assert int((reasons == C.BlockReason.FLOW).sum()) == 4
+    # Zero direct mutations: only the initial load touched the managers.
+    assert len(calls) == 1
+    # Backoff: the unchanged RT breach proposes NOTHING for 30s.
+    now = _drive(eng, "mir", 8, 1, now, rt_ms=50)
+    out = _tick(eng, now)
+    assert out == {"status": "frozen", "reason": "abort-backoff",
+                   "timestamp": now}
+    assert eng.adaptive.proposal_count == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: the loop freezes rather than actuates on bad senses
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_stale_telemetry_freezes_and_aborts_inflight(engine):
+    """Blackholed/stale telemetry mid-rollout: the loop must freeze AND
+    tear its in-flight candidate down through the rollout manager —
+    promoting on senses nobody refreshed would be blind actuation."""
+    eng = engine
+    st.load_flow_rules([st.FlowRule(resource="stale", count=4)])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="stale", max_block_rate=0.05, floor=1.0, ceiling=64.0,
+        min_entries=8)])
+    eng.adaptive.enable()
+    now = _drive(eng, "stale", 16, 3, BASE_MS)
+    out = _tick(eng, now)
+    assert out["status"] == "proposed"
+    name = out["candidate"]
+    # The stream stops: 10 silent seconds > freeze.stale.seconds=5.
+    now += 10_000
+    out = _tick(eng, now)
+    assert out["status"] == "frozen"
+    assert out["reason"] == "telemetry-stale"
+    cand = eng.rollout.candidate(name)
+    assert cand.stage == "aborted"
+    assert "telemetry-stale" in cand.ended_reason
+    assert eng.rollout.active_set() is None
+    # Frozen means READ-ONLY: repeated ticks propose nothing.
+    out = _tick(eng, now + 2_000)
+    assert out["status"] == "frozen"
+    assert eng.adaptive.proposal_count == 1
+    # Traffic resumes -> fresh seconds -> the loop thaws (backoff from
+    # the freeze-abort still applies first — also a freeze state).
+    kinds = [e["kind"] for e in eng.adaptive.history()["events"]]
+    assert "freeze" in kinds and "abort" in kinds
+
+
+@pytest.mark.chaos
+def test_token_server_death_mid_loop_freezes_on_fault_channel(engine):
+    """FaultInjector kills the token-server wire mid-loop: entries
+    degrade to local fallback (counted on the engine's fault channels),
+    and the NEXT tick freezes — the recorded series is missing exactly
+    the traffic that misbehaved, so it must not actuate."""
+    from sentinel_tpu.cluster.constants import THRESHOLD_GLOBAL, \
+        TokenResultStatus
+    from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.resilience import FaultInjector
+
+    eng = engine
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [st.FlowRule(
+        resource="shared", count=1000.0, cluster_mode=True,
+        cluster_config={"flowId": 910, "thresholdType": THRESHOLD_GLOBAL,
+                        "fallbackToLocalWhenFail": True})])
+    service = DefaultTokenService(rules=rules)
+    server = ClusterTokenServer(service=service, host="127.0.0.1").start()
+    try:
+        st.load_flow_rules([st.FlowRule(
+            resource="shared", count=100.0, cluster_mode=True,
+            cluster_config={"flowId": 910,
+                            "thresholdType": THRESHOLD_GLOBAL,
+                            "fallbackToLocalWhenFail": True})])
+        eng.adaptive.load_targets([AdaptiveTarget(
+            resource="shared", max_block_rate=0.5, min_entries=4)])
+        eng.adaptive.enable()
+        eng.cluster.set_to_client("127.0.0.1", server.bound_port,
+                                  request_timeout_s=2.0)
+        import time as _time
+
+        deadline = _time.monotonic() + 5
+        while eng.cluster.client_if_active() is None \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        client = eng.cluster.token_client
+        # Warm the token service jit so the healthy phase is healthy.
+        deadline = _time.monotonic() + 10
+        while client.request_token(910).status != TokenResultStatus.OK \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        # Healthy phase: entries pass remotely, the loop is thawed.
+        now = BASE_MS
+        for _ in range(3):
+            time_util.freeze_time(now)
+            with eng.entry("shared"):
+                pass
+            now += 1000
+        time_util.freeze_time(now)
+        out = _tick(eng, now)
+        assert out["status"] != "frozen", out
+        fallbacks0 = eng.cluster_fallback_count
+        # Token-server death: every subsequent frame write raises.
+        with FaultInjector(seed=7) as inj:
+            inj.arm("cluster.client.send", "error")
+            for _ in range(3):
+                time_util.freeze_time(now)
+                try:
+                    with eng.entry("shared"):
+                        pass
+                except Exception:  # noqa: BLE001 — local verdict may block
+                    pass
+                now += 1000
+        assert eng.cluster_fallback_count > fallbacks0
+        time_util.freeze_time(now)
+        out = _tick(eng, now)
+        assert out["status"] == "frozen"
+        assert out["reason"] == "telemetry-faulted"
+        assert eng.adaptive.proposal_count == 0
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # ~30s of shadow/canary compiles; the rollout-level
+# SLO abort is tier-1 in test_slo.py::test_slo_breach_aborts_rollout and
+# the loop's abort bookkeeping is tier-1 in the mirror test above
+def test_slo_page_mid_canary_aborts_and_backs_off(engine):
+    """An SLO page firing while the adaptive candidate is enforcing its
+    canary slice: the rollout SLO gate aborts IMMEDIATELY (no streak),
+    the loop books the abort and enters backoff."""
+    from sentinel_tpu.slo.objectives import BurnWindow, SloObjective
+
+    eng = engine
+    st.load_flow_rules([st.FlowRule(resource="pg", count=4)])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="pg", max_block_rate=0.05, floor=1.0, ceiling=64.0,
+        min_entries=8)])
+    eng.adaptive.enable()
+    now = _drive(eng, "pg", 16, 3, BASE_MS)
+    assert _tick(eng, now)["status"] == "proposed"
+    name = eng.adaptive.status()["inflight"]["candidate"]
+    # Soak shadow to canary (two healthy windows + the 2s soak).
+    for _ in range(4):
+        now = _drive(eng, "pg", 16, 1, now)
+        out = _tick(eng, now)
+        if out["status"] == "canary":
+            break
+    assert eng.rollout.candidate(name).stage == "canary"
+    # NOW the page arrives: objective loaded mid-flight, the sustained
+    # blocking burns its budget instantly (min_events=1, burn 2x).
+    eng.slo.load_objectives([SloObjective(
+        resource="pg", objective=0.9, min_events=1,
+        windows=(BurnWindow(10, 2, 2.0, "page"),))])
+    now = _drive(eng, "pg", 16, 2, now)
+    out = _tick(eng, now)
+    assert out["status"] == "aborted"
+    cand = eng.rollout.candidate(name)
+    assert cand.stage == "aborted" and "slo:" in cand.ended_reason
+    assert eng.adaptive.abort_count == 1
+    assert eng.adaptive.promotion_count == 0
+    # Live rules never moved; backoff holds.
+    assert _count_of(eng, "pg") == 4.0
+    assert _tick(eng, now + 1000)["reason"] == "abort-backoff"
+
+
+@pytest.mark.slow  # ~30s (a full promote cycle + pinned steady-state);
+# the no-flap invariants are tier-1 at unit level (envelope cooldown /
+# flip-hysteresis / deadband tests above)
+def test_no_oscillation_across_target_under_step_load(engine):
+    """Step-load change with a binding ceiling: the loop walks the
+    threshold UP to the ceiling and then goes quiet — no direction flip,
+    no candidate churn at the band edge (one transition-logged reject),
+    however long the over-target blocking persists."""
+    eng = engine
+    st.load_flow_rules([st.FlowRule(resource="osc", count=32)])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="osc", max_block_rate=0.05, floor=1.0, ceiling=40.0,
+        min_entries=8)])
+    eng.adaptive.enable()
+    # Phase 1: demand 16/s under a 32 limit — inside the deadband,
+    # nothing proposed.
+    now = _drive(eng, "osc", 16, 3, BASE_MS)
+    assert _tick(eng, now)["status"] == "steady"
+    # Phase 2: step to 48/s (3 batches of 16 per second).
+    from tests.test_telemetry import _batch
+
+    def burst(now):
+        time_util.freeze_time(now)
+        for _ in range(3):
+            eng.check_batch(_batch(eng, [("osc", "", None)] * 16),
+                            now_ms=now)
+        return now + 1000
+
+    directions = []
+    last = _count_of(eng, "osc")
+    for _ in range(30):
+        now = burst(now)
+        time_util.freeze_time(now)
+        _tick(eng, now)
+        cur = _count_of(eng, "osc")
+        if cur != last:
+            directions.append(1 if cur > last else -1)
+            last = cur
+    # Walked up to the ceiling, never down: monotone, no flapping.
+    assert directions and all(d == 1 for d in directions)
+    assert _count_of(eng, "osc") == 40.0
+    # Pinned at the ceiling: proposals stopped (clamped no-ops), and
+    # the reject is logged ONCE, not once per tick.
+    rejects = [e for e in eng.adaptive.history()["events"]
+               if e["kind"] == "reject" and e.get("reason") == "no-op"]
+    assert len(rejects) == 1
+    assert eng.adaptive.clamp_count >= 1
+    st_now = eng.adaptive.status()
+    assert st_now["inflight"] is None
+    # Still honest about the residual gap: delta stays positive.
+    assert eng.adaptive.target_deltas()["osc"] > 0
+
+
+def test_active_alert_gates_proposals(engine):
+    """Any active alert on a resource (a page here) vetoes proposals
+    touching it — a proposal has no canary blast shield yet."""
+    from sentinel_tpu.slo.objectives import BurnWindow, SloObjective
+
+    eng = engine
+    st.load_flow_rules([st.FlowRule(resource="al", count=4)])
+    eng.slo.load_objectives([SloObjective(
+        resource="al", objective=0.9, min_events=1,
+        windows=(BurnWindow(10, 2, 2.0, "page"),))])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="al", max_block_rate=0.05, floor=1.0, ceiling=64.0,
+        min_entries=8)])
+    eng.adaptive.enable()
+    now = _drive(eng, "al", 16, 4, BASE_MS)
+    eng.slo_refresh(now_ms=now)
+    assert eng.slo.active_alerts_on({"al"}), "breach never paged"
+    out = _tick(eng, now)
+    assert out["status"] == "steady"  # desire existed but was vetoed
+    assert eng.adaptive.proposal_count == 0
+    rejects = [e for e in eng.adaptive.history()["events"]
+               if e["kind"] == "reject"]
+    assert rejects and rejects[0]["reason"] == "alert-active"
+
+
+def test_operator_candidate_wins_and_disable_aborts(engine):
+    """A human-staged rollout holds the device: the loop skips instead
+    of fighting it. And disable() tears the loop's own candidate down
+    through the rollout manager."""
+    eng = engine
+    st.load_flow_rules([st.FlowRule(resource="op", count=4)])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="op", max_block_rate=0.05, floor=1.0, ceiling=64.0,
+        min_entries=8)])
+    eng.adaptive.enable()
+    eng.rollout.load_candidate(
+        "human-v1", {"flow": [{"resource": "other", "count": 5}]})
+    now = _drive(eng, "op", 16, 3, BASE_MS)
+    out = _tick(eng, now)
+    assert out["status"] == "skipped"
+    assert eng.rollout.active_name == "human-v1"
+    eng.rollout.abort("human-v1")
+    # Now the loop proposes; disable aborts its in-flight candidate.
+    now = _drive(eng, "op", 16, 2, now)
+    out = _tick(eng, now)
+    assert out["status"] == "proposed"
+    name = out["candidate"]
+    eng.adaptive.disable()
+    cand = eng.rollout.candidate(name)
+    assert cand.stage == "aborted" and "disabled" in cand.ended_reason
+    assert _tick(eng, now + 1000) == {"status": "disabled"}
+
+
+# ---------------------------------------------------------------------------
+# surfaces: ops command, exporter, resilience_stats, A/B device guard
+# ---------------------------------------------------------------------------
+
+def test_adaptive_ops_command_roundtrip(engine):
+    from sentinel_tpu.transport.command_center import CommandRequest
+    from sentinel_tpu.transport.handlers import cmd_adaptive
+
+    eng = engine
+
+    def run(params, body=""):
+        resp = cmd_adaptive(CommandRequest(parameters=params, body=body,
+                                           engine=eng))
+        assert resp.success, resp.result
+        return json.loads(resp.result) if resp.result else None
+
+    assert run({"op": "enable"}) == {"enabled": True}
+    out = run({"op": "set"}, body=json.dumps([
+        {"resource": "cmd", "maxBlockRate": 0.1, "floor": 2,
+         "ceiling": 20}]))
+    assert out == {"loaded": 1}
+    got = run({"op": "get"})
+    assert got[0]["resource"] == "cmd" and got[0]["floor"] == 2.0
+    status = run({"op": "status"})
+    assert status["enabled"] and not status["frozen"]
+    assert status["targets"][0]["resource"] == "cmd"
+    assert run({"op": "freeze", "reason": "drill"}) == {"frozen": True}
+    assert run({"op": "status"})["frozen"] is True
+    assert run({"op": "status"})["freezeReason"] == "manual"
+    assert run({"op": "tick"})["status"] == "frozen"
+    assert run({"op": "unfreeze"}) == {"frozen": False}
+    hist = run({"op": "history"})
+    kinds = [e["kind"] for e in hist["events"]]
+    assert "enabled" in kinds and "freeze" in kinds and "unfreeze" in kinds
+    # sinceSeq cursor is strictly-after; limit=0 returns cursor only.
+    assert run({"op": "history", "sinceSeq": str(hist["nextSeq"])})[
+        "events"] == []
+    assert run({"op": "history", "limit": "0"})["events"] == []
+    assert run({"op": "disable"}) == {"enabled": False}
+    bad = cmd_adaptive(CommandRequest(parameters={"op": "nope"},
+                                      engine=eng))
+    assert not bad.success
+    bad = cmd_adaptive(CommandRequest(parameters={"op": "set"},
+                                      body="[{\"resource\": \"\"}]",
+                                      engine=eng))
+    assert not bad.success
+
+
+def test_exporter_renders_adaptive_families(engine):
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    eng = engine
+    st.load_flow_rules([st.FlowRule(resource="mx", count=4)])
+    eng.adaptive.load_targets([AdaptiveTarget(
+        resource="mx", max_block_rate=0.05, floor=1.0, ceiling=64.0,
+        min_entries=8)])
+    eng.adaptive.enable()
+    now = _drive(eng, "mx", 16, 3, BASE_MS)
+    _tick(eng, now)
+    text = render_engine_metrics(eng)
+    assert "sentinel_tpu_adaptive_enabled 1" in text
+    assert "sentinel_tpu_adaptive_frozen 0" in text
+    assert "sentinel_tpu_adaptive_proposals_total 1" in text
+    assert "sentinel_tpu_adaptive_promotions_total 0" in text
+    assert "sentinel_tpu_adaptive_aborts_total 0" in text
+    assert 'sentinel_tpu_adaptive_target_delta{resource="mx"}' in text
+    # resilience_stats carries the same compact slice.
+    ad = eng.resilience_stats()["adaptive"]
+    assert ad["enabled"] and ad["proposals"] == 1
+    assert ad["inflightCandidate"] == "adaptive-1"
+
+
+def test_adaptive_loop_adds_no_device_work():
+    """A/B guard (the bench phase's tier-1 twin): the same driven
+    stream with the loop enabled-but-steady dispatches the SAME device
+    programs as with it disabled — sensing is host arithmetic riding
+    the once-per-second fold."""
+    from tests.test_telemetry import _batch
+
+    def run(with_adaptive):
+        from sentinel_tpu.core.config import config
+        from sentinel_tpu.core.context import replace_context
+
+        config.set("csp.sentinel.adaptive.interval.seconds", "1")
+        replace_context(None)
+        eng = st.reset(capacity=256)
+        st.load_flow_rules([st.FlowRule(resource="ab", count=64)])
+        if with_adaptive:
+            eng.adaptive.load_targets([AdaptiveTarget(
+                resource="ab", max_block_rate=0.5, min_entries=8)])
+            eng.adaptive.enable()
+        now = BASE_MS
+        for _ in range(5):
+            time_util.freeze_time(now)
+            eng._run_entry_batch(_batch(eng, [("ab", "", None)] * 8))
+            eng.slo_refresh(now_ms=now)  # the fold ride ticks the loop
+            now += 1000
+        time_util.freeze_time(now)
+        eng.slo_refresh(now_ms=now)
+        dispatches = {k: v["dispatches"]
+                      for k, v in eng.step_timer.snapshot().items()}
+        sensed = len(eng.adaptive.status()["senses"])
+        return dispatches, sensed
+
+    time_util.freeze_time(BASE_MS)
+    try:
+        base, _ = run(False)
+        with_loop, sensed = run(True)
+    finally:
+        time_util.unfreeze_time()
+        from sentinel_tpu.core.config import config
+
+        config.reset_for_tests()
+        st.reset(capacity=512)
+    assert sensed == 1, "the A/B run never exercised sensing"
+    assert with_loop == base

@@ -133,6 +133,16 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_alert_fired",
                  "sentinel_tpu_step_duration_ms"):
         assert name in seen, f"{name} not declared in the exporters"
+    # adaptive-limiting families (ISSUE 10): declared exactly once (the
+    # dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_adaptive_enabled",
+                 "sentinel_tpu_adaptive_frozen",
+                 "sentinel_tpu_adaptive_proposals",
+                 "sentinel_tpu_adaptive_promotions",
+                 "sentinel_tpu_adaptive_aborts",
+                 "sentinel_tpu_adaptive_clamped",
+                 "sentinel_tpu_adaptive_target_delta"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -313,6 +323,68 @@ def test_slo_config_keys_accessor_only_and_documented():
     assert not undocumented, (
         "SLO/alert config keys missing from docs/OPERATIONS.md: "
         + ", ".join(undocumented))
+
+
+def test_adaptive_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.adaptive.*`` config key must (a) be defined
+    and read ONLY in core/config.py — the rest of the package goes
+    through the ``SentinelConfig`` accessors — and (b) appear in
+    docs/OPERATIONS.md "Adaptive limiting", so the runbook can never
+    silently drift from the knobs the code actually reads (same rule
+    shape as the cluster-HA / overload / SLO / pipeline gates)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.adaptive\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.adaptive.* literals outside core/config.py "
+        "(use the SentinelConfig adaptive_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no adaptive config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "adaptive config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_adaptive_actuates_only_through_the_rollout_manager():
+    """The safety story of sentinel_tpu/adaptive/ is that EVERY rule
+    change rides the staged-rollout lifecycle (shadow evaluation, the
+    block-rate guardrail, the SLO auto-abort). A ``load_rules`` call —
+    or any direct write into an engine rule manager — from inside the
+    adaptive package would be an actuation path with no blast shield;
+    so would constructing its own RolloutManager (a private manager
+    shares no device state with the engine's). Forbid all three."""
+    import re
+
+    patterns = [
+        # the wholesale rule-application entry point every family shares
+        (re.compile(r"\.load_rules\s*\("), "load_rules("),
+        # direct replacement of a rule manager on the engine
+        (re.compile(r"\.(?:flow|degrade|authority|system|param)_rules\s*="),
+         "rule-manager assignment"),
+        (re.compile(r"RolloutManager\s*\("), "private RolloutManager"),
+    ]
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu" / "adaptive").rglob("*.py")):
+        for lineno, code in _code_lines(path):
+            for pattern, what in patterns:
+                if pattern.search(code):
+                    offenders.append(
+                        f"{path.relative_to(REPO)}:{lineno} ({what})")
+    assert not offenders, (
+        "adaptive code must actuate ONLY via the engine's RolloutManager "
+        "(load_candidate/set_stage/promote/abort): " + ", ".join(offenders))
 
 
 @pytest.mark.skipif(shutil.which("ruff") is None,

@@ -717,15 +717,32 @@ def test_fuzz_rate_limiter_mixed_counts_bounded(engine, frozen_time, seed):
 
 class OracleWarmUpWindowed:
     """Serial WarmUpController against the fuzz's OracleWindow (1s/2
-    buckets — matching SPEC_1S), supporting arbitrary timestamps."""
+    buckets — matching SPEC_1S), supporting arbitrary timestamps.
+
+    Sync/threshold arithmetic runs in float32, mirroring the device
+    (compile_flow_rules stores wt/mt/slope as float32 and _sync_warmup /
+    the warm admission are float32 throughout). This is load-bearing:
+    warm-up has positive feedback across seconds (an admission flipped
+    at a float boundary changes the prev-bucket pass count, which
+    changes the next sync's stored tokens, which keeps the thresholds
+    diverged), so a float64 oracle can drift from the device by far
+    more than the per-flip ±1 — seed 31 below accumulated -11 over 50
+    steps. In float32 the oracle IS the device decision-for-decision;
+    the tolerances below only absorb batch-internal ordering."""
+
+    F = np.float32
 
     def __init__(self, count: float, warm_up_sec: int):
         cold = C.COLD_FACTOR
-        self.count = float(count)
-        self.wt = warm_up_sec * count / (cold - 1)
-        self.mt = self.wt + 2.0 * warm_up_sec * count / (1 + cold)
-        self.slope = (cold - 1.0) / count / (self.mt - self.wt)
-        self.stored = 0.0
+        # Constants exactly as compiled: float64 host math, then the
+        # float32 cast the rule tensors apply.
+        wt64 = warm_up_sec * count / (cold - 1)
+        mt64 = wt64 + 2.0 * warm_up_sec * count / (1 + cold)
+        self.count = self.F(count)
+        self.wt = self.F(wt64)
+        self.mt = self.F(mt64)
+        self.slope = self.F((cold - 1.0) / count / max(mt64 - wt64, 1e-9))
+        self.stored = self.F(0.0)
         self.last_filled = 0
         self.win = OracleWindow()
 
@@ -733,30 +750,33 @@ class OracleWarmUpWindowed:
         idx = ((now_ms // 500) - 1) % 2
         ws = (now_ms - now_ms % 500) - 500
         if self.win.starts[idx] == ws:
-            return float(self.win.counts[idx])
-        return 0.0
+            return self.F(self.win.counts[idx])
+        return self.F(0.0)
 
     def sync(self, now_ms):
+        F = self.F
         cold = C.COLD_FACTOR
         now_sec = now_ms // 1000 * 1000
         if now_sec <= self.last_filled:
             return
         prev_pass = self._prev_bucket_pass(now_ms)
         stored = self.stored
-        refill = stored + (now_sec - self.last_filled) / 1000.0 * self.count
+        elapsed_s = F(now_sec - self.last_filled) / F(1000.0)
+        refill = stored + elapsed_s * self.count
         below = stored < self.wt
         above = stored > self.wt
-        if below or (above and prev_pass < self.count / cold):
+        if below or (above and prev_pass < self.count / F(cold)):
             stored = refill
         stored = min(stored, self.mt)
-        stored = max(stored - prev_pass, 0.0)
+        stored = max(F(stored - prev_pass), F(0.0))
         self.stored = stored
         self.last_filled = now_sec
 
     def threshold(self):
+        F = self.F
         if self.stored >= self.wt:
-            return 1.0 / ((self.stored - self.wt) * self.slope
-                          + 1.0 / self.count)
+            return F(1.0) / (F(self.stored - self.wt) * self.slope
+                             + F(1.0) / self.count)
         return self.count
 
     def try_acquire(self, now_ms):
