@@ -634,6 +634,113 @@ def bench_native_token_loopback() -> dict:
         server.stop()
 
 
+def bench_wire_mesh() -> dict:
+    """ISSUE 11 acceptance: end-to-end wire QPS at mesh concurrency —
+    64 pipelined TLV connections through the reactor frontend over real
+    loopback sockets, each keeping a 64-request burst in flight. This
+    is the first honest network-inclusive throughput number (BENCH_9's
+    `native_token_loopback` measured the serial thread-per-connection
+    path at ~504 acquires/s; the target here is ≥20x that). Client
+    frames are pre-encoded per thread, so the measurement is the
+    server's wire path + device amortization, not client encode cost."""
+    import socket as _socket
+
+    import sentinel_tpu as st
+    from sentinel_tpu.cluster import codec
+    from sentinel_tpu.cluster.constants import MSG_FLOW
+    from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+    n_threads, conns_per_thread, burst = 8, 8, 64
+    n_conns = n_threads * conns_per_thread
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [
+        st.FlowRule(resource=f"wm{i}", count=1e9, cluster_mode=True,
+                    cluster_config={"flowId": 6000 + i, "thresholdType": 1})
+        for i in range(64)
+    ])
+    # Per-namespace limiter lifted: this phase measures the wire path,
+    # not the server's self-protection cap.
+    svc = DefaultTokenService(rules, max_allowed_qps=1e12)
+    for w in (burst, 256, 1024, 4096):  # absorb the coalesce-width jits
+        svc.request_tokens([(6000, 1, False)] * w)
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+    stop = threading.Event()
+    replies = [0] * n_threads
+    ok = [0] * n_threads
+    barrier = threading.Barrier(n_threads + 1)
+
+    def worker(tid: int) -> None:
+        conns = []
+        try:
+            for c in range(conns_per_thread):
+                s = _socket.create_connection(
+                    ("127.0.0.1", server.bound_port), timeout=10)
+                s.settimeout(10)
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                conns.append((s, codec.FrameReader()))
+            frames = b"".join(
+                codec.encode_request(
+                    xid + 1, MSG_FLOW,
+                    codec.encode_flow_request(
+                        6000 + (tid * conns_per_thread + xid) % 64, 1, False))
+                for xid in range(burst))
+            barrier.wait()
+            while not stop.is_set():
+                for s, _ in conns:
+                    s.sendall(frames)
+                for s, reader in conns:
+                    got = 0
+                    while got < burst:
+                        data = s.recv(65536)
+                        if not data:
+                            return
+                        for body in reader.feed(data):
+                            resp = codec.decode_response(body)
+                            got += 1
+                            replies[tid] += 1
+                            if resp.status == 0:
+                                ok[tid] += 1
+        except OSError:
+            pass
+        finally:
+            for s, _ in conns:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    time.sleep(4.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    wall = time.perf_counter() - t0
+    wire = server.wire_stats() or {}
+    server.stop()
+    return {"wire_mesh": {
+        "acquires_per_sec": round(sum(replies) / wall, 1),
+        "ok_per_sec": round(sum(ok) / wall, 1),
+        "connections": n_conns,
+        "pipelined_per_conn": burst,
+        "coalesced_batch_p50": wire.get("coalescedBatchP50", 0),
+        "coalesced_batch_max": wire.get("coalescedBatchMax", 0),
+        "rtt_p50_ms": wire.get("rttP50Ms", 0.0),
+        "rtt_p99_ms": wire.get("rttP99Ms", 0.0),
+        "coalesce_wait_p50_ms": wire.get("coalesceWaitP50Ms", 0.0),
+        "queue_wait_p50_ms": wire.get("queueWaitP50Ms", 0.0),
+        "fused_batches": wire.get("fusedBatches", 0),
+        "vs_bench9_loopback": round(
+            sum(replies) / wall / 503.7, 1),  # BENCH_9 serial baseline
+    }}
+
+
 def _probe_backend(timeout_s: float = 90.0):
     """Probe jax backend init in a SUBPROCESS: when the axon tunnel is
     down, ``jax.devices()`` blocks forever inside ``make_c_api_client``
@@ -683,7 +790,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_9.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_10.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -888,7 +995,7 @@ def main() -> None:
         # loopback transport): each is individually guarded so one
         # failure costs its own row, not the record.
         for section in (bench_degrade_1k, bench_param_cms_100k,
-                        bench_native_token_loopback):
+                        bench_native_token_loopback, bench_wire_mesh):
             try:
                 out.update(section())
             except Exception as ex:  # noqa: BLE001

@@ -34,6 +34,32 @@ class _GarbageFrame(Exception):
     a connection loss (internal to the read loop)."""
 
 
+class _Gather:
+    """Shared completion latch for one pipelined batch (ISSUE 11): every
+    xid of the batch registers THIS object in ``_pending`` instead of
+    its own ``threading.Event`` — ``set()`` counts a response down and
+    wakes the waiter once, when the LAST response (or drop) lands. One
+    wakeup per batch, not per request; duck-types the per-request Event
+    for the read loop and ``_drop_connection``, which only call set()."""
+
+    __slots__ = ("_event", "_remaining", "_lock")
+
+    def __init__(self, n: int):
+        self._event = threading.Event()
+        self._remaining = n
+        self._lock = threading.Lock()
+
+    def set(self) -> None:
+        with self._lock:
+            self._remaining -= 1
+            if self._remaining > 0:
+                return
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
 _CONFIG_GATE = object()  # default marker: build the HealthGate from config
 
 
@@ -287,13 +313,19 @@ class ClusterTokenClient:
         if trace is not None:
             entity = codec.append_trace_tlv(entity, trace.traceparent())
         resp = self._gated_call(MSG_FLOW, entity, timeout_s, gate_neutral)
+        return self._flow_result(resp, traced=trace is not None)
+
+    def _flow_result(self, resp: Optional[codec.Response],
+                     traced: bool = False) -> TokenResult:
+        """Decode one FLOW response (epoch fence, OVERLOADED retry-after,
+        span TLV) — shared by the per-request and pipelined paths."""
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
         if self._epoch_stale(resp.entity, codec.FLOW_RESP_SIZE):
             return TokenResult(TokenResultStatus.FAIL)
         remaining, wait_ms = codec.decode_flow_response(resp.entity)
         span = (self._read_server_span(resp.entity, codec.FLOW_RESP_SIZE)
-                if trace is not None else None)
+                if traced else None)
         if resp.status in (TokenResultStatus.SHOULD_WAIT,
                            TokenResultStatus.OVERLOADED):
             # OVERLOADED is a shed, not a verdict: waitMs carries the
@@ -304,6 +336,75 @@ class ClusterTokenClient:
                                server_span=span)
         return TokenResult(resp.status, remaining=remaining,
                            server_span=span)
+
+    def request_tokens_pipelined(self, requests: Sequence[Tuple],
+                                 timeout_s: Optional[float] = None,
+                                 gate_neutral: bool = False):
+        """Batched acquires with >1 request in flight on ONE socket
+        (ISSUE 11): every request gets its own xid, all frames go out as
+        ONE coalesced write, and responses are matched back by xid in
+        any arrival order — the old path serialized send+wait per call,
+        so a single connection could never keep the server's coalescing
+        collector fed. Requests are ``(flow_id, count, prioritized)``
+        tuples; returns one TokenResult per request, in request order.
+
+        Semantics are per-request identical to :meth:`request_token`
+        (epoch fencing, OVERLOADED retry-after, FAIL on drop/timeout);
+        the health gate is consulted once for the batch and fed one
+        outcome: success if any response arrived, failure (unless
+        ``gate_neutral``) if none did."""
+        n = len(requests)
+        if n == 0:
+            return []
+        gate = self.health_gate
+        if gate is not None and not gate.allow():
+            return [TokenResult(TokenResultStatus.FAIL)] * n
+        gather = _Gather(n)
+        xids = []
+        frames = []
+        boxes = []
+        with self._lock:
+            sock = self._sock
+            if sock is None:
+                return [TokenResult(TokenResultStatus.FAIL)] * n
+            for flow_id, count, prioritized in requests:
+                xid = next(self._xid)
+                box: dict = {}
+                try:
+                    frames.append(codec.encode_request(
+                        xid, MSG_FLOW, codec.encode_flow_request(
+                            flow_id, count, prioritized)))
+                except (ValueError, struct.error):
+                    # Oversized/garbage request: pre-resolved FAIL slot,
+                    # never registered — the gather shrinks accordingly.
+                    gather.set()
+                    boxes.append(None)
+                    xids.append(None)
+                    continue
+                self._pending[xid] = (gather, box)
+                xids.append(xid)
+                boxes.append(box)
+        try:
+            faults.fire("cluster.client.send")
+            with self._send_lock:  # frames must not interleave on the wire
+                sock.sendall(b"".join(frames))
+        except OSError:
+            self._drop_connection()  # sets the gather for every pending xid
+        wait_s = self.request_timeout_s if timeout_s is None \
+            else min(timeout_s, self.request_timeout_s)
+        gather.wait(wait_s)
+        with self._lock:
+            for xid in xids:
+                if xid is not None:
+                    self._pending.pop(xid, None)
+        out = [self._flow_result(box.get("resp")) if box is not None
+               else TokenResult(TokenResultStatus.FAIL) for box in boxes]
+        if gate is not None:
+            if any(b is not None and "resp" in b for b in boxes):
+                gate.record_success()
+            elif not gate_neutral:
+                gate.record_failure()
+        return out
 
     def request_param_token(self, flow_id: int, count: int, params: Sequence,
                             timeout_s: Optional[float] = None,

@@ -44,6 +44,15 @@ class Request(NamedTuple):
     msg_type: int
     entity: bytes
 
+    def materialized(self) -> "Request":
+        """A Request whose entity owns its bytes: zero-copy decode hands
+        out memoryview entities aliasing the recv chunk, which must be
+        materialized before crossing a thread (the reactor's worker
+        hand-off) or outliving the chunk."""
+        if isinstance(self.entity, memoryview):
+            return self._replace(entity=bytes(self.entity))
+        return self
+
 
 class Response(NamedTuple):
     xid: int
@@ -93,6 +102,63 @@ class FrameReader:
                 break
             frames.append(bytes(self._buf[_LEN.size:_LEN.size + length]))
             del self._buf[:_LEN.size + length]
+        return frames
+
+
+class FrameScanner:
+    """Zero-copy incremental frame splitter (the reactor ingest path).
+
+    Where :class:`FrameReader` appends every chunk into one rolling
+    ``bytearray`` and copies every frame body out of it (two copies per
+    frame, O(buffer) deletes), ``feed`` returns ``memoryview`` slices
+    INTO the fed chunk for every frame that lies wholly inside it — zero
+    copies on the hot path. Only a frame split across reads is stitched,
+    and the stitch copies exactly the partial bytes, never the whole
+    buffer. All entity decoders read via ``struct.unpack_from``, which
+    accepts memoryviews directly.
+
+    Contract: the yielded views alias the chunk's buffer, so callers
+    must finish decoding them (or materialize with ``bytes()``) before
+    reusing the chunk.
+    """
+
+    __slots__ = ("_carry",)
+
+    def __init__(self):
+        self._carry = bytearray()  # partial trailing frame, if any
+
+    def feed(self, chunk: bytes) -> List[memoryview]:
+        frames: List[memoryview] = []
+        n = len(chunk)
+        pos = 0
+        carry = self._carry
+        if carry:
+            # Finish the split frame first: top the carry up to a full
+            # header, then to the full frame, taking only what's needed.
+            if len(carry) < _LEN.size:
+                take = min(_LEN.size - len(carry), n)
+                carry.extend(memoryview(chunk)[:take])
+                pos = take
+                if len(carry) < _LEN.size:
+                    return frames
+            need = _LEN.size + ((carry[0] << 8) | carry[1]) - len(carry)
+            if need > 0:
+                take = min(need, n - pos)
+                carry.extend(memoryview(chunk)[pos:pos + take])
+                pos += take
+                if take < need:
+                    return frames
+            frames.append(memoryview(bytes(carry))[_LEN.size:])
+            carry.clear()
+        mv = memoryview(chunk)
+        while n - pos >= _LEN.size:
+            end = pos + _LEN.size + ((chunk[pos] << 8) | chunk[pos + 1])
+            if end > n:
+                break
+            frames.append(mv[pos + _LEN.size:end])
+            pos = end
+        if pos < n:
+            carry.extend(mv[pos:])
         return frames
 
 
@@ -171,15 +237,16 @@ def append_trace_tlv(entity: bytes, value: str) -> bytes:
 
 def read_trace_tlv(entity: bytes, offset: int) -> Optional[str]:
     """The TLV's utf-8 value at ``offset`` (= the entity's fixed size),
-    or None when absent/garbled."""
+    or None when absent/garbled. Accepts memoryview entities (the
+    zero-copy reactor path) as well as bytes."""
     if offset < 0 or len(entity) < offset + _TLV_HEAD.size:
         return None
     tag, n = _TLV_HEAD.unpack_from(entity, offset)
     if tag != TLV_TRACE or len(entity) < offset + _TLV_HEAD.size + n:
         return None
     try:
-        return entity[offset + _TLV_HEAD.size:
-                      offset + _TLV_HEAD.size + n].decode("utf-8")
+        return bytes(entity[offset + _TLV_HEAD.size:
+                            offset + _TLV_HEAD.size + n]).decode("utf-8")
     except UnicodeDecodeError:
         return None
 
@@ -219,7 +286,7 @@ def encode_ping(namespace: str) -> bytes:
 
 def decode_ping(entity: bytes) -> str:
     n = entity[0] if entity else 0
-    return entity[1:1 + n].decode("utf-8")
+    return bytes(entity[1:1 + n]).decode("utf-8")
 
 
 def encode_flow_request(flow_id: int, count: int, prioritized: bool) -> bytes:
@@ -281,7 +348,8 @@ def decode_params(entity: bytes, offset: int = 0) -> Tuple[list, int]:
         else:
             (length,) = struct.unpack_from(">H", entity, offset)
             offset += 2
-            params.append(entity[offset:offset + length].decode("utf-8"))
+            params.append(bytes(entity[offset:offset + length])
+                          .decode("utf-8"))
             offset += length
     return params, offset
 
@@ -326,7 +394,8 @@ def _unpack_str8(entity: bytes, offset: int) -> Tuple[str, int]:
     # Tolerant receive (strict send): a peer that DID split a multibyte
     # char must cost itself one mangled name, not the connection — which
     # carries other threads' live entries.
-    return (entity[offset + 1:offset + 1 + n].decode("utf-8", "replace"),
+    return (bytes(entity[offset + 1:offset + 1 + n]).decode("utf-8",
+                                                            "replace"),
             offset + 1 + n)
 
 

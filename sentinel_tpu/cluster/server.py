@@ -69,10 +69,18 @@ class _Batcher:
                  crash_cb=None, max_queue_groups: Optional[int] = None,
                  watermark_pct: Optional[int] = None,
                  deadline_ms: Optional[int] = None,
-                 retry_after_ms: Optional[int] = None):
+                 retry_after_ms: Optional[int] = None,
+                 inflight_depth: Optional[int] = None):
         self.service = service
         self.linger_s = linger_s
         self.max_batch = max_batch
+        # Pipelined drain (ISSUE 11): up to this many fused batches ride
+        # the device stream at once via the token service's enqueue-only
+        # dispatch/harvest split (the PR 8 pattern). Depth 1 (or a
+        # service without dispatch_tokens) is the old synchronous drain.
+        self.inflight_depth = int(
+            inflight_depth if inflight_depth is not None
+            else config.wire_inflight_depth())
         # Leader-crash seam (resilience/faults.py "cluster.ha.leader.crash"):
         # fired per drained batch; when armed, ``crash_cb`` hard-kills the
         # owning server — the chaos suite's process-crash analog.
@@ -89,6 +97,16 @@ class _Batcher:
                                   else config.overload_retry_after_ms())
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.max_queue_groups)
         self._stats_lock = threading.Lock()
+        # Submit-time sheds are terminal and identical for every caller,
+        # so they share ONE pre-set Event and ONE immutable box — the
+        # shed path allocates NOTHING per request or per group (the
+        # ISSUE 11 wakeup/allocation-storm fix, pinned by test_wire's
+        # allocation-count test). Admitted groups still get their own
+        # event: one wakeup per GROUP, never per request.
+        self._shed_done = threading.Event()
+        self._shed_done.set()
+        self._shed_box = {"shed_retry_after_ms": self.retry_after_ms}
+        self.groups_allocated = 0
         self.admitted_groups = 0
         self.admitted_requests = 0
         self.shed_watermark = 0
@@ -107,29 +125,36 @@ class _Batcher:
         box["shed_retry_after_ms"] = self.retry_after_ms
         done.set()
 
+    def _shed_fast(self, n_requests: int, cause: str):
+        """Submit-time shed: counters only — the reply rides the SHARED
+        pre-set event + immutable box (zero allocations per shed)."""
+        with self._stats_lock:
+            setattr(self, cause, getattr(self, cause) + 1)
+            self.shed_requests += n_requests
+        return self._shed_done, self._shed_box
+
     def submit_many(self, requests, budget: Optional[DeadlineBudget] = None):
         """One group: ``(done_event, box)``; ``box["results"]`` carries
         one TokenResult per request (absent on a failed device call), or
         ``box["shed_retry_after_ms"]`` when the group was shed instead of
         admitted. ``budget`` is the group's remaining deadline (defaults
         to the configured overload deadline)."""
-        done = threading.Event()
-        box = {}
         reqs = list(requests)
-        if budget is None:
-            budget = DeadlineBudget(self.deadline_ms)
         # Watermark shed: past the high-water mark the queue is already
         # deeper than a healthy drain can clear inside a deadline, so an
         # explicit "not now" beats silently joining the backlog.
         if self._queue.qsize() >= self.watermark_groups:
-            self._shed(box, done, len(reqs), "shed_watermark")
-            return done, box
+            return self._shed_fast(len(reqs), "shed_watermark")
+        if budget is None:
+            budget = DeadlineBudget(self.deadline_ms)
+        done = threading.Event()
+        box: dict = {}
         try:
             self._queue.put_nowait((reqs, done, box, budget))
         except queue.Full:
-            self._shed(box, done, len(reqs), "shed_queue_full")
-            return done, box
+            return self._shed_fast(len(reqs), "shed_queue_full")
         with self._stats_lock:
+            self.groups_allocated += 1
             self.admitted_groups += 1
             self.admitted_requests += len(reqs)
             depth = self._queue.qsize()
@@ -169,11 +194,42 @@ class _Batcher:
         self._thread.start()
         return self
 
+    def _fail(self, groups) -> None:
+        for _reqs, done, _box, _budget in groups:
+            done.set()  # empty box -> handler replies FAIL
+
+    def _complete(self, groups, results) -> None:
+        off = 0
+        for reqs, done, box, _budget in groups:
+            box["results"] = results[off:off + len(reqs)]
+            off += len(reqs)
+            done.set()
+
+    def _harvest(self, ticket, groups, n_flat: int) -> None:
+        """Resolve one in-flight fused batch: the np readback happens
+        here, outside the service lock — an async device death fails
+        exactly this batch's groups (the drain loop keeps running)."""
+        try:
+            results = self.service.harvest_tokens(ticket)[:n_flat]
+        except Exception as ex:  # noqa: BLE001 — poison harvest
+            from sentinel_tpu.log.record_log import record_log
+
+            record_log.warn("token batch harvest failed: %r", ex)
+            self._fail(groups)
+            return
+        self._complete(groups, results)
+
     def _run(self):
+        from collections import deque
+
+        # In-flight fused batches (ticket, groups, n_flat), oldest first.
+        inflight: "deque" = deque()
         while not self._stop.is_set():
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
+                while inflight:  # idle: resolve whatever still rides
+                    self._harvest(*inflight.popleft())
                 continue
             groups = [first]
             try:
@@ -231,21 +287,45 @@ class _Batcher:
             # None flow id -> slot -1 -> NO_RULE_EXISTS, get sliced off.
             n_flat = len(flat)
             width = pad_width(n_flat)
+            padded = flat + [(None, 0, False)] * (width - n_flat)
+            dispatch = getattr(self.service, "dispatch_tokens", None)
+            if dispatch is None or self.inflight_depth <= 1:
+                # Synchronous drain: services without the dispatch/
+                # harvest split (stubs), or depth pinned to 1.
+                try:
+                    results = self.service.request_tokens(padded)[:n_flat]
+                except Exception as ex:  # a poison batch must not kill the loop
+                    from sentinel_tpu.log.record_log import record_log
+
+                    record_log.warn("token batch failed: %r", ex)
+                    self._fail(groups)
+                    continue
+                self._complete(groups, results)
+                continue
+            # Pipelined drain: keep at most inflight_depth fused batches
+            # on the device stream. Each dispatch consumes the DONATED
+            # previous state, so execution order is forced by the data
+            # dependency — verdicts stay bit-identical to the sync drain
+            # (same argument as docs/SEMANTICS.md "Pipeline ordering").
+            while len(inflight) >= self.inflight_depth:
+                self._harvest(*inflight.popleft())
             try:
-                results = self.service.request_tokens(
-                    flat + [(None, 0, False)] * (width - n_flat))[:n_flat]
-            except Exception as ex:  # a poison batch must not kill the loop
+                ticket = dispatch(padded)
+            except Exception as ex:  # a poison dispatch must not kill the loop
                 from sentinel_tpu.log.record_log import record_log
 
-                record_log.warn("token batch failed: %r", ex)
-                for _reqs, done, _box, _budget in groups:
-                    done.set()  # empty box -> handler replies FAIL
+                record_log.warn("token batch dispatch failed: %r", ex)
+                self._fail(groups)
                 continue
-            off = 0
-            for reqs, done, box, _budget in groups:
-                box["results"] = results[off:off + len(reqs)]
-                off += len(reqs)
-                done.set()
+            inflight.append((ticket, groups, n_flat))
+            if self._queue.empty():
+                # Idle queue ⇒ immediate harvest: the no-concurrency
+                # latency floor stays one step, overlap only engages
+                # when there is follow-on work to overlap with.
+                while inflight:
+                    self._harvest(*inflight.popleft())
+        while inflight:  # stop(): every submitted group still resolves
+            self._harvest(*inflight.popleft())
 
     def stop(self):
         self._stop.set()
@@ -253,28 +333,128 @@ class _Batcher:
             self._thread.join(timeout=1.0)
 
 
+def stamp_epoch(server: "ClusterTokenServer", entity: bytes) -> bytes:
+    """Append the leader's epoch TLV (cluster/ha.py fencing) to a
+    token response entity; epoch 0 (pre-HA) keeps the wire format
+    byte-identical. The payload passes the ``cluster.ha.stale.epoch``
+    mutate seam so the chaos suite can replay a deposed epoch."""
+    epoch = server.service.epoch
+    if not epoch:
+        return entity
+    return codec.append_epoch_tlv(entity, faults.mutate(
+        "cluster.ha.stale.epoch", codec.encode_epoch_value(epoch)))
+
+
+def mutate_reply(data: bytes) -> bytes:
+    """Every reply write passes the ``cluster.server.frame`` fault
+    point, so the chaos suite can corrupt/delay/kill server->client
+    bytes without a proxy — and the ``cluster.ha.halfopen`` seam,
+    whose garbage=b"" mode swallows replies with the connection left
+    up (a half-open socket the client must time out of). Shared by the
+    legacy handler and the reactor flush path."""
+    return faults.mutate("cluster.ha.halfopen",
+                         faults.mutate("cluster.server.frame", data))
+
+
+def build_flow_reply(server: "ClusterTokenServer", xid: int, result,
+                     shed_retry) -> bytes:
+    """One FLOW response frame from a batcher outcome — the ONE reply
+    encoder both frontends (legacy handler, reactor) share, so the wire
+    bytes can never drift between them."""
+    if shed_retry is not None:
+        # Admission-queue shed: explicit OVERLOADED with a retry-after
+        # hint in the waitMs field — never a silent queue or hung socket.
+        return codec.encode_response(
+            xid, MSG_FLOW, TokenResultStatus.OVERLOADED,
+            stamp_epoch(server, codec.encode_flow_response(0, shed_retry)))
+    if result is None:
+        return codec.encode_response(xid, MSG_FLOW, TokenResultStatus.FAIL)
+    entity = codec.encode_flow_response(result.remaining, result.wait_ms)
+    if result.server_span is not None:
+        sp = result.server_span
+        entity = codec.append_trace_tlv(
+            entity, codec.encode_span_info(
+                sp["spanId"], sp["startMs"], sp["durationUs"]))
+    # Epoch AFTER the span TLV: pre-HA clients read the span at a
+    # fixed offset.
+    entity = stamp_epoch(server, entity)
+    return codec.encode_response(xid, MSG_FLOW, result.status, entity)
+
+
+def process_control_frame(server: "ClusterTokenServer", req: codec.Request,
+                          remote_entries: dict, namespace):
+    """Handle every non-FLOW message type; -> (reply_bytes, namespace').
+
+    Shared by the legacy thread-per-connection handler and the reactor's
+    worker pool — one implementation, so the two frontends answer
+    byte-identically (pinned by test_wire's wire-compat test)."""
+    if req.msg_type == MSG_PING:
+        ns = codec.decode_ping(req.entity)
+        if namespace is None and ns:
+            server.service.connections.connect(ns)
+            namespace = ns
+        return (codec.encode_response(
+            req.xid, MSG_PING, TokenResultStatus.OK), namespace)
+    if req.msg_type == MSG_PARAM_FLOW:
+        from sentinel_tpu.telemetry.spans import parse_traceparent
+
+        flow_id, count, params = codec.decode_param_flow_request(req.entity)
+        tp = codec.read_trace_tlv(
+            req.entity, codec.param_flow_request_size(req.entity))
+        ctx = parse_traceparent(tp) if tp else None
+        result = server.service.request_param_token(
+            flow_id, count, params, trace=ctx)
+        entity = b""
+        if result.server_span is not None:
+            sp = result.server_span
+            entity = codec.append_trace_tlv(
+                b"", codec.encode_span_info(
+                    sp["spanId"], sp["startMs"], sp["durationUs"]))
+        entity = stamp_epoch(server, entity)
+        return (codec.encode_response(
+            req.xid, MSG_PARAM_FLOW, result.status, entity), namespace)
+    if req.msg_type == MSG_ENTRY:
+        resource, origin, count, etype, prio, params = \
+            codec.decode_entry_request(req.entity)
+        handle, reason = server.remote_entry(
+            resource, origin, count, etype, prio, params)
+        if handle is not None:
+            entry_id = server.next_entry_id()
+            remote_entries[entry_id] = handle
+            return (codec.encode_response(
+                req.xid, MSG_ENTRY, TokenResultStatus.OK,
+                codec.encode_entry_response(entry_id, 0)), namespace)
+        if reason < 0:  # engine unavailable, fail-open on the JVM
+            return (codec.encode_response(
+                req.xid, MSG_ENTRY, TokenResultStatus.FAIL,
+                codec.encode_entry_response(0, 0)), namespace)
+        return (codec.encode_response(
+            req.xid, MSG_ENTRY, TokenResultStatus.BLOCKED,
+            codec.encode_entry_response(0, reason)), namespace)
+    if req.msg_type == MSG_EXIT:
+        entry_id, error, count = codec.decode_exit_request(req.entity)
+        handle = remote_entries.pop(entry_id, None)
+        if handle is None:
+            return (codec.encode_response(
+                req.xid, MSG_EXIT, TokenResultStatus.BAD_REQUEST), namespace)
+        if error:
+            handle.trace(None)  # biz exception on the JVM side
+        handle.exit(count if count >= 0 else None)
+        return (codec.encode_response(
+            req.xid, MSG_EXIT, TokenResultStatus.OK), namespace)
+    return (codec.encode_response(
+        req.xid, req.msg_type, TokenResultStatus.BAD_REQUEST), namespace)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def _send(self, data: bytes) -> None:
-        """Every reply write passes the ``cluster.server.frame`` fault
-        point, so the chaos suite can corrupt/delay/kill server->client
-        bytes without a proxy — and the ``cluster.ha.halfopen`` seam,
-        whose garbage=b"" mode swallows replies with the connection left
-        up (a half-open socket the client must time out of)."""
-        data = faults.mutate("cluster.ha.halfopen",
-                             faults.mutate("cluster.server.frame", data))
+        """Reply write through :func:`mutate_reply`'s chaos seams."""
+        data = mutate_reply(data)
         if data:
             self.request.sendall(data)
 
     def _stamp_epoch(self, entity: bytes) -> bytes:
-        """Append the leader's epoch TLV (cluster/ha.py fencing) to a
-        token response entity; epoch 0 (pre-HA) keeps the wire format
-        byte-identical. The payload passes the ``cluster.ha.stale.epoch``
-        mutate seam so the chaos suite can replay a deposed epoch."""
-        epoch = self.server.token_server.service.epoch
-        if not epoch:
-            return entity
-        return codec.append_epoch_tlv(entity, faults.mutate(
-            "cluster.ha.stale.epoch", codec.encode_epoch_value(epoch)))
+        return stamp_epoch(self.server.token_server, entity)
 
     def handle(self):
         server: "ClusterTokenServer" = self.server.token_server
@@ -346,37 +526,13 @@ class _Handler(socketserver.BaseRequestHandler):
                             + len(burst) * 0.01)
                         results = box.get("results")
                         shed_retry = box.get("shed_retry_after_ms")
-                        replies = []
-                        for k, (xid, _r) in enumerate(burst):
-                            result = results[k] if results else None
-                            if shed_retry is not None:
-                                # Admission-queue shed: explicit
-                                # OVERLOADED with a retry-after hint in
-                                # the waitMs field — never a silent
-                                # queue or a hung socket.
-                                replies.append(codec.encode_response(
-                                    xid, MSG_FLOW,
-                                    TokenResultStatus.OVERLOADED,
-                                    self._stamp_epoch(
-                                        codec.encode_flow_response(
-                                            0, shed_retry))))
-                            elif result is None:
-                                replies.append(codec.encode_response(
-                                    xid, MSG_FLOW, TokenResultStatus.FAIL))
-                            else:
-                                entity = codec.encode_flow_response(
-                                    result.remaining, result.wait_ms)
-                                if result.server_span is not None:
-                                    sp = result.server_span
-                                    entity = codec.append_trace_tlv(
-                                        entity, codec.encode_span_info(
-                                            sp["spanId"], sp["startMs"],
-                                            sp["durationUs"]))
-                                # Epoch AFTER the span TLV: pre-HA clients
-                                # read the span at a fixed offset.
-                                entity = self._stamp_epoch(entity)
-                                replies.append(codec.encode_response(
-                                    xid, MSG_FLOW, result.status, entity))
+                        server_obj = self.server.token_server
+                        replies = [
+                            build_flow_reply(
+                                server_obj, xid,
+                                results[k] if results else None, shed_retry)
+                            for k, (xid, _r) in enumerate(burst)
+                        ]
                         self._send(b"".join(replies))
                         i = j
                     else:
@@ -400,67 +556,12 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _process(self, server, req: codec.Request, namespace):
         # NOTE: no MSG_FLOW arm — handle() consumes every FLOW frame in
-        # its burst branch (a lone frame is a burst of one), so a second
-        # reply/encode implementation here would just be drift fodder.
-        if req.msg_type == MSG_PING:
-            ns = codec.decode_ping(req.entity)
-            if namespace is None and ns:
-                server.service.connections.connect(ns)
-                namespace = ns
-            self._send(codec.encode_response(
-                req.xid, MSG_PING, TokenResultStatus.OK))
-        elif req.msg_type == MSG_PARAM_FLOW:
-            from sentinel_tpu.telemetry.spans import parse_traceparent
-
-            flow_id, count, params = codec.decode_param_flow_request(req.entity)
-            tp = codec.read_trace_tlv(
-                req.entity, codec.param_flow_request_size(req.entity))
-            ctx = parse_traceparent(tp) if tp else None
-            result = server.service.request_param_token(
-                flow_id, count, params, trace=ctx)
-            entity = b""
-            if result.server_span is not None:
-                sp = result.server_span
-                entity = codec.append_trace_tlv(
-                    b"", codec.encode_span_info(
-                        sp["spanId"], sp["startMs"], sp["durationUs"]))
-            entity = self._stamp_epoch(entity)
-            self._send(codec.encode_response(
-                req.xid, MSG_PARAM_FLOW, result.status, entity))
-        elif req.msg_type == MSG_ENTRY:
-            resource, origin, count, etype, prio, params = \
-                codec.decode_entry_request(req.entity)
-            handle, reason = server.remote_entry(
-                resource, origin, count, etype, prio, params)
-            if handle is not None:
-                entry_id = server.next_entry_id()
-                self._remote_entries[entry_id] = handle
-                self._send(codec.encode_response(
-                    req.xid, MSG_ENTRY, TokenResultStatus.OK,
-                    codec.encode_entry_response(entry_id, 0)))
-            elif reason < 0:  # engine unavailable, fail-open on the JVM
-                self._send(codec.encode_response(
-                    req.xid, MSG_ENTRY, TokenResultStatus.FAIL,
-                    codec.encode_entry_response(0, 0)))
-            else:
-                self._send(codec.encode_response(
-                    req.xid, MSG_ENTRY, TokenResultStatus.BLOCKED,
-                    codec.encode_entry_response(0, reason)))
-        elif req.msg_type == MSG_EXIT:
-            entry_id, error, count = codec.decode_exit_request(req.entity)
-            handle = self._remote_entries.pop(entry_id, None)
-            if handle is None:
-                self._send(codec.encode_response(
-                    req.xid, MSG_EXIT, TokenResultStatus.BAD_REQUEST))
-            else:
-                if error:
-                    handle.trace(None)  # biz exception on the JVM side
-                handle.exit(count if count >= 0 else None)
-                self._send(codec.encode_response(
-                    req.xid, MSG_EXIT, TokenResultStatus.OK))
-        else:
-            self._send(codec.encode_response(
-                req.xid, req.msg_type, TokenResultStatus.BAD_REQUEST))
+        # its burst branch (a lone frame is a burst of one). All other
+        # types route through the SHARED process_control_frame, the same
+        # implementation the reactor's worker pool runs.
+        reply, namespace = process_control_frame(
+            server, req, self._remote_entries, namespace)
+        self._send(reply)
         return namespace
 
 
@@ -477,7 +578,19 @@ class _ThreadingTCP(socketserver.ThreadingTCPServer):
 
 
 class ClusterTokenServer:
-    """Embedded-or-standalone token server (``SentinelDefaultTokenServer``)."""
+    """Embedded-or-standalone token server (``SentinelDefaultTokenServer``).
+
+    Two frontends share this facade (and every seam: the batcher, the
+    chaos fault points, the shared reply encoders):
+
+    * the REACTOR (cluster/reactor.py, default): one selectors-based
+      I/O loop multiplexing every connection, zero-copy TLV parse, and
+      a coalescing collector folding ALL ready connections into
+      pipelined fused-step batches — the ISSUE 11 wire path;
+    * the legacy thread-per-connection socketserver (``reactor=False``
+      or ``csp.sentinel.wire.reactor.enabled=false``), kept as the
+      wire-compat reference implementation.
+    """
 
     def __init__(self, service: Optional[DefaultTokenService] = None,
                  host: str = "0.0.0.0", port: int = 0,
@@ -486,10 +599,13 @@ class ClusterTokenServer:
                  watermark_pct: Optional[int] = None,
                  deadline_ms: Optional[int] = None,
                  idle_timeout_s: Optional[int] = None,
-                 conn_max_burst: Optional[int] = None):
+                 conn_max_burst: Optional[int] = None,
+                 reactor: Optional[bool] = None):
         self.service = service or DefaultTokenService()
         self.host = host
         self.port = port
+        self.reactor_enabled = bool(
+            config.wire_reactor_enabled() if reactor is None else reactor)
         self.idle_timeout_s = int(
             idle_timeout_s if idle_timeout_s is not None
             else config.overload_idle_timeout_s())
@@ -504,6 +620,7 @@ class ClusterTokenServer:
         self.crashed = False
         self._server: Optional[_ThreadingTCP] = None
         self._thread: Optional[threading.Thread] = None
+        self._reactor = None
         # Engine serving MSG_ENTRY/MSG_EXIT (the M4 slot-chain bridge).
         # None -> the process default engine, resolved lazily so merely
         # constructing a token server never boots the engine singleton.
@@ -562,9 +679,21 @@ class ClusterTokenServer:
 
     @property
     def bound_port(self) -> int:
+        if self._reactor is not None:
+            return self._reactor.bound_port
         return self._server.server_address[1] if self._server else self.port
 
     def start(self) -> "ClusterTokenServer":
+        # Bind BEFORE starting the batcher drain thread: a failed bind
+        # (EADDRINUSE on a role flip) must leave nothing running — the
+        # caller retries, and a leaked drain thread per attempt would
+        # accumulate (both frontends bind synchronously here).
+        if self.reactor_enabled:
+            from sentinel_tpu.cluster.reactor import WireReactor
+
+            self._reactor = WireReactor(self).start()
+            self.batcher.start()
+            return self
         self._server = _ThreadingTCP((self.host, self.port), _Handler)
         self._server.token_server = self
         self.batcher.start()
@@ -587,7 +716,16 @@ class ClusterTokenServer:
             **self.batcher.overload_stats(),
             "idleTimeoutS": self.idle_timeout_s,
             "connMaxBurst": self.conn_max_burst,
+            "reactor": self.reactor_enabled,
         }
+
+    def wire_stats(self) -> Optional[dict]:
+        """Reactor wire-path snapshot (connections, coalesced batch
+        sizes, RTT split, outbuf sheds — the ``sentinel_tpu_wire_*``
+        gauges' source), or None on the legacy frontend."""
+        if self._reactor is None:
+            return None
+        return self._reactor.wire_stats()
 
     def _fault_crash(self) -> None:
         """Hard-kill for the ``cluster.ha.leader.crash`` fault point: the
@@ -599,6 +737,9 @@ class ClusterTokenServer:
 
     def stop(self) -> None:
         self.batcher.stop()
+        if self._reactor is not None:
+            self._reactor.stop()
+            self._reactor = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

@@ -263,3 +263,14 @@ class ClusterStateManager:
         if srv is None:
             return None
         return srv.overload_stats()
+
+    def wire_stats(self) -> Optional[dict]:
+        """The embedded token server's reactor wire-path snapshot
+        (connections, coalesced batch sizes, RTT split, outbuf sheds),
+        or None when this instance is not a server — or serves through
+        the legacy thread-per-connection frontend. Lock-free like
+        :meth:`ha_stats`."""
+        srv = self.token_server
+        if srv is None:
+            return None
+        return srv.wire_stats()

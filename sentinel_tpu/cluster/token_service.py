@@ -44,6 +44,24 @@ from sentinel_tpu.utils import time_util
 from sentinel_tpu.utils.param_hash import hash_param
 
 
+class TokenTicket(NamedTuple):
+    """An in-flight batched acquire (the wire path's analog of PR 8's
+    enqueue-only engine dispatch): ``dispatch_tokens`` returns one with
+    ``status``/``extra`` still LAZY device arrays (or plain results on
+    the sync fallback), ``harvest_tokens`` resolves it OUTSIDE the
+    service lock — so the TCP frontend can stage + dispatch batch N+1
+    while batch N still computes on the device stream."""
+
+    requests: tuple
+    traces: tuple
+    pre: tuple          # pre-decided TokenResults (limiter/TOO_MANY), or None
+    status: object      # lazy int32[N] (or None on the sync fallback)
+    extra: object       # lazy int32[N] (or None on the sync fallback)
+    now_ms: int
+    t0: float           # dispatch perf_counter (span timing)
+    sync_results: object = None  # pre-resolved results (sync fallback)
+
+
 class TokenResult(NamedTuple):
     """Reference: ``TokenResult`` (status + optional wait hint).
 
@@ -297,14 +315,53 @@ class DefaultTokenService:
         TLV. Traced requests get a server-side span (recorded in
         ``self.spans`` AND returned in ``TokenResult.server_span``)
         timing the actual device acquire step their verdict came from.
+
+        Synchronous form of :meth:`dispatch_tokens` +
+        :meth:`harvest_tokens` — one code path, so the pipelined wire
+        frontend and direct callers can never drift. When an instance
+        override exists, this (class-level) body is only reachable
+        THROUGH the override's captured real(), so it goes straight to
+        the device path rather than looping back into the override.
+        """
+        return self.harvest_tokens(self._dispatch_device(requests, now_ms))
+
+    def dispatch_tokens(self, requests: Sequence[Tuple],
+                        now_ms: Optional[int] = None) -> TokenTicket:
+        """Enqueue-only batched acquire: all host prep + the jitted
+        device dispatch happen under the service lock, but the verdict
+        arrays come back LAZY — the caller resolves them later with
+        :meth:`harvest_tokens` (outside the lock), which is what lets
+        the wire frontend keep up to ``wire.inflight.depth`` fused
+        batches riding the device stream (the PR 8 dispatch/harvest
+        split, applied to the token path).
+
+        When ``request_tokens`` has been overridden on the INSTANCE
+        (test harnesses wrap it to inject step latency or faults), the
+        override must see every batch — the ticket degrades to a
+        pre-resolved synchronous one through it. (No reentry hazard:
+        the override's captured real() is the CLASS request_tokens,
+        which dispatches via :meth:`_dispatch_device` directly.)
         """
         import time as _time
 
+        if "request_tokens" in self.__dict__:
+            t0 = _time.perf_counter()
+            results = self.__dict__["request_tokens"](requests, now_ms)
+            return TokenTicket(tuple(requests), (), (), None, None,
+                               now_ms or 0, t0, sync_results=list(results))
+        return self._dispatch_device(requests, now_ms)
+
+    def _dispatch_device(self, requests: Sequence[Tuple],
+                         now_ms: Optional[int] = None) -> TokenTicket:
+        """The real enqueue-only device dispatch (the body behind both
+        :meth:`dispatch_tokens` and :meth:`request_tokens`)."""
+        import time as _time
+
         now = now_ms if now_ms is not None else time_util.current_time_millis()
-        traces = [r[3] if len(r) > 3 else None for r in requests]
+        traces = tuple(r[3] if len(r) > 3 else None for r in requests)
         with self._lock:
             self._ensure_compiled()
-            out: List[Optional[TokenResult]] = [None] * len(requests)
+            pre: List[Optional[TokenResult]] = [None] * len(requests)
             slots = np.full(len(requests), -1, np.int32)
             counts = np.zeros(len(requests), np.int32)
             prio = np.zeros(len(requests), bool)
@@ -316,36 +373,68 @@ class DefaultTokenService:
                     continue  # slot stays -1 -> NO_RULE_EXISTS
                 ns = self._ns_of.get(flow_id)
                 if ns is not None and not self.limiter.try_pass(ns, now):
-                    out[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
+                    pre[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
                     continue
                 slots[i] = self._slot_of.get(flow_id, -1)
                 counts[i] = count
                 prio[i] = prioritized
             t0 = _time.perf_counter()
-            self._state, status, extra = self._acquire_jit(
-                self._state, self._rt, self._conn_tensor(),
-                jnp.asarray(slots), jnp.asarray(counts), jnp.asarray(prio),
-                jnp.asarray(now, jnp.int64),
-                max_occupy_ratio=self.max_occupy_ratio,
-            )
-            status = np.asarray(status)
-            extra = np.asarray(extra)
-            # The batch shares one device step; each traced request's span
-            # carries the step wall (its verdict's true compute cost) plus
-            # its own verdict attributes.
-            step_us = int((_time.perf_counter() - t0) * 1e6)
-            for i in range(len(requests)):
-                if out[i] is None:
-                    s = int(status[i])
-                    if s == CC.TokenResultStatus.SHOULD_WAIT:
-                        out[i] = TokenResult(s, wait_ms=int(extra[i]))
-                    else:
-                        out[i] = TokenResult(s, remaining=int(extra[i]))
-                if traces[i] is not None:
-                    out[i] = out[i]._replace(server_span=self._record_span(
-                        traces[i], requests[i][0], now, step_us,
-                        int(out[i].status), len(requests)))
-            return out  # type: ignore[return-value]
+            try:
+                self._state, status, extra = self._acquire_jit(
+                    self._state, self._rt, self._conn_tensor(),
+                    jnp.asarray(slots), jnp.asarray(counts),
+                    jnp.asarray(prio), jnp.asarray(now, jnp.int64),
+                    max_occupy_ratio=self.max_occupy_ratio,
+                )
+            except Exception:
+                # A failed dispatch may have consumed (donated) the state
+                # buffer: drop cold and recompile on the next batch
+                # rather than serving from a poisoned tensor.
+                self._state = None
+                self._compiled_version = -1
+                raise
+            return TokenTicket(tuple(requests), traces, tuple(pre),
+                               status, extra, now, t0)
+
+    def harvest_tokens(self, ticket: TokenTicket) -> List[TokenResult]:
+        """Resolve a dispatched batch to concrete TokenResults. The
+        ``np.asarray`` readback happens HERE — outside the service lock,
+        so a slow device step never blocks the next batch's dispatch.
+        An async device death surfaces here; the service state drops
+        cold (recompiled on the next dispatch) exactly like a dispatch
+        death, and the caller fails the batch's requests."""
+        import time as _time
+
+        if ticket.sync_results is not None:
+            return ticket.sync_results
+        try:
+            status = np.asarray(ticket.status)
+            extra = np.asarray(ticket.extra)
+        except Exception:
+            with self._lock:
+                self._state = None
+                self._compiled_version = -1
+            raise
+        # The batch shares one device step; each traced request's span
+        # carries the dispatch-to-harvest wall (its verdict's true
+        # compute cost, including any pipelined overlap) plus its own
+        # verdict attributes.
+        step_us = int((_time.perf_counter() - ticket.t0) * 1e6)
+        out: List[TokenResult] = []
+        for i, req in enumerate(ticket.requests):
+            result = ticket.pre[i]
+            if result is None:
+                s = int(status[i])
+                if s == CC.TokenResultStatus.SHOULD_WAIT:
+                    result = TokenResult(s, wait_ms=int(extra[i]))
+                else:
+                    result = TokenResult(s, remaining=int(extra[i]))
+            if ticket.traces[i] is not None:
+                result = result._replace(server_span=self._record_span(
+                    ticket.traces[i], req[0], ticket.now_ms, step_us,
+                    int(result.status), len(ticket.requests)))
+            out.append(result)
+        return out
 
     def _record_span(self, ctx, flow_id, start_ms: int, duration_us: int,
                      status: int, batch_n: int) -> Dict:

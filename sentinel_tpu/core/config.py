@@ -128,6 +128,26 @@ ADAPTIVE_SHADOW_SECONDS = "csp.sentinel.adaptive.shadow.seconds"
 ADAPTIVE_CANARY_SECONDS = "csp.sentinel.adaptive.canary.seconds"
 ADAPTIVE_CANARY_BPS = "csp.sentinel.adaptive.canary.bps"
 ADAPTIVE_HISTORY_CAPACITY = "csp.sentinel.adaptive.history.capacity"
+# Wire-path ingestion (cluster/reactor.py — no reference twin: the
+# reference rides Netty's event loop; this is the Python-native analog).
+# Every key here MUST be read through the accessors below and documented
+# in docs/OPERATIONS.md "Wire-path tuning" (pinned by test_lint).
+# reactor.enabled: the selectors-based multiplexing frontend (false =
+# legacy thread-per-connection socketserver, kept for wire-compat drills);
+# coalesce.max.batch: max requests folded into one fused-step group;
+# inflight.depth: fused wire batches allowed on the device stream at once
+# (the PR 8 dispatch/harvest split applied to the token path);
+# outbuf.max.bytes: per-connection reply backlog bound — past it the
+# connection stops being read and freshly parsed requests shed OVERLOADED;
+# read.chunk.bytes: recv size per readable socket per loop cycle;
+# workers: compute worker pool for non-FLOW frames (ENTRY/EXIT/PARAM).
+WIRE_REACTOR_ENABLED = "csp.sentinel.wire.reactor.enabled"
+WIRE_COALESCE_MAX_BATCH = "csp.sentinel.wire.coalesce.max.batch"
+WIRE_INFLIGHT_DEPTH = "csp.sentinel.wire.inflight.depth"
+WIRE_OUTBUF_MAX_BYTES = "csp.sentinel.wire.outbuf.max.bytes"
+WIRE_READ_CHUNK_BYTES = "csp.sentinel.wire.read.chunk.bytes"
+WIRE_WORKERS = "csp.sentinel.wire.workers"
+WIRE_RLS_BATCHED = "csp.sentinel.wire.rls.batched"
 SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
 SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
 SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
@@ -198,6 +218,15 @@ DEFAULT_OVERLOAD_CLIENT_BACKOFF_MS = 250
 # matches the historical collector default.
 DEFAULT_PIPELINE_INFLIGHT_DEPTH = 2
 DEFAULT_PIPELINE_LINGER_US = 100
+# Wire-path defaults. Coalesce cap 1024 matches the conn burst cap (one
+# fused step per reactor cycle, padded on the jit ladder); depth 2 =
+# classic double buffering on the token acquire stream; 1 MiB outbuf is
+# ~60k flow replies — a consumer that far behind is dead, not slow.
+DEFAULT_WIRE_COALESCE_MAX_BATCH = 1024
+DEFAULT_WIRE_INFLIGHT_DEPTH = 2
+DEFAULT_WIRE_OUTBUF_MAX_BYTES = 1_048_576
+DEFAULT_WIRE_READ_CHUNK_BYTES = 131_072
+DEFAULT_WIRE_WORKERS = 4
 # SLO defaults. alpha=0.2 ≈ a ~5-second effective memory on the EWMA
 # baseline mean (fast enough to track diurnal drift, slow enough that a
 # one-second spike cannot hide itself); z>=4 on a per-second signal
@@ -448,6 +477,39 @@ class SentinelConfig:
             if w > 0:
                 out.append(w)
         return tuple(out)
+
+    # Wire-path accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.wire.* keys — test_lint forbids reading the literals
+    # anywhere else in the package).
+
+    def wire_reactor_enabled(self) -> bool:
+        return (self.get(WIRE_REACTOR_ENABLED) or "true").lower() != "false"
+
+    def wire_coalesce_max_batch(self) -> int:
+        v = self.get_int(WIRE_COALESCE_MAX_BATCH,
+                         DEFAULT_WIRE_COALESCE_MAX_BATCH)
+        return v if v > 0 else DEFAULT_WIRE_COALESCE_MAX_BATCH
+
+    def wire_inflight_depth(self) -> int:
+        v = self.get_int(WIRE_INFLIGHT_DEPTH, DEFAULT_WIRE_INFLIGHT_DEPTH)
+        return v if v > 0 else DEFAULT_WIRE_INFLIGHT_DEPTH
+
+    def wire_outbuf_max_bytes(self) -> int:
+        v = self.get_int(WIRE_OUTBUF_MAX_BYTES,
+                         DEFAULT_WIRE_OUTBUF_MAX_BYTES)
+        return v if v > 0 else DEFAULT_WIRE_OUTBUF_MAX_BYTES
+
+    def wire_read_chunk_bytes(self) -> int:
+        v = self.get_int(WIRE_READ_CHUNK_BYTES,
+                         DEFAULT_WIRE_READ_CHUNK_BYTES)
+        return v if v > 0 else DEFAULT_WIRE_READ_CHUNK_BYTES
+
+    def wire_workers(self) -> int:
+        v = self.get_int(WIRE_WORKERS, DEFAULT_WIRE_WORKERS)
+        return v if v > 0 else DEFAULT_WIRE_WORKERS
+
+    def wire_rls_batched(self) -> bool:
+        return (self.get(WIRE_RLS_BATCHED) or "false").lower() == "true"
 
     # SLO / alerting accessors (the ONLY sanctioned readers of the
     # csp.sentinel.slo.* and csp.sentinel.alert.* keys — test_lint

@@ -1614,6 +1614,9 @@ class SentinelEngine:
             # admission-queue depth/bounds and shed counters, None while
             # this instance is not a server.
             "overload": self.cluster.overload_stats(),
+            # Wire path (ISSUE 11): the reactor frontend's connection /
+            # coalescing / RTT snapshot, None while not a reactor server.
+            "wire": self.cluster.wire_stats(),
             # Staged-rollout guardrail beside the degradation channels:
             # active candidate set, stage, and windows-to-abort — one
             # unified picture of everything currently between the live

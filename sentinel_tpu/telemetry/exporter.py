@@ -202,6 +202,35 @@ def render_engine_metrics(engine) -> str:
         b.sample("sentinel_tpu_overload_shed_requests_total", None,
                  ov["shedRequests"])
 
+    # -- wire path (reactor ingestion — ISSUE 11) -------------------------
+    # Families render -1 / nothing while this instance is not a reactor
+    # token server, so one scrape config fits every role.
+    wire = res_stats.get("wire")
+    b.family("sentinel_tpu_wire_connections", "gauge",
+             "Live connections multiplexed by the wire reactor (-1: not "
+             "a reactor server)")
+    b.sample("sentinel_tpu_wire_connections", None,
+             wire["connections"] if wire else -1)
+    b.family("sentinel_tpu_wire_coalesced_batch", "gauge",
+             "Requests folded per fused wire batch (p50 over the recent "
+             "window; -1: not a reactor server)")
+    b.sample("sentinel_tpu_wire_coalesced_batch", None,
+             wire["coalescedBatchP50"] if wire else -1)
+    b.family("sentinel_tpu_wire_rtt_ms", "gauge",
+             "Server-side request RTT (arrival to reply built), recent "
+             "percentiles in ms")
+    if wire:
+        b.sample("sentinel_tpu_wire_rtt_ms", {"quantile": "0.50"},
+                 wire["rttP50Ms"])
+        b.sample("sentinel_tpu_wire_rtt_ms", {"quantile": "0.99"},
+                 wire["rttP99Ms"])
+    b.family("sentinel_tpu_wire_outbuf_shed", "counter",
+             "Requests shed OVERLOADED because the connection's bounded "
+             "reply backlog was full (slow consumer)")
+    if wire:
+        b.sample("sentinel_tpu_wire_outbuf_shed_total", None,
+                 wire["outbufShed"])
+
     # -- staged rollout guardrail ----------------------------------------
     guard = res_stats.get("rollout") or {}
     b.family("sentinel_tpu_rollout_active", "gauge",

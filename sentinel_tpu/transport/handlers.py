@@ -592,6 +592,9 @@ def cmd_get_cluster_mode(req: CommandRequest) -> CommandResponse:
         # engine-side count of entries a shed degraded to the local
         # lease/fallback path.
         "overload": cs.overload_stats(),
+        # Wire path (ISSUE 11): the reactor frontend's connection /
+        # coalescing / RTT snapshot (None while not a reactor server).
+        "wire": cs.wire_stats(),
         "clusterOverloadCount": getattr(
             req.engine, "cluster_overload_count", 0),
     })

@@ -562,7 +562,12 @@ def test_warmup_lease_cold_start_throttles(engine, frozen_time):
     assert admitted <= 90 / C.COLD_FACTOR + 1
 
 
-@pytest.mark.parametrize("seed", [3, 17])
+@pytest.mark.parametrize("seed", [
+    3,
+    # Second seed slow-tier'd (ISSUE 11 tier-1 wall-time trim): ~18s
+    # for the same randomized param-lease regimes as seed 3.
+    pytest.param(17, marks=pytest.mark.slow),
+])
 def test_single_param_rule_is_leased_and_matches_device(engine,
                                                         frozen_time, seed):
     """Oracle parity for the param mirror: per-value windowed token

@@ -143,6 +143,13 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_adaptive_clamped",
                  "sentinel_tpu_adaptive_target_delta"):
         assert name in seen, f"{name} not declared in the exporters"
+    # wire-path families (ISSUE 11): declared exactly once (the dupe
+    # gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_wire_connections",
+                 "sentinel_tpu_wire_coalesced_batch",
+                 "sentinel_tpu_wire_rtt_ms",
+                 "sentinel_tpu_wire_outbuf_shed"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -385,6 +392,66 @@ def test_adaptive_actuates_only_through_the_rollout_manager():
     assert not offenders, (
         "adaptive code must actuate ONLY via the engine's RolloutManager "
         "(load_candidate/set_stage/promote/abort): " + ", ".join(offenders))
+
+
+def test_wire_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.wire.*`` config key must (a) be defined and
+    read ONLY in core/config.py — the rest of the package goes through
+    the ``SentinelConfig`` accessors — and (b) appear in
+    docs/OPERATIONS.md "Wire-path tuning", so the runbook can never
+    silently drift from the knobs the code actually reads (same rule
+    shape as the cluster-HA / overload / pipeline gates)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.wire\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.wire.* literals outside core/config.py "
+        "(use the SentinelConfig wire_* accessors): " + ", ".join(offenders))
+    assert keys, "no wire config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "wire config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_reactor_path_zero_copy_and_coalesced_writes():
+    """The reactor ingest/egress hygiene gates (ISSUE 11):
+
+    * no ``sendall(`` — every write must go through the per-connection
+      coalesced non-blocking flush (one buffer per connection per
+      flush), never a blocking per-request write;
+    * no ``+= b...`` / rolling bytes accumulation — frame parsing is
+      the zero-copy ``FrameScanner`` (memoryview slices), and reply
+      buffers are chunk deques, not growing byte strings.
+    """
+    import re
+
+    patterns = [
+        (re.compile(r"\.sendall\s*\("), "per-request sendall"),
+        (re.compile(r"\+=\s*(?:b[\"']|data\b|chunk\b|frame\b|body\b|"
+                    r"raw\b|reply\b|payload\b)"),
+         "rolling bytes accumulation"),
+    ]
+    path = REPO / "sentinel_tpu" / "cluster" / "reactor.py"
+    offenders = []
+    for lineno, code in _code_lines(path):
+        for pattern, what in patterns:
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno} ({what})")
+    assert not offenders, (
+        "reactor wire path must stay zero-copy with coalesced "
+        "non-blocking writes: " + ", ".join(offenders))
 
 
 @pytest.mark.skipif(shutil.which("ruff") is None,

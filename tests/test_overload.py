@@ -478,10 +478,17 @@ def _pipelined_burst(port: int, flow_id: int, n: int,
 def _run_harness(n_conns: int, burst: int, rounds: int, step_delay_s: float,
                  max_queue_groups: int, watermark_pct: int,
                  max_batch: int = 256, deadline_ms: int = 2_000,
-                 rls_threads: int = 0, rls_calls: int = 0):
+                 rls_threads: int = 0, rls_calls: int = 0,
+                 conn_max_burst: int = None):
     """Drive concurrent pipelined TLV connections (and optionally RLS
     callers) through a deliberately slowed device step; returns
-    (per-burst results, per-burst walls, server stats, rls stats)."""
+    (per-burst results, per-burst walls, server stats, rls stats).
+
+    ``conn_max_burst`` below the burst size splits each connection's
+    burst into multiple admission groups — the knob that makes the
+    bounded queue actually fill under the reactor frontend, whose
+    coalescing would otherwise fold a whole drill into a handful of
+    groups (ISSUE 11)."""
     service = DefaultTokenService(_rules())
     # absorb the jit compiles for the widths this run can produce, so
     # the timed section measures queueing, not XLA
@@ -495,7 +502,8 @@ def _run_harness(n_conns: int, burst: int, rounds: int, step_delay_s: float,
                                 max_queue_groups=max_queue_groups,
                                 watermark_pct=watermark_pct,
                                 max_batch=max_batch,
-                                deadline_ms=deadline_ms).start()
+                                deadline_ms=deadline_ms,
+                                conn_max_burst=conn_max_burst).start()
     rls = None
     if rls_threads:
         from sentinel_tpu.envoy_rls.service import SentinelEnvoyRlsService
@@ -576,7 +584,8 @@ def test_overload_harness_small():
     n_conns, burst, rounds = 12, 32, 3
     results, walls, stats, _ = _run_harness(
         n_conns, burst, rounds, step_delay_s=0.05,
-        max_queue_groups=4, watermark_pct=50, max_batch=32)
+        max_queue_groups=4, watermark_pct=50, max_batch=32,
+        conn_max_burst=8)
     ok, shed = _assert_overload_invariants(
         results, walls, stats, n_conns * rounds, burst,
         max_queue_groups=4, deadline_ms=2_000, goodput_floor=burst)
@@ -598,7 +607,7 @@ def test_overload_harness_full():
     results, walls, stats, (rls_stats, rls_codes) = _run_harness(
         n_conns, burst, rounds, step_delay_s=0.02,
         max_queue_groups=16, watermark_pct=50,
-        rls_threads=8, rls_calls=25)
+        rls_threads=8, rls_calls=25, conn_max_burst=16)
     ok, shed = _assert_overload_invariants(
         results, walls, stats, n_conns * rounds, burst,
         max_queue_groups=16, deadline_ms=2_000,

@@ -298,7 +298,13 @@ def _stream_rules():
     ]
 
 
-@pytest.mark.parametrize("seed", [11, 23, 47])
+@pytest.mark.parametrize("seed", [
+    11,
+    # Redundant seeds slow-tier'd (ISSUE 11 tier-1 wall-time trim):
+    # ~21s each for the same async-vs-sync regimes as seed 11.
+    pytest.param(23, marks=pytest.mark.slow),
+    pytest.param(47, marks=pytest.mark.slow),
+])
 def test_async_pipeline_matches_sync_differential(seed, frozen_time):
     """ISSUE 8 correctness oracle: the async double-buffered path must
     produce BIT-IDENTICAL verdicts to the synchronous path over a

@@ -233,7 +233,14 @@ def _pick_param_values(rng):
 
 
 @pytest.mark.parametrize("seed,steps", [
-    (11, 40), (23, 40), (37, 40), (59, 40), (101, 40), (137, 40),
+    (11, 40), (23, 40),
+    # Redundant 40-step seeds ride the slow tier (ISSUE 11 tier-1
+    # wall-time trim): each costs ~14s and exercises the same regimes
+    # as the two tier-1 seeds; the full sweep still runs with -m slow.
+    pytest.param(37, 40, marks=pytest.mark.slow),
+    pytest.param(59, 40, marks=pytest.mark.slow),
+    pytest.param(101, 40, marks=pytest.mark.slow),
+    pytest.param(137, 40, marks=pytest.mark.slow),
     # One long soak: many breaker retry cycles, stat-window rolls, and
     # QPS-window turnovers against a single compile.
     (7, 150),

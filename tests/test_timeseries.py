@@ -104,7 +104,12 @@ def _assert_second_matches(sec_dict, oracle_second):
         assert got["rtBuckets"] == cell["rtBuckets"].tolist()
 
 
-@pytest.mark.parametrize("seed", [7, 23])
+@pytest.mark.parametrize("seed", [
+    7,
+    # Second seed slow-tier'd (ISSUE 11 tier-1 wall-time trim): ~47s
+    # for the same oracle regimes as seed 7; full sweep via -m slow.
+    pytest.param(23, marks=pytest.mark.slow),
+])
 def test_flight_recorder_matches_host_oracle(engine, seed):
     """The recorded per-second series == the host oracle, for every
     complete second of a randomized mixed-count stream with exits —

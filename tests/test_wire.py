@@ -1,0 +1,445 @@
+"""Wire-path reactor tests (ISSUE 11): zero-copy frame scanning, the
+coalescing/pipelining reactor's edge cases (partial frames, slow
+consumers, mid-harvest connection death), byte-identical wire compat
+between the reactor and the legacy thread-per-connection frontend, the
+pipelined client, the allocation-free shed paths, and the batched RLS
+mode."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.constants import (
+    MSG_ENTRY,
+    MSG_EXIT,
+    MSG_FLOW,
+    MSG_PARAM_FLOW,
+    MSG_PING,
+    TokenResultStatus,
+)
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer, _Batcher
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+FLOW_ID = 8100
+
+
+def _rules(count=1e9):
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [st.FlowRule(
+        resource="wire-res", count=count, cluster_mode=True,
+        cluster_config={"flowId": FLOW_ID, "thresholdType": 1})])
+    return rules
+
+
+def _recv_frames(sock, n, timeout_s=15.0):
+    """Read exactly n reply frames; -> (raw_bytes, [Response])."""
+    sock.settimeout(timeout_s)
+    reader = codec.FrameReader()
+    raw = bytearray()
+    out = []
+    while len(out) < n:
+        data = sock.recv(65536)
+        if not data:
+            break
+        raw.extend(data)
+        for body in reader.feed(data):
+            out.append(codec.decode_response(body))
+    return bytes(raw), out
+
+
+# -- FrameScanner (zero-copy parse) -------------------------------------------
+
+
+def test_frame_scanner_matches_reader_on_every_split():
+    """Differential: FrameScanner == FrameReader over one multi-frame
+    byte string split at EVERY boundary into two feeds (the partial-
+    frame-across-reads cases), plus byte-by-byte delivery."""
+    bodies = [b"a", b"bb" * 7, b"", b"x" * 300, b"tail"]
+    stream = b"".join(codec.frame(b) for b in bodies)
+    for cut in range(len(stream) + 1):
+        scanner = codec.FrameScanner()
+        got = [bytes(f) for f in scanner.feed(stream[:cut])]
+        got += [bytes(f) for f in scanner.feed(stream[cut:])]
+        assert got == bodies, f"split at {cut}"
+    scanner = codec.FrameScanner()
+    got = []
+    for i in range(len(stream)):
+        got += [bytes(f) for f in scanner.feed(stream[i:i + 1])]
+    assert got == bodies
+
+
+def test_frame_scanner_whole_frames_are_zero_copy_views():
+    """Frames wholly inside a chunk come back as memoryviews ALIASING
+    the chunk — no per-frame bytes copy (the FrameReader behavior the
+    reactor path replaces)."""
+    bodies = [b"hello", b"world" * 10]
+    chunk = b"".join(codec.frame(b) for b in bodies)
+    frames = codec.FrameScanner().feed(chunk)
+    assert [bytes(f) for f in frames] == bodies
+    for f in frames:
+        assert isinstance(f, memoryview)
+        assert f.obj is chunk  # view into the fed chunk, not a copy
+
+
+# -- reactor edge cases over real sockets -------------------------------------
+
+
+@pytest.fixture()
+def wire_server(frozen_time):
+    svc = DefaultTokenService(_rules())
+    svc.request_tokens([(FLOW_ID, 1, False)])  # absorb width-1 compile
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+    assert server.reactor_enabled
+    yield server
+    server.stop()
+
+
+def test_partial_frames_split_across_reads(wire_server):
+    """A pipelined burst delivered in 3-byte slices (every frame spans
+    reads) still answers completely and in order."""
+    n = 8
+    frames = b"".join(
+        codec.encode_request(xid, MSG_FLOW,
+                             codec.encode_flow_request(FLOW_ID, 1, False))
+        for xid in range(1, n + 1))
+    with socket.create_connection(
+            ("127.0.0.1", wire_server.bound_port), timeout=10) as sock:
+        for i in range(0, len(frames), 3):
+            sock.sendall(frames[i:i + 3])
+            time.sleep(0.002)
+        _raw, resps = _recv_frames(sock, n)
+    assert [r.xid for r in resps] == list(range(1, n + 1))
+    assert all(r.status == TokenResultStatus.OK for r in resps)
+
+
+def test_slow_consumer_outbuf_bounded_and_sheds(frozen_time):
+    """A client that writes a flood but never reads: the per-connection
+    reply backlog stays bounded (reading stops at the bound), requests
+    parsed past the bound shed OVERLOADED, and once the client drains,
+    every request has exactly one reply."""
+    from sentinel_tpu.core.config import config
+
+    config.set("csp.sentinel.wire.outbuf.max.bytes", "4096")
+    try:
+        svc = DefaultTokenService(_rules())
+        svc.request_tokens([(FLOW_ID, 1, False)] * 256)
+        server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+        try:
+            n = 4000
+            frames = b"".join(
+                codec.encode_request(
+                    xid, MSG_FLOW,
+                    codec.encode_flow_request(FLOW_ID, 1, False))
+                for xid in range(1, n + 1))
+            with socket.create_connection(
+                    ("127.0.0.1", server.bound_port), timeout=10) as sock:
+                sock.sendall(frames)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    wire = server.wire_stats()
+                    if wire["outbufShed"] > 0:
+                        break
+                    time.sleep(0.05)
+                wire = server.wire_stats()
+                assert wire["outbufShed"] > 0, wire
+                # Bounded: the backlog never exceeds the configured bound
+                # plus one read-chunk's worth of replies.
+                reactor = server._reactor
+                for conn in list(reactor._conns.values()):
+                    assert conn.out_bytes <= 4096 + reactor.read_chunk * 2
+                _raw, resps = _recv_frames(sock, n, timeout_s=30.0)
+            assert len(resps) == n  # zero silent drops
+            statuses = {int(r.status) for r in resps}
+            assert statuses <= {int(TokenResultStatus.OK),
+                                int(TokenResultStatus.OVERLOADED)}
+            assert int(TokenResultStatus.OVERLOADED) in statuses
+        finally:
+            server.stop()
+    finally:
+        config.set("csp.sentinel.wire.outbuf.max.bytes", "0")  # -> default
+
+
+def test_mid_harvest_connection_death_drops_verdict_no_strand(frozen_time):
+    """A connection that dies while its fused batch is on the device:
+    the verdict is dropped (counted), the reactor keeps serving other
+    connections, and nothing strands."""
+    svc = DefaultTokenService(_rules())
+    svc.request_tokens([(FLOW_ID, 1, False)] * 4)
+    real = svc.request_tokens
+    svc.request_tokens = lambda reqs, now_ms=None: (
+        time.sleep(0.3), real(reqs, now_ms))[1]
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+    try:
+        doomed = socket.create_connection(
+            ("127.0.0.1", server.bound_port), timeout=10)
+        doomed.sendall(codec.encode_request(
+            1, MSG_FLOW, codec.encode_flow_request(FLOW_ID, 1, False)))
+        time.sleep(0.05)  # let the request stage + dispatch
+        doomed.close()    # dies mid-harvest
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if server.wire_stats()["droppedReplies"] >= 1:
+                break
+            time.sleep(0.05)
+        assert server.wire_stats()["droppedReplies"] >= 1
+        # the reactor is still healthy for everyone else
+        with socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=10) as sock:
+            sock.sendall(codec.encode_request(
+                2, MSG_FLOW, codec.encode_flow_request(FLOW_ID, 1, False)))
+            _raw, resps = _recv_frames(sock, 1)
+        assert resps and resps[0].status == TokenResultStatus.OK
+    finally:
+        server.stop()
+
+
+def test_per_connection_fifo_preserved_across_mixed_types(wire_server):
+    """FLOW (harvested off-thread) interleaved with PING (filled
+    inline): reply BYTES still leave in request order — the slot ring
+    contract (docs/SEMANTICS.md "Coalescing ordering")."""
+    msgs = []
+    for xid in range(1, 9):
+        if xid % 2:
+            msgs.append(codec.encode_request(
+                xid, MSG_FLOW, codec.encode_flow_request(FLOW_ID, 1, False)))
+        else:
+            msgs.append(codec.encode_request(
+                xid, MSG_PING, codec.encode_ping("default")))
+    with socket.create_connection(
+            ("127.0.0.1", wire_server.bound_port), timeout=10) as sock:
+        sock.sendall(b"".join(msgs))
+        _raw, resps = _recv_frames(sock, 8)
+    assert [r.xid for r in resps] == list(range(1, 9))
+
+
+# -- wire compat: reactor <-> legacy byte-identical ---------------------------
+
+
+def _scripted_replies(engine, reactor: bool, epoch: int):
+    """Run the full scripted message sequence against a fresh server on
+    the given frontend; -> the raw concatenated reply bytes."""
+    svc = DefaultTokenService(_rules(), epoch=epoch)
+    svc.request_tokens([(FLOW_ID, 1, False)] * 2)  # absorb width compiles
+    svc.request_tokens([(999, 1, False)])
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0,
+                                engine=engine, reactor=reactor).start()
+    script = [
+        codec.encode_request(1, MSG_PING, codec.encode_ping("default")),
+        codec.encode_request(2, MSG_FLOW,
+                             codec.encode_flow_request(FLOW_ID, 2, False)),
+        codec.encode_request(3, MSG_FLOW,
+                             codec.encode_flow_request(999, 1, False)),
+        codec.encode_request(4, MSG_PARAM_FLOW,
+                             codec.encode_param_flow_request(
+                                 FLOW_ID, 1, ["k", 7])),
+        codec.encode_request(5, MSG_ENTRY, codec.encode_entry_request(
+            "wire-compat-res", "origin-a", 1, 0, False)),
+        codec.encode_request(6, MSG_EXIT, codec.encode_exit_request(1, False)),
+        codec.encode_request(7, MSG_EXIT, codec.encode_exit_request(99, False)),
+        codec.encode_request(8, 42, b"junk"),  # unknown type -> BAD_REQUEST
+    ]
+    try:
+        with socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=15) as sock:
+            sock.sendall(b"".join(script))
+            raw, resps = _recv_frames(sock, len(script))
+        assert len(resps) == len(script)
+        return raw
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("epoch", [0, 5])
+def test_wire_compat_reactor_and_legacy_byte_identical(engine, epoch):
+    """THE compat pin: the same scripted request stream (every message
+    type, incl. the epoch-TLV-stamped variants) answers byte-for-byte
+    identically on the reactor and the legacy thread-per-connection
+    frontend — an old client cannot tell the frontends apart."""
+    legacy = _scripted_replies(engine, reactor=False, epoch=epoch)
+    reactor = _scripted_replies(engine, reactor=True, epoch=epoch)
+    assert reactor == legacy
+
+
+@pytest.mark.parametrize("reactor", [False, True])
+def test_new_client_pipelined_against_both_frontends(engine, reactor,
+                                                     frozen_time):
+    """The pipelined client (new-client half of the compat matrix):
+    xid-correlated batch acquires work identically against the legacy
+    (old-server) and reactor frontends, epoch fencing included."""
+    svc = DefaultTokenService(_rules(), epoch=3)
+    svc.request_tokens([(FLOW_ID, 1, False)] * 16)
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0,
+                                engine=engine, reactor=reactor).start()
+    c = ClusterTokenClient("127.0.0.1", server.bound_port,
+                           request_timeout_s=10.0)
+    try:
+        c.start()
+        deadline = time.monotonic() + 5
+        while not c.is_connected() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        out = c.request_tokens_pipelined(
+            [(FLOW_ID, 1, False)] * 15 + [(999, 1, False)])
+        assert [int(r.status) for r in out[:15]] == [0] * 15
+        assert out[15].status == TokenResultStatus.NO_RULE_EXISTS
+    finally:
+        c.stop()
+        server.stop()
+
+
+def test_pipelined_client_overloaded_semantics(frozen_time):
+    """OVERLOADED reaches pipelined callers exactly as it reaches
+    per-request callers: status + retry-after, breaker neutral-success
+    (the wire round-tripped)."""
+    svc = DefaultTokenService(_rules())
+    svc.request_tokens([(FLOW_ID, 1, False)])
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+
+    def shed(requests, budget=None):
+        done = threading.Event()
+        box = {"shed_retry_after_ms": 40}
+        done.set()
+        return done, box
+
+    server.batcher.submit_many = shed
+    c = ClusterTokenClient("127.0.0.1", server.bound_port,
+                           request_timeout_s=5.0)
+    try:
+        c.start()
+        deadline = time.monotonic() + 5
+        while not c.is_connected() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        out = c.request_tokens_pipelined([(FLOW_ID, 1, False)] * 4)
+        assert all(r.status == TokenResultStatus.OVERLOADED for r in out)
+        assert all(r.wait_ms == 40 for r in out)
+        assert c.health_gate.snapshot()["state"] == "CLOSED"
+    finally:
+        c.stop()
+        server.stop()
+
+
+# -- allocation-free shed paths + coalescing granularity ----------------------
+
+
+class _StubService:
+    def request_tokens(self, requests, now_ms=None):
+        from sentinel_tpu.cluster.token_service import TokenResult
+
+        return [TokenResult(TokenResultStatus.OK, remaining=1)
+                for _ in requests]
+
+
+def test_batcher_shed_paths_allocate_nothing():
+    """Submit-time sheds return the SHARED pre-set event + immutable box
+    — zero allocations per shed request or group (the ISSUE 11
+    allocation-count pin), and admitted groups allocate exactly one
+    event+box per GROUP, never per request."""
+    b = _Batcher(_StubService(), 0.0, 256, max_queue_groups=10,
+                 watermark_pct=20, retry_after_ms=77)
+    # not started: submissions park in the queue -> watermark engages
+    admitted = [b.submit_many([(FLOW_ID, 1, False)] * 32) for _ in range(2)]
+    assert b.groups_allocated == 2  # one pair per 32-request group
+    s1 = b.submit_many([(FLOW_ID, 1, False)] * 500)
+    s2 = b.submit_many([(FLOW_ID, 1, False)])
+    assert s1[0] is s2[0] and s1[1] is s2[1]  # the shared shed pair
+    assert s1[0].is_set()
+    assert s1[1]["shed_retry_after_ms"] == 77
+    assert b.groups_allocated == 2  # sheds allocated nothing
+    assert b.shed_requests == 501
+    # admitted groups kept their own (distinct) pairs
+    assert admitted[0][0] is not admitted[1][0]
+
+
+def test_reactor_coalesces_connections_into_shared_groups(frozen_time):
+    """N pipelined single-connection bursts coalesce into O(cycles)
+    fused groups — not one group (nor one Event) per request: the
+    per-request wakeup storm the reactor removes."""
+    svc = DefaultTokenService(_rules())
+    for w in (64, 128, 192, 256):
+        svc.request_tokens([(FLOW_ID, 1, False)] * w)
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+    sizes = []
+    orig = server.batcher.submit_many
+
+    def spying(requests, budget=None):
+        reqs = list(requests)
+        sizes.append(len(reqs))
+        return orig(reqs, budget)
+
+    server.batcher.submit_many = spying
+    try:
+        n_conns, burst = 4, 64
+        socks = [socket.create_connection(
+            ("127.0.0.1", server.bound_port), timeout=10)
+            for _ in range(n_conns)]
+        frames = b"".join(
+            codec.encode_request(xid, MSG_FLOW,
+                                 codec.encode_flow_request(FLOW_ID, 1, False))
+            for xid in range(1, burst + 1))
+        for s in socks:
+            s.sendall(frames)
+        for s in socks:
+            _raw, resps = _recv_frames(s, burst)
+            assert len(resps) == burst
+            s.close()
+        total = n_conns * burst
+        assert sum(sizes) == total
+        # far fewer groups than requests: coalescing actually engaged
+        assert len(sizes) <= total // 8
+        assert server.batcher.groups_allocated <= len(sizes)
+    finally:
+        server.stop()
+
+
+# -- batched RLS mode ---------------------------------------------------------
+
+
+def test_rls_batched_mode_coalesces_descriptor_sets(frozen_time):
+    from sentinel_tpu.envoy_rls import (
+        EnvoyRlsRule,
+        KeyValueResource,
+        ResourceDescriptor,
+        proto,
+    )
+    from sentinel_tpu.envoy_rls.service import SentinelEnvoyRlsService
+
+    rls = SentinelEnvoyRlsService(batched=True)
+    rls.rules.load_rules([EnvoyRlsRule("web", [ResourceDescriptor(
+        [KeyValueResource("path", "/api")], 3)])])
+    try:
+        codes = []
+        for _ in range(5):
+            overall, statuses = rls.should_rate_limit(
+                "web", [[("path", "/api")]])
+            codes.append(overall)
+            assert len(statuses) == 1
+        assert codes.count(proto.CODE_OK) == 3
+        assert codes.count(proto.CODE_OVER_LIMIT) == 2
+        assert rls.overload_stats()["batched"] is True
+        assert rls.overload_stats()["batcher"]["admittedGroups"] >= 1
+    finally:
+        rls.close()
+
+
+# -- telemetry surface --------------------------------------------------------
+
+
+def test_wire_families_exported(engine):
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    text = render_engine_metrics(engine)
+    assert "sentinel_tpu_wire_connections -1" in text  # not a server
+    assert "sentinel_tpu_wire_coalesced_batch" in text
+    try:
+        engine.cluster.set_to_server(host="127.0.0.1", port=0)
+        text = render_engine_metrics(engine)
+        assert "sentinel_tpu_wire_connections 0" in text
+        wire = engine.resilience_stats()["wire"]
+        assert wire is not None and wire["connections"] == 0
+    finally:
+        engine.cluster.stop()
