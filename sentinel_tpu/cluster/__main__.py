@@ -70,7 +70,8 @@ class StandaloneHAParticipant:
                  refresh_ms: int = 3000, host: str = "0.0.0.0"):
         from sentinel_tpu.cluster.ha import ClusterHAManager
         from sentinel_tpu.cluster.state import ClusterStateManager
-        from sentinel_tpu.datasource.converters import cluster_map_from_json
+        from sentinel_tpu.datasource.converters import (
+            any_cluster_map_from_json)
 
         self.state = ClusterStateManager()
         self.ha = ClusterHAManager(state=self.state, machine_id=machine_id,
@@ -84,7 +85,7 @@ class StandaloneHAParticipant:
             self._rules_source.property.add_listener(
                 SimplePropertyListener(self._apply_rules))
         self._map_source = FileRefreshableDataSource(
-            map_path, converter=cluster_map_from_json,
+            map_path, converter=any_cluster_map_from_json,
             recommend_refresh_ms=refresh_ms)
         self.ha.watch(self._map_source.property)
 

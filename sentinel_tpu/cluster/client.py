@@ -70,7 +70,8 @@ class ClusterTokenClient:
                  retry_policy: Optional[RetryPolicy] = None,
                  health_gate=_CONFIG_GATE,
                  epoch_fence=None,
-                 connect_timeout_s: float = 3.0):
+                 connect_timeout_s: float = 3.0,
+                 fence_scope_fn=None):
         self.host = host
         self.port = port
         self.namespace = namespace
@@ -82,6 +83,13 @@ class ClusterTokenClient:
         # deposed leader — rejected as FAIL so split-brain can never
         # double-grant quota. None (default) disables fencing.
         self.epoch_fence = epoch_fence
+        # Sharded fencing (cluster/sharding.py): maps a request's
+        # flowId to the fence SCOPE its response is judged under (the
+        # flow's hash slice, via the shared ``sharding.slice_of``
+        # helper) — per-slice leadership terms are independent, so one
+        # slice's epoch must never gate another's. None (default)
+        # keeps the single global fence lane.
+        self.fence_scope_fn = fence_scope_fn
         # Backoff schedule for the reconnect loop: first delay is exactly
         # ``reconnect_interval_s`` (legacy cadence), repeated failures
         # back off with decorrelated jitter instead of hammering a dead
@@ -313,15 +321,33 @@ class ClusterTokenClient:
         if trace is not None:
             entity = codec.append_trace_tlv(entity, trace.traceparent())
         resp = self._gated_call(MSG_FLOW, entity, timeout_s, gate_neutral)
-        return self._flow_result(resp, traced=trace is not None)
+        return self._flow_result(resp, traced=trace is not None,
+                                 scope=self._scope_for(flow_id))
+
+    def _scope_for(self, flow_id):
+        """The fence scope (hash slice) a flow's responses are judged
+        under, or None on un-sharded clients."""
+        if self.fence_scope_fn is None:
+            return None
+        return self.fence_scope_fn(flow_id)
 
     def _flow_result(self, resp: Optional[codec.Response],
-                     traced: bool = False) -> TokenResult:
+                     traced: bool = False, scope=None) -> TokenResult:
         """Decode one FLOW response (epoch fence, OVERLOADED retry-after,
         span TLV) — shared by the per-request and pipelined paths."""
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
-        if self._epoch_stale(resp.entity, codec.FLOW_RESP_SIZE):
+        if resp.status == TokenResultStatus.WRONG_SLICE:
+            # Out-of-slice (cluster/sharding.py): not a verdict and not
+            # fenced (the replying leader holds no term for the slice).
+            # waitMs mirrors the map-version TLV; prefer the TLV.
+            _, wait_ms = codec.decode_flow_response(resp.entity)
+            ver = codec.read_map_version_tlv(resp.entity,
+                                             codec.FLOW_RESP_SIZE)
+            return TokenResult(resp.status,
+                               wait_ms=int(ver if ver is not None
+                                           else wait_ms))
+        if self._epoch_stale(resp.entity, codec.FLOW_RESP_SIZE, scope):
             return TokenResult(TokenResultStatus.FAIL)
         remaining, wait_ms = codec.decode_flow_response(resp.entity)
         span = (self._read_server_span(resp.entity, codec.FLOW_RESP_SIZE)
@@ -363,6 +389,7 @@ class ClusterTokenClient:
         xids = []
         frames = []
         boxes = []
+        scopes = [self._scope_for(r[0]) for r in requests]
         with self._lock:
             sock = self._sock
             if sock is None:
@@ -397,8 +424,10 @@ class ClusterTokenClient:
             for xid in xids:
                 if xid is not None:
                     self._pending.pop(xid, None)
-        out = [self._flow_result(box.get("resp")) if box is not None
-               else TokenResult(TokenResultStatus.FAIL) for box in boxes]
+        out = [self._flow_result(box.get("resp"), scope=scopes[k])
+               if box is not None
+               else TokenResult(TokenResultStatus.FAIL)
+               for k, box in enumerate(boxes)]
         if gate is not None:
             if any(b is not None and "resp" in b for b in boxes):
                 gate.record_success()
@@ -417,21 +446,29 @@ class ClusterTokenClient:
                                 gate_neutral)
         if resp is None:
             return TokenResult(TokenResultStatus.FAIL)
-        if self._epoch_stale(resp.entity, 0):
+        if resp.status == TokenResultStatus.WRONG_SLICE:
+            # Param responses carry the shard-map version ONLY in the
+            # TLV (no waitMs field in the entity).
+            ver = codec.read_map_version_tlv(resp.entity, 0)
+            return TokenResult(resp.status,
+                               wait_ms=int(ver) if ver is not None else 0)
+        if self._epoch_stale(resp.entity, 0, self._scope_for(flow_id)):
             return TokenResult(TokenResultStatus.FAIL)
         span = (self._read_server_span(resp.entity, 0)
                 if trace is not None else None)
         return TokenResult(resp.status, server_span=span)
 
-    def _epoch_stale(self, entity: bytes, offset: int) -> bool:
+    def _epoch_stale(self, entity: bytes, offset: int, scope=None) -> bool:
         """True when the response's epoch TLV is below the fence's
         high-water mark: a deposed leader replied, and honoring its
         grant could double-spend quota the new leader is also granting.
-        Unstamped responses (pre-HA servers) pass through unfenced."""
+        ``scope`` keys the fence lane (the flow's hash slice on sharded
+        clients — per-slice terms are independent); unstamped responses
+        (pre-HA servers) pass through unfenced."""
         fence = self.epoch_fence
         if fence is None:
             return False
         epoch = codec.read_epoch_tlv(entity, offset)
         if epoch is None:
             return False
-        return not fence.observe(epoch)
+        return not fence.observe(epoch, scope)

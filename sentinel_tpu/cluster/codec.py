@@ -185,6 +185,15 @@ TLV_TRACE = 0x54
 # responses whose epoch is below the highest they have ever observed,
 # so a deposed leader's replies can never double-grant quota.
 TLV_EPOCH = 0x45
+# Shard-map version TLV (cluster/sharding.py — ISSUE 12): WRONG_SLICE
+# responses carry the replying server's current shard-map version so a
+# mis-routed client can tell HOW stale its map is and self-heal (walk
+# the other leaders, adopt the one that answers) without a config push.
+# Appended after any span TLV like the epoch TLV; old peers skip it as
+# trailing bytes. Flow responses ALSO mirror the version into the
+# waitMs field (cheap access), but the TLV is the canonical carrier —
+# param responses have no waitMs field.
+TLV_MAP_VERSION = 0x4D
 
 _TLV_HEAD = struct.Struct(">BH")
 _EPOCH_VALUE = struct.Struct(">q")
@@ -225,6 +234,17 @@ def append_epoch_tlv(entity: bytes, raw: bytes) -> bytes:
 
 def read_epoch_tlv(entity: bytes, offset: int) -> Optional[int]:
     raw = read_tlv(entity, offset, TLV_EPOCH)
+    if raw is None or len(raw) != _EPOCH_VALUE.size:
+        return None
+    return _EPOCH_VALUE.unpack(raw)[0]
+
+
+def append_map_version_tlv(entity: bytes, version: int) -> bytes:
+    return append_tlv(entity, TLV_MAP_VERSION, _EPOCH_VALUE.pack(int(version)))
+
+
+def read_map_version_tlv(entity: bytes, offset: int) -> Optional[int]:
+    raw = read_tlv(entity, offset, TLV_MAP_VERSION)
     if raw is None or len(raw) != _EPOCH_VALUE.size:
         return None
     return _EPOCH_VALUE.unpack(raw)[0]

@@ -51,6 +51,17 @@ class TokenResultStatus(enum.IntEnum):
     # local lease/fallback path. A stock reference client treats the
     # unknown status as its fallbackToLocal path — same degradation.
     OVERLOADED = 6
+    # TPU extension (no reference twin): sharded multi-leader clusters
+    # (cluster/sharding.py) partition the flowId space into hash slices,
+    # each owned by exactly one leader. A request for a flow whose slice
+    # this server does NOT own is answered WRONG_SLICE — not a quota
+    # verdict, not a failure: the client's routing map is stale. The
+    # reply carries the server's current shard-map version (flow
+    # responses in waitMs, and canonically in a trailing map-version
+    # TLV), so a routing client can walk the other leaders and self-heal
+    # without waiting for a config push. A stock reference client treats
+    # the unknown status as fallbackToLocal — same safe degradation.
+    WRONG_SLICE = 7
 
 
 class ClusterFlowEvent(enum.IntEnum):

@@ -445,6 +445,12 @@ class ClusterHAManager:
             else config.cluster_ha_checkpoint_period_ms() / 1000.0)
         self.server_host = server_host
         self.map: Optional[ClusterMap] = None
+        # Sharded assignment (cluster/sharding.py — ISSUE 12): the last
+        # ShardMap applied, plus handoff accounting. A manager follows
+        # EITHER plain cluster maps or shard maps; apply_map dispatches
+        # on the pushed type.
+        self.shard_map = None
+        self.handoffs = 0
         self.checkpoints_published = 0
         self.rows_restored = 0
         self._lock = threading.RLock()
@@ -466,6 +472,11 @@ class ClusterHAManager:
 
     def apply_map(self, cmap: Optional[ClusterMap]) -> None:
         if cmap is None:
+            return
+        from sentinel_tpu.cluster.sharding import ShardMap
+
+        if isinstance(cmap, ShardMap):
+            self.apply_shard_map(cmap)
             return
         from sentinel_tpu.log.record_log import record_log
 
@@ -624,6 +635,249 @@ class ClusterHAManager:
         self.state.epoch = int(cmap.epoch)
         self.state.fence.observe(cmap.epoch)
 
+    # -- sharded multi-leader assignment (cluster/sharding.py) -------------
+
+    def apply_shard_map(self, smap) -> None:
+        """Adopt a :class:`~sentinel_tpu.cluster.sharding.ShardMap`:
+        become (or stay) the leader of the slices it assigns this seat —
+        publishing handoff checkpoints for slices LOST and warm-starting
+        slices GAINED — or route as a sharded client of the leader set.
+
+        Chaos seams: ``cluster.shard.map.split`` (an armed error makes
+        this seat sit out the push — the fleet splits across map
+        versions, which per-slice fencing + WRONG_SLICE self-healing
+        must absorb) and ``cluster.shard.donor.zombie`` (a donor that
+        neither publishes nor fences — its stale-epoch replies must be
+        fence-rejected fleet-wide)."""
+        from sentinel_tpu.cluster.state import SliceEpochFence
+        from sentinel_tpu.log.record_log import record_log
+        from sentinel_tpu.resilience import faults
+
+        with self._lock:
+            cur = self.shard_map
+            if cur is not None and smap.version < cur.version:
+                record_log.warn(
+                    "ignoring stale shard map version %d (< applied %d)",
+                    smap.version, cur.version)
+                return
+            if cur is not None and smap.n_slices != cur.n_slices:
+                record_log.warn(
+                    "rejecting shard map version %d: ring size %d != "
+                    "applied %d (the slice ring is fixed for a cluster's "
+                    "lifetime)", smap.version, smap.n_slices, cur.n_slices)
+                return
+            try:
+                faults.fire("cluster.shard.map.split")
+            except OSError:
+                record_log.warn(
+                    "shard map version %d not applied (map.split fault): "
+                    "seat stays on version %s", smap.version,
+                    cur.version if cur else None)
+                return
+            # Per-slice terms need a per-slice fence; swap the global
+            # fence in before any role runs under this map (duck-typed:
+            # EpochFence callers keep working through scope=None).
+            if not isinstance(self.state.fence, SliceEpochFence):
+                self.state.fence = SliceEpochFence()
+            mine = smap.epochs_of(self.machine_id)
+            spec = smap.server_for(self.machine_id)
+            srv = self.state.token_server
+            cur_shard = (srv.service.shard
+                         if srv is not None and not srv.crashed
+                         and self.state.mode == CLUSTER_SERVER else None)
+            if cur_shard is not None and set(cur_shard.epochs) - set(mine):
+                # This seat is a DONOR under the new map (losing one or
+                # more slices — possibly all of them). Zombie seam: when
+                # armed, the donor neither publishes nor fences; it
+                # keeps granting the moved slices at their old epochs,
+                # and the fleet's per-slice fences must reject those
+                # late replies (pinned by the chaos suite).
+                try:
+                    faults.fire("cluster.shard.donor.zombie")
+                except OSError:
+                    record_log.warn(
+                        "shard map version %d ignored (donor.zombie "
+                        "fault): still serving %d deposed slice(s)",
+                        smap.version,
+                        len(set(cur_shard.epochs) - set(mine)))
+                    return
+            try:
+                if mine and spec is not None:
+                    self._become_shard_server(smap, spec, mine)
+                else:
+                    self._become_shard_client(smap)
+            except Exception as ex:  # noqa: BLE001 — transition must retry
+                record_log.warn(
+                    "shard map version %d transition failed: %r — "
+                    "retrying in %.1fs", smap.version, ex,
+                    self.retry_delay_s)
+                self._schedule_retry(smap)
+                return
+            self.shard_map = smap
+            self.state.epoch = int(max(smap.slice_epoch, default=0))
+
+    def _slice_ckpt_base(self) -> Optional[str]:
+        return config.cluster_shard_handoff_path() or self.checkpoint_path
+
+    def _slice_ckpt_path(self, slice_id: int) -> str:
+        """The shared per-slice handoff file: donor publishes, recipient
+        restores — the slice-granular twin of the PR 5 shared
+        checkpoint file."""
+        return f"{self._slice_ckpt_base()}.s{int(slice_id):03d}"
+
+    def _publish_slice(self, service, slice_id: int, epoch: int,
+                       n_slices: int) -> None:
+        from sentinel_tpu.core import checkpoint as ckpt
+        from sentinel_tpu.resilience import faults
+
+        if not self._slice_ckpt_base():
+            return  # no shared handoff storage configured: cold handoffs
+        # Handoff-stall seam (delay mode): a slow NFS / pod eviction
+        # stalling the publish — the recipient may warm-start from an
+        # OLDER file; the over-admission bound degrades gracefully to
+        # grants-since-THAT-publish, never breaks.
+        faults.fire("cluster.shard.handoff.stall")
+        ckpt.save_cluster_checkpoint(
+            service, self._slice_ckpt_path(slice_id),
+            slices=(slice_id,), n_slices=n_slices, epoch=epoch)
+        self.checkpoints_published += 1
+
+    def _become_shard_server(self, smap, me, mine) -> None:
+        """Leader-side map application; ``mine`` is {slice: epoch}.
+        (The donor-zombie seam fires in apply_shard_map, before any
+        transition; reaching here means the map IS being applied.)"""
+        from sentinel_tpu.cluster.sharding import ShardState
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.core import checkpoint as ckpt
+        from sentinel_tpu.log.record_log import record_log
+
+        srv = self.state.token_server
+        same_seat = (srv is not None and self.state.mode == CLUSTER_SERVER
+                     and not srv.crashed and srv.bound_port == me.port)
+        old_shard = srv.service.shard if srv is not None else None
+        if same_seat and old_shard is not None:
+            service = srv.service
+            lost = sorted(set(old_shard.epochs) - set(mine))
+            gained = sorted(set(mine) - set(old_shard.epochs))
+            for sl in lost:
+                # Publish BEFORE fencing ourselves out: grants between
+                # this publish and set_shard below are the (bounded)
+                # handoff over-admission margin.
+                try:
+                    self._publish_slice(service, sl,
+                                        old_shard.epochs.get(sl, 0),
+                                        smap.n_slices)
+                    self.handoffs += 1
+                except Exception as ex:  # noqa: BLE001 — best-effort drain
+                    record_log.warn(
+                        "slice %d handoff publish failed: %r", sl, ex)
+            for sl in gained if self._slice_ckpt_base() else ():
+                try:
+                    self.rows_restored += ckpt.restore_cluster_checkpoint(
+                        service, self._slice_ckpt_path(sl),
+                        slices=(sl,), n_slices=smap.n_slices)
+                    self.handoffs += 1
+                except FileNotFoundError:
+                    pass  # no donor publish yet: slice starts cold
+                except ValueError as ex:
+                    record_log.warn(
+                        "slice %d handoff not restored: %s", sl, ex)
+            service.set_shard(ShardState(smap.n_slices, smap.version,
+                                         dict(mine)))
+            for sl, ep in mine.items():
+                self.state.fence.observe(ep, sl)
+            return
+        # Fresh promotion (was a client / NOT_STARTED / crashed / moved
+        # port): build a service, warm-start every owned slice, bind.
+        service = DefaultTokenService(rules=self.state.server_rules())
+        if srv is not None and old_shard is not None:
+            # In-process re-promotion: the freshest rows live in the OLD
+            # service — publish its slices before restoring (the PR 5
+            # same-seat argument, per slice).
+            for sl, ep in old_shard.epochs.items():
+                try:
+                    self._publish_slice(srv.service, sl, ep,
+                                        old_shard.n_slices)
+                except Exception as ex:  # noqa: BLE001
+                    record_log.warn(
+                        "pre-promotion slice %d publish failed: %r", sl, ex)
+        elif (srv is not None and not srv.crashed
+              and self.state.mode == CLUSTER_SERVER):
+            # A FLAT (PR 5) leader adopting its first shard map owned
+            # the WHOLE key space: publish EVERY ring slice from the
+            # live flat service — the slices this seat keeps warm-start
+            # below, and the ones handed to other leaders graft on THEIR
+            # restore. Skipping this would cold-start every flow
+            # mid-window, voiding the grants-since-publish bound for the
+            # whole migration. Files carry the flat term (the successor
+            # epochs supersede it on their first periodic publish).
+            flat_epoch = int(getattr(srv.service, "epoch", 0))
+            for sl in range(int(smap.n_slices)):
+                try:
+                    self._publish_slice(srv.service, sl, flat_epoch,
+                                        smap.n_slices)
+                except Exception as ex:  # noqa: BLE001
+                    record_log.warn(
+                        "flat-migration slice %d publish failed: %r",
+                        sl, ex)
+        service.set_shard(ShardState(smap.n_slices, smap.version,
+                                     dict(mine)))
+        for sl in sorted(mine) if self._slice_ckpt_base() else ():
+            try:
+                self.rows_restored += ckpt.restore_cluster_checkpoint(
+                    service, self._slice_ckpt_path(sl),
+                    slices=(sl,), n_slices=smap.n_slices)
+            except FileNotFoundError:
+                pass  # cold slice
+            except ValueError as ex:
+                record_log.warn("slice %d not restored: %s", sl, ex)
+        try:
+            service.request_tokens([(None, 0, False)])  # pre-bind jit warm
+        except Exception as ex:  # noqa: BLE001 — warm-up is best-effort
+            record_log.warn("token-service warm-up failed: %r", ex)
+        self.state.set_to_server(host=self.server_host, port=me.port,
+                                 service=service,
+                                 epoch=int(max(mine.values())))
+        service.set_shard(ShardState(smap.n_slices, smap.version,
+                                     dict(mine)))  # epoch overwritten above
+        for sl, ep in mine.items():
+            self.state.fence.observe(ep, sl)
+        if self._slice_ckpt_base():
+            self._ckpt_timer = ckpt.CheckpointTimer(
+                service, "<per-slice>", period_s=self.checkpoint_period_s,
+                save=self._shard_timer_save).start()
+        return
+
+    def _shard_timer_save(self, service, _path) -> None:
+        """Periodic publish for a sharded leader: one handoff file per
+        OWNED slice, each fenced at its own epoch — the files a
+        successor warm-starts from after a crash (grants since the last
+        tick = the per-slice over-admission margin)."""
+        shard = service.shard
+        if shard is None:
+            return
+        for sl, ep in shard.epochs.items():
+            self._publish_slice(service, sl, ep, shard.n_slices)
+
+    def _become_shard_client(self, smap) -> None:
+        from sentinel_tpu.cluster.sharding import ShardedTokenClient
+
+        cur = self.state.token_client
+        if (self.state.mode == CLUSTER_CLIENT
+                and isinstance(cur, ShardedTokenClient)
+                and cur.apply_map(smap)):
+            # Same client, new map: sockets for unchanged leaders were
+            # reused in place (no reconnect storm on a rebalance — the
+            # PR 5 same-target pin extended to the per-leader pool).
+            return
+        if self.engine is not None:
+            thresholds_fn = self.engine.cluster_degraded_thresholds
+        else:
+            thresholds_fn = self.state.server_rules().thresholds
+        client = ShardedTokenClient(
+            smap, fence=self.state.fence, thresholds_fn=thresholds_fn)
+        self.state.set_client(client)
+
     # -- checkpoint plumbing -----------------------------------------------
 
     def on_server_teardown(self, server) -> None:
@@ -634,10 +888,20 @@ class ClusterHAManager:
         if self._ckpt_timer is not None:
             self._ckpt_timer.stop()
             self._ckpt_timer = None
+        from sentinel_tpu.log.record_log import record_log
+
+        shard = getattr(server.service, "shard", None)
+        if shard is not None:
+            # Sharded drain: one final publish per owned slice, each
+            # fenced at its own epoch — the successors' warm-start.
+            try:
+                self._shard_timer_save(server.service, None)
+            except Exception as ex:  # noqa: BLE001 — drain is best-effort
+                record_log.warn("shard drain checkpoint failed: %r", ex)
+            return
         if not self.checkpoint_path:
             return
         from sentinel_tpu.core import checkpoint as ckpt
-        from sentinel_tpu.log.record_log import record_log
 
         try:
             ckpt.save_cluster_checkpoint(server.service, self.checkpoint_path)
@@ -646,9 +910,15 @@ class ClusterHAManager:
             record_log.warn("drain checkpoint failed: %r", ex)
 
     def publish_checkpoint(self) -> None:
-        """One immediate checkpoint publish (ops / tests)."""
+        """One immediate checkpoint publish (ops / tests): per owned
+        slice on a sharded leader, the single shared file otherwise."""
         srv = self.state.token_server
-        if srv is not None and self.checkpoint_path:
+        if srv is None:
+            return
+        if getattr(srv.service, "shard", None) is not None:
+            self._shard_timer_save(srv.service, None)
+            return
+        if self.checkpoint_path:
             from sentinel_tpu.core import checkpoint as ckpt
 
             ckpt.save_cluster_checkpoint(srv.service, self.checkpoint_path)
@@ -661,9 +931,12 @@ class ClusterHAManager:
         # operators are watching a failover. Plain attribute reads are
         # atomic; a scrape racing a flip just sees the old values.
         cmap = self.map
+        smap = self.shard_map
         return {
             "machineId": self.machine_id,
             "mapEpoch": cmap.epoch if cmap else None,
+            "shardMapVersion": smap.version if smap else None,
+            "handoffs": self.handoffs,
             "checkpointsPublished": self.checkpoints_published,
             "rowsRestored": self.rows_restored,
         }

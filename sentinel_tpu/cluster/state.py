@@ -29,7 +29,10 @@ class EpochFence:
         self.highest_seen = 0
         self.stale_rejected_count = 0
 
-    def observe(self, epoch: int) -> bool:
+    def observe(self, epoch: int, scope=None) -> bool:
+        """``scope`` is accepted (and ignored) so the global fence and
+        the sharded :class:`SliceEpochFence` are drop-in interchangeable
+        on the token-client response path."""
         epoch = int(epoch)
         with self._lock:
             if epoch < self.highest_seen:
@@ -44,6 +47,46 @@ class EpochFence:
         with self._lock:
             self.highest_seen += 1
             return self.highest_seen
+
+
+class SliceEpochFence:
+    """Per-slice leadership-epoch fence (cluster/sharding.py — ISSUE 12).
+
+    Sharded clusters fence each hash slice's leadership INDEPENDENTLY:
+    slice 3 moving from leader A (epoch 2) to leader B (epoch 3) must
+    not invalidate leader C's epoch-1 replies for slice 7. ``observe``
+    therefore keys its high-water mark by ``scope`` (the slice id the
+    caller derived from the request's flowId via the shared
+    ``sharding.slice_of`` helper); ``scope=None`` tracks a separate
+    global lane, so the fence still duck-types :class:`EpochFence` for
+    un-scoped callers. Rejection semantics per slice are exactly the
+    single-seat fence's — the SEMANTICS.md "Per-slice fencing bound"
+    proof is the PR 5 argument applied slice-wise."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._highest = {}  # scope -> highest epoch observed
+        self.stale_rejected_count = 0
+
+    @property
+    def highest_seen(self) -> int:
+        """Max over every slice (the ops-glance / ha_stats shape)."""
+        with self._lock:
+            return max(self._highest.values(), default=0)
+
+    def observe(self, epoch: int, scope=None) -> bool:
+        epoch = int(epoch)
+        key = None if scope is None else int(scope)
+        with self._lock:
+            if epoch < self._highest.get(key, 0):
+                self.stale_rejected_count += 1
+                return False
+            self._highest[key] = epoch
+            return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._highest)
 
 
 class ClusterStateManager:
@@ -251,9 +294,22 @@ class ClusterStateManager:
         stats_fn = getattr(cli, "failover_stats", None)
         if stats_fn is not None:
             out.update(stats_fn())
+        if srv is not None:
+            # A sharded leader reports its slice ownership here (a
+            # sharded CLIENT's block rides failover_stats() above).
+            snap_fn = getattr(srv.service, "shard_snapshot", None)
+            snap = snap_fn() if snap_fn is not None else None
+            if snap is not None:
+                out["shard"] = snap
         if self.ha is not None:
             out["manager"] = self.ha.stats()
         return out
+
+    def shard_stats(self) -> Optional[dict]:
+        """The shard block of :meth:`ha_stats` (slice ownership for a
+        leader, routing/degraded-slice state for a sharded client), or
+        None when this instance is not part of a sharded cluster."""
+        return self.ha_stats().get("shard")
 
     def overload_stats(self) -> Optional[dict]:
         """The embedded token server's frontend overload snapshot

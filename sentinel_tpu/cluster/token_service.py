@@ -60,6 +60,7 @@ class TokenTicket(NamedTuple):
     now_ms: int
     t0: float           # dispatch perf_counter (span timing)
     sync_results: object = None  # pre-resolved results (sync fallback)
+    shard: object = None  # ShardState snapshot at dispatch (slice epochs)
 
 
 class TokenResult(NamedTuple):
@@ -67,12 +68,19 @@ class TokenResult(NamedTuple):
 
     ``server_span`` rides only on traced requests (telemetry/spans.py):
     the server-side token-service span's identity + timing, shipped back
-    over the wire so the client can stitch per-hop latency."""
+    over the wire so the client can stitch per-hop latency.
+
+    ``epoch`` (cluster/sharding.py): the PER-SLICE fencing epoch this
+    verdict was granted under — the TCP frontend stamps it into the
+    reply's epoch TLV instead of the service-global epoch, so each
+    slice's leadership fences independently. None keeps the pre-shard
+    behavior (the frontend stamps ``service.epoch``)."""
 
     status: int
     remaining: int = 0
     wait_ms: int = 0
     server_span: Optional[Dict] = None  # {"spanId","startMs","durationUs"}
+    epoch: Optional[int] = None         # per-slice fencing epoch
 
 
 class ConnectionManager:
@@ -247,6 +255,13 @@ class DefaultTokenService:
         self.limiter = GlobalRequestLimiter(max_allowed_qps)
         self.max_occupy_ratio = max_occupy_ratio
         self._lock = threading.Lock()
+        # Sharded ownership (cluster/sharding.py): when set, requests
+        # for flows hashing outside the owned slices are answered
+        # WRONG_SLICE (carrying the map version) instead of a verdict,
+        # and verdicts carry their slice's fencing epoch. Replaced
+        # wholesale by set_shard, read lock-free on the dispatch path.
+        self._shard = None
+        self.wrong_slice_count = 0
         self._compiled_version = -1
         self._rt: Optional[ClusterRuleTensors] = None
         self._state: Optional[ClusterMetricState] = None
@@ -263,6 +278,42 @@ class DefaultTokenService:
         from sentinel_tpu.telemetry.spans import SpanCollector
 
         self.spans = SpanCollector(sample_every=0)
+
+    # -- sharded ownership (cluster/sharding.py) ---------------------------
+
+    @property
+    def shard(self):
+        return self._shard
+
+    def set_shard(self, shard) -> None:
+        """Adopt a new slice-ownership view (a ``sharding.ShardState``;
+        None returns to unsharded single-leader behavior). The service
+        epoch becomes the max owned slice epoch for the epoch-keyed
+        consumers that take the SERVICE term when no per-slice one is in
+        play — checkpoint-save fencing (``save_cluster_checkpoint``'s
+        ``getattr(service, "epoch")`` default) and the flat teardown
+        publish. Wire replies are NOT among them: ``stamp_epoch`` stamps
+        sharded replies only from each verdict's own slice epoch (sheds
+        and pings from a sharded leader go out unstamped)."""
+        self._shard = shard
+        if shard is not None and shard.epochs:
+            self.epoch = int(max(shard.epochs.values()))
+
+    def shard_snapshot(self) -> Optional[dict]:
+        """The leader-side shard block of ``ha_stats`` (exporter +
+        dashboard source); lock-free like every stats read."""
+        shard = self._shard
+        if shard is None:
+            return None
+        return {
+            "mode": "server",
+            "mapVersion": shard.version,
+            "nSlices": shard.n_slices,
+            "slicesOwned": len(shard.epochs),
+            "sliceEpochs": {str(sl): int(ep)
+                            for sl, ep in sorted(shard.epochs.items())},
+            "wrongSliceRejected": self.wrong_slice_count,
+        }
 
     def _ensure_compiled(self):
         if self._compiled_version == self.rules.version:
@@ -359,6 +410,7 @@ class DefaultTokenService:
 
         now = now_ms if now_ms is not None else time_util.current_time_millis()
         traces = tuple(r[3] if len(r) > 3 else None for r in requests)
+        shard = self._shard
         with self._lock:
             self._ensure_compiled()
             pre: List[Optional[TokenResult]] = [None] * len(requests)
@@ -371,9 +423,26 @@ class DefaultTokenService:
                     flow_id = int(flow_id)
                 except (TypeError, ValueError):
                     continue  # slot stays -1 -> NO_RULE_EXISTS
+                slice_epoch = None
+                if shard is not None:
+                    slice_epoch = shard.epoch_for_flow(flow_id)
+                    if slice_epoch is None:
+                        # Out-of-slice: this leader does not own the
+                        # flow's hash slice — answer WRONG_SLICE with
+                        # the current map version (NOT a verdict; the
+                        # routing client walks the other leaders and
+                        # self-heals). Checked strictly before the
+                        # limiter and the device step, so a mis-routed
+                        # request never consumes quota here.
+                        self.wrong_slice_count += 1
+                        pre[i] = TokenResult(
+                            CC.TokenResultStatus.WRONG_SLICE,
+                            wait_ms=shard.version)
+                        continue
                 ns = self._ns_of.get(flow_id)
                 if ns is not None and not self.limiter.try_pass(ns, now):
-                    pre[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
+                    pre[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST,
+                                         epoch=slice_epoch)
                     continue
                 slots[i] = self._slot_of.get(flow_id, -1)
                 counts[i] = count
@@ -394,7 +463,7 @@ class DefaultTokenService:
                 self._compiled_version = -1
                 raise
             return TokenTicket(tuple(requests), traces, tuple(pre),
-                               status, extra, now, t0)
+                               status, extra, now, t0, shard=shard)
 
     def harvest_tokens(self, ticket: TokenTicket) -> List[TokenResult]:
         """Resolve a dispatched batch to concrete TokenResults. The
@@ -429,6 +498,12 @@ class DefaultTokenService:
                     result = TokenResult(s, wait_ms=int(extra[i]))
                 else:
                     result = TokenResult(s, remaining=int(extra[i]))
+                if ticket.shard is not None:
+                    # Stamp the verdict with ITS slice's fencing epoch
+                    # (the ticket's snapshot — a concurrent rebalance
+                    # must not retag an already-granted verdict).
+                    result = result._replace(
+                        epoch=ticket.shard.epoch_for_flow(req[0]))
             if ticket.traces[i] is not None:
                 result = result._replace(server_span=self._record_span(
                     ticket.traces[i], req[0], ticket.now_ms, step_us,
@@ -469,12 +544,28 @@ class DefaultTokenService:
             flow_id = int(flow_id)  # one bucket key space for "123" and 123
         except (TypeError, ValueError):
             return TokenResult(CC.TokenResultStatus.NO_RULE_EXISTS)
+        shard = self._shard
+        slice_epoch = None
+        if shard is not None:
+            slice_epoch = shard.epoch_for_flow(flow_id)
+            if slice_epoch is None:
+                # Out-of-slice, same contract as the flow path: checked
+                # before the rule lookup and limiter so a mis-routed
+                # param request never consumes a bucket here.
+                self.wrong_slice_count += 1
+                return TokenResult(CC.TokenResultStatus.WRONG_SLICE,
+                                   wait_ms=shard.version)
         rule = self.rules.rule_by_flow_id(flow_id)
         if rule is None:
-            return TokenResult(CC.TokenResultStatus.NO_RULE_EXISTS)
+            # Every owned-slice reply carries ITS slice's epoch (None
+            # when unsharded): stamping a flat service epoch here would
+            # let one slice's term pollute another's fence lane.
+            return TokenResult(CC.TokenResultStatus.NO_RULE_EXISTS,
+                               epoch=slice_epoch)
         ns = self.rules.namespace_of_flow_id(flow_id)
         if ns is not None and not self.limiter.try_pass(ns, now):
-            return TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST)
+            return TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST,
+                               epoch=slice_epoch)
         # AVG_LOCAL scales the per-value threshold by the namespace's live
         # client count, mirroring the flow-token path (reference:
         # ClusterParamFlowChecker.calcGlobalThreshold).
@@ -501,7 +592,8 @@ class DefaultTokenService:
                     break
                 pending[key] = within + count
             if blocked:
-                return TokenResult(CC.TokenResultStatus.BLOCKED)
+                return TokenResult(CC.TokenResultStatus.BLOCKED,
+                                   epoch=slice_epoch)
             for key, add in pending.items():
                 start, used = self._param_buckets.get(key, (window_start, 0.0))
                 if start != window_start:
@@ -509,7 +601,7 @@ class DefaultTokenService:
                 self._param_buckets[key] = (window_start, used + add)
             if len(self._param_buckets) > 100_000:  # bounded key space
                 self._param_buckets.clear()
-        return TokenResult(CC.TokenResultStatus.OK)
+        return TokenResult(CC.TokenResultStatus.OK, epoch=slice_epoch)
 
     # -- introspection -----------------------------------------------------
 

@@ -321,8 +321,17 @@ def _peek_header_epoch(path: str) -> Optional[int]:
         return None
 
 
-def save_cluster_checkpoint(service, path: str) -> None:
+def save_cluster_checkpoint(service, path: str, slices=None,
+                            n_slices: Optional[int] = None,
+                            epoch: Optional[int] = None) -> None:
     """Atomically snapshot a ``DefaultTokenService``'s flow windows.
+
+    ``slices``/``n_slices`` (cluster/sharding.py rebalancing): restrict
+    the snapshot to flows hashing into those slices of an ``n_slices``
+    ring — a slice HANDOFF publishes exactly the donor's rows for the
+    moving slice, nothing else. ``epoch`` overrides the header's fencing
+    epoch with the slice's own term (per-slice epochs, not the
+    service-global max).
 
     The shared file is epoch-fenced like the wire: a save from a service
     whose epoch is BELOW the file's is refused, so a deposed leader's
@@ -335,6 +344,16 @@ def save_cluster_checkpoint(service, path: str) -> None:
     fencing) keep last-writer-wins."""
     import jax
 
+    keep = None
+    if slices is not None:
+        from sentinel_tpu.cluster.sharding import slice_of
+
+        n = int(n_slices) if n_slices is not None else 0
+        if n <= 0:
+            raise ValueError("slice-filtered save needs n_slices > 0")
+        wanted = {int(s) for s in slices}
+        keep = lambda fid: slice_of(fid, n) in wanted  # noqa: E731
+
     # Snapshot first (service lock only) — never hold the file lock
     # while waiting on the device.
     with service._lock:
@@ -342,9 +361,15 @@ def save_cluster_checkpoint(service, path: str) -> None:
         state = jax.block_until_ready(service._state)
         header = {
             "version": CLUSTER_CHECKPOINT_VERSION,
-            "epoch": int(getattr(service, "epoch", 0)),
-            "flows": {str(fid): slot for fid, slot in service._slot_of.items()},
+            "epoch": int(epoch if epoch is not None
+                         else getattr(service, "epoch", 0)),
+            "flows": {str(fid): slot
+                      for fid, slot in service._slot_of.items()
+                      if keep is None or keep(fid)},
         }
+        if slices is not None:
+            header["slices"] = sorted(int(s) for s in slices)
+            header["nSlices"] = int(n_slices)
         arrays = {
             "counts": np.asarray(state.win.counts),
             "starts": np.asarray(state.win.starts),
@@ -378,15 +403,30 @@ def save_cluster_checkpoint(service, path: str) -> None:
                 pass
 
 
-def restore_cluster_checkpoint(service, path: str) -> int:
+def restore_cluster_checkpoint(service, path: str, slices=None,
+                               n_slices: Optional[int] = None) -> int:
     """Warm-start ``service``'s flow windows from a leader's snapshot.
 
     Grafts each surviving flowId's window row into the service's OWN
     compiled layout; rows whose bucket geometry differs (rule edit
-    between leaders) or whose flowId is unknown here start cold. Returns
-    the number of rows restored. A corrupted/truncated file raises
-    ``ValueError`` before any service state is touched."""
+    between leaders) or whose flowId is unknown here start cold.
+    ``slices``/``n_slices`` restrict the graft to flows hashing into
+    those slices (cluster/sharding.py: a handoff recipient warm-starts
+    ONLY the slice it gained — rows for slices it does not own must not
+    shadow their true owner's state). Returns the number of rows
+    restored. A corrupted/truncated file raises ``ValueError`` before
+    any service state is touched."""
     import jax.numpy as jnp
+
+    keep = None
+    if slices is not None:
+        from sentinel_tpu.cluster.sharding import slice_of
+
+        n = int(n_slices) if n_slices is not None else 0
+        if n <= 0:
+            raise ValueError("slice-filtered restore needs n_slices > 0")
+        wanted = {int(s) for s in slices}
+        keep = lambda fid: slice_of(fid, n) in wanted  # noqa: E731
 
     header, arrays = _load_npz(path)
     if header.get("version") != CLUSTER_CHECKPOINT_VERSION:
@@ -414,6 +454,8 @@ def restore_cluster_checkpoint(service, path: str) -> int:
             try:
                 fid, old_slot = int(fid_str), int(old_slot)
             except (TypeError, ValueError):
+                continue
+            if keep is not None and not keep(fid):
                 continue
             new_slot = service._slot_of.get(fid)
             # old_slot must index EVERY old array (a corrupted file can

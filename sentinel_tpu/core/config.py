@@ -58,6 +58,8 @@ CLUSTER_HA_RECONNECT_MS = "csp.sentinel.cluster.ha.reconnect.ms"
 CLUSTER_HA_DEGRADED_DIVISOR = "csp.sentinel.cluster.ha.degraded.divisor"
 CLUSTER_HA_CHECKPOINT_PATH = "csp.sentinel.cluster.ha.checkpoint.path"
 CLUSTER_HA_CHECKPOINT_PERIOD_MS = "csp.sentinel.cluster.ha.checkpoint.period.ms"
+CLUSTER_SHARD_SLICES = "csp.sentinel.cluster.shard.slices"
+CLUSTER_SHARD_HANDOFF_PATH = "csp.sentinel.cluster.shard.handoff.path"
 # Telemetry layer (sentinel_tpu/telemetry/ — no reference twin).
 # profile.syncEvery: every Nth device dispatch blocks for a true
 # synchronous step wall (StepTimer sampling cadence; the rest record
@@ -186,6 +188,11 @@ DEFAULT_CLUSTER_HA_RECONNECT_MS = 250
 # sum-of-shares <= global-threshold bound to hold (docs/SEMANTICS.md).
 DEFAULT_CLUSTER_HA_DEGRADED_DIVISOR = 1
 DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS = 5_000
+# Sharded multi-leader ring size (cluster/sharding.py): slices per
+# cluster when a shard map doesn't say otherwise. FIXED for a cluster's
+# lifetime — ownership rebalances, the ring never resizes (resizing
+# would remap every flow's slice and void the per-slice fencing bound).
+DEFAULT_CLUSTER_SHARD_SLICES = 64
 DEFAULT_PROFILE_SYNC_EVERY = 64
 DEFAULT_TELEMETRY_TRACE_SAMPLE_EVERY = 64
 DEFAULT_TELEMETRY_TRACE_CAPACITY = 256
@@ -403,6 +410,17 @@ class SentinelConfig:
         v = self.get_int(CLUSTER_HA_CHECKPOINT_PERIOD_MS,
                          DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS)
         return v if v > 0 else DEFAULT_CLUSTER_HA_CHECKPOINT_PERIOD_MS
+
+    # Sharded-cluster accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.cluster.shard.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def cluster_shard_slices(self) -> int:
+        v = self.get_int(CLUSTER_SHARD_SLICES, DEFAULT_CLUSTER_SHARD_SLICES)
+        return v if v > 0 else DEFAULT_CLUSTER_SHARD_SLICES
+
+    def cluster_shard_handoff_path(self) -> Optional[str]:
+        return self.get(CLUSTER_SHARD_HANDOFF_PATH)
 
     # Overload accessors (the ONLY sanctioned readers of the
     # csp.sentinel.overload.* keys — test_lint forbids reading the

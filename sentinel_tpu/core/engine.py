@@ -264,6 +264,12 @@ class SentinelEngine:
         # OVERLOADED (the token server shed before admission) and were
         # served via the local lease/fallback path instead.
         self.cluster_overload_count = 0
+        # Shard mis-routes (ISSUE 12): entries whose cluster check came
+        # back WRONG_SLICE un-healed — the client's routing map was
+        # stale past what the self-healing walk could absorb (or a
+        # plain unsharded client is pointed at a sharded leader); the
+        # rule degraded to its local fallback.
+        self.cluster_wrong_slice_count = 0
         from sentinel_tpu.core.config import (
             DEFAULT_RESILIENCE_ENTRY_BUDGET_MS, RESILIENCE_ENTRY_BUDGET_MS)
 
@@ -1220,6 +1226,17 @@ class SentinelEngine:
                     all_ok = False
                     self._note_cluster_fallback()
                 continue
+            if tr.status == TokenResultStatus.WRONG_SLICE:
+                # The leader we reached no longer owns this flow's hash
+                # slice and the client could not self-heal within this
+                # entry (cluster/sharding.py): not a verdict — degrade
+                # to the local check like a FAIL, separately counted so
+                # a stale-map storm is visible in resilience_stats.
+                self.cluster_wrong_slice_count += 1
+                if fallback:
+                    all_ok = False
+                    self._note_cluster_fallback()
+                continue
             if fallback:  # FAIL / NO_RULE / TOO_MANY_REQUEST -> local check
                 all_ok = False
                 self._note_cluster_fallback()
@@ -1243,6 +1260,12 @@ class SentinelEngine:
                 return False, True
             if tr.status == TokenResultStatus.OVERLOADED:
                 self.cluster_overload_count += 1
+                if fallback:
+                    all_ok = False
+                    self._note_cluster_fallback()
+                continue
+            if tr.status == TokenResultStatus.WRONG_SLICE:
+                self.cluster_wrong_slice_count += 1
                 if fallback:
                     all_ok = False
                     self._note_cluster_fallback()
@@ -1608,6 +1631,7 @@ class SentinelEngine:
             "clusterFallbackCount": self.cluster_fallback_count,
             "clusterBudgetExhaustedCount": self.cluster_budget_exhausted_count,
             "clusterOverloadCount": self.cluster_overload_count,
+            "clusterWrongSliceCount": self.cluster_wrong_slice_count,
             "clusterEntryBudgetMs": self.cluster_entry_budget_ms,
             "tokenClientBreaker": None,
             # Frontend overload (ISSUE 6): the embedded token server's

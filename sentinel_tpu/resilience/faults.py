@@ -51,6 +51,22 @@ FAULT_POINTS = (
     "cluster.ha.leader.crash",
     "cluster.ha.halfopen",
     "cluster.ha.stale.epoch",
+    # Sharded multi-leader seams (cluster/sharding.py — ISSUE 12):
+    # * shard.handoff.stall — fired (delay mode) before each per-slice
+    #   handoff-checkpoint publish; a stalled publish widens the
+    #   recipient's warm-start margin to grants-since-the-PREVIOUS
+    #   publish, which the drill asserts stays bounded.
+    # * shard.map.split — fired at the top of every shard-map apply; an
+    #   armed error makes that seat sit the push out, splitting the
+    #   fleet across map versions (stale routers must self-heal through
+    #   WRONG_SLICE walks, never double-grant through the fence).
+    # * shard.donor.zombie — fired on a donor losing slices; an armed
+    #   error makes it neither publish nor fence — it keeps granting
+    #   the moved slices at their old epochs, and every client's
+    #   per-slice fence must reject those late replies.
+    "cluster.shard.handoff.stall",
+    "cluster.shard.map.split",
+    "cluster.shard.donor.zombie",
 )
 
 

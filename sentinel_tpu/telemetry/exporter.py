@@ -154,6 +154,39 @@ def render_engine_metrics(engine) -> str:
               "Cumulative seconds spent in degraded-quota mode",
               ha.get("degradedSeconds", 0.0))
 
+    # -- sharded multi-leader cluster (cluster/sharding.py — ISSUE 12) ----
+    # One family set for both roles: a LEADER reports slice ownership
+    # and per-slice epochs; a routing CLIENT reports the degraded blast
+    # radius. Absent (unsharded) instances render zeros so one scrape
+    # config fits every role.
+    shard = ha.get("shard") or {}
+    mgr = ha.get("manager") or {}
+    b.family("sentinel_tpu_shard_slices_owned", "gauge",
+             "Hash slices this leader currently owns (0: not a sharded "
+             "leader)")
+    b.sample("sentinel_tpu_shard_slices_owned", None,
+             shard.get("slicesOwned", 0))
+    b.family("sentinel_tpu_shard_slice_epoch", "gauge",
+             "Per-slice leadership epoch of each OWNED slice (the fence "
+             "term stamped into that slice's verdicts)")
+    for sl, ep in sorted(shard.get("sliceEpochs", {}).items(),
+                         key=lambda kv: int(kv[0])):
+        b.sample("sentinel_tpu_shard_slice_epoch", {"slice": str(sl)}, ep)
+    b.counter("sentinel_tpu_shard_wrong_slice_rejected",
+              "Requests answered (server) or observed (client) "
+              "WRONG_SLICE: the flow hashed outside the reached "
+              "leader's owned slices",
+              shard.get("wrongSliceRejected", 0))
+    b.counter("sentinel_tpu_shard_handoffs",
+              "Slice handoffs this seat completed (donor publishes + "
+              "recipient warm-starts through the checkpoint graft)",
+              mgr.get("handoffs", 0))
+    b.family("sentinel_tpu_shard_degraded_slices", "gauge",
+             "Slices currently served from the per-client degraded "
+             "share because their owning leader is unreachable")
+    b.sample("sentinel_tpu_shard_degraded_slices", None,
+             shard.get("degradedSlices", 0))
+
     # -- frontend overload (bounded ingestion — ISSUE 6) ------------------
     # Server-side families render -1 / nothing while this instance is
     # not a token server, so one scrape config fits every role.

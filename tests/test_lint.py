@@ -150,6 +150,14 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_wire_rtt_ms",
                  "sentinel_tpu_wire_outbuf_shed"):
         assert name in seen, f"{name} not declared in the exporters"
+    # sharded-cluster families (ISSUE 12): declared exactly once (the
+    # dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_shard_slices_owned",
+                 "sentinel_tpu_shard_slice_epoch",
+                 "sentinel_tpu_shard_wrong_slice_rejected",
+                 "sentinel_tpu_shard_handoffs",
+                 "sentinel_tpu_shard_degraded_slices"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -193,6 +201,74 @@ def test_cluster_ha_config_keys_accessor_only_and_documented():
     assert not undocumented, (
         "cluster HA config keys missing from docs/OPERATIONS.md: "
         + ", ".join(undocumented))
+
+
+def test_shard_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.cluster.shard.*`` config key must (a) be
+    defined and read ONLY in core/config.py — the rest of the package
+    goes through the ``SentinelConfig`` accessors — and (b) appear in
+    docs/OPERATIONS.md, so the sharded-cluster runbook can never
+    silently drift from the knobs the code actually reads (same rule
+    shape as the cluster-HA gate above)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.cluster\.shard\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.cluster.shard.* literals outside core/config.py "
+        "(use the SentinelConfig cluster_shard_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no cluster shard config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "cluster shard config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_slice_hashing_only_in_the_shared_routing_helper():
+    """Client-side routing and server-side ownership checks must agree
+    BYTE-FOR-BYTE on the flowId→slice mapping, so there is exactly one
+    implementation: ``sharding.slice_of``. A re-implementation anywhere
+    else in the package (a copied hash constant, a second ``slice_of``
+    definition, or a bare flowId modulus) can silently diverge and void
+    the per-slice fencing bound."""
+    import re
+
+    helper = Path("sentinel_tpu") / "cluster" / "sharding.py"
+    mix = re.compile(r"0x9E3779B97F4A7C15", re.IGNORECASE)
+    # Module-level definitions only: parallel/namespaces.py's
+    # NamespaceShardMap.slice_of METHOD hashes NAMESPACES for host-side
+    # pod routing — a different domain with no wire-agreement contract.
+    defn = re.compile(r"^def\s+slice_of\s*\(")
+    modulus = re.compile(r"flow_id\s*%|fid\s*%\s*n_slices")
+    offenders = []
+    seen_helper = False
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        is_helper = rel == helper
+        for lineno, code in _code_lines(path):
+            if is_helper:
+                seen_helper = seen_helper or bool(defn.search(code))
+                continue
+            for pat, what in ((mix, "the slice-hash constant"),
+                              (defn, "a second slice_of definition"),
+                              (modulus, "a bare flowId modulus")):
+                if pat.search(code):
+                    offenders.append(f"{rel}:{lineno} carries {what}")
+    assert seen_helper, "sharding.slice_of not found (helper moved?)"
+    assert not offenders, (
+        "flowId→slice hashing outside cluster/sharding.py "
+        "(route through sharding.slice_of): " + ", ".join(offenders))
 
 
 def test_no_unbounded_queues_in_serving_paths():

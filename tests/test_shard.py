@@ -1,0 +1,1055 @@
+"""Sharded multi-leader cluster suite (ISSUE 12 tentpole): fixed-ring
+flowId hash slices with per-slice epoch-fenced ownership, client-side
+slice routing with WRONG_SLICE self-healing, per-slice failover (only a
+lost leader's slices degrade), and crash-safe rebalancing through the
+slice-filtered checkpoint grafting path.
+
+Determinism stance matches test_cluster_ha.py: host-side quota math and
+degraded-mode state machines run on the frozen ``utils/time_util``
+clock; socket scenarios use real time for connect/reconnect waits. The
+multi-spell chaos drill is ``slow``-marked from the start (870s tier-1
+discipline); one scaled-down seed of every invariant stays tier-1.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from collections import Counter
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.constants import THRESHOLD_GLOBAL, TokenResultStatus
+from sentinel_tpu.cluster.ha import (
+    ClusterHAManager,
+    ClusterMap,
+    ClusterServerSpec,
+    DegradedQuota,
+)
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.sharding import (
+    ShardedTokenClient,
+    ShardMap,
+    ShardState,
+    slice_of,
+)
+from sentinel_tpu.cluster.state import (
+    CLUSTER_CLIENT,
+    CLUSTER_SERVER,
+    ClusterStateManager,
+    SliceEpochFence,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.core import checkpoint as ckpt
+from sentinel_tpu.datasource.converters import (
+    any_cluster_map_from_json,
+    shard_map_from_json,
+    shard_map_to_dict,
+)
+from sentinel_tpu.resilience import FaultInjector
+from sentinel_tpu.utils import time_util
+
+pytestmark = pytest.mark.chaos
+
+N = 8  # scaled-down ring (the shipped default is 64; the math is size-free)
+
+# Three flowIds landing in three DISTINCT slices of the 8-ring (pinned
+# below by test_slice_of_pinned_and_stable, so these stay honest).
+FID_A, FID_B, FID_C = 9003, 9001, 9000   # slices 0, 4, 6
+SL_A, SL_B, SL_C = 0, 4, 6
+
+
+@pytest.fixture()
+def injector():
+    with FaultInjector(seed=4242) as inj:
+        yield inj
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait(pred, timeout_s: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _rule(flow_id, count, **cc):
+    return st.FlowRule(
+        resource=f"res-{flow_id}", count=count, cluster_mode=True,
+        cluster_config={"flowId": flow_id, "thresholdType": THRESHOLD_GLOBAL,
+                        **cc})
+
+
+def _rules(*pairs):
+    mgr = ClusterFlowRuleManager()
+    mgr.load_rules("default", [_rule(fid, cnt) for fid, cnt in pairs])
+    return mgr
+
+
+def _owner_map(assign, version=1, epochs=None, servers=None, clients=("X",)):
+    """assign: {machine_id: [slices]}; unlisted slices go to the first
+    machine. epochs: {slice: epoch} overrides (default = version)."""
+    owner = [None] * N
+    for mid, sls in assign.items():
+        for sl in sls:
+            owner[sl] = mid
+    first = next(iter(assign))
+    owner = [m if m is not None else first for m in owner]
+    eps = [version] * N
+    for sl, ep in (epochs or {}).items():
+        eps[sl] = ep
+    return ShardMap(version=version, n_slices=N, servers=tuple(servers),
+                    slice_owner=tuple(owner), slice_epoch=tuple(eps),
+                    clients=tuple(clients))
+
+
+def _seats(tmp_path, machine_ids, rule_pairs):
+    base = str(tmp_path / "shard.ck")
+    out = {}
+    for mid in machine_ids:
+        state = ClusterStateManager()
+        state.server_rules().load_rules(
+            "default", [_rule(fid, cnt) for fid, cnt in rule_pairs])
+        out[mid] = ClusterHAManager(
+            state=state, machine_id=mid, checkpoint_path=base,
+            checkpoint_period_s=3600.0, server_host="127.0.0.1")
+    return out
+
+
+# -- routing helper + fence (no sockets) --------------------------------------
+
+
+def test_slice_of_pinned_and_stable():
+    """The flowId→slice mapping is a WIRE contract (client and server
+    recompute it independently): pin concrete values so any drift in
+    the shared helper fails loudly, and sanity-check spread."""
+    # Pins for the shipped 64-ring and the test 8-ring.
+    assert slice_of(6000, 64) == 30
+    assert slice_of(6001, 64) == 36
+    assert slice_of(123456789, 64) == 48
+    assert [slice_of(f, N) for f in (FID_A, FID_B, FID_C)] \
+        == [SL_A, SL_B, SL_C]
+    # Full range + non-degenerate spread over sequential ids (the
+    # common flowId allocation pattern a bare modulus would stripe).
+    counts = Counter(slice_of(i, N) for i in range(10_000))
+    assert set(counts) <= set(range(N))
+    assert len(counts) == N
+    assert max(counts.values()) < 10_000 // N * 3
+    # Deterministic (no process-seeded hash()).
+    assert slice_of(2**63 - 1, 64) == slice_of(2**63 - 1, 64)
+
+
+def test_slice_epoch_fence_lanes_independent():
+    f = SliceEpochFence()
+    assert f.observe(5, scope=3)
+    # Slice 7's lane is untouched by slice 3's term.
+    assert f.observe(1, scope=7)
+    assert not f.observe(4, scope=3)       # stale in lane 3
+    assert f.stale_rejected_count == 1
+    assert f.observe(5, scope=3)           # equal epoch passes
+    assert f.observe(2, scope=None)        # global lane independent too
+    assert not f.observe(1, scope=None)
+    assert f.highest_seen == 5
+    assert f.snapshot() == {3: 5, 7: 1, None: 2}
+
+
+# -- converter ----------------------------------------------------------------
+
+
+def _map_json(owners, version=3, n=N, epochs=None):
+    d = {
+        "version": version, "nSlices": n,
+        "servers": [{"machineId": "a", "host": "10.0.0.1", "port": 1871},
+                    {"machineId": "b", "host": "10.0.0.2", "port": 1871}],
+        "sliceOwners": owners,
+        "clients": ["c1", "c2"],
+    }
+    if epochs is not None:
+        d["sliceEpochs"] = epochs
+    return d
+
+
+def test_shard_map_converter_roundtrip():
+    m = shard_map_from_json(_map_json(
+        {"a": [0, 1, 2, 3], "b": [4, 5, 6, 7]}, epochs={"4": 9}))
+    assert m.version == 3 and m.n_slices == N
+    assert m.slice_owner == ("a",) * 4 + ("b",) * 4
+    assert m.slice_epoch == (3, 3, 3, 3, 9, 3, 3, 3)  # default = version
+    assert m.clients == ("c1", "c2")
+    assert m.slices_of("b") == (4, 5, 6, 7)
+    assert m.epochs_of("a") == {0: 3, 1: 3, 2: 3, 3: 3}
+    # List form + roundtrip through to_dict.
+    m2 = shard_map_from_json(shard_map_to_dict(m))
+    assert m2 == m
+    flat = dict(_map_json(list(m.slice_owner)))
+    assert shard_map_from_json(flat).slice_owner == m.slice_owner
+    # Dual-flavor converter dispatches on the sliceOwners key.
+    assert isinstance(any_cluster_map_from_json(
+        _map_json({"a": list(range(8))})), ShardMap)
+    assert not isinstance(any_cluster_map_from_json(
+        {"epoch": 1, "servers": [{"machineId": "a", "host": "h",
+                                  "port": 1}]}), ShardMap)
+
+
+def test_shard_map_converter_rejects_malformed():
+    good = _map_json({"a": [0, 1, 2, 3], "b": [4, 5, 6, 7]})
+    bad = [
+        {**good, "sliceOwners": {"a": [0, 1], "b": [4, 5, 6, 7]}},  # gaps
+        {**good, "sliceOwners": {"a": [0, 0, 1, 2, 3],
+                                 "b": [4, 5, 6, 7]}},   # double-assigned
+        {**good, "sliceOwners": {"zz": list(range(8))}},  # unknown owner
+        {**good, "sliceOwners": {"a": [0, 1, 2, 99],
+                                 "b": [3, 4, 5, 6, 7]}},  # out of ring
+        {**good, "sliceOwners": ["a"] * 7},               # short list
+        {**good, "nSlices": 0},                           # empty ring
+        {**good, "version": "x"},                         # non-int version
+        {**good, "servers": []},                          # no leaders
+        {**good, "sliceEpochs": {"99": 2}},               # epoch off-ring
+        {**good, "sliceEpochs": [1, 2]},                  # short epoch list
+        {**good, "clients": "c1"},                        # bare string
+        [],                                               # not an object
+    ]
+    for d in bad:
+        with pytest.raises(ValueError):
+            shard_map_from_json(d)
+
+
+# -- server-side ownership (direct service, no sockets) -----------------------
+
+
+def test_service_wrong_slice_is_pre_device_and_quota_free(frozen_time):
+    svc = DefaultTokenService(_rules((FID_A, 4), (FID_C, 4)))
+    svc.set_shard(ShardState(N, 7, {SL_A: 2}))
+    # Unowned slice: WRONG_SLICE carrying the map version; repeated
+    # requests consume NOTHING (checked before limiter + device step).
+    for _ in range(6):
+        r = svc.request_token(FID_C)
+        assert r.status == TokenResultStatus.WRONG_SLICE
+        assert r.wait_ms == 7
+    assert svc.wrong_slice_count == 6
+    # Owned slice serves its full quota, stamped with ITS slice epoch.
+    got = [svc.request_token(FID_A) for _ in range(5)]
+    assert [g.status for g in got] == [TokenResultStatus.OK] * 4 \
+        + [TokenResultStatus.BLOCKED]
+    assert all(g.epoch == 2 for g in got)
+    # Param path: same ownership contract.
+    r = svc.request_param_token(FID_C, 1, ["k"])
+    assert r.status == TokenResultStatus.WRONG_SLICE and r.wait_ms == 7
+    r = svc.request_param_token(FID_A, 1, ["k"])
+    assert r.status == TokenResultStatus.OK and r.epoch == 2
+    snap = svc.shard_snapshot()
+    assert snap["slicesOwned"] == 1 and snap["sliceEpochs"] == {"0": 2}
+    assert snap["wrongSliceRejected"] == 7
+
+
+def test_wrong_slice_wire_roundtrip_and_fence_hygiene(frozen_time):
+    """WRONG_SLICE on the real wire: status + map version through the
+    dedicated TLV, and NO epoch TLV — an out-of-slice reply must never
+    write into the requesting slice's fence lane (the replying leader
+    holds no term there)."""
+    svc = DefaultTokenService(_rules((FID_A, 100), (FID_C, 100)))
+    svc.set_shard(ShardState(N, 5, {SL_A: 9}))
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+    fence = SliceEpochFence()
+    cli = ClusterTokenClient(
+        "127.0.0.1", server.bound_port, request_timeout_s=10.0,
+        epoch_fence=fence,
+        fence_scope_fn=lambda fid: slice_of(int(fid), N)).start()
+    try:
+        assert _wait(cli.is_connected)
+        r = cli.request_token(FID_C)
+        assert r.status == TokenResultStatus.WRONG_SLICE
+        assert r.wait_ms == 5                       # map version, not retry
+        assert fence.snapshot() == {}               # lane untouched
+        r = cli.request_token(FID_A)
+        assert r.status == TokenResultStatus.OK
+        assert fence.snapshot() == {SL_A: 9}        # per-slice epoch landed
+        # Param flavor: version rides the TLV (no waitMs field).
+        r = cli.request_param_token(FID_C, 1, ["k"])
+        assert r.status == TokenResultStatus.WRONG_SLICE and r.wait_ms == 5
+    finally:
+        cli.stop()
+        server.stop()
+
+
+def test_stale_slice_epoch_rejected_per_lane(frozen_time):
+    """A deposed donor's late replies carry its old slice epoch and are
+    fence-rejected — while an UNRELATED slice's lower-epoch leader keeps
+    serving (per-slice lanes, the tentpole's fencing contract)."""
+    svc = DefaultTokenService(_rules((FID_A, 100), (FID_C, 100)))
+    # Zombie view: still claims slice SL_A at epoch 2, and honestly
+    # owns SL_C at epoch 1.
+    svc.set_shard(ShardState(N, 1, {SL_A: 2, SL_C: 1}))
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+    fence = SliceEpochFence()
+    fence.observe(3, SL_A)   # the fleet has seen SL_A's epoch-3 owner
+    cli = ClusterTokenClient(
+        "127.0.0.1", server.bound_port, request_timeout_s=10.0,
+        epoch_fence=fence,
+        fence_scope_fn=lambda fid: slice_of(int(fid), N)).start()
+    try:
+        assert _wait(cli.is_connected)
+        r = cli.request_token(FID_A)
+        assert r.status == TokenResultStatus.FAIL   # stale term: rejected
+        assert fence.stale_rejected_count == 1
+        r = cli.request_token(FID_C)                # unrelated slice: fine
+        assert r.status == TokenResultStatus.OK
+        assert fence.snapshot()[SL_C] == 1
+    finally:
+        cli.stop()
+        server.stop()
+
+
+# -- sharded client routing ---------------------------------------------------
+
+
+def _two_leader_wire(counts=((FID_A, 1000), (FID_B, 1000), (FID_C, 1000)),
+                     a_slices=(SL_A,), version=1):
+    """Two real leaders: A owning ``a_slices``, B the rest."""
+    servers, specs = [], []
+    for mid in ("A", "B"):
+        owned = set(a_slices) if mid == "A" \
+            else set(range(N)) - set(a_slices)
+        svc = DefaultTokenService(_rules(*counts), max_allowed_qps=1e9)
+        svc.set_shard(ShardState(N, version, {s: version for s in owned}))
+        srv = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+        servers.append(srv)
+        specs.append(ClusterServerSpec(mid, "127.0.0.1", srv.bound_port))
+    return servers, specs
+
+
+def test_sharded_client_routes_by_slice_and_pipelines(frozen_time):
+    servers, specs = _two_leader_wire()
+    smap = _owner_map({"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+                      servers=specs)
+    cli = ShardedTokenClient(smap, request_timeout_s=10.0).start()
+    try:
+        assert _wait(cli.is_connected)
+        for fid in (FID_A, FID_B, FID_C):
+            assert cli.request_token(fid).status == TokenResultStatus.OK
+        # Correct routing = zero wrong-slice traffic anywhere.
+        assert servers[0].service.wrong_slice_count == 0
+        assert servers[1].service.wrong_slice_count == 0
+        # Pipelined: one batch splits per owning leader, results land
+        # in request order.
+        out = cli.request_tokens_pipelined(
+            [(FID_A, 1, False), (FID_B, 1, False), (FID_C, 1, False),
+             (FID_A, 1, False)])
+        assert [r.status for r in out] == [TokenResultStatus.OK] * 4
+        assert servers[0].service.wrong_slice_count == 0
+        assert servers[1].service.wrong_slice_count == 0
+    finally:
+        cli.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_sharded_client_self_heals_on_stale_map(frozen_time):
+    """A client whose map routes every slice to A walks to B on
+    WRONG_SLICE, adopts B as the learned owner, and stops paying the
+    mis-route on subsequent requests — no config push involved."""
+    servers, specs = _two_leader_wire()
+    stale = _owner_map({"A": list(range(N))}, servers=specs)
+    cli = ShardedTokenClient(stale, request_timeout_s=10.0).start()
+    try:
+        assert _wait(cli.is_connected)
+        for fid in (FID_A, FID_B, FID_C):
+            assert cli.request_token(fid).status == TokenResultStatus.OK
+        s = cli.failover_stats()["shard"]
+        assert s["wrongSliceRejected"] == 2      # B's two slices healed
+        assert s["learnedOverrides"] == 2
+        assert s["staleMapVersionSeen"] == 1     # B's reply named its map
+        assert cli.failover_count == 2
+        w0 = cli.wrong_slice_count
+        for fid in (FID_A, FID_B, FID_C):        # learned: direct now
+            assert cli.request_token(fid).status == TokenResultStatus.OK
+        assert cli.wrong_slice_count == w0
+    finally:
+        cli.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_per_slice_failover_only_victim_slices_degrade(frozen_time):
+    """Killing leader B degrades ONLY B's slices: A's keep full-fidelity
+    verdicts with zero degraded entries, B's serve the per-client share
+    after the failover deadline — the blast-radius contract."""
+    servers, specs = _two_leader_wire()
+    smap = _owner_map({"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+                      servers=specs)
+    cli = ShardedTokenClient(
+        smap, request_timeout_s=0.3, failover_deadline_ms=400,
+        degraded=DegradedQuota(divisor=2,
+                               thresholds={FID_B: (8.0, 1000)})).start()
+    try:
+        assert _wait(cli.is_connected)
+        assert cli.request_token(FID_A).status == TokenResultStatus.OK
+        assert cli.request_token(FID_B).status == TokenResultStatus.OK
+        servers[1].stop()                        # B dies (no drain)
+        assert _wait(lambda: not cli._pool["B"].is_connected())
+        # First verdict-free walk starts B's clock only.
+        assert cli.request_token(FID_B).status == TokenResultStatus.FAIL
+        time_util.advance_time(500)              # past the deadline
+        r = cli.request_token(FID_B)             # degraded share: 8/2 = 4
+        assert r.status == TokenResultStatus.OK
+        got = [cli.request_token(FID_B).status for _ in range(4)]
+        assert got == [TokenResultStatus.OK] * 3 \
+            + [TokenResultStatus.BLOCKED]
+        # A's slice: untouched, still full fidelity, zero degraded.
+        assert cli.request_token(FID_A).status == TokenResultStatus.OK
+        s = cli.failover_stats()
+        assert s["degraded"] is True
+        assert s["shard"]["degradedSlices"] == N - 1   # B's slices only
+        assert s["shard"]["leaders"]["A"]["degraded"] is False
+        assert s["shard"]["leaders"]["B"]["degraded"] is True
+        assert cli.fence.stale_rejected_count == 0
+        # B recovers -> its slices exit degraded on the next verdict.
+        svc = DefaultTokenService(_rules((FID_B, 1000)), max_allowed_qps=1e9)
+        svc.set_shard(ShardState(N, 1, {s: 1 for s in range(N)
+                                        if s != SL_A}))
+        revived = ClusterTokenServer(
+            svc, host="127.0.0.1", port=specs[1].port).start()
+        try:
+            assert _wait(lambda: cli._pool["B"].is_connected(), 10.0)
+            assert _wait(lambda: cli.request_token(FID_B).status
+                         == TokenResultStatus.OK, 10.0)
+            assert cli.failover_stats()["shard"]["degradedSlices"] == 0
+        finally:
+            revived.stop()
+    finally:
+        cli.stop()
+        for s in servers:
+            s.stop()
+
+
+class _StatusStub:
+    """Pool stand-in answering a fixed wire status (no sockets)."""
+
+    def __init__(self, status, wait_ms=0, connected=True):
+        from sentinel_tpu.cluster.token_service import TokenResult
+
+        self._result = TokenResult(status, wait_ms=wait_ms)
+        self._connected = connected
+        self.calls = 0
+
+    def is_connected(self):
+        return self._connected
+
+    def request_token(self, *a, **k):
+        self.calls += 1
+        return self._result
+
+    def request_param_token(self, *a, **k):
+        return self.request_token()
+
+    def stop(self):
+        pass
+
+
+def test_survivor_overload_does_not_mask_victim_failover(frozen_time):
+    """A survivor shedding OVERLOADED must not reset the dead owner's
+    failover clock: a frontend sheds BEFORE its slice check, so it sheds
+    for slices it does not even own — if that reply were credited to the
+    owner, the victim's slices could never enter degraded mode for as
+    long as any other leader is loaded. The owner's clock stops only
+    when the owner ITSELF proves alive (its own OVERLOADED answer, or
+    the backoff window such an answer opened)."""
+    specs = (ClusterServerSpec("A", "127.0.0.1", _free_port()),
+             ClusterServerSpec("B", "127.0.0.1", _free_port()))
+    smap = _owner_map({"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+                      servers=specs)
+    cli = ShardedTokenClient(
+        smap, request_timeout_s=0.3, failover_deadline_ms=400,
+        degraded=DegradedQuota(divisor=2, thresholds={FID_B: (8.0, 1000)}),
+        health_gate=None)
+    try:
+        shedding_a = _StatusStub(TokenResultStatus.OVERLOADED, wait_ms=50)
+        cli._pool = {"A": shedding_a, "B": _StatusStub(
+            TokenResultStatus.FAIL, connected=False)}     # B is DOWN
+        # Walk for B's slice: B dead, A sheds -> OVERLOADED surfaces
+        # (safe local degradation) but B's clock STARTS.
+        r = cli.request_token(FID_B)
+        assert r.status == TokenResultStatus.OVERLOADED
+        assert shedding_a.calls == 1
+        time_util.advance_time(500)                       # past deadline
+        # Still shedding elsewhere — B's slices now serve the per-client
+        # degraded share regardless (8/2 = 4).
+        got = [cli.request_token(FID_B).status for _ in range(5)]
+        assert got == [TokenResultStatus.OK] * 4 \
+            + [TokenResultStatus.BLOCKED]
+        s = cli.failover_stats()
+        assert s["shard"]["leaders"]["B"]["degraded"] is True
+        assert s["shard"]["leaders"]["A"]["degraded"] is False
+        # The owner ITSELF answering OVERLOADED is alive: its spell ends
+        # and its slices return OVERLOADED, not degraded verdicts.
+        time_util.advance_time(300)                       # A's backoff over
+        cli._pool["B"] = _StatusStub(TokenResultStatus.OVERLOADED,
+                                     wait_ms=50)
+        r = cli.request_token(FID_B)
+        assert r.status == TokenResultStatus.OVERLOADED
+        assert cli.failover_stats()["shard"]["leaders"]["B"]["degraded"] \
+            is False
+    finally:
+        cli.stop()
+
+
+def test_map_change_reuses_live_sockets(frozen_time):
+    """A rebalance that only moves slices keeps every unchanged leader's
+    live socket (no reconnect storm): the PR 5 same-target-reuse pin
+    extended to the per-leader pool."""
+    servers, specs = _two_leader_wire()
+    m1 = _owner_map({"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+                    servers=specs)
+    cli = ShardedTokenClient(m1, request_timeout_s=10.0).start()
+    try:
+        assert _wait(cli.is_connected)
+        inner_a, inner_b = cli._pool["A"], cli._pool["B"]
+        assert cli.request_token(FID_A).status == TokenResultStatus.OK
+        m2 = _owner_map({"A": [SL_A, SL_B],
+                         "B": [s for s in range(N) if s not in (SL_A, SL_B)]},
+                        version=2, servers=specs)
+        assert cli.apply_map(m2)
+        assert cli._pool["A"] is inner_a         # same sockets, no churn
+        assert cli._pool["B"] is inner_b
+        assert cli.socket_reuse_count == 2
+        assert cli.map.version == 2
+        # Stale and ring-resize maps are refused.
+        assert not cli.apply_map(m1)
+        assert not cli.apply_map(m2._replace(version=3, n_slices=N * 2))
+        # A leader address CHANGE does rebuild that one client.
+        specs2 = (specs[0],
+                  ClusterServerSpec("B", "127.0.0.1", _free_port()))
+        m3 = _owner_map({"A": [SL_A, SL_B],
+                         "B": [s for s in range(N) if s not in (SL_A, SL_B)]},
+                        version=3, servers=specs2)
+        assert cli.apply_map(m3)
+        assert cli._pool["A"] is inner_a
+        assert cli._pool["B"] is not inner_b
+    finally:
+        cli.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- checkpoint slice filtering ----------------------------------------------
+
+
+def test_checkpoint_slice_filter_roundtrip(frozen_time, tmp_path):
+    path = str(tmp_path / "slice.ck")
+    svc = DefaultTokenService(_rules((FID_A, 10), (FID_B, 10), (FID_C, 10)))
+    for _ in range(3):
+        assert svc.request_token(FID_A).status == TokenResultStatus.OK
+    for _ in range(5):
+        assert svc.request_token(FID_C).status == TokenResultStatus.OK
+    ckpt.save_cluster_checkpoint(svc, path, slices=(SL_A,), n_slices=N,
+                                 epoch=4)
+    header, _arrays = ckpt._load_npz(path)
+    assert set(header["flows"]) == {str(FID_A)}      # only SL_A's flows
+    assert header["epoch"] == 4 and header["slices"] == [SL_A]
+    # Restore into a fresh service: only the filtered slice grafts; a
+    # filter EXCLUDING the file's slice grafts nothing.
+    svc2 = DefaultTokenService(_rules((FID_A, 10), (FID_B, 10), (FID_C, 10)))
+    assert ckpt.restore_cluster_checkpoint(svc2, path, slices=(SL_C,),
+                                           n_slices=N) == 0
+    assert ckpt.restore_cluster_checkpoint(svc2, path, slices=(SL_A,),
+                                           n_slices=N) == 1
+    got = [svc2.request_token(FID_A).status for _ in range(8)]
+    assert got.count(TokenResultStatus.OK) == 7      # 3 carried + 7 = 10
+    assert svc2.request_token(FID_C).status == TokenResultStatus.OK
+    with pytest.raises(ValueError):
+        ckpt.save_cluster_checkpoint(svc, path, slices=(SL_A,))  # no ring
+
+
+def test_handoff_preserves_quota_bound(frozen_time, tmp_path):
+    """Graceful rebalance: donor publishes the slice's rows then fences
+    itself; the recipient warm-starts from them — total admissions for a
+    flow across the handoff never exceed its threshold (margin 0 for a
+    graceful handoff; a crash's margin is grants-since-last-publish,
+    drilled in the 3-leader test)."""
+    T = 6
+    seats = _seats(tmp_path, ("A", "B"), [(FID_A, T), (FID_C, T)])
+    specs = (ClusterServerSpec("A", "127.0.0.1", _free_port()),
+             ClusterServerSpec("B", "127.0.0.1", _free_port()))
+    m1 = _owner_map({"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+                    servers=specs)
+    try:
+        seats["A"].apply_map(m1)
+        seats["B"].apply_map(m1)
+        svc_a = seats["A"].state.token_server.service
+        svc_b = seats["B"].state.token_server.service
+        for _ in range(4):
+            assert svc_a.request_token(FID_A).status == TokenResultStatus.OK
+        # Move SL_A to B, bumping ONLY that slice's epoch (unchanged
+        # slices keep term 1 — per-slice epochs, not a global term).
+        m2 = _owner_map(
+            {"B": list(range(N))}, version=2,
+            epochs={**{s: 1 for s in range(N)}, SL_A: 2}, servers=specs)
+        seats["A"].apply_map(m2)     # donor drains + flips to client
+        assert seats["A"].state.mode == CLUSTER_CLIENT
+        assert svc_a.shard.epochs == {SL_A: 1}  # old view, now fenced out
+        seats["B"].apply_map(m2)
+        assert seats["B"].rows_restored >= 1
+        assert seats["B"].handoffs >= 1
+        got = [svc_b.request_token(FID_A) for _ in range(4)]
+        assert [g.status for g in got] \
+            == [TokenResultStatus.OK, TokenResultStatus.OK,
+                TokenResultStatus.BLOCKED, TokenResultStatus.BLOCKED]
+        assert all(g.epoch == 2 for g in got)   # the bumped slice term
+        # Unchanged slices kept epoch 1 — still fenced per-slice.
+        assert svc_b.request_token(FID_C).epoch == 1
+    finally:
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+def test_flat_leader_first_shard_map_publishes_whole_ring(frozen_time,
+                                                          tmp_path):
+    """A FLAT (PR 5) leader adopting its FIRST shard map owned the whole
+    key space: the migration publishes EVERY ring slice from the live
+    flat service before the sharded world restores — the slices this
+    seat keeps graft on its own warm-start, and the moved ones graft on
+    the recipients'. No flow cold-starts mid-window."""
+    T = 6
+    seats = _seats(tmp_path, ("A", "B"), [(FID_A, T), (FID_B, T)])
+    specs = (ClusterServerSpec("A", "127.0.0.1", _free_port()),
+             ClusterServerSpec("B", "127.0.0.1", _free_port()))
+    flat = ClusterMap(epoch=3, servers=(
+        ClusterServerSpec("A", "127.0.0.1", _free_port()),), clients=("X",))
+    try:
+        seats["A"].apply_map(flat)               # PR 5 flat leadership
+        assert seats["A"].state.mode == CLUSTER_SERVER
+        svc_flat = seats["A"].state.token_server.service
+        assert svc_flat.shard is None
+        for _ in range(4):
+            assert svc_flat.request_token(FID_A).status \
+                == TokenResultStatus.OK
+        for _ in range(3):
+            assert svc_flat.request_token(FID_B).status \
+                == TokenResultStatus.OK
+        # First shard map: A keeps FID_A's slice, B gains the rest
+        # (including FID_B's).
+        m = _owner_map({"A": [SL_A],
+                        "B": [s for s in range(N) if s != SL_A]},
+                       version=4, servers=specs)
+        seats["A"].apply_map(m)
+        seats["B"].apply_map(m)
+        svc_a = seats["A"].state.token_server.service
+        svc_b = seats["B"].state.token_server.service
+        assert svc_a.shard is not None and svc_a is not svc_flat
+        # A's retained slice kept its rows: 4 of T=6 carried over.
+        got = [svc_a.request_token(FID_A).status for _ in range(3)]
+        assert got == [TokenResultStatus.OK, TokenResultStatus.OK,
+                       TokenResultStatus.BLOCKED]
+        # B's gained slice grafted the flat rows: 3 of T=6 carried.
+        got = [svc_b.request_token(FID_B).status for _ in range(4)]
+        assert got == [TokenResultStatus.OK, TokenResultStatus.OK,
+                       TokenResultStatus.OK, TokenResultStatus.BLOCKED]
+    finally:
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+# -- chaos seams --------------------------------------------------------------
+
+
+def test_handoff_stall_widens_margin_but_stays_bounded(frozen_time, tmp_path,
+                                                       injector):
+    """cluster.shard.handoff.stall (delay mode): the donor's publish is
+    slow but completes — the handoff still lands and the quota bound
+    still holds (a stall widens the margin only when a crash interrupts
+    the publish; a slow graceful drain costs latency, not correctness)."""
+    T = 5
+    seats = _seats(tmp_path, ("A", "B"), [(FID_A, T)])
+    specs = (ClusterServerSpec("A", "127.0.0.1", _free_port()),
+             ClusterServerSpec("B", "127.0.0.1", _free_port()))
+    m1 = _owner_map({"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+                    servers=specs)
+    injector.arm("cluster.shard.handoff.stall", "delay", delay_ms=50,
+                 times=64)
+    try:
+        seats["A"].apply_map(m1)
+        seats["B"].apply_map(m1)
+        svc_a = seats["A"].state.token_server.service
+        for _ in range(3):
+            assert svc_a.request_token(FID_A).status == TokenResultStatus.OK
+        m2 = _owner_map({"B": list(range(N))}, version=2,
+                        epochs={**{s: 1 for s in range(N)}, SL_A: 2},
+                        servers=specs)
+        t0 = time.monotonic()
+        seats["A"].apply_map(m2)
+        assert time.monotonic() - t0 >= 0.05     # the stall really fired
+        seats["B"].apply_map(m2)
+        assert seats["B"].rows_restored >= 1
+        svc_b = seats["B"].state.token_server.service
+        got = [svc_b.request_token(FID_A).status for _ in range(3)]
+        assert got == [TokenResultStatus.OK, TokenResultStatus.OK,
+                       TokenResultStatus.BLOCKED]   # 3 carried + 2 = T
+    finally:
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+def test_map_split_seat_sits_out_push(frozen_time, tmp_path, injector):
+    """cluster.shard.map.split: a seat the push cannot reach stays on
+    its old map version — visible as a version split in stats — and
+    rejoins on the next successful push."""
+    seats = _seats(tmp_path, ("A",), [(FID_A, 5)])
+    specs = (ClusterServerSpec("A", "127.0.0.1", _free_port()),)
+    m1 = _owner_map({"A": list(range(N))}, servers=specs)
+    try:
+        seats["A"].apply_map(m1)
+        assert seats["A"].shard_map.version == 1
+        injector.arm("cluster.shard.map.split", "error", times=1)
+        m2 = _owner_map({"A": list(range(N))}, version=2, servers=specs)
+        seats["A"].apply_map(m2)
+        assert seats["A"].shard_map.version == 1    # sat the push out
+        assert seats["A"].stats()["shardMapVersion"] == 1
+        seats["A"].apply_map(m2)                    # next push lands
+        assert seats["A"].shard_map.version == 2
+    finally:
+        seats["A"].stop()
+
+
+def test_donor_zombie_late_replies_fence_rejected(frozen_time, tmp_path,
+                                                  injector):
+    """cluster.shard.donor.zombie: the donor neither publishes nor
+    fences — it keeps granting the moved slice at the old epoch. A
+    client that saw the new map must fence-reject its late replies (no
+    double-granting across the split)."""
+    seats = _seats(tmp_path, ("A", "B"), [(FID_A, 100)])
+    specs = [ClusterServerSpec(mid, "127.0.0.1", _free_port())
+             for mid in seats]
+    m1 = _owner_map({"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+                    servers=specs)
+    try:
+        seats["A"].apply_map(m1)
+        seats["B"].apply_map(m1)
+        m2 = _owner_map({"B": list(range(N))}, version=2,
+                        epochs={**{s: 1 for s in range(N)}, SL_A: 2},
+                        servers=specs)
+        injector.arm("cluster.shard.donor.zombie", "error", times=1)
+        seats["A"].apply_map(m2)                 # zombie: map unapplied
+        assert seats["A"].shard_map.version == 1
+        assert seats["A"].state.mode == CLUSTER_SERVER   # still serving!
+        svc_a = seats["A"].state.token_server.service
+        assert svc_a.shard.epochs == {SL_A: 1}
+        seats["B"].apply_map(m2)                 # the fleet moves on
+        # A fenced client (saw m2's epochs) rejects the zombie's grants.
+        fence = SliceEpochFence()
+        for sl, ep in enumerate(m2.slice_epoch):
+            fence.observe(ep, sl)
+        cli = ClusterTokenClient(
+            "127.0.0.1", specs[0].port, request_timeout_s=10.0,
+            epoch_fence=fence,
+            fence_scope_fn=lambda fid: slice_of(int(fid), N)).start()
+        try:
+            assert _wait(cli.is_connected)
+            r = cli.request_token(FID_A)
+            assert r.status == TokenResultStatus.FAIL
+            assert fence.stale_rejected_count == 1
+        finally:
+            cli.stop()
+    finally:
+        seats["A"].stop()
+        seats["B"].stop()
+
+
+# -- engine + ops surfaces ----------------------------------------------------
+
+
+class _WrongSliceStub:
+    serves_degraded = False
+
+    def __init__(self):
+        self.calls = 0
+
+    def is_connected(self):
+        return True
+
+    def request_token(self, *a, **k):
+        from sentinel_tpu.cluster.token_service import TokenResult
+
+        self.calls += 1
+        return TokenResult(TokenResultStatus.WRONG_SLICE, wait_ms=9)
+
+    def request_param_token(self, *a, **k):
+        return self.request_token()
+
+    def stop(self):
+        pass
+
+
+def test_engine_wrong_slice_degrades_to_local_check(engine):
+    """An un-healed WRONG_SLICE reaching the engine (e.g. a plain
+    client pointed at a sharded leader) degrades the rule to its local
+    check — counted separately so a stale-map storm is visible."""
+    st.load_flow_rules([st.FlowRule(
+        resource="shard-res", count=3, cluster_mode=True,
+        cluster_config={"flowId": 4242, "thresholdType": THRESHOLD_GLOBAL,
+                        "fallbackToLocalWhenFail": True})])
+    stub = _WrongSliceStub()
+    engine.cluster.token_client = stub
+    engine.cluster.mode = CLUSTER_CLIENT
+    try:
+        ok = blocked = 0
+        for _ in range(5):
+            try:
+                engine.entry("shard-res").exit()
+                ok += 1
+            except st.BlockException:
+                blocked = blocked + 1
+        assert stub.calls == 5
+        assert ok == 3 and blocked == 2      # the LOCAL check enforced
+        rs = engine.resilience_stats()
+        assert rs["clusterWrongSliceCount"] == 5
+        assert rs["clusterFallbackCount"] >= 5
+    finally:
+        engine.cluster.token_client = None
+        engine.cluster.mode = -1
+
+
+def test_shard_stats_reach_exporter_and_ha_stats(engine, frozen_time):
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    engine.cluster.server_rules().load_rules("default", [_rule(FID_A, 50)])
+    svc = DefaultTokenService(engine.cluster.server_rules())
+    svc.set_shard(ShardState(N, 3, {SL_A: 4, SL_B: 2}))
+    engine.cluster.set_to_server(host="127.0.0.1", port=0, service=svc,
+                                 epoch=4)
+    svc.set_shard(ShardState(N, 3, {SL_A: 4, SL_B: 2}))  # epoch reset above
+    try:
+        assert svc.request_token(FID_C).status \
+            == TokenResultStatus.WRONG_SLICE
+        ha = engine.cluster.ha_stats()
+        assert ha["shard"]["slicesOwned"] == 2
+        assert ha["shard"]["wrongSliceRejected"] == 1
+        assert engine.cluster.shard_stats() == ha["shard"]
+        text = render_engine_metrics(engine)
+        assert "sentinel_tpu_shard_slices_owned 2" in text
+        assert 'sentinel_tpu_shard_slice_epoch{slice="0"} 4' in text
+        assert 'sentinel_tpu_shard_slice_epoch{slice="4"} 2' in text
+        assert "sentinel_tpu_shard_wrong_slice_rejected_total 1" in text
+        assert "sentinel_tpu_shard_handoffs_total" in text
+        assert "sentinel_tpu_shard_degraded_slices 0" in text
+    finally:
+        engine.cluster.stop()
+
+
+# -- the 3-leader drill -------------------------------------------------------
+
+
+def _three_leader_cluster(tmp_path, T=6):
+    """Three HA seats, one slice-distinct flow each, shared handoff
+    files, and a sharded client with a static degraded share."""
+    pairs = [(FID_A, T), (FID_B, T), (FID_C, T)]
+    seats = _seats(tmp_path, ("A", "B", "C"), pairs)
+    specs = tuple(ClusterServerSpec(mid, "127.0.0.1", _free_port())
+                  for mid in ("A", "B", "C"))
+    rest = [s for s in range(N) if s not in (SL_A, SL_B, SL_C)]
+    m1 = _owner_map({"A": [SL_A], "B": [SL_B], "C": [SL_C] + rest},
+                    servers=specs)
+    for seat in seats.values():
+        seat.apply_map(m1)
+        # Absorb the per-width jit compiles up front (pad_width is exact
+        # below 64, so widths 1..4 each compile separately): a first
+        # compile landing mid-drill stalls EVERY seat's replies (shared
+        # process GIL) past the client timeout — a latency artifact the
+        # concurrent-traffic drill would misread as a lost leader.
+        svc = seat.state.token_server.service
+        for w in (1, 2, 3, 4):
+            svc.request_tokens([(None, 0, False)] * w)
+    # health_gate=None + a generous request timeout: the three leaders
+    # share this process's GIL, and a checkpoint publish (fsync-heavy)
+    # on one can stall another's reply thread past a tight timeout on a
+    # loaded CI box — which would trip the per-leader breaker and turn
+    # a latency hiccup into a FAIL cascade the drill would misread as a
+    # shard-semantics violation. Breaker behavior has its own pins
+    # (test_chaos / test_cluster_ha); these drills pin SLICE semantics.
+    cli = ShardedTokenClient(
+        m1, request_timeout_s=2.0, failover_deadline_ms=400,
+        health_gate=None,
+        degraded=DegradedQuota(
+            divisor=1, thresholds={fid: (float(T), 1000)
+                                   for fid, _ in pairs})).start()
+    return seats, specs, m1, cli
+
+
+def test_three_leader_crash_drill_scaled(frozen_time, tmp_path):
+    """Tier-1-scaled ISSUE 12 acceptance seed: kill one of three leaders
+    mid-traffic; only its slices degrade (zero degraded verdicts and
+    zero fence violations on the survivors), and its slices recover via
+    a checkpoint-grafted handoff with over-admission == grants since the
+    victim's last publish."""
+    T = 6
+    seats, specs, m1, cli = _three_leader_cluster(tmp_path, T)
+    try:
+        assert _wait(lambda: all(c.is_connected()
+                                 for c in cli._pool.values()))
+        # Mid-traffic: C grants 3, publishes, grants 1 more (the margin).
+        for _ in range(2):
+            assert cli.request_token(FID_A).status == TokenResultStatus.OK
+            assert cli.request_token(FID_B).status == TokenResultStatus.OK
+        for _ in range(3):
+            assert cli.request_token(FID_C).status == TokenResultStatus.OK
+        seats["C"].publish_checkpoint()
+        assert cli.request_token(FID_C).status == TokenResultStatus.OK
+        ok_c_before = 4
+
+        # Hard crash: listener + connections die, NO drain publish.
+        seats["C"].state.token_server._fault_crash()
+        assert _wait(lambda: not cli._pool["C"].is_connected())
+
+        # Survivors: full fidelity, zero degraded, zero fence rejects.
+        assert cli.request_token(FID_A).status == TokenResultStatus.OK
+        assert cli.request_token(FID_B).status == TokenResultStatus.OK
+        assert cli.failover_stats()["shard"]["degradedSlices"] == 0
+
+        # The victim's slices degrade to the per-client share after the
+        # deadline (share == T here: single client, divisor 1).
+        assert cli.request_token(FID_C).status == TokenResultStatus.FAIL
+        time_util.advance_time(500)
+        assert cli.request_token(FID_C).status == TokenResultStatus.OK
+        st_shard = cli.failover_stats()["shard"]
+        assert st_shard["degradedSlices"] == len(m1.slices_of("C"))
+        assert st_shard["leaders"]["A"]["degraded"] is False
+        assert st_shard["leaders"]["B"]["degraded"] is False
+
+        # Rebalance: C's slices move to B (epoch bump per moved slice);
+        # B warm-starts from C's last publish.
+        # Rebalance protocol (OPERATIONS): bump ONLY the moved slices'
+        # epochs — standing leaders' in-flight replies stay honest.
+        moved = m1.slices_of("C")
+        m2 = _owner_map(
+            {"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+            version=2,
+            epochs={**{s: 1 for s in range(N)}, **{s: 2 for s in moved}},
+            servers=specs)
+        seats["A"].apply_map(m2)
+        seats["B"].apply_map(m2)
+        assert seats["B"].rows_restored >= 1
+        assert cli.apply_map(m2)
+
+        # Over-admission bound: C published at 3 grants, then granted 1
+        # more (lost). B restored 3 -> T - 3 = 3 remain; total device
+        # grants = 4 + 3 = T + 1 = T + grants-since-publish.
+        time_util.advance_time(100)  # same window: bound must hold NOW
+        post = [cli.request_token(FID_C).status for _ in range(4)]
+        assert post == [TokenResultStatus.OK] * 3 \
+            + [TokenResultStatus.BLOCKED]
+        assert ok_c_before + post.count(TokenResultStatus.OK) == T + 1
+
+        # Recovered: nothing degraded, still zero fence violations for
+        # the survivors' lanes, and every leader answered in-slice.
+        assert cli.failover_stats()["shard"]["degradedSlices"] == 0
+        # Healed routing pays no further mis-route tax anywhere.
+        wrong_before = (
+            seats["A"].state.token_server.service.wrong_slice_count,
+            seats["B"].state.token_server.service.wrong_slice_count)
+        assert cli.request_token(FID_A).status == TokenResultStatus.OK
+        assert cli.request_token(FID_B).status == TokenResultStatus.OK
+        assert cli.request_token(FID_C).status == TokenResultStatus.BLOCKED
+        assert wrong_before == (
+            seats["A"].state.token_server.service.wrong_slice_count,
+            seats["B"].state.token_server.service.wrong_slice_count)
+    finally:
+        cli.stop()
+        for seat in seats.values():
+            seat.stop()
+
+
+@pytest.mark.slow
+def test_three_leader_multi_spell_drill(frozen_time, tmp_path):
+    """Multi-spell flavor of the crash drill: two successive victim
+    crashes with rebalances in between, concurrent traffic on the
+    survivors throughout — per-slice blast radius, fencing, and the
+    per-slice over-admission bound hold across BOTH spells."""
+    import threading
+
+    T = 50
+    seats, specs, m1, cli = _three_leader_cluster(tmp_path, T)
+    stop = threading.Event()
+    survivor_fail = []
+
+    def hammer():
+        # A is never a victim: its slice must serve a wire-grade
+        # verdict (OK/BLOCKED, never FAIL/degraded) through BOTH spells.
+        while not stop.is_set():
+            r = cli.request_token(FID_A)
+            if r.status not in (TokenResultStatus.OK,
+                                TokenResultStatus.BLOCKED):
+                survivor_fail.append(("A", r.status))
+            time.sleep(0.01)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    try:
+        assert _wait(lambda: all(c.is_connected()
+                                 for c in cli._pool.values()))
+        t.start()
+        # Spell 1: crash C, degrade, rebalance onto B.
+        for _ in range(5):
+            assert cli.request_token(FID_C).status == TokenResultStatus.OK
+        seats["C"].publish_checkpoint()
+        seats["C"].state.token_server._fault_crash()
+        assert _wait(lambda: not cli._pool["C"].is_connected())
+        cli.request_token(FID_C)
+        time_util.advance_time(500)
+        assert cli.request_token(FID_C).status == TokenResultStatus.OK
+        # Bump ONLY the moved slices' epochs (the OPERATIONS rebalance
+        # protocol): bumping a standing leader's lane would fence-reject
+        # its own honest in-flight replies — exactly what the concurrent
+        # hammer on A's slice is here to catch.
+        moved = m1.slices_of("C")
+        m2 = _owner_map(
+            {"A": [SL_A], "B": [s for s in range(N) if s != SL_A]},
+            version=2,
+            epochs={**{s: 1 for s in range(N)}, **{s: 2 for s in moved}},
+            servers=specs)
+        seats["A"].apply_map(m2)
+        seats["B"].apply_map(m2)
+        assert cli.apply_map(m2)
+        assert _wait(lambda: cli.request_token(FID_C).status
+                     == TokenResultStatus.OK, 10.0)
+        # Spell 2: crash B (now owning everything but SL_A); only A's
+        # slice keeps serving wire verdicts.
+        seats["B"].publish_checkpoint()
+        seats["B"].state.token_server._fault_crash()
+        assert _wait(lambda: not cli._pool["B"].is_connected())
+        cli.request_token(FID_B)
+        time_util.advance_time(500)
+        assert cli.request_token(FID_B).status in (
+            TokenResultStatus.OK, TokenResultStatus.BLOCKED)  # share
+        assert cli.request_token(FID_A).status == TokenResultStatus.OK
+        m3 = _owner_map({"A": list(range(N))}, version=3,
+                        epochs={**{s: 3 for s in range(N)}, SL_A: 1},
+                        servers=specs)
+        seats["A"].apply_map(m3)
+        assert cli.apply_map(m3)
+        assert _wait(lambda: cli.request_token(FID_B).status
+                     in (TokenResultStatus.OK, TokenResultStatus.BLOCKED),
+                     10.0)
+        stop.set()
+        t.join(timeout=5)
+        # The never-killed leader's lane saw no FAIL and no fence
+        # violation across both spells. (A's service DOES answer
+        # WRONG_SLICE probes while walks search for dead leaders'
+        # slices — that's the healing path, not a violation.)
+        assert survivor_fail == []
+        assert cli.fence.stale_rejected_count == 0
+    finally:
+        stop.set()
+        cli.stop()
+        for seat in seats.values():
+            seat.stop()
