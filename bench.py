@@ -24,7 +24,7 @@ sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
 and the queue-wait vs device-wait split.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_8.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_12.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -456,6 +456,34 @@ def bench_adaptive_loop() -> dict:
             loop["refresh_mean_ms"] - base["refresh_mean_ms"], 4),
         "sensed_resources": loop["sensed"],
         "dispatch_guard_equal": guard_ok,
+    }}
+
+
+def bench_sim_replay() -> dict:
+    """Trace-replay throughput (ISSUE 13 acceptance): seconds-of-trace
+    replayed per wall second at a FIXED scenario — flash_crowd seed 7,
+    600 trace seconds = a 10-minute trace — on the CPU tier, open loop
+    (the adaptive lab has its own harness; this measures the replay
+    substrate every lab run rides). The replay loop is timed
+    steady-state (ladder widths precompiled by ``run(warmup=True)``,
+    the discipline every section here uses); the end-to-end total
+    including engine build + XLA compiles is reported beside it.
+    Target: >= 100x realtime (``vs_realtime``)."""
+    from sentinel_tpu.simulator import ReplayEngine, build_scenario
+
+    trace = build_scenario("flash_crowd", seconds=600, seed=7)
+    result = ReplayEngine(trace).run(warmup=True)
+    rate = result.seconds / result.replay_wall_s
+    return {"sim_replay": {
+        "scenario": "flash_crowd", "seed": 7,
+        "trace_seconds": result.seconds,
+        "replay_wall_s": round(result.replay_wall_s, 3),
+        "total_wall_s": round(result.total_wall_s, 3),
+        "seconds_per_wall_second": round(rate, 1),
+        "vs_realtime": round(rate, 1),
+        "offered_tokens": result.offered,
+        "passed_tokens": result.passed,
+        "verdict_sha256": result.verdict_sha256,
     }}
 
 
@@ -982,7 +1010,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_11.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_12.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1236,6 +1264,8 @@ def main() -> None:
         out.update(bench_pipeline_steady())
         persist(out)
         out.update(bench_adaptive_loop())
+        persist(out)
+        out.update(bench_sim_replay())
         persist(out)
         # BASELINE per-config sections (eval configs #2/#3 + the shim
         # loopback transport): each is individually guarded so one

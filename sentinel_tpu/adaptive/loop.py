@@ -55,8 +55,6 @@ from sentinel_tpu.rollout.manager import (
     STAGE_PROMOTED,
     STAGE_SHADOW,
 )
-from sentinel_tpu.utils import time_util
-
 CANDIDATE_PREFIX = "adaptive-"
 
 
@@ -142,7 +140,7 @@ class AdaptiveLoop:
         if cand.name.startswith(CANDIDATE_PREFIX):
             self._rollout_events.append(
                 (event, cand.name, reason,
-                 time_util.current_time_millis()))
+                 self.engine.now_ms()))
 
     # -- ops controls ------------------------------------------------------
 
@@ -195,6 +193,28 @@ class AdaptiveLoop:
                 self._log("unfreeze")
             return {"frozen": False}
 
+    def reset_timebase(self) -> None:
+        """Forget absolute-stamp state (the engine's ``set_clock``
+        seam): the abort backoff and the envelope's per-resource
+        cooldown stamps are wall-clock absolutes — after a backward
+        timebase swap `now < backoff_until_ms` would hold for (simulated)
+        decades and the loop would report frozen-in-backoff forever.
+        An in-flight candidate is aborted FIRST (the freeze stance: its
+        ``stage_since_ms`` soak age is meaningless across timebases, so
+        it would otherwise sit "soaking" forever and block proposals);
+        the backoff that abort arms is then cleared with the rest.
+        Counters, targets, and the decision log survive; the LKG
+        snapshot's rules survive too (only its stamp is refreshed)."""
+        self._abort_inflight("timebase swap")
+        now = self.engine.now_ms()
+        with self._lock:
+            self._backoff_until_ms = 0
+            self._last_tick_ms = 0
+            self._fault_baseline = None
+            if self._lkg is not None:
+                self._lkg_ms = now
+        self.envelope.reset()
+
     def load_targets(self, targets: List[AdaptiveTarget]) -> None:
         with self._lock:
             self.controller.load_targets(targets)
@@ -204,9 +224,19 @@ class AdaptiveLoop:
 
     def on_spill(self, now_ms: int) -> None:
         """Ride the once-per-second fold: evaluate at most once per
-        configured interval. Zero work while disabled beyond two reads."""
+        configured interval. Zero work while disabled beyond two reads.
+
+        The interval gate must survive a clock that stepped BACKWARD
+        (NTP slew, a test re-freezing to an earlier epoch, a simulator
+        timebase installed on a live engine): with the old stamp ahead
+        of ``now_ms`` the subtraction stays negative and the loop would
+        silently never tick again — the latent real-time-monotonicity
+        assumption ISSUE 13's clock seam flushed out. A backward jump
+        re-arms the gate at the new timebase instead."""
         if not self._enabled:
             return
+        if now_ms < self._last_tick_ms:
+            self._last_tick_ms = now_ms  # clock stepped back: re-arm
         if now_ms - self._last_tick_ms < self.interval_s * 1000:
             return
         self.tick(now_ms)
@@ -219,7 +249,7 @@ class AdaptiveLoop:
             return {"status": "busy"}
         try:
             now = (now_ms if now_ms is not None
-                   else time_util.current_time_millis())
+                   else self.engine.now_ms())
             if force:
                 # Ops/test-driven ticks bring judgement current first;
                 # spill-driven ticks ride a spill that just did.
@@ -342,7 +372,7 @@ class AdaptiveLoop:
             event, name, reason, _ms = self._rollout_events.popleft()
             if name != self._inflight:
                 continue
-            now = time_util.current_time_millis()
+            now = self.engine.now_ms()
             if event == "promoted":
                 self._note_promotion(name, now)
             else:
@@ -393,7 +423,7 @@ class AdaptiveLoop:
         cand = self.engine.rollout.candidate(name)
         self._note_abort(
             name, cand.ended_reason if cand else reason,
-            time_util.current_time_millis())
+            self.engine.now_ms())
 
     # -- proposing ---------------------------------------------------------
 
@@ -526,7 +556,7 @@ class AdaptiveLoop:
         rules = list(self.engine.flow_rules.get_rules())
         with self._lock:
             self._lkg = {"flow": rules}
-            self._lkg_ms = time_util.current_time_millis()
+            self._lkg_ms = self.engine.now_ms()
 
     def _lkg_intact(self) -> bool:
         """Live rules byte-equal the retained snapshot (rules are frozen
@@ -549,7 +579,7 @@ class AdaptiveLoop:
         self._seq += 1
         self._events.append({
             "seq": self._seq, "kind": kind,
-            "timestamp": time_util.current_time_millis(), **fields})
+            "timestamp": self.engine.now_ms(), **fields})
 
     def history(self, since_seq: int = 0,
                 limit: Optional[int] = None) -> Dict:
@@ -565,7 +595,7 @@ class AdaptiveLoop:
     def status(self) -> Dict:
         from sentinel_tpu.datasource.converters import adaptive_target_to_dict
 
-        now = time_util.current_time_millis()
+        now = self.engine.now_ms()
         with self._lock:
             cand = self.engine.rollout.candidate(self._inflight) \
                 if self._inflight else None

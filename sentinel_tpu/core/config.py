@@ -150,6 +150,19 @@ WIRE_OUTBUF_MAX_BYTES = "csp.sentinel.wire.outbuf.max.bytes"
 WIRE_READ_CHUNK_BYTES = "csp.sentinel.wire.read.chunk.bytes"
 WIRE_WORKERS = "csp.sentinel.wire.workers"
 WIRE_RLS_BATCHED = "csp.sentinel.wire.rls.batched"
+# Trace-replay simulator (sentinel_tpu/simulator/ — no reference twin:
+# the reference has no offline evaluation story). Every key here MUST be
+# read through the accessors below and documented in docs/OPERATIONS.md
+# "Trace capture & replay" (pinned by test_lint).
+# epoch.ms: the simulated timebase origin for traces that carry none —
+# deliberately far from the wall clock so an accidental ambient clock
+# read in a replayed path produces instantly-wrong seconds;
+# max.batch: widest fused-step ladder width one simulated second's
+# demand is chunked into; drill.max.seconds: cap on the `sim op=run`
+# command's synchronous drill replays (offline suites use the library).
+SIM_EPOCH_MS = "csp.sentinel.sim.epoch.ms"
+SIM_MAX_BATCH = "csp.sentinel.sim.max.batch"
+SIM_DRILL_MAX_SECONDS = "csp.sentinel.sim.drill.max.seconds"
 SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
 SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
 SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
@@ -234,6 +247,13 @@ DEFAULT_WIRE_INFLIGHT_DEPTH = 2
 DEFAULT_WIRE_OUTBUF_MAX_BYTES = 1_048_576
 DEFAULT_WIRE_READ_CHUNK_BYTES = 131_072
 DEFAULT_WIRE_WORKERS = 4
+# Simulator defaults. One day past epoch 0 keeps simulated stamps far
+# from any plausible wall clock (the replay-honesty canary); 512 keeps
+# the per-second chunking on a mid-ladder width (fewer distinct XLA
+# shapes per replay); 300 bounds the ops-command drill.
+DEFAULT_SIM_EPOCH_MS = 86_400_000
+DEFAULT_SIM_MAX_BATCH = 512
+DEFAULT_SIM_DRILL_MAX_SECONDS = 300
 # SLO defaults. alpha=0.2 ≈ a ~5-second effective memory on the EWMA
 # baseline mean (fast enough to track diurnal drift, slow enough that a
 # one-second spike cannot hide itself); z>=4 on a per-second signal
@@ -528,6 +548,23 @@ class SentinelConfig:
 
     def wire_rls_batched(self) -> bool:
         return (self.get(WIRE_RLS_BATCHED) or "false").lower() == "true"
+
+    # Simulator accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.sim.* keys — test_lint forbids reading the literals
+    # anywhere else in the package).
+
+    def sim_epoch_ms(self) -> int:
+        v = self.get_int(SIM_EPOCH_MS, DEFAULT_SIM_EPOCH_MS)
+        return v if v > 0 else DEFAULT_SIM_EPOCH_MS
+
+    def sim_max_batch(self) -> int:
+        v = self.get_int(SIM_MAX_BATCH, DEFAULT_SIM_MAX_BATCH)
+        return v if v > 0 else DEFAULT_SIM_MAX_BATCH
+
+    def sim_drill_max_seconds(self) -> int:
+        v = self.get_int(SIM_DRILL_MAX_SECONDS,
+                         DEFAULT_SIM_DRILL_MAX_SECONDS)
+        return v if v > 0 else DEFAULT_SIM_DRILL_MAX_SECONDS
 
     # SLO / alerting accessors (the ONLY sanctioned readers of the
     # csp.sentinel.slo.* and csp.sentinel.alert.* keys — test_lint

@@ -100,8 +100,7 @@ class EntryHandle:
         self.count = count
         # Callers on the µs-scale fast path pass the clock they already
         # read; everyone else pays the (cached-tick) read here.
-        self.created_ms = (time_util.current_time_millis()
-                           if now_ms is None else now_ms)
+        self.created_ms = (engine.now_ms() if now_ms is None else now_ms)
         self.error = False
         self.exited = False
         self.params = params
@@ -137,7 +136,15 @@ class SentinelEngine:
     linearized step stream.
     """
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096, clock=None):
+        # Clock-injection seam (ISSUE 13): every internal wall-clock read
+        # goes through now_ms(), so a simulator can drive a REAL engine on
+        # a program-advanced clock (sentinel_tpu/simulator/replay.py) with
+        # no global freeze. None = the process clock (time_util, which
+        # tests may freeze globally); a callable = this engine's private
+        # timebase. The device step already takes ``now`` as an explicit
+        # argument — this seam closes the host-side reads.
+        self._clock = clock
         self.registry = NodeRegistry(capacity)
         self.capacity = capacity
         # Instant-window geometry (reference: IntervalProperty /
@@ -203,7 +210,13 @@ class SentinelEngine:
         self.param_rules.add_listener(lambda: self._on_rules_changed("param"))
         self.system_status = Y.SystemStatusListener()
         self._signals_refreshed_ms = 0
-        self._sealed_sec = time_util.current_time_millis() // 1000 - 1
+        self._sealed_sec = self.now_ms() // 1000 - 1
+        # Flight-recorder tee (ISSUE 13): callables invoked with each
+        # freshly spilled complete second, already rendered to the
+        # ``second_to_dict`` JSON shape — the trace writer subscribes
+        # here (simulator/trace.py) so live traffic can be captured into
+        # a portable replay trace with zero extra device work.
+        self._flight_tees: List = []
         # Cluster role (client / embedded server) — host-side maps from
         # resource to its cluster-mode rules' (flowId, fallbackToLocal).
         from sentinel_tpu.cluster.state import ClusterStateManager
@@ -406,6 +419,79 @@ class SentinelEngine:
         # fires them once the default engine is installed (the reference's
         # "first SphU.entry triggers doInit" ordering).
 
+    # -- clock seam (ISSUE 13) ---------------------------------------------
+
+    def now_ms(self) -> int:
+        """This engine's timebase: the injected clock when one is set
+        (simulator replay), else the process clock (which tests freeze
+        globally via time_util). Every host-side time read inside the
+        engine — and in the adaptive/rollout/SLO layers riding it — goes
+        through here, so a replayed engine experiences ONE consistent,
+        program-advanced time."""
+        clock = self._clock
+        return clock() if clock is not None else \
+            time_util.current_time_millis()
+
+    def set_clock(self, clock) -> None:
+        """Install (or clear, with None) an injected clock, resetting
+        the engine's time cursors AND its volatile statistics to the
+        new timebase.
+
+        The cursors assume time never moves backward: ``_sealed_sec``
+        gates the metric log, ``timeseries.last_stamp_ms`` gates the
+        flight-recorder spill, and the signal/log throttles hold
+        last-read stamps. Swapping to a timebase earlier than the old
+        one would otherwise silently wedge all of them (seconds "already
+        sealed/spilled", throttles never expiring) — the latent
+        real-time-monotonicity assumption this seam flushes out. Device
+        state is dropped cold for the same reason: window bucket
+        starts, the staged second, and flight-ring slots all carry
+        old-timebase stamps that would interleave wrongly with the new
+        one. Rules survive, statistics restart — the reference restart
+        stance, rebuilt on the next dispatch (shape-cached jits make
+        that a cheap ``make_state``, not a recompile)."""
+        with self._config_lock, self._lock:
+            self._clock = clock
+            now = self.now_ms()
+            self._sealed_sec = now // 1000 - 1
+            self._signals_refreshed_ms = 0
+            self._fail_open_logged_ms = 0
+            self._state = None  # stats ephemeral; _ensure_compiled rebuilds
+            self.timeseries.clear()
+            # Lease mirrors carry last-filled / window stamps of the OLD
+            # timebase: a warm-up mirror with a future-stamped sync (or a
+            # param bucket that can never refill) would wedge the fast
+            # path exactly like the spill cursors above. Drop the table
+            # and rebuild COLD — swapping the fast path to empty first
+            # keeps _rebuild_leases from carrying the stale mirrors over
+            # (its carry-over exists for rule pushes, where the timebase
+            # is continuous).
+            self._fastpath = _FastPathState({}, frozenset(),
+                                            self.lease_enabled)
+            self._rebuild_leases()
+        # Stamp-bearing subsystem cursors reset OUTSIDE the engine locks
+        # (they take their own locks, and the established order is
+        # adaptive/slo -> engine, never the inverse): SLO ingest/eval
+        # cursors + series/baselines/alerts, and the adaptive loop's
+        # abort backoff + envelope cooldown stamps — all absolute times
+        # of the old timebase that would wedge judgement or freeze
+        # retuning for (simulated) decades after a backward swap.
+        self.slo.reset_timebase()
+        adaptive = getattr(self, "adaptive", None)
+        if adaptive is not None:
+            adaptive.reset_timebase()
+
+    def add_flight_tee(self, fn) -> None:
+        """Subscribe ``fn(second_dict)`` to every freshly spilled
+        complete flight-recorder second (the trace-capture hook)."""
+        self._flight_tees.append(fn)
+
+    def remove_flight_tee(self, fn) -> None:
+        try:
+            self._flight_tees.remove(fn)
+        except ValueError:
+            pass
+
     @property
     def _leases(self):
         return self._fastpath.leases
@@ -505,7 +591,7 @@ class SentinelEngine:
                     rows[res] = row
         committer = self._committer
         pending = committer.pending_pass_counts() if committer else {}
-        now = time_util.current_time_millis()
+        now = self.now_ms()
         for res in targets:
             if res not in rows:
                 continue  # never served traffic: mirror stays empty
@@ -622,7 +708,7 @@ class SentinelEngine:
         if self._state is None:
             for k in self._dirty:
                 self._dirty[k] = False
-            now = time_util.current_time_millis()
+            now = self.now_ms()
             ft, named = F.compile_flow_rules(
                 self.flow_rules.get_rules(), self.registry, self.capacity,
                 min_slots=self._slot_floor["flow"])
@@ -652,7 +738,7 @@ class SentinelEngine:
             return
         if not any(self._dirty.values()):
             return
-        now = time_util.current_time_millis()
+        now = self.now_ms()
         if self._dirty["flow"]:
             self._dirty["flow"] = False
             ft, named = F.compile_flow_rules(
@@ -926,8 +1012,13 @@ class SentinelEngine:
         return self._cluster_thresholds
 
     def _refresh_signals(self, now_ms: int) -> None:
-        """Fold the latest host OS sample into device state (≤ 1 Hz)."""
-        if now_ms - self._signals_refreshed_ms < 1000:
+        """Fold the latest host OS sample into device state (≤ 1 Hz).
+
+        A clock that stepped BACKWARD (NTP slew, a test re-freezing to an
+        earlier epoch, a simulator timebase) must refresh rather than
+        wait for real time to catch the stale stamp up — the throttle
+        gates only genuinely-recent refreshes."""
+        if 0 <= now_ms - self._signals_refreshed_ms < 1000:
             return
         self._signals_refreshed_ms = now_ms
         self._state = self._state._replace(
@@ -1008,7 +1099,7 @@ class SentinelEngine:
             from sentinel_tpu.log.record_log import log_block
 
             log_block(resource, type(custom_ex).__name__, ctx.origin, count,
-                      time_util.current_time_millis())
+                      self.now_ms())
             raise custom_ex
 
         # Token-lease fast path (core/lease.py): eligible resources admit
@@ -1022,7 +1113,7 @@ class SentinelEngine:
         fast_ok = (not slots and self._pipeline is None
                    and not self._spi.device_checkers())
         if lease is not None and not prioritized and fast_ok:
-            now = time_util.current_time_millis()
+            now = self.now_ms()
             # admit() returns a BlockReason int (0 = pass): plain leases
             # run the DEFAULT window ring; widened leases (warm-up flow
             # rules, single-param resources — ROADMAP 3c) also mirror the
@@ -1098,7 +1189,7 @@ class SentinelEngine:
             from sentinel_tpu.log.record_log import log_block
 
             log_block(resource, type(ex).__name__, ctx.origin, count,
-                      time_util.current_time_millis())
+                      self.now_ms())
             raise ex
         if wait_us > 0:
             time.sleep(wait_us / 1e6)
@@ -1106,7 +1197,7 @@ class SentinelEngine:
             # Occupy grants land in the bucket after the wait — recording
             # post-sleep stamps them there. params keep a widened lease's
             # per-value buckets honest for device-path passes.
-            lease.add(count, time_util.current_time_millis(), params)
+            lease.add(count, self.now_ms(), params)
 
         handle = EntryHandle(self, resource, ctx, cluster_row, dn_row,
                              origin_row, entry_in, count, params)
@@ -1116,7 +1207,7 @@ class SentinelEngine:
     def _note_fail_open(self, why: str) -> None:
         """Count + rate-limited log of an unguarded pass-through."""
         self.fail_open_count += 1
-        now = time_util.current_time_millis()
+        now = self.now_ms()
         if now - self._fail_open_logged_ms >= 1000:
             self._fail_open_logged_ms = now
             import logging
@@ -1323,7 +1414,7 @@ class SentinelEngine:
 
     def _run_entry_batch_locked(self, batch: EntryBatch) -> Decisions:
         self._ensure_compiled()
-        now = time_util.current_time_millis()
+        now = self.now_ms()
         self._refresh_signals(now)
         try:
             self._state, dec = timed_call(
@@ -1348,7 +1439,7 @@ class SentinelEngine:
     def _run_exit_batch(self, batch: ExitBatch) -> None:
         with self._lock:
             self._ensure_compiled()
-            now = time_util.current_time_millis()
+            now = self.now_ms()
             try:
                 self._state = timed_call(
                     self.step_timer, "exit", batch.size, self._exit_jit,
@@ -1453,7 +1544,7 @@ class SentinelEngine:
         if handle.cluster_row < 0:
             ctx_mod.auto_exit_context()
             return
-        now = time_util.current_time_millis()
+        now = self.now_ms()
         rt = max(0, now - handle.created_ms)
         slots = self._spi.host_slots()
         if slots:
@@ -1514,7 +1605,7 @@ class SentinelEngine:
     def check_batch(self, batch: EntryBatch, now_ms: Optional[int] = None) -> Decisions:
         with self._lock:
             self._ensure_compiled()
-            now = now_ms if now_ms is not None else time_util.current_time_millis()
+            now = now_ms if now_ms is not None else self.now_ms()
             self._refresh_signals(now)
             try:
                 self._state, dec = self._entry_jit(
@@ -1533,7 +1624,7 @@ class SentinelEngine:
     def complete_batch(self, batch: ExitBatch, now_ms: Optional[int] = None) -> None:
         with self._lock:
             self._ensure_compiled()
-            now = now_ms if now_ms is not None else time_util.current_time_millis()
+            now = now_ms if now_ms is not None else self.now_ms()
             try:
                 self._state = self._exit_jit(self._state, self._rules, batch,
                                              now,
@@ -1557,7 +1648,7 @@ class SentinelEngine:
         from sentinel_tpu.core.registry import KIND_CLUSTER
         from sentinel_tpu.metrics.metric_node import MetricNode
 
-        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        now = now_ms if now_ms is not None else self.now_ms()
         now_sec = now // 1000
         self._flush_committer()  # leased commits land before sealing
         with self._lock:
@@ -1625,7 +1716,7 @@ class SentinelEngine:
         last-success ages. Lock-free — plain counter/snapshot reads."""
         from sentinel_tpu import resilience
 
-        now = time_util.current_time_millis()
+        now = self.now_ms()
         out: Dict = {
             "failOpenCount": self.fail_open_count,
             "clusterFallbackCount": self.cluster_fallback_count,
@@ -1783,7 +1874,7 @@ class SentinelEngine:
             second_to_dict,
         )
 
-        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        now = now_ms if now_ms is not None else self.now_ms()
         fresh = []
         with self._lock:
             self._ensure_compiled()
@@ -1815,7 +1906,20 @@ class SentinelEngine:
             # Judgement rides the spill: each complete second feeds the
             # SLO manager's objective series + anomaly baselines (host
             # arithmetic, outside the engine lock).
-            self.slo.ingest(stamp, second_to_dict(rec, metas)["resources"])
+            sec_dict = second_to_dict(rec, metas)
+            self.slo.ingest(stamp, sec_dict["resources"])
+            # Trace capture rides the same render: tees (the flight
+            # recorder's trace writer, simulator/trace.py) see every
+            # complete second exactly once, in stamp order. A broken tee
+            # must not stall the spill (or the step stream behind it).
+            for tee in list(self._flight_tees):
+                try:
+                    tee(sec_dict)
+                except Exception:  # noqa: BLE001 — tee bugs can't stall spill
+                    from sentinel_tpu.log.record_log import record_log
+
+                    record_log.warn("flight tee %r failed; detaching", tee)
+                    self.remove_flight_tee(tee)
         # Burn rules re-evaluate at the newest complete second boundary
         # on EVERY spill (even with no fresh seconds: idle decay must
         # resolve alerts without requiring new traffic).
@@ -1947,7 +2051,7 @@ class SentinelEngine:
         self._flush_committer()
         with self._lock:
             self._ensure_compiled()
-            now = time_util.current_time_millis()
+            now = self.now_ms()
             totals, threads = self._w1_read_jit(
                 self._state, jnp.asarray(now, jnp.int64))
             return np.asarray(totals), np.asarray(threads)
@@ -1987,7 +2091,7 @@ class SentinelEngine:
         self._flush_committer()
         with self._lock:
             self._ensure_compiled()
-            now = time_util.current_time_millis()
+            now = self.now_ms()
             totals, threads = self._w1_read_jit(
                 self._state, jnp.asarray(now, jnp.int64))
             totals = np.asarray(totals)

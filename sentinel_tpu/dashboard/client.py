@@ -159,6 +159,11 @@ class SentinelApiClient:
             params["limit"] = limit
         return json.loads(self.get(ip, port, "adaptive", params))
 
+    def fetch_sim(self, ip: str, port: int, op: str = "report") -> Dict:
+        """Simulator state (``sim`` command): the last policy-lab
+        report (per-policy objective vectors) or the scenario catalog."""
+        return json.loads(self.get(ip, port, "sim", {"op": op}))
+
     def fetch_explain(self, ip: str, port: int,
                       resource: Optional[str] = None,
                       index: int = 0) -> Dict:

@@ -40,6 +40,8 @@ set as a small JSON API plus one static page:
     frozen, in-flight candidate, targets, senses, decision counters
   * ``GET  /alerts.json?app=``                SLO/anomaly alerts: active
     set + transition log (proxies the machines' ``alerts`` command)
+  * ``GET  /sim.json?app=``                   trace-replay simulator: last
+    policy-lab report / scenario catalog (proxies the ``sim`` command)
   * ``POST /rollout/command?app=&op=``        stage/canary/promote/abort/tick
     (no reference twin — proxies the engines' ``rollout`` command)
   * ``POST /cluster/assign?app=&ip=&port=``   token-server assignment
@@ -252,6 +254,16 @@ class DashboardServer:
         m = self._first_healthy(app)
         return self.api.fetch_adaptive(m.ip, m.port, op=op,
                                        since_seq=since_seq, limit=limit)
+
+    def get_sim(self, app: str, op: str = "report"):
+        """Simulator read path (``sim`` command report/scenarios) from
+        the first healthy machine — the Simulator panel's source.
+        Read-only: drill replays and lab runs go through the machines'
+        command plane / the offline lab directly."""
+        if op not in ("report", "scenarios"):
+            raise ValueError(f"unsupported sim op {op!r}")
+        m = self._first_healthy(app)
+        return self.api.fetch_sim(m.ip, m.port, op=op)
 
     def get_telemetry(self, app: str, kind: str = "summary",
                       limit: Optional[int] = None):
@@ -503,6 +515,9 @@ class _Handler(BaseHTTPRequestHandler):
                     q.get("app", ""), op=q.get("op", "status"),
                     since_seq=int(since) if since else None,
                     limit=int(limit) if limit else None))
+            if path == "/sim.json":
+                return self._ok(d.get_sim(
+                    q.get("app", ""), op=q.get("op", "report")))
             if path == "/alerts.json":
                 m = d._first_healthy(q.get("app", ""))
                 since = q.get("sinceSeq")

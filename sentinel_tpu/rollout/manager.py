@@ -50,7 +50,6 @@ from typing import Dict, List, Optional
 from sentinel_tpu.datasource import converters as CV
 from sentinel_tpu.ops import step as S
 from sentinel_tpu.rollout.canary import CANARY_BPS_MAX
-from sentinel_tpu.utils import time_util
 
 STAGE_SHADOW = "shadow"
 STAGE_CANARY = "canary"
@@ -210,7 +209,7 @@ class RolloutManager:
                 raise ValueError(
                     f"candidate {cur.name!r} is already {cur.stage}; "
                     "promote or abort it first")
-            now = time_util.current_time_millis()
+            now = self.engine.now_ms()
             cand = CandidateSet(
                 name=name, stage=stage, rules=parsed, source=source,
                 created_ms=now, stage_since_ms=now,
@@ -250,7 +249,7 @@ class RolloutManager:
         with self._lock():
             cand = self._require_active(name)
             cand.stage = stage
-            cand.stage_since_ms = time_util.current_time_millis()
+            cand.stage_since_ms = self.engine.now_ms()
             if stage == STAGE_CANARY:
                 cand.canary_bps = self._clamp_bps(
                     canary_bps if canary_bps is not None
@@ -275,7 +274,7 @@ class RolloutManager:
                 getattr(self.engine, attr).load_rules(detagged)
                 loaded[fam] = len(detagged)
             cand.stage = STAGE_PROMOTED
-            cand.stage_since_ms = time_util.current_time_millis()
+            cand.stage_since_ms = self.engine.now_ms()
             cand.ended_reason = "promoted"
             self._active = None
             self.promotion_epoch += 1
@@ -291,7 +290,7 @@ class RolloutManager:
         with self._lock():
             cand = self._require_active(name)
             cand.stage = STAGE_ABORTED
-            cand.stage_since_ms = time_util.current_time_millis()
+            cand.stage_since_ms = self.engine.now_ms()
             cand.ended_reason = reason
             self._active = None
             self._reset_guardrail()
@@ -419,7 +418,7 @@ class RolloutManager:
         directly with a pinned clock. Idempotence is per-call: each call
         IS one window.
         """
-        now = now_ms if now_ms is not None else time_util.current_time_millis()
+        now = now_ms if now_ms is not None else self.engine.now_ms()
         cand = self.active_set()
         if cand is None or cand.stage not in ACTIVE_STAGES:
             return {"active": None}

@@ -420,11 +420,36 @@ class SloManager:
             return [dict(a) for a in self._active.values()
                     if a["resource"] in resources]
 
+    def reset_timebase(self) -> None:
+        """Forget every stamp-bearing cursor and series (the engine's
+        ``set_clock`` seam): ingest/eval cursors, objective series,
+        baselines, burn snapshots, and active alerts all carry absolute
+        stamps of the OLD timebase — after a backward swap the ingest
+        cursor would silently drop every new second as "already seen"
+        and judgement would go dead with no error. Objectives and the
+        seq-numbered transition LOG survive (config and history are not
+        statistics); active alerts clear without transitions — their
+        fire stamps belong to a timebase that no longer exists."""
+        with self._lock:
+            self._last_ingest_ms = -1
+            self._eval_end_ms = -1
+            self._series = {k: deque() for k in self._objectives}
+            self._baselines.clear()
+            self._burn.clear()
+            self._active.clear()
+            self._shed_end_ms = -1
+            self._shed_last = None
+
     def stop(self) -> None:
         self.webhook.stop()
 
-    @staticmethod
-    def _now_ms() -> int:
+    def _now_ms(self) -> int:
+        # Ride the owning engine's timebase (clock-injection seam,
+        # ISSUE 13) so in-sim judgement stamps with simulated time; an
+        # engine-less manager (unit tests) keeps the process clock.
+        engine = self.engine
+        if engine is not None:
+            return engine.now_ms()
         from sentinel_tpu.utils import time_util
 
         return time_util.current_time_millis()

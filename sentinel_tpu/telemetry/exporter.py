@@ -508,6 +508,35 @@ def render_engine_metrics(engine) -> str:
         b.sample("sentinel_tpu_adaptive_target_delta",
                  {"resource": res}, delta)
 
+    # -- trace-replay simulator (sentinel_tpu/simulator/) ----------------
+    # Process-wide, not per-engine: the offline lab runs on its own sim
+    # engines; this exposition is where its last verdict lands for
+    # scrapers and the dashboard Simulator panel.
+    from sentinel_tpu.simulator.lab import counters as sim_counters
+    from sentinel_tpu.simulator.lab import last_report as sim_last_report
+
+    simc = sim_counters()
+    b.counter("sentinel_tpu_sim_lab_runs",
+              "Policy-lab comparison runs completed in this process",
+              simc["labRuns"])
+    b.counter("sentinel_tpu_sim_replayed_seconds",
+              "Simulated seconds replayed through the policy lab",
+              simc["replayedSeconds"])
+    report = sim_last_report()
+    b.family("sentinel_tpu_sim_replay_rate", "gauge",
+             "Last lab run's simulated seconds per wall second "
+             "(accelerated-clock speedup; 0 until a lab run completes)")
+    b.sample("sentinel_tpu_sim_replay_rate", None,
+             (report or {}).get("secondsPerWallSecond", 0))
+    b.family("sentinel_tpu_sim_policy_score", "gauge",
+             "Last lab run's scalarized objective score per "
+             "(scenario, policy) — higher is better; see the `sim` "
+             "command for the full objective vectors")
+    for scen, cell in sorted((report or {}).get("results", {}).items()):
+        for pol, run in sorted(cell.items()):
+            b.sample("sentinel_tpu_sim_policy_score",
+                     {"scenario": scen, "policy": pol}, run["score"])
+
     # -- span sampling health --------------------------------------------
     ssnap = engine.spans.snapshot(limit=0)
     b.counter("sentinel_tpu_spans_seen",

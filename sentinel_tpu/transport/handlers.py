@@ -496,6 +496,138 @@ def cmd_adaptive(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(str(ex))
 
 
+@command_mapping("flightrec", "flight recorder trace capture: export "
+                              "history as a replay trace, tee live "
+                              "seconds to a file")
+def cmd_flightrec(req: CommandRequest) -> CommandResponse:
+    """Trace capture surface of the simulator (sentinel_tpu/simulator/
+    — no reference twin). ``op`` selects the action:
+
+      * ``status`` (default) — recorder/retention state + active tee
+      * ``export`` — the spilled flight-recorder history as one
+        versioned trace document (``startMs=``/``endMs=`` bound the
+        window, ``limit=`` keeps the newest N seconds, ``resource=``
+        filters); feed it to ``ReplayEngine`` / the ``sim`` command
+      * ``tee`` — start streaming every complete second to ``path=``
+        (JSONL: header + one line per second, crash-safe)
+      * ``stop`` — detach and close the active tee
+    """
+    from sentinel_tpu.simulator.trace import TraceWriter, export_trace
+
+    eng = req.engine
+    op = req.get_param("op", "status")
+    # The active writer lives ON the engine (not a module registry):
+    # its lifecycle is the engine's, so a discarded engine can't leak a
+    # retained writer + open file behind an unreachable id() key.
+    writer = getattr(eng, "_flightrec_writer", None)
+    try:
+        if op == "status":
+            return CommandResponse.of_success({
+                "recorderSeconds": eng.flight_seconds,
+                "retainedSeconds": eng.timeseries.retained(),
+                "tee": writer.status() if writer is not None else None,
+            })
+        if op == "export":
+            start = req.get_param("startMs")
+            end = req.get_param("endMs")
+            limit = req.get_param("limit")
+            trace = export_trace(
+                eng,
+                start_ms=int(start) if start is not None else None,
+                end_ms=int(end) if end is not None else None,
+                limit=int(limit) if limit is not None else None,
+                resource=req.get_param("resource"))
+            return CommandResponse.of_success(trace.to_dict())
+        if op == "tee":
+            if writer is not None and not writer.status()["closed"]:
+                return CommandResponse.of_failure(
+                    f"tee already active to {writer.path!r} (op=stop first)")
+            path = req.get_param("path")
+            if not path:
+                return CommandResponse.of_failure("missing parameter: path")
+            writer = TraceWriter(path, eng)
+            eng._flightrec_writer = writer
+            eng.add_flight_tee(writer.on_second)
+            return CommandResponse.of_success(writer.status())
+        if op == "stop":
+            if writer is None:
+                return CommandResponse.of_failure("no tee active")
+            # Land any staged-but-unspilled seconds WHILE the tee is
+            # still attached (the spill is what feeds it), so the
+            # capture covers everything complete at stop time; only
+            # then detach and close.
+            eng.slo_refresh()
+            eng.remove_flight_tee(writer.on_second)
+            writer.close()
+            eng._flightrec_writer = None
+            return CommandResponse.of_success(writer.status())
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError, OSError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
+@command_mapping("sim", "trace-replay simulator: policy-lab report, "
+                        "scenario catalog, drill replays")
+def cmd_sim(req: CommandRequest) -> CommandResponse:
+    """Read/drill surface of the offline simulator (sentinel_tpu/
+    simulator/ — no reference twin). ``op`` selects the action:
+
+      * ``report`` (default) — the last policy-lab comparison report
+        (per-policy objective vectors, winners; the dashboard panel's
+        source). Offline suites populate it via ``run_lab``.
+      * ``scenarios`` — the built-in synthetic scenario catalog
+      * ``run`` — replay one scenario NOW, open loop, on a fresh sim
+        engine: ``scenario=`` (+ ``seconds=``, ``seed=``). Synchronous
+        and CPU-bound — bounded by ``csp.sentinel.sim.drill.max.
+        seconds``; real policy evaluation belongs in the offline lab.
+    """
+    op = req.get_param("op", "report")
+    try:
+        if op == "report":
+            from sentinel_tpu.simulator.lab import last_report
+
+            report = last_report()
+            if report is None:
+                return CommandResponse.of_success(
+                    {"report": None,
+                     "hint": "no policy-lab run in this process yet — "
+                             "populate it with simulator.lab.run_lab "
+                             "(op=run is a plain replay drill; it does "
+                             "not produce a comparison report)"})
+            return CommandResponse.of_success({"report": report})
+        if op == "scenarios":
+            from sentinel_tpu.simulator.scenarios import SCENARIOS
+
+            return CommandResponse.of_success(
+                {"scenarios": sorted(SCENARIOS)})
+        if op == "run":
+            from sentinel_tpu.simulator.replay import ReplayEngine
+            from sentinel_tpu.simulator.scenarios import build_scenario
+
+            name = req.get_param("scenario")
+            if not name:
+                return CommandResponse.of_failure(
+                    "missing parameter: scenario")
+            cap = config.sim_drill_max_seconds()
+            seconds = int(req.get_param("seconds", "60"))
+            if seconds > cap:
+                return CommandResponse.of_failure(
+                    f"seconds={seconds} exceeds the drill cap {cap} "
+                    "(csp.sentinel.sim.drill.max.seconds); run longer "
+                    "scenarios through the offline lab")
+            trace = build_scenario(
+                name, seconds=seconds, seed=int(req.get_param("seed", "0")))
+            result = ReplayEngine(trace).run(warmup=True)
+            out = result.to_dict()
+            out["scenario"] = name
+            out["secondsPerWallSecond"] = round(
+                result.seconds / result.replay_wall_s, 1)
+            return CommandResponse.of_success(out)
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("metrics", "Prometheus/OpenMetrics exposition")
 def cmd_metrics(req: CommandRequest) -> CommandResponse:
     """``GET /metrics``: the whole engine — attribution counters, RT

@@ -96,6 +96,61 @@ def test_no_wall_clock_in_device_ops():
         + ", ".join(offenders))
 
 
+def test_no_wall_clock_in_simulator():
+    """Replay must be deterministic BY CONSTRUCTION: the simulator
+    (sentinel_tpu/simulator/) drives everything off the injected
+    program clock, so an ambient wall-clock read anywhere in the
+    package would silently couple a replay to the host's clock. Same
+    rule (and skip logic) as the device-ops gate above; the one
+    sanctioned wall read is ``time.perf_counter`` — it MEASURES replay
+    speed (the ``sim_replay`` bench metric), it never drives replay."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu" / "simulator").rglob("*.py")):
+        for lineno, code in _code_lines(path):
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in simulator code (drive everything off the "
+        "SimClock; perf_counter only for speed measurement): "
+        + ", ".join(offenders))
+
+
+def test_sim_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.sim.*`` config key must (a) be defined and
+    read ONLY in core/config.py — the rest of the package goes through
+    the ``SentinelConfig`` accessors — and (b) appear in
+    docs/OPERATIONS.md "Trace capture & replay", so the runbook can
+    never silently drift from the knobs the code actually reads (same
+    rule shape as the cluster-HA / overload / pipeline gates)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.sim\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.sim.* literals outside core/config.py "
+        "(use the SentinelConfig sim_* accessors): " + ", ".join(offenders))
+    assert keys, "no sim config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "sim config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
 def test_exported_metric_names_registered_exactly_once():
     """Every ``sentinel_tpu_*`` metric family must be declared exactly
     once across the telemetry exporters — a name declared twice renders
@@ -157,6 +212,13 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_shard_wrong_slice_rejected",
                  "sentinel_tpu_shard_handoffs",
                  "sentinel_tpu_shard_degraded_slices"):
+        assert name in seen, f"{name} not declared in the exporters"
+    # trace-replay simulator families (ISSUE 13): declared exactly once
+    # (the dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_sim_lab_runs",
+                 "sentinel_tpu_sim_replayed_seconds",
+                 "sentinel_tpu_sim_replay_rate",
+                 "sentinel_tpu_sim_policy_score"):
         assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
