@@ -24,7 +24,7 @@ sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
 and the queue-wait vs device-wait split.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_12.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_13.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -456,6 +456,95 @@ def bench_adaptive_loop() -> dict:
             loop["refresh_mean_ms"] - base["refresh_mean_ms"], 4),
         "sensed_resources": loop["sensed"],
         "dispatch_guard_equal": guard_ok,
+    }}
+
+
+def bench_fleet_scrape() -> dict:
+    """Fleet aggregation overhead (ISSUE 14): 3 loopback leaders on
+    injected clocks, a FleetView collector pulling at 1 Hz (one poll
+    per simulated second). A/B the SAME driven stream without vs with
+    the collector attached: reported are the per-poll scrape wall, the
+    seconds federated, and the dispatch-count guard — per-step ENTRY/
+    EXIT device programs MUST be identical across the two runs (the
+    scrape is host JSON + the same once-per-second spill folds the SLO
+    ride already pays; it adds zero admission-path device work — the
+    PR 7/9 guard shape)."""
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+    from sentinel_tpu.core.engine import SentinelEngine
+    from sentinel_tpu.telemetry.fleet import FleetView
+
+    import sentinel_tpu as st
+
+    seconds = 20
+
+    def run(with_scrape: bool) -> dict:
+        now_box = [1_700_000_000_000]
+        engines, servers, batches = [], [], []
+        for i in range(3):
+            eng = SentinelEngine(512, clock=lambda: now_box[0],
+                                 journal_path="")
+            eng.flow_rules.load_rules([st.FlowRule(
+                resource=f"fl{i}", count=1e9)])
+            reg = eng.registry
+            buf = make_entry_batch_np(256)
+            buf["cluster_row"][:] = reg.cluster_row(f"fl{i}")
+            buf["dn_row"][:] = -1
+            buf["count"][:] = 1
+            batches.append(EntryBatch(
+                **{k: np.asarray(v) for k, v in buf.items()}))
+            engines.append(eng)
+            servers.append(ClusterTokenServer(
+                engine=eng, host="127.0.0.1", port=0).start())
+        fv = None
+        poll_walls = []
+        try:
+            if with_scrape:
+                fv = FleetView(
+                    [(f"L{i}", "127.0.0.1", servers[i].bound_port)
+                     for i in range(3)],
+                    clock=lambda: now_box[0], stale_ms=1 << 40)
+                fv.wait_connected()
+            for eng, batch in zip(engines, batches):
+                eng.check_batch(batch, now_ms=now_box[0])  # warm compiles
+                eng.slo_refresh(now_ms=now_box[0])
+            for _sec in range(seconds):
+                now_box[0] += 1000
+                for eng, batch in zip(engines, batches):
+                    eng.check_batch(batch, now_ms=now_box[0])
+                    eng.slo_refresh(now_ms=now_box[0])
+                if fv is not None:
+                    t0 = time.perf_counter()
+                    fv.poll()
+                    poll_walls.append((time.perf_counter() - t0) * 1e3)
+            dispatches = {}
+            for i, eng in enumerate(engines):
+                for k, v in eng.step_timer.snapshot().items():
+                    dispatches[f"L{i}:{k}"] = v["dispatches"]
+            federated = (sum(ls.seconds_ingested
+                             for ls in fv._leaders.values())
+                         if fv is not None else 0)
+            return {"dispatches": dispatches, "federated": federated,
+                    "poll_walls": poll_walls}
+        finally:
+            if fv is not None:
+                fv.stop()
+            for srv in servers:
+                srv.stop()
+            for eng in engines:
+                eng.close()
+
+    base = run(False)
+    scraped = run(True)
+    walls = scraped["poll_walls"] or [0.0]
+    return {"fleet_scrape": {
+        "leaders": 3,
+        "seconds_driven": seconds,
+        "seconds_federated": scraped["federated"],
+        "poll_p50_ms": round(float(np.median(walls)), 4),
+        "poll_mean_ms": round(float(np.mean(walls)), 4),
+        "dispatch_guard_equal":
+            scraped["dispatches"] == base["dispatches"],
     }}
 
 
@@ -1010,7 +1099,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_12.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_13.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1264,6 +1353,8 @@ def main() -> None:
         out.update(bench_pipeline_steady())
         persist(out)
         out.update(bench_adaptive_loop())
+        persist(out)
+        out.update(bench_fleet_scrape())
         persist(out)
         out.update(bench_sim_replay())
         persist(out)

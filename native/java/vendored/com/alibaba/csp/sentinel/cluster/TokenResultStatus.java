@@ -18,6 +18,12 @@ public final class TokenResultStatus {
      * request before admission (bounded-queue overload protection).
      * Clients that predate it treat 6 as unknown -> fallbackToLocal. */
     public static final int OVERLOADED = 6;
+    /** TPU wire extension (not upstream): a sharded leader answered a
+     * request for a flow whose hash slice it does not own — the
+     * client's shard map is stale; the reply names the server's map
+     * version so routing clients self-heal. Clients that predate it
+     * treat 7 as unknown -> fallbackToLocal. */
+    public static final int WRONG_SLICE = 7;
 
     private TokenResultStatus() {
     }

@@ -114,6 +114,20 @@ class AdaptiveLoop:
         # Decision log: bounded, seq-cursored (`adaptive` command history).
         self._events: deque = deque(maxlen=_cfg.adaptive_history_capacity())
         self._seq = 0
+        # Control-plane audit journal (ISSUE 14): every decision mirrors
+        # into it with causality back-pointers (canary -> its propose,
+        # promote -> its canary, abort -> the freeze that killed it),
+        # and — the restart fix — a file-backed journal re-seeds the
+        # decision log + seq cursor here so `history sinceSeq=` cursors
+        # survive a process restart.
+        self._journal = getattr(engine, "journal", None)
+        self._jseq: Dict[str, int] = {}  # decision kind -> journal seq
+        if self._journal is not None:
+            for rec in self._journal.replay(kind="adaptiveDecision"):
+                ev = rec.get("event")
+                if isinstance(ev, dict) and "seq" in ev:
+                    self._events.append(ev)
+                    self._seq = max(self._seq, int(ev["seq"]))
         # Freeze inputs: fault-channel baseline (deltas, not absolutes —
         # a long-lived engine's historical fallbacks must not freeze the
         # loop forever) and envelope-rejection dedup for the log.
@@ -216,9 +230,19 @@ class AdaptiveLoop:
         self.envelope.reset()
 
     def load_targets(self, targets: List[AdaptiveTarget]) -> None:
+        from sentinel_tpu.datasource.converters import adaptive_target_to_dict
+        from sentinel_tpu.telemetry.journal import MAX_RULES_PER_RECORD
+
         with self._lock:
             self.controller.load_targets(targets)
-            self._log("targets", count=len(targets))
+            # Target dicts ride the decision event into the journal, so
+            # a propose's causeSeq walk lands on the exact objective set
+            # (with datasource provenance) that shaped it — capped like
+            # every other load record (the count stays exact).
+            self._log("targets", count=len(targets),
+                      targets=[adaptive_target_to_dict(t)
+                               for t in targets[:MAX_RULES_PER_RECORD]],
+                      targetsTruncated=len(targets) > MAX_RULES_PER_RECORD)
 
     # -- the loop ----------------------------------------------------------
 
@@ -393,6 +417,10 @@ class AdaptiveLoop:
             self._log("promote", candidate=name, changes=[
                 {k: ch[k] for k in ("resource", "from", "to")}
                 for ch in changes])
+            # Next cycle's decisions must not link back to THIS
+            # candidate's lifecycle records.
+            self._jseq.pop("propose", None)
+            self._jseq.pop("canary", None)
         self._capture_lkg()
 
     def _note_abort(self, name: str, reason, now: int) -> None:
@@ -407,6 +435,8 @@ class AdaptiveLoop:
             self._log("abort", candidate=name, reason=str(reason),
                       backoffUntilMs=self._backoff_until_ms,
                       lkgIntact=self._lkg_intact())
+            self._jseq.pop("propose", None)
+            self._jseq.pop("canary", None)
         record_log.warn("adaptive candidate %s aborted: %s (backoff %ss)",
                         name, reason, self.backoff_s)
 
@@ -577,9 +607,31 @@ class AdaptiveLoop:
     def _log(self, kind: str, **fields) -> None:
         """Caller holds self._lock."""
         self._seq += 1
-        self._events.append({
-            "seq": self._seq, "kind": kind,
-            "timestamp": self.engine.now_ms(), **fields})
+        event = {"seq": self._seq, "kind": kind,
+                 "timestamp": self.engine.now_ms(), **fields}
+        self._events.append(event)
+        if self._journal is not None:
+            self._jseq[kind] = self._journal.record(
+                "adaptiveDecision", cause_seq=self._decision_cause(kind),
+                event=dict(event))
+
+    def _decision_cause(self, kind: str) -> Optional[int]:
+        """The journal seq that SHAPED this decision: a canary links to
+        its propose, a promote to the canary it graduated from, an
+        abort to the freeze that killed it (else the stage it died in),
+        a propose to the target load it serves. Caller holds _lock."""
+        j = self._jseq
+        if kind == "canary":
+            return j.get("propose")
+        if kind == "promote":
+            return j.get("canary") or j.get("propose")
+        if kind == "abort":
+            return j.get("freeze") or j.get("canary") or j.get("propose")
+        if kind == "thaw":
+            return j.get("freeze")
+        if kind == "propose":
+            return j.get("targets")
+        return None
 
     def history(self, since_seq: int = 0,
                 limit: Optional[int] = None) -> Dict:

@@ -20,6 +20,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from sentinel_tpu.cluster import codec
 from sentinel_tpu.cluster.constants import (
+    MSG_FLEET,
     MSG_FLOW,
     MSG_PARAM_FLOW,
     MSG_PING,
@@ -434,6 +435,40 @@ class ClusterTokenClient:
             elif not gate_neutral:
                 gate.record_failure()
         return out
+
+    def request_fleet_telemetry(self, since_ms: int = 0,
+                                max_seconds: int = 16,
+                                timeout_s: Optional[float] = None
+                                ) -> Optional[dict]:
+        """Pull one fleetTelemetry page (ISSUE 14): the leader's
+        complete seconds strictly after ``since_ms``, its instance
+        health, and shard ownership, as a decoded dict (plus
+        ``wireEpoch`` when the reply carried the epoch TLV). None on
+        disconnect/timeout/garbled payload; ``{"unsupported": True}``
+        when the server predates the command (BAD_REQUEST).
+
+        Deliberately NOT behind the health gate: a telemetry scrape
+        failing must never trip the breaker the TOKEN path relies on —
+        the read plane reports staleness, it doesn't fail admission."""
+        resp = self._call(
+            MSG_FLEET, codec.encode_fleet_request(since_ms, max_seconds),
+            timeout_s)
+        if resp is None:
+            return None
+        if resp.status == TokenResultStatus.BAD_REQUEST:
+            return {"unsupported": True}
+        if resp.status != TokenResultStatus.OK:
+            return None
+        payload, end = codec.decode_json_entity(resp.entity)
+        if payload is None:
+            return None
+        epoch = codec.read_epoch_tlv(resp.entity, end)
+        if epoch is not None:
+            # Reported, never fenced: telemetry is read-only — a stale
+            # leader's page is still true history, and rejecting it
+            # would inflate the fence's stale counter with reads.
+            payload["wireEpoch"] = epoch
+        return payload
 
     def request_param_token(self, flow_id: int, count: int, params: Sequence,
                             timeout_s: Optional[float] = None,

@@ -22,6 +22,7 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 from sentinel_tpu.cluster.constants import (
     MSG_ENTRY,
     MSG_EXIT,
+    MSG_FLEET,
     MSG_FLOW,
     MSG_PARAM_FLOW,
     MSG_PING,
@@ -452,3 +453,54 @@ def encode_exit_request(entry_id: int, error: bool, count: int = -1) -> bytes:
 def decode_exit_request(entity: bytes) -> Tuple[int, bool, int]:
     entry_id, error, count = struct.unpack_from(">qBi", entity)
     return entry_id, bool(error), count
+
+
+# -- MSG_FLEET (TPU extension — ISSUE 14 fleet telemetry pull) ----------------
+#
+# FLEET request:  since_ms:i64 | max_seconds:i32 — "complete seconds
+#                 strictly after since_ms, at most max_seconds of them".
+# FLEET response: u32 json_len | json utf-8 | trailing TLVs — the JSON
+#                 document is the leader's fleet page (telemetry/fleet.py
+#                 ``leader_fleet_payload``); the length prefix gives the
+#                 TLV scan a fixed offset, so the response is epoch-
+#                 stamped exactly like a token reply (stamp_epoch), and
+#                 future TLVs ride behind it without touching the JSON.
+
+_FLEET_REQ = struct.Struct(">qi")
+_JSON_HEAD = struct.Struct(">I")
+
+
+def encode_fleet_request(since_ms: int, max_seconds: int) -> bytes:
+    return _FLEET_REQ.pack(int(since_ms), int(max_seconds))
+
+
+def decode_fleet_request(entity: bytes) -> Tuple[int, int]:
+    since_ms, max_seconds = _FLEET_REQ.unpack_from(entity)
+    return since_ms, max_seconds
+
+
+def encode_json_entity(obj) -> bytes:
+    import json as _json
+
+    raw = _json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return _JSON_HEAD.pack(len(raw)) + raw
+
+
+def decode_json_entity(entity) -> Tuple[Optional[dict], int]:
+    """(decoded object, offset past the JSON — where the TLV run
+    starts), or (None, -1) on any malformation. Accepts memoryview
+    entities (the zero-copy reactor path) as well as bytes."""
+    import json as _json
+
+    if len(entity) < _JSON_HEAD.size:
+        return None, -1
+    (n,) = _JSON_HEAD.unpack_from(entity)
+    end = _JSON_HEAD.size + n
+    if len(entity) < end:
+        return None, -1
+    try:
+        return _json.loads(bytes(entity[_JSON_HEAD.size:end])
+                           .decode("utf-8")), end
+    except (ValueError, UnicodeDecodeError):
+        return None, -1

@@ -18,6 +18,12 @@ MSG_PARAM_FLOW = 2
 # bridge maps to its fail-open path.
 MSG_ENTRY = 10  # full slot-chain check + stats commit on the backend
 MSG_EXIT = 11   # exit/commit (RT, success, thread-count release)
+# Fleet telemetry pull (ISSUE 14): a collector asks a leader for its
+# flight-recorder spill (complete seconds after a cursor), instance
+# health, and shard ownership — one epoch-stamped JSON entity per
+# reply page. Stock reference servers answer BAD_REQUEST; the
+# FleetView collector marks such leaders unsupported and moves on.
+MSG_FLEET = 12
 
 # ClusterFlowConfig.thresholdType (reference: ClusterRuleConstant).
 THRESHOLD_AVG_LOCAL = 0  # effective threshold = count × connected clients

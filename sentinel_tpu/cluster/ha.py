@@ -35,10 +35,13 @@ Four cooperating pieces close the single-token-server availability gap:
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from sentinel_tpu.telemetry.journal import causing as journal_causing
 
 from sentinel_tpu.cluster.state import (
     CLUSTER_CLIENT,
@@ -460,6 +463,15 @@ class ClusterHAManager:
         self.retry_delay_s = config.cluster_ha_reconnect_ms() / 1000.0
         self._retry_timer = None
         self.state.ha = self
+        # Audit-journal back-pointers (ISSUE 14): each map apply links
+        # to the previous one, so the journal shows the assignment
+        # history as one causal chain per kind.
+        self._map_jseq = None
+        self._shard_jseq = None
+
+    def _journal(self):
+        return getattr(self.engine, "journal", None) \
+            if self.engine is not None else None
 
     # -- datasource wiring -------------------------------------------------
 
@@ -497,12 +509,25 @@ class ClusterHAManager:
                 return
             leader = cmap.leader()
             mine = cmap.server_for(self.machine_id)
+            # The apply record lands BEFORE the transition it drives,
+            # and the transition runs under causing(seq): the haRoleFlip
+            # the transition commits links back to this map — the
+            # journal's "why did this seat flip" answer.
+            j = self._journal()
+            jseq = j.record(
+                "clusterMapApply", epoch=int(cmap.epoch),
+                leader=leader.machine_id if leader else None,
+                servers=[s.machine_id for s in cmap.servers],
+                cause_seq=self._map_jseq) if j is not None else None
             try:
-                if leader is not None and mine is not None \
-                        and mine.machine_id == leader.machine_id:
-                    self._become_server(cmap, mine)
-                else:
-                    self._become_client(cmap)
+                with (journal_causing(jseq) if j is not None
+                      else contextlib.nullcontext()):
+                    if leader is not None and mine is not None \
+                            and mine.machine_id == leader.machine_id:
+                        self._become_server(cmap, mine)
+                    else:
+                        self._become_client(cmap)
+                self._map_jseq = jseq
             except Exception as ex:  # noqa: BLE001 — transition must retry
                 # Do NOT commit the map: the datasource property caches
                 # its value and never re-fires for an unchanged map, so
@@ -701,11 +726,22 @@ class ClusterHAManager:
                         smap.version,
                         len(set(cur_shard.epochs) - set(mine)))
                     return
+            j = self._journal()
+            jseq = j.record(
+                "shardMapApply", version=int(smap.version),
+                nSlices=int(smap.n_slices),
+                role="server" if (mine and spec is not None) else "client",
+                slicesOwned=sorted(int(s) for s in mine),
+                sliceEpochs={str(s): int(e) for s, e in sorted(mine.items())},
+                cause_seq=self._shard_jseq) if j is not None else None
             try:
-                if mine and spec is not None:
-                    self._become_shard_server(smap, spec, mine)
-                else:
-                    self._become_shard_client(smap)
+                with (journal_causing(jseq) if j is not None
+                      else contextlib.nullcontext()):
+                    if mine and spec is not None:
+                        self._become_shard_server(smap, spec, mine)
+                    else:
+                        self._become_shard_client(smap)
+                self._shard_jseq = jseq
             except Exception as ex:  # noqa: BLE001 — transition must retry
                 record_log.warn(
                     "shard map version %d transition failed: %r — "
@@ -875,7 +911,12 @@ class ClusterHAManager:
         else:
             thresholds_fn = self.state.server_rules().thresholds
         client = ShardedTokenClient(
-            smap, fence=self.state.fence, thresholds_fn=thresholds_fn)
+            smap, fence=self.state.fence, thresholds_fn=thresholds_fn,
+            # Walk spans (ISSUE 14) join the engine's span collector so
+            # a sharded client's self-heal/failover routes stitch into
+            # the same traces the entry path samples.
+            spans=getattr(self.engine, "spans", None)
+            if self.engine is not None else None)
         self.state.set_client(client)
 
     # -- checkpoint plumbing -----------------------------------------------

@@ -21,6 +21,7 @@ from sentinel_tpu.cluster import codec
 from sentinel_tpu.cluster.constants import (
     MSG_ENTRY,
     MSG_EXIT,
+    MSG_FLEET,
     MSG_FLOW,
     MSG_PARAM_FLOW,
     MSG_PING,
@@ -456,6 +457,22 @@ def process_control_frame(server: "ClusterTokenServer", req: codec.Request,
         return (codec.encode_response(
             req.xid, MSG_ENTRY, TokenResultStatus.BLOCKED,
             codec.encode_entry_response(0, reason)), namespace)
+    if req.msg_type == MSG_FLEET:
+        # Fleet telemetry pull (ISSUE 14): this leader's flight-recorder
+        # spill page + instance health + shard ownership, epoch-stamped
+        # like any token reply. Shared by both frontends, so the reactor
+        # serves it off its worker pool with zero-copy ingest for free.
+        from sentinel_tpu.telemetry.fleet import leader_fleet_payload
+
+        try:
+            since_ms, max_s = codec.decode_fleet_request(req.entity)
+            entity = stamp_epoch(
+                server, leader_fleet_payload(server, since_ms, max_s))
+            return (codec.encode_response(
+                req.xid, MSG_FLEET, TokenResultStatus.OK, entity), namespace)
+        except Exception:  # noqa: BLE001 — a read must never kill the conn
+            return (codec.encode_response(
+                req.xid, MSG_FLEET, TokenResultStatus.FAIL), namespace)
     if req.msg_type == MSG_EXIT:
         entry_id, error, count = codec.decode_exit_request(req.entity)
         handle = remote_entries.pop(entry_id, None)

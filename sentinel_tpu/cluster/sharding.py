@@ -172,8 +172,17 @@ class ShardedTokenClient:
                  thresholds_fn: Optional[Callable[[], Dict]] = None,
                  reconnect_interval_s: Optional[float] = None,
                  connect_timeout_s: float = 1.0,
-                 health_gate=_CONFIG_GATE):
+                 health_gate=_CONFIG_GATE,
+                 spans=None):
         from sentinel_tpu.cluster.ha import DegradedQuota
+
+        # Cross-leader span stitching (ISSUE 14): with a SpanCollector
+        # attached, any walk that does more than hit the owner (a
+        # WRONG_SLICE self-heal hop, a failover walk, a degraded
+        # verdict) records one ``cluster.slice_walk`` span whose hop
+        # list shows the whole route — joined to the caller's trace
+        # when the acquire rides one, sampled standalone otherwise.
+        self.spans = spans
 
         if not smap.servers:
             raise ValueError("sharded client needs at least one leader")
@@ -400,7 +409,8 @@ class ShardedTokenClient:
         return order
 
     def _route(self, flow_id, fn, degraded_fn,
-               timeout_s: Optional[float] = None) -> TokenResult:
+               timeout_s: Optional[float] = None,
+               trace=None) -> TokenResult:
         """The per-slice walk shared by flow and param acquires; ``fn``
         is ``(client, remaining_timeout) -> TokenResult``."""
         try:
@@ -409,6 +419,7 @@ class ShardedTokenClient:
             return TokenResult(TokenResultStatus.FAIL)
         sl = slice_of(fid, self.map.n_slices)
         owner = self._owner_of(sl)
+        hops: Optional[list] = [] if self.spans is not None else None
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
         now_ms = time_util.current_time_millis()
@@ -422,6 +433,8 @@ class ShardedTokenClient:
                 backed_off = self._backoff_until_ms[mid] - now_ms
                 if mid == owner:
                     owner_alive = True
+                if hops is not None:
+                    hops.append({"leader": mid, "event": "backed_off"})
                 continue
             remaining = None
             if deadline is not None:
@@ -436,6 +449,9 @@ class ShardedTokenClient:
                 self.wrong_slice_count += 1
                 if tr.wait_ms > self.stale_map_version_seen:
                     self.stale_map_version_seen = tr.wait_ms
+                if hops is not None:
+                    hops.append({"leader": mid, "event": "wrong_slice",
+                                 "mapVersion": int(tr.wait_ms)})
                 continue
             if tr.status == TokenResultStatus.OVERLOADED:
                 # The reply round-tripped the wire: THIS leader is alive
@@ -446,9 +462,13 @@ class ShardedTokenClient:
                 overload_hint = tr.wait_ms
                 if mid == owner:
                     owner_alive = True
+                if hops is not None:
+                    hops.append({"leader": mid, "event": "overloaded"})
                 continue
             if tr.status != TokenResultStatus.FAIL:
                 self._note_served(mid)
+                if hops is not None:
+                    hops.append({"leader": mid, "event": "served"})
                 if mid != owner:
                     # Self-heal: this leader answered for a slice our
                     # map routes elsewhere — adopt it until the next
@@ -458,8 +478,15 @@ class ShardedTokenClient:
                         self.failover_count += 1
                         self.last_failover_ms = \
                             time_util.current_time_millis()
+                    self._record_walk(trace, fid, sl, owner, hops,
+                                      "self-healed", served_by=mid)
+                else:
+                    self._record_walk(trace, fid, sl, owner, hops,
+                                      "served", served_by=mid)
                 return tr
             # FAIL: dead/partitioned/stale-fenced — walk on.
+            if hops is not None:
+                hops.append({"leader": mid, "event": "fail"})
         # No verdict anywhere for this slice: only ITS owner's clock
         # advances — other leaders' slices are untouched (per-slice
         # failover, the tentpole's blast-radius contract). An OVERLOADED
@@ -472,13 +499,48 @@ class ShardedTokenClient:
             self.degraded_entry_count += 1
             result = degraded_fn()
             if result is not None:
+                self._record_walk(trace, fid, sl, owner, hops, "degraded")
                 return result
+        self._record_walk(trace, fid, sl, owner, hops,
+                          "overloaded" if (overload_hint is not None
+                                           or backed_off is not None)
+                          else "fail")
         if overload_hint is not None or backed_off is not None:
             return TokenResult(
                 TokenResultStatus.OVERLOADED,
                 wait_ms=int(overload_hint if overload_hint is not None
                             else backed_off))
         return TokenResult(TokenResultStatus.FAIL)
+
+    def _record_walk(self, trace, fid: int, sl: int, owner: str,
+                     hops: Optional[list], outcome: str,
+                     served_by: Optional[str] = None) -> None:
+        """One ``cluster.slice_walk`` span per INTERESTING walk (a
+        WRONG_SLICE self-heal hop, a failover/degraded walk) — so the
+        trace of a sharded acquire shows the whole route, not just the
+        hop that finally answered. Boring owner-answered walks record
+        nothing (the steady state must stay span-free)."""
+        spans = self.spans
+        if spans is None or hops is None:
+            return
+        boring = (outcome == "served" and served_by == owner
+                  and len(hops) == 1)
+        if boring:
+            return
+        from sentinel_tpu.telemetry.spans import Span
+
+        if trace is not None:
+            ctx, parent = trace.child(), trace.span_id
+        else:
+            ctx, parent = spans.sample(), ""
+            if ctx is None:
+                return
+        spans.record(Span("cluster.slice_walk", ctx,
+                          parent_span_id=parent,
+                          attrs={"flowId": fid, "slice": sl,
+                                 "owner": owner, "outcome": outcome,
+                                 "servedBy": served_by or "",
+                                 "hops": list(hops)}).finish())
 
     def request_token(self, flow_id, count: int = 1,
                       prioritized: bool = False,
@@ -492,7 +554,7 @@ class ShardedTokenClient:
                                          gate_neutral=gate_neutral,
                                          trace=trace),
             lambda: self.degraded.acquire(flow_id, count),
-            timeout_s=timeout_s)
+            timeout_s=timeout_s, trace=trace)
 
     def request_param_token(self, flow_id, count, params,
                             timeout_s: Optional[float] = None,
@@ -508,7 +570,7 @@ class ShardedTokenClient:
                                                gate_neutral=gate_neutral,
                                                trace=trace),
             lambda: None,
-            timeout_s=timeout_s)
+            timeout_s=timeout_s, trace=trace)
 
     def request_tokens_pipelined(self, requests: Sequence[Tuple],
                                  timeout_s: Optional[float] = None,

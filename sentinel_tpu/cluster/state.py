@@ -116,6 +116,29 @@ class ClusterStateManager:
         self.epoch = 0
         self.mode_flips = 0
         self.ha = None
+        # Control-plane audit journal (ISSUE 14): set by the owning
+        # engine; role flips record through it (standalone managers
+        # leave it None and skip the audit).
+        self.journal = None
+        # The owning engine (set by SentinelEngine.__init__): servers
+        # this manager starts serve THIS engine's MSG_ENTRY bridge and
+        # fleetTelemetry payloads. None (standalone managers) keeps the
+        # historical lazy default-engine resolution.
+        self.engine = None
+
+    def _journal_flip(self, role_name: str, **fields) -> None:
+        """One ``haRoleFlip`` audit record per committed role change.
+        causeSeq rides the thread-local ``causing()`` context: an HA
+        map apply wraps its transition, so the flip links back to the
+        cluster/shard-map record that drove it."""
+        j = self.journal
+        if j is None:
+            return
+        try:
+            j.record("haRoleFlip", role=role_name, epoch=self.epoch,
+                     modeFlips=self.mode_flips, **fields)
+        except Exception:  # noqa: BLE001 — audit must not break a flip
+            pass
 
     def server_rules(self):
         from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
@@ -180,6 +203,7 @@ class ClusterStateManager:
                 epoch_fence=self.fence).start()
             self.mode = CLUSTER_CLIENT
             self.mode_flips += 1
+            self._journal_flip("CLIENT", target=f"{host}:{port}")
 
     def set_client(self, client) -> None:
         """Flip to CLIENT with a pre-built token client (the HA layer's
@@ -192,6 +216,8 @@ class ClusterStateManager:
             self.token_client = client.start()
             self.mode = CLUSTER_CLIENT
             self.mode_flips += 1
+            self._journal_flip("CLIENT",
+                               targets=getattr(client, "targets", None))
 
     def set_to_server(self, host: str = "0.0.0.0", port: int = 0,
                       service=None, epoch: Optional[int] = None) -> "object":
@@ -215,11 +241,14 @@ class ClusterStateManager:
             else:
                 self.fence.observe(epoch)
             self.token_server = ClusterTokenServer(
-                service=service, host=host, port=port).start()
+                service=service, host=host, port=port,
+                engine=self.engine).start()
             self.token_server.service.epoch = int(epoch)
             self.epoch = int(epoch)
             self.mode = CLUSTER_SERVER
             self.mode_flips += 1
+            self._journal_flip("SERVER",
+                               port=self.token_server.bound_port)
             return self.token_server
 
     def _teardown(self):
@@ -239,8 +268,11 @@ class ClusterStateManager:
 
     def stop(self) -> None:
         with self._lock:
+            had_role = self.mode != CLUSTER_NOT_STARTED
             self._teardown()
             self.mode = CLUSTER_NOT_STARTED
+            if had_role:  # a no-op stop (engine close) is not a flip
+                self._journal_flip("NOT_STARTED")
 
     def client_if_active(self):
         """The connected token client, or None (drives the fallback path).

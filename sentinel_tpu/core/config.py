@@ -163,6 +163,27 @@ WIRE_RLS_BATCHED = "csp.sentinel.wire.rls.batched"
 SIM_EPOCH_MS = "csp.sentinel.sim.epoch.ms"
 SIM_MAX_BATCH = "csp.sentinel.sim.max.batch"
 SIM_DRILL_MAX_SECONDS = "csp.sentinel.sim.drill.max.seconds"
+# Control-plane audit journal (telemetry/journal.py — no reference
+# twin: the reference's rule pushes leave no durable record). Every key
+# MUST be read through the accessors below and documented in
+# docs/OPERATIONS.md "Fleet observability & forensics" (pinned by
+# test_lint). path: empty = in-memory tail only (no file); capacity:
+# bounded in-memory tail the `journal` command serves; rotate.bytes:
+# fsync'd segment rotation threshold for the JSONL file.
+JOURNAL_PATH = "csp.sentinel.journal.path"
+JOURNAL_CAPACITY = "csp.sentinel.journal.capacity"
+JOURNAL_ROTATE_BYTES = "csp.sentinel.journal.rotate.bytes"
+# Fleet telemetry federation (telemetry/fleet.py — the mesh-wide half
+# of the observability plane). Every key MUST be read through the
+# accessors below and documented in docs/OPERATIONS.md "Fleet
+# observability & forensics" (pinned by test_lint). history.seconds:
+# fleet-wide per-second records the collector retains; stale.ms: how
+# old a leader's newest complete second may be before it reports
+# stale; max.seconds: complete seconds one fleetTelemetry reply page
+# carries (the cursor loops for more).
+FLEET_HISTORY_SECONDS = "csp.sentinel.fleet.history.seconds"
+FLEET_STALE_MS = "csp.sentinel.fleet.stale.ms"
+FLEET_MAX_SECONDS = "csp.sentinel.fleet.max.seconds"
 SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
 SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
 SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
@@ -287,6 +308,20 @@ DEFAULT_ADAPTIVE_SHADOW_SECONDS = 5
 DEFAULT_ADAPTIVE_CANARY_SECONDS = 5
 DEFAULT_ADAPTIVE_CANARY_BPS = 1_000
 DEFAULT_ADAPTIVE_HISTORY_CAPACITY = 256
+# Journal defaults. The in-memory tail bounds what the `journal`
+# command serves without file reads; 4 MiB per segment keeps three
+# rotated segments (~12 MiB) of control-plane history — mutations are
+# rare, so that is weeks of causality at production rates.
+DEFAULT_JOURNAL_CAPACITY = 512
+DEFAULT_JOURNAL_ROTATE_BYTES = 4 * 1024 * 1024
+# Fleet defaults. 512 retained fleet seconds ≈ 8.5 minutes of exact
+# mesh-wide series; a leader 5s behind the collector clock is stale
+# (the spill cadence is 1 Hz — 5 missed spills means the leader, not
+# the schedule); 16 seconds per reply page keeps the payload well
+# under the u16 frame bound at realistic resource counts.
+DEFAULT_FLEET_HISTORY_SECONDS = 512
+DEFAULT_FLEET_STALE_MS = 5_000
+DEFAULT_FLEET_MAX_SECONDS = 16
 
 
 def _env_key(key: str) -> str:
@@ -674,6 +709,34 @@ class SentinelConfig:
         v = self.get_int(ADAPTIVE_HISTORY_CAPACITY,
                          DEFAULT_ADAPTIVE_HISTORY_CAPACITY)
         return v if v > 0 else DEFAULT_ADAPTIVE_HISTORY_CAPACITY
+
+    # Journal / fleet accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.journal.* and csp.sentinel.fleet.* keys — test_lint
+    # forbids reading the literals anywhere else in the package).
+
+    def journal_path(self) -> Optional[str]:
+        v = self.get(JOURNAL_PATH)
+        return v if v else None
+
+    def journal_capacity(self) -> int:
+        v = self.get_int(JOURNAL_CAPACITY, DEFAULT_JOURNAL_CAPACITY)
+        return v if v > 0 else DEFAULT_JOURNAL_CAPACITY
+
+    def journal_rotate_bytes(self) -> int:
+        v = self.get_int(JOURNAL_ROTATE_BYTES, DEFAULT_JOURNAL_ROTATE_BYTES)
+        return v if v > 0 else DEFAULT_JOURNAL_ROTATE_BYTES
+
+    def fleet_history_seconds(self) -> int:
+        v = self.get_int(FLEET_HISTORY_SECONDS, DEFAULT_FLEET_HISTORY_SECONDS)
+        return v if v > 0 else DEFAULT_FLEET_HISTORY_SECONDS
+
+    def fleet_stale_ms(self) -> int:
+        v = self.get_int(FLEET_STALE_MS, DEFAULT_FLEET_STALE_MS)
+        return v if v > 0 else DEFAULT_FLEET_STALE_MS
+
+    def fleet_max_seconds(self) -> int:
+        v = self.get_int(FLEET_MAX_SECONDS, DEFAULT_FLEET_MAX_SECONDS)
+        return v if v > 0 else DEFAULT_FLEET_MAX_SECONDS
 
     def log_dir(self) -> str:
         d = self.get(LOG_DIR)

@@ -136,7 +136,8 @@ class SentinelEngine:
     linearized step stream.
     """
 
-    def __init__(self, capacity: int = 4096, clock=None):
+    def __init__(self, capacity: int = 4096, clock=None,
+                 journal_path: Optional[str] = None):
         # Clock-injection seam (ISSUE 13): every internal wall-clock read
         # goes through now_ms(), so a simulator can drive a REAL engine on
         # a program-advanced clock (sentinel_tpu/simulator/replay.py) with
@@ -211,6 +212,24 @@ class SentinelEngine:
         self.system_status = Y.SystemStatusListener()
         self._signals_refreshed_ms = 0
         self._sealed_sec = self.now_ms() // 1000 - 1
+        # Control-plane audit journal (telemetry/journal.py — ISSUE 14):
+        # every rule/SLO/target load, rollout transition, HA role flip,
+        # shard-map apply, adaptive decision, and clock swap appends one
+        # seq-numbered, causally-linked record. Constructed FIRST among
+        # the observability surfaces: the rule managers, rollout, SLO,
+        # adaptive, and cluster layers below all write through it (and
+        # the SLO/adaptive logs RESTORE from it after a restart when a
+        # file backs it). Stamps ride now_ms(), so a simulator replay
+        # journals in simulated time. journal_path: None = the
+        # csp.sentinel.journal.path config, "" = force memory-only
+        # (the simulator's determinism stance — a shared file would
+        # leak one replay's records into the next).
+        from sentinel_tpu.telemetry.journal import ControlPlaneJournal
+
+        self.journal = ControlPlaneJournal(self.now_ms, path=journal_path)
+        # Fleet federation (telemetry/fleet.py): a FleetView collector
+        # attached via the `fleet` ops command (None = not watching).
+        self.fleet = None
         # Flight-recorder tee (ISSUE 13): callables invoked with each
         # freshly spilled complete second, already rendered to the
         # ``second_to_dict`` JSON shape — the trace writer subscribes
@@ -222,6 +241,12 @@ class SentinelEngine:
         from sentinel_tpu.cluster.state import ClusterStateManager
 
         self.cluster = ClusterStateManager()
+        # Role flips (ops setClusterMode, HA promotions) journal through
+        # the owning engine — and servers the manager starts serve THIS
+        # engine's bridge + fleet telemetry; standalone managers leave
+        # both None.
+        self.cluster.journal = self.journal
+        self.cluster.engine = self
         # Staged rollout (sentinel_tpu/rollout/): candidate rulesets
         # evaluated in shadow lanes of the fused step, optionally enforced
         # for a deterministic canary slice. The compiled candidate pack +
@@ -480,6 +505,11 @@ class SentinelEngine:
         adaptive = getattr(self, "adaptive", None)
         if adaptive is not None:
             adaptive.reset_timebase()
+        # Audit the swap itself — stamped with the NEW timebase (the
+        # old one no longer exists to stamp with). seq stays monotone
+        # across the swap even though timestamps may step backward;
+        # SEMANTICS.md "Journal causality" names this asymmetry.
+        self.journal.record("clockSwap", injected=clock is not None)
 
     def add_flight_tee(self, fn) -> None:
         """Subscribe ``fn(second_dict)`` to every freshly spilled
@@ -649,6 +679,35 @@ class SentinelEngine:
             self._dirty[family] = True
             self._sync_rollout_sources()
             self._rebuild_leases()
+        self._journal_rule_load(family)
+
+    def _journal_rule_load(self, family: str) -> None:
+        """One ``ruleLoad`` audit record per family load: who pushed
+        (the ``acting()`` provenance context — datasource pollers and
+        ops commands set it), what is now in force (rule dicts, capped),
+        and what caused it (a rollout promotion's ``causing()`` seam).
+        Runs OUTSIDE the config lock — the journal fsync must never
+        extend the window a rule push holds the config plane."""
+        from sentinel_tpu.datasource import converters as CV
+        from sentinel_tpu.telemetry.journal import MAX_RULES_PER_RECORD
+
+        mgr, to_dict = {
+            "flow": (self.flow_rules, CV.flow_rule_to_dict),
+            "degrade": (self.degrade_rules, CV.degrade_rule_to_dict),
+            "authority": (self.authority_rules, CV.authority_rule_to_dict),
+            "system": (self.system_rules, CV.system_rule_to_dict),
+            "param": (self.param_rules, CV.param_rule_to_dict),
+        }[family]
+        rules = list(mgr.get_rules())
+        dicts = []
+        for r in rules[:MAX_RULES_PER_RECORD]:
+            try:
+                dicts.append(to_dict(r))
+            except Exception:  # noqa: BLE001 — audit must not break loads
+                dicts.append({"resource": getattr(r, "resource", None)})
+        self.journal.record(
+            "ruleLoad", family=family, count=len(rules), rules=dicts,
+            rulesTruncated=len(rules) > MAX_RULES_PER_RECORD)
 
     def _sync_rollout_sources(self) -> None:
         """Rule pushes may carry staged (candidate-tagged) rules, and the
@@ -687,6 +746,7 @@ class SentinelEngine:
             else:
                 self._cluster_param_info = self._cluster_info(
                     self.param_rules.get_rules(), with_param_idx=True)
+        self._journal_rule_load(family)
 
     def _ensure_compiled(self):
         """(Re)build rule tensors + state after a config push (§3.2).
@@ -978,6 +1038,11 @@ class SentinelEngine:
         self.cluster.stop()
         self.traces.stop()
         self.slo.stop()
+        fleet = self.fleet
+        if fleet is not None:
+            self.fleet = None
+            fleet.stop()
+        self.journal.close()
 
     @staticmethod
     def _cluster_info(rules, with_param_idx: bool = False) -> Dict[str, list]:
@@ -2041,6 +2106,17 @@ class SentinelEngine:
                 "matchedRules": matched,
             },
         }
+
+    def why_query(self, resource: str,
+                  stamp_ms: Optional[int] = None) -> Dict:
+        """Forensic "why": join the flight-recorder second at
+        ``stamp_ms`` with the journal records in force then — blocking
+        rule + its load provenance (actor, seq, causeSeq chain), the
+        rollout candidate in force, the shard map in force. The ``why``
+        ops command's implementation (telemetry/journal.py)."""
+        from sentinel_tpu.telemetry.journal import forensic_why
+
+        return forensic_why(self, resource, stamp_ms)
 
     def row_stats(self):
         """(per-second QPS totals f32[R, E], threads int[R]) as numpy.

@@ -164,6 +164,25 @@ class SentinelApiClient:
         report (per-policy objective vectors) or the scenario catalog."""
         return json.loads(self.get(ip, port, "sim", {"op": op}))
 
+    def fetch_fleet(self, ip: str, port: int, op: str = "status",
+                    params: Optional[Dict] = None) -> Dict:
+        """Fleet federation state (``fleet`` command): per-leader
+        staleness/skew/health (op=status) or the exact federated
+        per-second series (op=series)."""
+        return json.loads(self.get(ip, port, "fleet",
+                                   {"op": op, **(params or {})}))
+
+    def fetch_journal(self, ip: str, port: int,
+                      params: Optional[Dict] = None) -> Dict:
+        """Audit-journal tail (``journal`` command): seq-cursored
+        control-plane records (sinceSeq/limit/kind)."""
+        return json.loads(self.get(ip, port, "journal", params or {}))
+
+    def fetch_why(self, ip: str, port: int,
+                  params: Optional[Dict] = None) -> Dict:
+        """Forensic ``why`` join for one (resource, stampMs)."""
+        return json.loads(self.get(ip, port, "why", params or {}))
+
     def fetch_explain(self, ip: str, port: int,
                       resource: Optional[str] = None,
                       index: int = 0) -> Dict:

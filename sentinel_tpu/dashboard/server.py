@@ -42,6 +42,11 @@ set as a small JSON API plus one static page:
     set + transition log (proxies the machines' ``alerts`` command)
   * ``GET  /sim.json?app=``                   trace-replay simulator: last
     policy-lab report / scenario catalog (proxies the ``sim`` command)
+  * ``GET  /fleet.json?app=``                 fleet observability: federated
+    per-leader staleness/skew/health + exact fleet series (proxies the
+    machines' ``fleet`` command; ``op=series`` for the per-second sums,
+    ``op=why&resource=&stampMs=`` routes the forensic ``why`` join,
+    ``op=journal`` the audit-journal tail)
   * ``POST /rollout/command?app=&op=``        stage/canary/promote/abort/tick
     (no reference twin — proxies the engines' ``rollout`` command)
   * ``POST /cluster/assign?app=&ip=&port=``   token-server assignment
@@ -254,6 +259,22 @@ class DashboardServer:
         m = self._first_healthy(app)
         return self.api.fetch_adaptive(m.ip, m.port, op=op,
                                        since_seq=since_seq, limit=limit)
+
+    def get_fleet(self, app: str, op: str = "status",
+                  params: Optional[Dict[str, str]] = None):
+        """Fleet observability read path: the machines' ``fleet``
+        command (status/series), the ``journal`` tail, or the ``why``
+        forensic join — one dashboard proxy for the whole plane."""
+        m = self._first_healthy(app)
+        if op == "journal":
+            return self.api.fetch_journal(m.ip, m.port,
+                                          params=params or {})
+        if op == "why":
+            return self.api.fetch_why(m.ip, m.port, params=params or {})
+        if op not in ("status", "series", "poll"):
+            raise ValueError(f"unsupported fleet op {op!r}")
+        return self.api.fetch_fleet(m.ip, m.port, op=op,
+                                    params=params or {})
 
     def get_sim(self, app: str, op: str = "report"):
         """Simulator read path (``sim`` command report/scenarios) from
@@ -518,6 +539,12 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/sim.json":
                 return self._ok(d.get_sim(
                     q.get("app", ""), op=q.get("op", "report")))
+            if path == "/fleet.json":
+                op = q.get("op", "status")
+                params = {k: v for k, v in q.items()
+                          if k not in ("app", "op")}
+                return self._ok(d.get_fleet(q.get("app", ""), op=op,
+                                            params=params))
             if path == "/alerts.json":
                 m = d._first_healthy(q.get("app", ""))
                 since = q.get("sinceSeq")

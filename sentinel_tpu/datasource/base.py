@@ -140,11 +140,20 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
         self._thread.start()
         return self
 
+    def _acting(self):
+        """Provenance context for the audit journal (ISSUE 14): every
+        rule/objective/target load this source pushes records
+        ``datasource:<ClassName>`` as its actor."""
+        from sentinel_tpu.telemetry.journal import acting
+
+        return acting(f"datasource:{type(self).__name__}")
+
     def first_load(self) -> None:
         try:
             value = self.load_config()
             if value is not None:
-                self._property.update_value(value)
+                with self._acting():
+                    self._property.update_value(value)
             self._note_success()
         except Exception as ex:
             _log_warn("datasource initial load failed: %r", ex)
@@ -161,7 +170,8 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
             return False
         value = self.load_config()
         if value is not None:
-            self._property.update_value(value)
+            with self._acting():
+                self._property.update_value(value)
         return True
 
     def _note_success(self) -> None:

@@ -138,7 +138,12 @@ class PushDataSource(AbstractDataSource[str, T]):
             _log_warn("push datasource convert failed (kept last good): %r", ex)
             return
         if value is not None:
-            self._property.update_value(value)
+            from sentinel_tpu.telemetry.journal import acting
+
+            # Journal provenance (ISSUE 14): pushed loads attribute to
+            # the concrete source class, like the poll loop's reads.
+            with acting(f"datasource:{type(self).__name__}"):
+                self._property.update_value(value)
 
 
 class BrokerDataSource(PushDataSource[T]):

@@ -99,6 +99,10 @@ class CandidateSet:
     # ``rolloutStage`` tags last requested. Re-publishes with unchanged
     # tags must not clobber an ops-side escalation (see refresh_staged).
     source_stage: Optional[str] = None
+    # Audit-journal seq of this candidate's staging record (ISSUE 14):
+    # later transitions (stage flips, promote, abort) carry it as their
+    # causeSeq, so the journal shows one linked lifecycle per candidate.
+    journal_seq: Optional[int] = None
 
     def families(self) -> List[str]:
         return [f for f in FAMILIES if self.rules.get(f)]
@@ -220,6 +224,13 @@ class RolloutManager:
             self._active = name
             self._reset_guardrail()
             self._notify()
+            j = getattr(self.engine, "journal", None)
+            if j is not None:
+                cand.journal_seq = j.record(
+                    "rolloutStage", name=name, stage=stage, source=source,
+                    canaryBps=cand.canary_bps,
+                    families={f: len(cand.rules[f])
+                              for f in cand.families()})
             return cand
 
     @staticmethod
@@ -257,6 +268,11 @@ class RolloutManager:
             # Stage flips tune the traced canary scalars only — the
             # shadow world (counters, controller state) carries over.
             self.engine._set_canary(*self.canary_config())
+            j = getattr(self.engine, "journal", None)
+            if j is not None:
+                j.record("rolloutStage", name=cand.name, stage=stage,
+                         canaryBps=cand.canary_bps,
+                         cause_seq=cand.journal_seq)
             return cand
 
     def promote(self, name: str) -> Dict:
@@ -264,15 +280,29 @@ class RolloutManager:
         candidate touches, load the MERGED ruleset through the family
         manager (the same property path datasources push through), then
         tear the shadow world down."""
+        import contextlib as _ctxlib
+
+        from sentinel_tpu.telemetry import journal as journal_mod
+
         with self._lock():
             cand = self._require_active(name)
+            # The promote record lands BEFORE the rule loads it fires,
+            # and the loads run under causing(seq): the resulting
+            # ruleLoad records carry causeSeq -> this promote — the
+            # causality the why-query's chain walk follows back through
+            # the candidate's staging record.
+            j = getattr(self.engine, "journal", None)
+            jseq = j.record("rolloutPromote", name=cand.name,
+                            cause_seq=cand.journal_seq) if j else None
             loaded = {}
-            for fam in cand.families():
-                merged = self.merged_rules(fam, cand)
-                detagged = [self._detag(r) for r in merged]
-                attr, _ = _FAMILY_BIND[fam]
-                getattr(self.engine, attr).load_rules(detagged)
-                loaded[fam] = len(detagged)
+            with (journal_mod.causing(jseq) if j is not None
+                  else _ctxlib.nullcontext()):
+                for fam in cand.families():
+                    merged = self.merged_rules(fam, cand)
+                    detagged = [self._detag(r) for r in merged]
+                    attr, _ = _FAMILY_BIND[fam]
+                    getattr(self.engine, attr).load_rules(detagged)
+                    loaded[fam] = len(detagged)
             cand.stage = STAGE_PROMOTED
             cand.stage_since_ms = self.engine.now_ms()
             cand.ended_reason = "promoted"
@@ -295,6 +325,10 @@ class RolloutManager:
             self._active = None
             self._reset_guardrail()
             self._notify()
+            j = getattr(self.engine, "journal", None)
+            if j is not None:
+                j.record("rolloutAbort", name=cand.name, reason=reason,
+                         cause_seq=cand.journal_seq)
             self._fire("aborted", cand, reason)
             return {"aborted": cand.name, "reason": reason}
 

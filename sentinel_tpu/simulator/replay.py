@@ -86,7 +86,7 @@ class ReplayResult:
     __slots__ = ("trace_meta", "seconds", "offered", "passed", "blocked",
                  "retried", "verdict_sha256", "series", "rt_hist",
                  "decisions", "counters", "final_counts", "band_violations",
-                 "replay_wall_s", "total_wall_s")
+                 "journal", "replay_wall_s", "total_wall_s")
 
     def __init__(self):
         self.trace_meta: Dict = {}
@@ -102,6 +102,11 @@ class ReplayResult:
         self.counters: Dict = {}         # adaptive monotone counters
         self.final_counts: Dict[str, float] = {}  # tunable rule counts
         self.band_violations = 0
+        # The sim engine's control-plane audit journal (ISSUE 14):
+        # memory-only (never file-backed — see _build_engine), stamped
+        # in SIMULATED time, so two runs of one trace+seed produce
+        # identical record streams — the journal-determinism oracle.
+        self.journal: List[Dict] = []
         # Wall timing (perf_counter, the one sanctioned wall read in
         # this package — it measures speed, it never drives replay):
         # replay_wall_s covers the second loop only (steady state, what
@@ -144,6 +149,7 @@ class ReplayResult:
             "finalCounts": self.final_counts,
             "bandViolations": self.band_violations,
             "decisions": len(self.decisions),
+            "journalRecords": len(self.journal),
         }
 
 
@@ -199,7 +205,11 @@ class ReplayEngine:
         from sentinel_tpu.core.engine import SentinelEngine
         from sentinel_tpu.datasource import converters as CV
 
-        eng = SentinelEngine(self.capacity, clock=clock.now_ms)
+        # journal_path="" forces a memory-only journal whatever the
+        # process config says: a shared file would leak one replay's
+        # records into the next run's restore, breaking determinism.
+        eng = SentinelEngine(self.capacity, clock=clock.now_ms,
+                             journal_path="")
         # The trace ring's worker thread is the one async consumer on
         # the check_batch path; stopped, submit() is a pinned no-op —
         # zero nondeterministic host work rides the verdict stream.
@@ -464,6 +474,11 @@ class ReplayEngine:
         hist = loop.history()
         result.decisions = hist["events"]
         result.counters = dict(loop._counters())
+        # The full audit stream, simulated-time-stamped: rule loads at
+        # build, every rollout transition and adaptive decision the run
+        # produced. Deterministic given the trace + seed (the oracle in
+        # tests/test_fleet.py pins it).
+        result.journal = eng.journal.tail()
         for r in eng.flow_rules.get_rules():
             if _tunable(r):
                 result.final_counts[r.resource] = float(r.count)

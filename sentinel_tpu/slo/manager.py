@@ -79,6 +79,21 @@ class SloManager:
         self._seq = 0
         self.fired_count = 0
         self.resolved_count = 0
+        # Control-plane audit journal (ISSUE 14): every transition
+        # mirrors into it (a resolve carries causeSeq -> its fire), and
+        # — the restart fix — a file-backed journal re-seeds the
+        # transition log + seq cursor here, so `alerts sinceSeq=`
+        # cursors held by external consumers survive a process restart
+        # instead of silently replaying from 1.
+        self.journal = getattr(engine, "journal", None) \
+            if engine is not None else None
+        self._fired_jseq: Dict[str, int] = {}
+        if self.journal is not None:
+            for rec in self.journal.replay(kind="sloTransition"):
+                ev = rec.get("event")
+                if isinstance(ev, dict) and "seq" in ev:
+                    self._events.append(ev)
+                    self._seq = max(self._seq, int(ev["seq"]))
         self.webhook = AlertWebhook()
         # Evaluation cursors + last burn snapshot per objective.
         self._last_ingest_ms = -1
@@ -129,6 +144,15 @@ class SloManager:
                         and alert["resource"] in covered)
                 if gone:
                     self._transition(key, False, now, alert)
+        if self.journal is not None:
+            from sentinel_tpu.datasource.converters import (
+                slo_objective_to_dict)
+            from sentinel_tpu.telemetry.journal import MAX_RULES_PER_RECORD
+
+            self.journal.record(
+                "sloLoad", count=len(validated),
+                objectives=[slo_objective_to_dict(o)
+                            for o in validated[:MAX_RULES_PER_RECORD]])
 
     def objectives(self) -> List[SloObjective]:
         with self._lock:
@@ -315,6 +339,17 @@ class SloManager:
         event = {"seq": self._seq, "type": kind, "timestamp": now_ms,
                  "alert": dict(alert)}
         self._events.append(event)
+        if self.journal is not None:
+            # A resolve is CAUSED by its fire: the back-pointer lets the
+            # why-query's chain walk show an alert's full arc.
+            key = alert.get("key")
+            cause = self._fired_jseq.get(key) if kind == "resolved" else None
+            jseq = self.journal.record("sloTransition", cause_seq=cause,
+                                       event=dict(event))
+            if kind == "fired":
+                self._fired_jseq[key] = jseq
+            else:
+                self._fired_jseq.pop(key, None)
         if self.webhook.enabled:
             from sentinel_tpu.core.config import config as _cfg
 

@@ -537,6 +537,64 @@ def render_engine_metrics(engine) -> str:
             b.sample("sentinel_tpu_sim_policy_score",
                      {"scenario": scen, "policy": pol}, run["score"])
 
+    # -- control-plane audit journal (telemetry/journal.py) ---------------
+    jstats = engine.journal.stats()
+    b.family("sentinel_tpu_journal_last_seq", "gauge",
+             "Highest audit-journal seq (monotone across restarts when "
+             "a file backs the journal)")
+    b.sample("sentinel_tpu_journal_last_seq", None, jstats["lastSeq"])
+    b.counter("sentinel_tpu_journal_records",
+              "Audit records appended by this process",
+              jstats["appended"])
+    b.counter("sentinel_tpu_journal_dropped_partial",
+              "Torn tail records dropped (loudly) during crash recovery",
+              jstats["droppedPartial"])
+    b.counter("sentinel_tpu_journal_rotations",
+              "Journal file segment rotations", jstats["rotations"])
+    b.family("sentinel_tpu_journal_durable", "gauge",
+             "1 while a file backs the journal (0: in-memory tail only)")
+    b.sample("sentinel_tpu_journal_durable", None,
+             1 if jstats["durable"] else 0)
+
+    # -- fleet federation (telemetry/fleet.py) ----------------------------
+    # Families render -1 / nothing while no FleetView collector is
+    # attached, so one scrape config fits every role.
+    fleet = engine.fleet
+    fstatus = fleet.status() if fleet is not None else None
+    b.family("sentinel_tpu_fleet_leaders", "gauge",
+             "Leaders the attached FleetView federates (-1: no "
+             "collector attached)")
+    b.sample("sentinel_tpu_fleet_leaders", None,
+             fstatus["leaderCount"] if fstatus else -1)
+    b.family("sentinel_tpu_fleet_stale_leaders", "gauge",
+             "Leaders whose newest complete second is older than the "
+             "staleness bound")
+    b.sample("sentinel_tpu_fleet_stale_leaders", None,
+             fstatus["staleLeaders"] if fstatus else -1)
+    b.family("sentinel_tpu_fleet_health", "gauge",
+             "Fleet health: min of the federated leaders' instance "
+             "health scores (-1: no collector / no data)")
+    fh = (fstatus or {}).get("fleetHealth")
+    b.sample("sentinel_tpu_fleet_health", None, fh if fh is not None else -1)
+    b.family("sentinel_tpu_fleet_retained_seconds", "gauge",
+             "Fleet-wide per-second records the collector retains")
+    b.sample("sentinel_tpu_fleet_retained_seconds", None,
+             fstatus["retainedSeconds"] if fstatus else -1)
+    b.family("sentinel_tpu_fleet_skew_ms", "gauge",
+             "Signed clock skew per federated leader (leader nowMs "
+             "minus collector clock at receive)")
+    if fstatus:
+        for name, row in sorted(fstatus["leaders"].items()):
+            if row["skewMs"] is not None:
+                b.sample("sentinel_tpu_fleet_skew_ms", {"leader": name},
+                         row["skewMs"])
+    b.counter("sentinel_tpu_fleet_polls",
+              "FleetView scrape cycles completed",
+              fstatus["polls"] if fstatus else 0)
+    b.counter("sentinel_tpu_fleet_poll_errors",
+              "Leader page pulls that returned no payload",
+              fstatus["pollErrors"] if fstatus else 0)
+
     # -- span sampling health --------------------------------------------
     ssnap = engine.spans.snapshot(limit=0)
     b.counter("sentinel_tpu_spans_seen",

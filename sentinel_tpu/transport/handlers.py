@@ -88,7 +88,10 @@ def cmd_set_rules(req: CommandRequest) -> CommandResponse:
         rules = fam[1](data or "[]")
     except (ValueError, KeyError, TypeError) as ex:
         return CommandResponse.of_failure(f"parse error: {ex}")
-    getattr(req.engine, fam[0]).load_rules(rules)
+    from sentinel_tpu.telemetry.journal import acting
+
+    with acting("ops:setRules"):  # audit-journal provenance (ISSUE 14)
+        getattr(req.engine, fam[0]).load_rules(rules)
     ds = _writable_datasources.get(rule_type)
     if ds is not None:
         try:
@@ -427,9 +430,12 @@ def cmd_slo(req: CommandRequest) -> CommandResponse:
             return CommandResponse.of_success(
                 [CV.slo_objective_to_dict(o) for o in slo.objectives()])
         if op == "set":
+            from sentinel_tpu.telemetry.journal import acting
+
             data = req.get_param("data") or req.body
             objectives = CV.slo_objectives_from_json(data or "[]")
-            slo.load_objectives(objectives)
+            with acting("ops:slo"):
+                slo.load_objectives(objectives)
             return CommandResponse.of_success(
                 {"loaded": len(objectives)})
         return CommandResponse.of_failure(f"unknown op {op!r}")
@@ -485,9 +491,12 @@ def cmd_adaptive(req: CommandRequest) -> CommandResponse:
                 [CV.adaptive_target_to_dict(t)
                  for t in loop.controller.targets()])
         if op == "set":
+            from sentinel_tpu.telemetry.journal import acting
+
             data = req.get_param("data") or req.body
             targets = CV.adaptive_targets_from_json(data or "[]")
-            loop.load_targets(targets)
+            with acting("ops:adaptive"):
+                loop.load_targets(targets)
             return CommandResponse.of_success({"loaded": len(targets)})
         if op == "tick":
             return CommandResponse.of_success(loop.tick(force=True))
@@ -623,6 +632,139 @@ def cmd_sim(req: CommandRequest) -> CommandResponse:
             out["secondsPerWallSecond"] = round(
                 result.seconds / result.replay_wall_s, 1)
             return CommandResponse.of_success(out)
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
+@command_mapping("journal", "control-plane audit journal: seq-cursored "
+                            "record tail + causality walks")
+def cmd_journal(req: CommandRequest) -> CommandResponse:
+    """The crash-safe control-plane audit journal (telemetry/journal.py
+    — no reference twin: the reference's rule pushes leave no durable
+    record). ``op`` selects the action:
+
+      * ``tail`` (default) — records after ``sinceSeq=`` (the cursor;
+        strictly-after), newest kept under ``limit=``; ``kind=``
+        filters one record kind (ruleLoad, sloTransition,
+        adaptiveDecision, rolloutStage/Promote/Abort, haRoleFlip,
+        clusterMapApply, shardMapApply, clockSwap, ...)
+      * ``chain`` — the causality walk from ``seq=`` up its causeSeq
+        back-pointers (nearest first)
+      * ``status`` — seq cursor, retention, durability, drop counters
+    """
+    journal = req.engine.journal
+    op = req.get_param("op", "tail")
+    try:
+        if op == "status":
+            return CommandResponse.of_success(journal.stats())
+        if op == "chain":
+            seq = req.get_param("seq")
+            if seq is None:
+                return CommandResponse.of_failure("missing parameter: seq")
+            return CommandResponse.of_success(
+                {"chain": journal.chain(int(seq))})
+        if op == "tail":
+            since = int(req.get_param("sinceSeq", "0"))
+            limit = req.get_param("limit")
+            records = journal.tail(
+                since_seq=since, kind=req.get_param("kind"),
+                limit=int(limit) if limit is not None else None)
+            return CommandResponse.of_success(
+                {"records": records, "nextSeq": journal.last_seq})
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
+@command_mapping("why", "forensic verdict join: flight-recorder second "
+                        "× journal records in force at that stamp")
+def cmd_why(req: CommandRequest) -> CommandResponse:
+    """"Why was this resource blocked at T": joins the flight-recorder
+    second at ``stampMs=`` (default: the newest complete second for the
+    resource) with the journal records in force then — the blocking
+    rule family's live rules from the load record (with datasource
+    provenance and the causeSeq chain), the rollout candidate in force,
+    and the shard map in force (telemetry/journal.py
+    ``forensic_why``)."""
+    resource = req.get_param("resource")
+    if not resource:
+        return CommandResponse.of_failure("missing parameter: resource")
+    stamp = req.get_param("stampMs")
+    try:
+        out = req.engine.why_query(
+            resource, int(stamp) if stamp is not None else None)
+    except (ValueError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+    return CommandResponse.of_success(out)
+
+
+@command_mapping("fleet", "fleet telemetry: federate N leaders' "
+                          "per-second series, staleness, health")
+def cmd_fleet(req: CommandRequest) -> CommandResponse:
+    """The FleetView collector (telemetry/fleet.py — no reference twin:
+    the reference dashboard polls per-machine metric logs). ``op``:
+
+      * ``status`` (default) — per-leader staleness/skew/health/slice
+        ownership + fleet health (min of instance healths); refreshes
+        with one poll cycle first unless ``poll=false``
+      * ``watch`` — attach a collector: JSON list in ``data=``/body of
+        ``{"name":..., "host":..., "port":...}`` leader specs
+        (replaces any previous collector)
+      * ``series`` — the federated per-second series (``resource=``,
+        ``limit=``, ``sinceMs=`` filter/paginate); exact fleet sums
+        beside the per-leader split
+      * ``poll`` — force one scrape cycle now
+      * ``stop`` — detach the collector
+    """
+    from sentinel_tpu.telemetry.fleet import FleetView
+
+    eng = req.engine
+    op = req.get_param("op", "status")
+    try:
+        if op == "watch":
+            data = req.get_param("data") or req.body
+            leaders = json.loads(data or "[]")
+            if not isinstance(leaders, list) or not leaders:
+                return CommandResponse.of_failure(
+                    "expected a non-empty JSON list of "
+                    '{"name","host","port"} leader specs')
+            # Build (and fully validate) the NEW collector before
+            # touching the old one: a bad spec must leave the working
+            # collector attached, not tear it down and then fail. Names
+            # come from the VALIDATED collector — the raw payload may
+            # use tuple-form specs with no "name" key.
+            fresh = FleetView(leaders, clock=eng.now_ms)
+            watching = sorted(fresh._leaders.keys())
+            old, eng.fleet = eng.fleet, fresh
+            if old is not None:
+                old.stop()
+            return CommandResponse.of_success({"watching": watching})
+        fleet = eng.fleet
+        if fleet is None:
+            return CommandResponse.of_success(
+                {"watching": False,
+                 "hint": "no collector attached (op=watch first)"})
+        if op == "status":
+            if (req.get_param("poll") or "true").lower() != "false":
+                fleet.poll()
+            return CommandResponse.of_success(fleet.status())
+        if op == "poll":
+            return CommandResponse.of_success({"ingested": fleet.poll()})
+        if op == "series":
+            limit = req.get_param("limit")
+            since = req.get_param("sinceMs")
+            return CommandResponse.of_success({
+                "seconds": fleet.series(
+                    resource=req.get_param("resource"),
+                    limit=int(limit) if limit is not None else 60,
+                    since_ms=int(since) if since is not None else None),
+                "settledThroughMs": fleet.settled_through_ms(),
+            })
+        if op == "stop":
+            eng.fleet = None
+            fleet.stop()
+            return CommandResponse.of_success({"watching": False})
         return CommandResponse.of_failure(f"unknown op {op!r}")
     except (ValueError, KeyError, TypeError) as ex:
         return CommandResponse.of_failure(str(ex))

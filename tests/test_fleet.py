@@ -1,0 +1,808 @@
+"""Fleet observability plane suite (ISSUE 14 tentpole): the crash-safe
+control-plane audit journal (seq-monotone, causally-linked, restart-
+resuming), the fleetTelemetry wire command + FleetView federation
+(exact mesh-wide per-second series), and the forensic why-query join.
+
+Tier-1 discipline (870s cap): the 3-leader federation oracle runs
+scaled-down tier-1 WITHOUT a restart; the leader-restart variant and
+the multi-position journal byte-chop fuzz are ``slow``-marked from the
+start — one seed of each invariant stays tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.constants import (
+    MSG_FLEET,
+    THRESHOLD_GLOBAL,
+    TokenResultStatus,
+)
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.sharding import ShardedTokenClient, ShardState
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.core.config import config as _cfg
+from sentinel_tpu.core.context import replace_context
+from sentinel_tpu.core.engine import SentinelEngine
+from sentinel_tpu.core.exceptions import BlockException
+from sentinel_tpu.datasource import converters as CV
+from sentinel_tpu.telemetry.fleet import FleetView
+from sentinel_tpu.telemetry.journal import (
+    ControlPlaneJournal,
+    acting,
+    causing,
+)
+from sentinel_tpu.telemetry.spans import SpanCollector
+from sentinel_tpu.utils import time_util
+
+SUM_FIELDS = ("pass", "block", "success", "exception", "rtSumMs",
+              "occupiedPass")
+
+
+def _flow_rules(*pairs):
+    return CV.flow_rules_from_json(json.dumps(
+        [{"resource": res, "count": count, "grade": 1}
+         for res, count in pairs]))
+
+
+def _drive(eng, resource: str, n: int) -> None:
+    """n entries (pass or block) + immediate exits on the frozen clock."""
+    for _ in range(n):
+        try:
+            h = eng.entry(resource)
+        except BlockException:
+            continue
+        h.exit()
+    replace_context(None)
+
+
+def _wait(pred, timeout_s: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+@pytest.fixture()
+def eng(frozen_time):
+    replace_context(None)
+    e = SentinelEngine(128)
+    yield e
+    replace_context(None)
+    e.close()
+
+
+# ---------------------------------------------------------------------------
+# journal core
+# ---------------------------------------------------------------------------
+
+
+def test_journal_seq_cursor_capacity_and_kinds():
+    j = ControlPlaneJournal(lambda: 42_000, capacity=4)
+    seqs = [j.record("a", x=i) for i in range(3)]
+    assert seqs == [1, 2, 3]
+    j.record("b", y=9)
+    # Cursor semantics match the adaptive/SLO logs: strictly-after.
+    assert [r["seq"] for r in j.tail(since_seq=2)] == [3, 4]
+    assert [r["kind"] for r in j.tail(kind="b")] == ["b"]
+    assert j.tail(limit=0) == []
+    assert [r["seq"] for r in j.tail(limit=1)] == [4]
+    # Bounded tail: capacity 4 holds the newest 4 after a 5th record.
+    j.record("a", x=99)
+    assert [r["seq"] for r in j.tail()] == [2, 3, 4, 5]
+    rec = j.tail()[0]
+    assert rec["v"] == 1 and rec["timestamp"] == 42_000
+    assert rec["actor"] == "local" and rec["causeSeq"] is None
+
+
+def test_journal_acting_causing_and_chain():
+    j = ControlPlaneJournal(lambda: 1, capacity=16)
+    with acting("datasource:TestSource"):
+        root = j.record("ruleLoad", family="flow")
+    with causing(root):
+        mid = j.record("rolloutPromote")  # picks up the ambient cause
+    leaf = j.record("ruleLoad", cause_seq=mid)
+    assert j.find(root)["actor"] == "datasource:TestSource"
+    assert j.find(mid)["causeSeq"] == root
+    chain = j.chain(leaf)
+    assert [r["seq"] for r in chain] == [leaf, mid, root]
+    # in_force: newest matching record at/before a stamp.
+    j2 = ControlPlaneJournal(lambda: 10_000, capacity=16)
+    j2.record("ruleLoad", family="flow", count=1)
+    assert j2.in_force(10_000, "ruleLoad", family="flow")["count"] == 1
+    assert j2.in_force(9_999, "ruleLoad") is None
+    assert j2.in_force(10_000, "ruleLoad", family="param") is None
+
+
+def _chopped_journal(tmp_path, chop: int):
+    """Write 3 records, chop ``chop`` bytes off the tail, reopen."""
+    p = str(tmp_path / f"chop{chop}.jsonl")
+    j = ControlPlaneJournal(lambda: 5_000, path=p, capacity=16)
+    for i in range(3):
+        j.record("k", i=i)
+    j.close()
+    with open(p, "rb") as f:
+        data = f.read()
+    with open(p, "wb") as f:  # the test simulates the crash, not the journal
+        f.write(data[:len(data) - chop])
+    return p, data
+
+
+def test_journal_byte_chop_recovery(tmp_path):
+    """A torn tail record is dropped LOUDLY (counted), every complete
+    record survives, and seq stays monotone across the recovery."""
+    p, _ = _chopped_journal(tmp_path, chop=7)
+    j = ControlPlaneJournal(lambda: 6_000, path=p, capacity=16)
+    assert j.dropped_partial == 1
+    assert [r["seq"] for r in j.tail()] == [1, 2]  # complete records only
+    assert j.record("after") == 3                  # monotone, no reuse
+    j.close()
+    # And the terminated torn line never splices into the new record.
+    j2 = ControlPlaneJournal(lambda: 7_000, path=p, capacity=16)
+    assert [r["seq"] for r in j2.tail()] == [1, 2, 3]
+    assert j2.dropped_partial == 0   # the torn line was terminated, once
+    j2.close()
+
+
+def test_journal_newline_only_chop_commits_not_resurrects(tmp_path):
+    """Review pin: a tail record that lost ONLY its newline is a
+    complete committed record — recovery must count it (seq resumes
+    ABOVE it), not drop it and then let the newline-termination
+    resurrect it for replay() under a reused seq (duplicate-seq
+    split-brain)."""
+    p, _ = _chopped_journal(tmp_path, chop=1)  # only the '\n' lost
+    j = ControlPlaneJournal(lambda: 6_000, path=p, capacity=16)
+    assert j.dropped_partial == 0
+    assert [r["seq"] for r in j.tail()] == [1, 2, 3]  # all committed
+    assert j.record("after") == 4                     # no seq reuse
+    j.close()
+    j2 = ControlPlaneJournal(lambda: 7_000, path=p, capacity=16)
+    seqs = [r["seq"] for r in j2.replay()]
+    assert seqs == [1, 2, 3, 4] and len(set(seqs)) == len(seqs)
+    j2.close()
+
+
+@pytest.mark.slow
+def test_journal_byte_chop_fuzz(tmp_path):
+    """Every chop position (1 byte .. the whole last record and into
+    the one before) recovers: no exception, complete-prefix records
+    intact, seq monotone. The single-seed tier-1 version is above."""
+    _, data = _chopped_journal(tmp_path, chop=0)
+    for chop in range(1, min(len(data), 120)):
+        p, _ = _chopped_journal(tmp_path, chop=chop)
+        j = ControlPlaneJournal(lambda: 6_000, path=p, capacity=16)
+        recs = j.tail()
+        assert [r["seq"] for r in recs] == list(range(1, len(recs) + 1))
+        nxt = j.record("after")
+        assert nxt == (recs[-1]["seq"] if recs else 0) + 1
+        j.close()
+
+
+def test_journal_rotation_renames_only(tmp_path):
+    p = str(tmp_path / "rot.jsonl")
+    j = ControlPlaneJournal(lambda: 1_000, path=p, capacity=64,
+                            rotate_bytes=200)
+    for i in range(12):
+        j.record("k", pad="x" * 40, i=i)
+    assert j.rotations >= 1
+    assert (tmp_path / "rot.jsonl.1").exists()
+    # replay() stitches segments oldest-first: the full record set.
+    seqs = [r["seq"] for r in j.replay()]
+    assert seqs == sorted(seqs) and seqs[-1] == 12
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# restart-surviving decision/transition logs (satellite: cursor continuity)
+# ---------------------------------------------------------------------------
+
+
+def test_history_cursors_survive_restart(tmp_path, frozen_time):
+    """The AdaptiveLoop decision log and SloManager transition log used
+    to vanish on restart; file-backed journal re-seeds both, so a
+    consumer's ``sinceSeq`` cursor keeps working across the restart."""
+    _cfg.set("csp.sentinel.journal.path", str(tmp_path / "audit.jsonl"))
+    try:
+        e1 = SentinelEngine(128)
+        e1.adaptive.enable()
+        e1.adaptive.load_targets(CV.adaptive_targets_from_json(json.dumps(
+            [{"resource": "r1", "maxBlockRate": 0.1}])))
+        e1.adaptive.freeze(reason="drill")
+        # A real SLO transition: emit via the manager's state machine.
+        e1.slo._transition("t:x", True, e1.now_ms(), {
+            "key": "t:x", "kind": "burn_rate", "severity": "page",
+            "resource": "r1"})
+        hist1 = e1.adaptive.history()
+        alerts1 = e1.slo.alerts_snapshot()
+        assert hist1["nextSeq"] >= 3 and alerts1["nextSeq"] == 1
+        e1.close()
+
+        e2 = SentinelEngine(128)
+        hist2 = e2.adaptive.history()
+        assert [ev["kind"] for ev in hist2["events"]] \
+            == [ev["kind"] for ev in hist1["events"]]
+        assert hist2["nextSeq"] == hist1["nextSeq"]
+        # Cursor continuity: a consumer parked at seq k sees only newer.
+        k = hist1["nextSeq"] - 1
+        assert [ev["seq"] for ev in
+                e2.adaptive.history(since_seq=k)["events"]] == [k + 1]
+        alerts2 = e2.slo.alerts_snapshot()
+        assert alerts2["nextSeq"] == alerts1["nextSeq"]
+        assert [ev["seq"] for ev in alerts2["events"]] \
+            == [ev["seq"] for ev in alerts1["events"]]
+        # New transitions continue ABOVE the restored cursor space.
+        e2.slo._transition("t:y", True, e2.now_ms(), {
+            "key": "t:y", "kind": "burn_rate", "severity": "page",
+            "resource": "r1"})
+        assert e2.slo.alerts_snapshot()["nextSeq"] == alerts1["nextSeq"] + 1
+        e2.close()
+    finally:
+        _cfg.reset_for_tests()
+
+
+def test_rule_load_provenance_and_promote_causality(eng):
+    with acting("datasource:DrillSource"):
+        eng.flow_rules.load_rules(_flow_rules(("rA", 4)))
+    load = eng.journal.tail(kind="ruleLoad")[-1]
+    assert load["actor"] == "datasource:DrillSource"
+    assert load["family"] == "flow" and load["count"] == 1
+    assert load["rules"][0]["resource"] == "rA"
+    eng.rollout.load_candidate("c1", {"flow": [{"resource": "rA",
+                                                "count": 8, "grade": 1}]})
+    eng.rollout.promote("c1")
+    stage = eng.journal.tail(kind="rolloutStage")[-1]
+    promote = eng.journal.tail(kind="rolloutPromote")[-1]
+    merged_load = eng.journal.tail(kind="ruleLoad")[-1]
+    # promote <- staging; the rule load it fired <- promote.
+    assert promote["causeSeq"] == stage["seq"]
+    assert merged_load["causeSeq"] == promote["seq"]
+    chain = eng.journal.chain(merged_load["seq"])
+    assert [r["kind"] for r in chain] \
+        == ["ruleLoad", "rolloutPromote", "rolloutStage"]
+    # Abort path links to the staging record too.
+    eng.rollout.load_candidate("c2", {"flow": [{"resource": "rA",
+                                                "count": 2, "grade": 1}]})
+    eng.rollout.abort("c2", reason="drill")
+    ab = eng.journal.tail(kind="rolloutAbort")[-1]
+    assert ab["causeSeq"] == eng.journal.tail(kind="rolloutStage")[-1]["seq"]
+
+
+def test_clock_swap_and_role_flip_journaled(eng):
+    eng.set_clock(lambda: 99_000)
+    rec = eng.journal.tail(kind="clockSwap")[-1]
+    assert rec["injected"] is True and rec["timestamp"] == 99_000
+    eng.set_clock(None)
+    assert eng.journal.tail(kind="clockSwap")[-1]["injected"] is False
+    # HA role flips journal through the engine's state manager.
+    srv = eng.cluster.set_to_server(host="127.0.0.1", port=0,
+                                    service=DefaultTokenService())
+    flip = eng.journal.tail(kind="haRoleFlip")[-1]
+    assert flip["role"] == "SERVER" and flip["port"] == srv.bound_port
+    eng.cluster.stop()
+    assert eng.journal.tail(kind="haRoleFlip")[-1]["role"] == "NOT_STARTED"
+    # An idempotent stop with no role running is not a flip.
+    n = len(eng.journal.tail(kind="haRoleFlip"))
+    eng.cluster.stop()
+    assert len(eng.journal.tail(kind="haRoleFlip")) == n
+
+
+def test_shard_map_apply_journaled_with_causality(eng):
+    from sentinel_tpu.cluster.ha import ClusterHAManager
+    from sentinel_tpu.datasource.converters import shard_map_from_json
+
+    ha = ClusterHAManager(engine=eng, state=eng.cluster, machine_id="me")
+    smap = shard_map_from_json({
+        "version": 1, "nSlices": 8,
+        "servers": [{"machineId": "other", "host": "127.0.0.1",
+                     "port": 1}],
+        "sliceOwners": {"other": list(range(8))},
+        "clients": ["me"],
+    })
+    ha.apply_shard_map(smap)
+    try:
+        apply_rec = eng.journal.tail(kind="shardMapApply")[-1]
+        assert apply_rec["version"] == 1 and apply_rec["role"] == "client"
+        assert apply_rec["slicesOwned"] == []
+        flip = eng.journal.tail(kind="haRoleFlip")[-1]
+        assert flip["role"] == "CLIENT"
+        assert flip["causeSeq"] == apply_rec["seq"]  # apply drove the flip
+        # A second map links back to the first.
+        ha.apply_shard_map(smap._replace(version=2))
+        recs = eng.journal.tail(kind="shardMapApply")
+        assert recs[-1]["causeSeq"] == recs[-2]["seq"]
+    finally:
+        ha.stop()
+        eng.cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# forensic why-query
+# ---------------------------------------------------------------------------
+
+
+def test_why_query_names_rule_provenance_and_candidate(eng):
+    with acting("datasource:WhySource"):
+        eng.flow_rules.load_rules(_flow_rules(("rW", 3)))
+    eng.rollout.load_candidate("canary-1", {"flow": [
+        {"resource": "rW", "count": 5, "grade": 1}]})
+    _drive(eng, "rW", 8)           # 3 pass, 5 FLOW-blocked this second
+    stamp = eng.now_ms()
+    time_util.advance_time(1500)   # seal the second
+    out = eng.why_query("rW")
+    assert out["second"] is not None
+    assert out["second"]["timestamp"] == stamp - stamp % 1000
+    v = out["verdict"]
+    assert v["reason"] == "FLOW" and v["blockedThatSecond"] == 5
+    assert v["matchedRules"][0]["count"] == 3
+    prov = v["provenance"]
+    assert prov["actor"] == "datasource:WhySource"
+    assert prov["ruleCount"] == 1 and prov["seq"] >= 1
+    assert out["candidateInForce"]["name"] == "canary-1"
+    assert out["shardMapInForce"] is None
+    # Unknown stamp: second=None but the journal join still answers.
+    past = eng.why_query("rW", stamp_ms=1_000)
+    assert past["second"] is None and past["verdict"] is None
+
+
+# ---------------------------------------------------------------------------
+# fleetTelemetry wire + federation
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_codec_roundtrip_and_garbled():
+    assert codec.decode_fleet_request(
+        codec.encode_fleet_request(12_345, 7)) == (12_345, 7)
+    ent = codec.encode_json_entity({"a": 1})
+    obj, end = codec.decode_json_entity(ent)
+    assert obj == {"a": 1} and end == len(ent)
+    # Epoch TLV rides behind the JSON at the returned offset.
+    stamped = codec.append_epoch_tlv(ent, codec.encode_epoch_value(9))
+    assert codec.read_epoch_tlv(stamped, end) == 9
+    assert codec.decode_json_entity(b"\x00\x00") == (None, -1)
+    assert codec.decode_json_entity(
+        b"\x00\x00\x00\x05notjs") == (None, -1)
+    # memoryview (reactor zero-copy) decodes identically.
+    assert codec.decode_json_entity(memoryview(ent))[0] == {"a": 1}
+
+
+def _leader(name, resources, frozen=True):
+    """One leader: engine + flow rules + token server."""
+    e = SentinelEngine(128)
+    e.flow_rules.load_rules(_flow_rules(*resources))
+    srv = ClusterTokenServer(engine=e, host="127.0.0.1", port=0).start()
+    return e, srv
+
+
+def test_fleet_wire_roundtrip_and_paging(frozen_time):
+    replace_context(None)
+    e, srv = _leader("L", [("rA", 3)])
+    cli = None
+    try:
+        for _ in range(3):            # three recorded seconds
+            _drive(e, "rA", 5)
+            time_util.advance_time(1000)
+        e.slo_refresh()
+        cli = ClusterTokenClient("127.0.0.1", srv.bound_port).start()
+        assert _wait(cli.is_connected)
+        p = cli.request_fleet_telemetry(0, 16)
+        view = e.timeseries_view()
+        assert [s["timestamp"] for s in p["seconds"]] \
+            == [s["timestamp"] for s in view["seconds"]]
+        assert p["seconds"] == view["seconds"]     # bit-exact transport
+        assert p["moreAfterMs"] is None and p["shard"] is None
+        assert p["health"]["instance"] <= 100
+        # Cursor paging: one second per page, gap-free.
+        cursor, pages = 0, []
+        while True:
+            page = cli.request_fleet_telemetry(cursor, 1)
+            if not page["seconds"]:
+                break
+            pages.extend(s["timestamp"] for s in page["seconds"])
+            cursor = page["seconds"][-1]["timestamp"]
+            if page["moreAfterMs"] is None:
+                break
+        assert pages == [s["timestamp"] for s in view["seconds"]]
+        # An epoch-fenced leader stamps the reply TLV.
+        srv.service.epoch = 7
+        p = cli.request_fleet_telemetry(0, 4)
+        assert p["wireEpoch"] == 7 and p["epoch"] == 7
+    finally:
+        if cli is not None:
+            cli.stop()
+        srv.stop()
+        e.close()
+        replace_context(None)
+
+
+def _assert_fleet_exact(fv, engines):
+    """THE differential oracle: every fleet cell equals the bit-exact
+    sum of per-leader cells, and every per-leader cell equals that
+    leader's OWN timeseries_view for the stamp (when still retained)."""
+    truth = {}
+    for name, e in engines.items():
+        if e is None:
+            continue
+        truth[name] = {s["timestamp"]: s["resources"]
+                       for s in e.timeseries_view()["seconds"]}
+    series = fv.series()
+    assert series, "no federated seconds"
+    for sec in series:
+        stamp = sec["timestamp"]
+        for res, cell in sec["resources"].items():
+            fleet, leaders = cell["fleet"], cell["leaders"]
+            for f in SUM_FIELDS:
+                assert fleet[f] == sum(int(lc.get(f, 0))
+                                       for lc in leaders.values()), \
+                    (stamp, res, f)
+            for lname, lcell in leaders.items():
+                own = truth.get(lname, {}).get(stamp, {}).get(res)
+                if own is not None:
+                    assert lcell == own, (lname, stamp, res)
+    return series
+
+
+def _three_leader_mesh():
+    engines = {
+        "L1": _leader("L1", [("only1", 2), ("shared", 3)]),
+        "L2": _leader("L2", [("only2", 4), ("shared", 2)]),
+        "L3": _leader("L3", [("only3", 1)]),
+    }
+    return ({k: v[0] for k, v in engines.items()},
+            {k: v[1] for k, v in engines.items()})
+
+
+def test_fleet_federation_exact_3_leaders(frozen_time):
+    """Tier-1 seed of the federation oracle: 3 live leaders, mixed
+    shared/distinct resources, fleet series == bit-exact sum of the
+    per-leader views (the restart variant is slow-marked below)."""
+    replace_context(None)
+    engines, servers = _three_leader_mesh()
+    fv = None
+    try:
+        for _t in range(3):
+            _drive(engines["L1"], "only1", 4)
+            _drive(engines["L1"], "shared", 5)
+            _drive(engines["L2"], "only2", 6)
+            _drive(engines["L2"], "shared", 4)
+            _drive(engines["L3"], "only3", 3)
+            time_util.advance_time(1000)
+        for e in engines.values():
+            e.slo_refresh()
+        fv = FleetView([(n, "127.0.0.1", servers[n].bound_port)
+                        for n in engines],
+                       clock=engines["L1"].now_ms, stale_ms=10_000)
+        assert fv.wait_connected()
+        ingested = fv.poll()
+        assert all(v > 0 for v in ingested.values()), ingested
+        series = _assert_fleet_exact(fv, engines)
+        # A shared resource really sums across 2 leaders.
+        summed = [sec for sec in series
+                  if "shared" in sec["resources"]
+                  and len(sec["resources"]["shared"]["leaders"]) == 2]
+        assert summed, "shared resource never federated from both leaders"
+        st = fv.status()
+        assert st["leaderCount"] == 3 and st["staleLeaders"] == 0
+        assert st["fleetHealth"] is not None
+        assert st["settledThroughMs"] >= series[-1]["timestamp"]
+        for row in st["leaders"].values():
+            assert row["skewMs"] is not None and abs(row["skewMs"]) < 5_000
+        # Idempotent: a re-poll ingests nothing new, sums unchanged.
+        assert all(v == 0 for v in fv.poll().values())
+        _assert_fleet_exact(fv, engines)
+    finally:
+        if fv is not None:
+            fv.stop()
+        for s in servers.values():
+            s.stop()
+        for e in engines.values():
+            e.close()
+        replace_context(None)
+
+
+@pytest.mark.slow
+def test_fleet_federation_leader_restart_mid_run(frozen_time):
+    """The restart oracle: killing + rebuilding one leader mid-run
+    degrades ONLY its series — the fleet view retains its pre-restart
+    seconds, flags it stale while down, and resumes ingesting its fresh
+    engine's seconds after rebind; the other leaders stay bit-exact
+    throughout."""
+    replace_context(None)
+    engines, servers = _three_leader_mesh()
+    fv = None
+    try:
+        for _t in range(2):
+            for name, res in (("L1", "only1"), ("L2", "only2"),
+                              ("L3", "only3")):
+                _drive(engines[name], res, 3)
+            time_util.advance_time(1000)
+        for e in engines.values():
+            e.slo_refresh()
+        fv = FleetView([(n, "127.0.0.1", servers[n].bound_port)
+                        for n in engines],
+                       clock=engines["L1"].now_ms, stale_ms=4_000)
+        assert fv.wait_connected()
+        fv.poll()
+        pre = {sec["timestamp"]: sec for sec in fv.series()}
+        assert any("only2" in sec["resources"] for sec in pre.values())
+        # L2 dies; its port is remembered for the rebind.
+        port2 = servers["L2"].bound_port
+        servers["L2"].stop()
+        engines["L2"].close()
+        engines["L2"] = None
+        time_util.advance_time(5000)   # past stale_ms with no L2 seconds
+        _drive(engines["L1"], "only1", 2)
+        time_util.advance_time(1000)
+        engines["L1"].slo_refresh()
+        fv.poll()
+        st = fv.status()
+        assert st["leaders"]["L2"]["stale"] is True
+        assert st["leaders"]["L1"]["stale"] is False
+        assert st["staleLeaders"] == 1
+        # Pre-restart L2 seconds are RETAINED in the fleet store.
+        for stamp, sec in pre.items():
+            if "only2" in sec["resources"]:
+                now_sec = [s for s in fv.series()
+                           if s["timestamp"] == stamp][0]
+                assert now_sec["resources"]["only2"] \
+                    == sec["resources"]["only2"]
+        # L2 rebuilds on the same port with a fresh engine.
+        e2 = SentinelEngine(128)
+        e2.flow_rules.load_rules(_flow_rules(("only2", 4)))
+        srv2 = None
+        for _ in range(40):            # rebind can race TIME_WAIT
+            try:
+                srv2 = ClusterTokenServer(engine=e2, host="127.0.0.1",
+                                          port=port2).start()
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert srv2 is not None, "rebind failed"
+        engines["L2"], servers["L2"] = e2, srv2
+        assert _wait(
+            lambda: fv._leaders["L2"].client.is_connected(), 10.0)
+        _drive(e2, "only2", 5)
+        time_util.advance_time(1000)
+        e2.slo_refresh()
+        fv.poll()
+        st = fv.status()
+        assert st["leaders"]["L2"]["stale"] is False
+        # Exactness holds across the whole run (restart included): the
+        # retained pre-restart L2 cells are checked against the fleet
+        # sums; the live engines against their own views.
+        series = _assert_fleet_exact(fv, engines)
+        fresh = [sec for sec in series
+                 if "only2" in sec["resources"]
+                 and sec["timestamp"] > max(pre)]
+        assert fresh, "post-restart L2 seconds missing from the fleet view"
+    finally:
+        if fv is not None:
+            fv.stop()
+        for s in servers.values():
+            if s is not None:
+                s.stop()
+        for e in engines.values():
+            if e is not None:
+                e.close()
+        replace_context(None)
+
+
+class _DummyClient:
+    def is_connected(self):
+        return True
+
+    def stop(self):
+        pass
+
+
+def _bare_view(**kw):
+    return FleetView([("L1", "h", 1), ("L2", "h", 2)],
+                     clock=lambda: 1_000_000,
+                     client_factory=lambda h, p: _DummyClient(), **kw)
+
+
+def test_fleet_straggler_never_evicts_in_window_second():
+    """Review pin: a recovered leader reporting a stamp OLDER than the
+    bounded store's whole window must be the one evicted — not the
+    oldest in-window second (sort-before-evict)."""
+    fv = _bare_view(history_seconds=2)
+    cell = {"pass": 1, "block": 0}
+    for stamp in (100_000, 101_000):
+        fv._ingest(fv._leaders["L1"], {
+            "seconds": [{"timestamp": stamp, "resources": {"r": cell}}]})
+    # L2 was partitioned past the retention window; its straggler is
+    # out-of-window and must not displace stamp 100_000.
+    fv._ingest(fv._leaders["L2"], {
+        "seconds": [{"timestamp": 50_000, "resources": {"r": cell}}]})
+    assert [s["timestamp"] for s in fv.series()] == [100_000, 101_000]
+
+
+def test_fleet_skipped_fat_second_advances_cursor(monkeypatch, frozen_time):
+    """Review pin: a single second too fat for the u16 frame is skipped
+    LOUDLY (named in the page, counted by the collector) instead of
+    silently stalling the cursor on it forever."""
+    import sentinel_tpu.telemetry.fleet as fleet_mod
+
+    replace_context(None)
+    e, srv = _leader("L", [("rFat", 100)])
+    try:
+        _drive(e, "rFat", 6)
+        time_util.advance_time(1000)
+        _drive(e, "rFat", 4)
+        time_util.advance_time(1000)
+        e.slo_refresh()
+        stamps = [s["timestamp"] for s in e.timeseries_view()["seconds"]]
+        monkeypatch.setattr(fleet_mod, "MAX_ENTITY_BYTES", 400)
+        entity = fleet_mod.leader_fleet_payload(srv, 0, 16)
+        payload, _ = codec.decode_json_entity(entity)
+        assert payload["seconds"] == []
+        assert payload["skippedSecondMs"] == stamps[0]
+        assert payload["moreAfterMs"] == stamps[0]  # more seconds remain
+        fv = _bare_view()
+        ls = fv._leaders["L1"]
+        fv._ingest(ls, payload)
+        assert ls.cursor_ms == stamps[0] and ls.seconds_skipped == 1
+        assert fv.status()["leaders"]["L1"]["secondsSkipped"] == 1
+    finally:
+        srv.stop()
+        e.close()
+        replace_context(None)
+
+
+# ---------------------------------------------------------------------------
+# cross-leader span stitching (sharded walks)
+# ---------------------------------------------------------------------------
+
+
+def test_slice_walk_span_stitching(frozen_time):
+    """A WRONG_SLICE self-heal walk records ONE cluster.slice_walk span
+    whose hop list shows the whole route; boring owner-answered walks
+    record nothing."""
+    from sentinel_tpu.cluster.ha import ClusterServerSpec
+    from sentinel_tpu.cluster.sharding import ShardMap, slice_of
+
+    N = 8
+    fid = 9000                      # slice 6 on the 8-ring (pinned in
+    sl = slice_of(fid, N)           # test_shard.py)
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [CV.flow_rule_from_dict(
+        {"resource": "res", "count": 1000, "clusterMode": True,
+         "clusterConfig": {"flowId": fid,
+                           "thresholdType": THRESHOLD_GLOBAL}})])
+    servers, specs = [], []
+    for mid, owned in (("A", set(range(N)) - {sl}), ("B", {sl})):
+        svc = DefaultTokenService(rules=rules, max_allowed_qps=1e9)
+        svc.set_shard(ShardState(N, 1, {s: 1 for s in owned}))
+        srv = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+        servers.append(srv)
+        specs.append(ClusterServerSpec(mid, "127.0.0.1", srv.bound_port))
+    # Stale map: everything routed to A — the walk must hop to B.
+    smap = ShardMap(version=1, n_slices=N, servers=tuple(specs),
+                    slice_owner=("A",) * N, slice_epoch=(1,) * N,
+                    clients=("c",))
+    spans = SpanCollector(sample_every=1, capacity=32)
+    cli = ShardedTokenClient(smap, request_timeout_s=10.0,
+                             spans=spans).start()
+    try:
+        assert _wait(cli.is_connected)
+        assert cli.request_token(fid).status == TokenResultStatus.OK
+        walks = [s for s in spans.snapshot()["spans"]
+                 if s["name"] == "cluster.slice_walk"]
+        assert len(walks) == 1
+        attrs = walks[0]["attributes"]
+        assert attrs["outcome"] == "self-healed"
+        assert attrs["owner"] == "A" and attrs["servedBy"] == "B"
+        assert [h["event"] for h in attrs["hops"]] \
+            == ["wrong_slice", "served"]
+        assert [h["leader"] for h in attrs["hops"]] == ["A", "B"]
+        # Healed: the next acquire goes straight to B — no new span.
+        assert cli.request_token(fid).status == TokenResultStatus.OK
+        walks2 = [s for s in spans.snapshot()["spans"]
+                  if s["name"] == "cluster.slice_walk"]
+        assert len(walks2) == 1
+    finally:
+        cli.stop()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# simulator: journal determinism
+# ---------------------------------------------------------------------------
+
+
+def test_replay_journal_deterministic():
+    """Same trace + seed twice => IDENTICAL journal record streams,
+    stamped in simulated time (the clock seam carries the journal)."""
+    from sentinel_tpu.simulator.replay import ReplayEngine
+    from sentinel_tpu.simulator.scenarios import build_scenario
+
+    trace = build_scenario("flash_crowd", seconds=6, seed=11)
+    r1 = ReplayEngine(trace).run()
+    r2 = ReplayEngine(trace).run()
+    assert r1.verdict_sha256 == r2.verdict_sha256
+    assert r1.journal, "replay produced no journal records"
+    assert r1.journal == r2.journal
+    kinds = {r["kind"] for r in r1.journal}
+    assert "ruleLoad" in kinds
+    # Stamps are SIMULATED time (far from the wall clock by design).
+    sim_epoch = trace.epoch_ms
+    for rec in r1.journal:
+        assert abs(rec["timestamp"] - sim_epoch) < 3_600_000
+
+
+# ---------------------------------------------------------------------------
+# surfaces: exporter families + ops commands
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_renders_journal_and_fleet_families(eng):
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    eng.flow_rules.load_rules(_flow_rules(("rX", 5)))
+    text = render_engine_metrics(eng)
+    assert "sentinel_tpu_journal_last_seq" in text
+    assert "sentinel_tpu_journal_records_total" in text
+    assert "sentinel_tpu_fleet_leaders -1" in text  # no collector attached
+    assert "sentinel_tpu_fleet_polls_total 0" in text
+
+
+def test_journal_why_fleet_ops_commands(eng):
+    from sentinel_tpu.transport.command_center import CommandRequest
+    from sentinel_tpu.transport.handlers import (
+        cmd_fleet,
+        cmd_journal,
+        cmd_why,
+    )
+
+    def run(cmd, params, body=""):
+        resp = cmd(CommandRequest(parameters=params, body=body, engine=eng))
+        assert resp.success, resp.result
+        return json.loads(resp.result)
+
+    eng.flow_rules.load_rules(_flow_rules(("rC", 2)))
+    out = run(cmd_journal, {})
+    assert out["nextSeq"] >= 1
+    assert out["records"][-1]["kind"] == "ruleLoad"
+    assert run(cmd_journal, {"sinceSeq": str(out["nextSeq"])})["records"] \
+        == []
+    assert run(cmd_journal, {"op": "status"})["durable"] is False
+    chain = run(cmd_journal, {"op": "chain",
+                              "seq": str(out["nextSeq"])})["chain"]
+    assert chain[0]["seq"] == out["nextSeq"]
+    bad = cmd_journal(CommandRequest(parameters={"op": "nope"}, engine=eng))
+    assert not bad.success
+
+    _drive(eng, "rC", 4)
+    time_util.advance_time(1500)
+    why = run(cmd_why, {"resource": "rC"})
+    assert why["verdict"]["reason"] == "FLOW"
+    assert not cmd_why(CommandRequest(parameters={}, engine=eng)).success
+
+    assert run(cmd_fleet, {})["watching"] is False
+    # watch against a leader serving THIS engine (self-federation is a
+    # legitimate single-node deployment of the collector).
+    srv = ClusterTokenServer(engine=eng, host="127.0.0.1", port=0).start()
+    try:
+        out = run(cmd_fleet, {"op": "watch"}, body=json.dumps(
+            [{"name": "self", "host": "127.0.0.1",
+              "port": srv.bound_port}]))
+        assert out["watching"] == ["self"]
+        assert eng.fleet is not None
+        assert eng.fleet.wait_connected()
+        st = run(cmd_fleet, {})
+        assert st["leaderCount"] == 1
+        ser = run(cmd_fleet, {"op": "series"})
+        assert [s["timestamp"] for s in ser["seconds"]] \
+            == [s["timestamp"] for s in eng.timeseries_view()["seconds"]]
+        assert run(cmd_fleet, {"op": "stop"})["watching"] is False
+        assert eng.fleet is None
+    finally:
+        srv.stop()

@@ -220,6 +220,18 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_sim_replay_rate",
                  "sentinel_tpu_sim_policy_score"):
         assert name in seen, f"{name} not declared in the exporters"
+    # fleet observability families (ISSUE 14): declared exactly once
+    # (the dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_journal_last_seq",
+                 "sentinel_tpu_journal_records",
+                 "sentinel_tpu_journal_dropped_partial",
+                 "sentinel_tpu_journal_rotations",
+                 "sentinel_tpu_fleet_leaders",
+                 "sentinel_tpu_fleet_stale_leaders",
+                 "sentinel_tpu_fleet_health",
+                 "sentinel_tpu_fleet_skew_ms",
+                 "sentinel_tpu_fleet_polls"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -590,6 +602,89 @@ def test_reactor_path_zero_copy_and_coalesced_writes():
     assert not offenders, (
         "reactor wire path must stay zero-copy with coalesced "
         "non-blocking writes: " + ", ".join(offenders))
+
+
+def test_journal_fleet_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.journal.*`` / ``csp.sentinel.fleet.*``
+    config key must (a) be defined and read ONLY in core/config.py —
+    the rest of the package goes through the ``SentinelConfig``
+    accessors — and (b) appear in docs/OPERATIONS.md "Fleet
+    observability & forensics", so the runbook can never silently
+    drift from the knobs the code actually reads (same rule shape as
+    the cluster-HA / overload / SLO / sim gates)."""
+    import re
+
+    pattern = re.compile(
+        r"[\"']csp\.sentinel\.(?:journal|fleet)\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.journal.* / csp.sentinel.fleet.* literals outside "
+        "core/config.py (use the SentinelConfig journal_* / fleet_* "
+        "accessors): " + ", ".join(offenders))
+    assert keys, "no journal/fleet config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "journal/fleet config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_no_wall_clock_in_journal_and_fleet():
+    """The audit journal and the fleet collector must ride the ENGINE
+    timebase only (injected clock callables): an ambient wall-clock
+    read in either would (a) break the simulator's journal-determinism
+    contract — the same trace + seed must replay to an identical
+    record stream — and (b) let a collector's staleness/skew math mix
+    two clocks. Same rule (and skip logic) as the simulator gate;
+    ``time.perf_counter`` stays sanctioned for speed measurement."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    offenders = []
+    for name in ("journal.py", "fleet.py"):
+        path = REPO / "sentinel_tpu" / "telemetry" / name
+        for lineno, code in _code_lines(path):
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in journal/fleet code (ride the injected "
+        "engine clock): " + ", ".join(offenders))
+
+
+def test_journal_writes_append_only():
+    """The journal's crash-safety story is append-only JSONL: recovery
+    may terminate a torn line (an append) and rotation may RENAME the
+    live file aside, but nothing ever seeks, truncates, or reopens the
+    file in a write-from-scratch mode — a rewrite would turn 'crash
+    leaves every committed record intact' into a race."""
+    import re
+
+    patterns = [
+        (re.compile(r"\.seek\s*\("), "seek"),
+        (re.compile(r"\.truncate\s*\("), "truncate"),
+        (re.compile(r"open\s*\([^)]*[\"']w\+?b?[\"']"),
+         "write-mode open"),
+        (re.compile(r"open\s*\([^)]*[\"']r\+"), "read-write open"),
+    ]
+    path = REPO / "sentinel_tpu" / "telemetry" / "journal.py"
+    offenders = []
+    for lineno, code in _code_lines(path):
+        for pattern, what in patterns:
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno} ({what})")
+    assert not offenders, (
+        "journal file writes must stay append-only: " + ", ".join(offenders))
 
 
 @pytest.mark.skipif(shutil.which("ruff") is None,
