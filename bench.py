@@ -24,7 +24,7 @@ sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
 and the queue-wait vs device-wait split.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_13.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_14.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -640,6 +640,38 @@ def _fused_entry_throughput(rules_builder, batch_builder, capacity=4096,
     return iters * scan_steps * batch_n / (time.perf_counter() - t0)
 
 
+def bench_chaos_campaign() -> dict:
+    """Chaos campaign throughput + the ISSUE 15 acceptance gate: a
+    seeded campaign of >= 200 episodes over the FULL seam set (crash,
+    rebalance, link loss, conn drop/stall, half-open, stale-epoch
+    replay, torn checkpoint, journal disk-full, datasource flap, map
+    split, donor zombie, clock skew, overload) must complete with ZERO
+    invariant violations at HEAD. The committed record carries the
+    campaign's verdict/fault stream hashes, so any replay drift of any
+    episode is visible as a hash change — `chaos op=replay seed=14
+    episode=<k>` reproduces any single episode bit-identically."""
+    import os
+
+    from sentinel_tpu.chaos.campaign import ChaosCampaign
+
+    episodes = int(os.environ.get("BENCH_CHAOS_EPISODES", "200"))
+    report = ChaosCampaign(campaign_seed=14, episodes=episodes).run()
+    return {"chaos_campaign": {
+        "campaign_seed": 14,
+        "episodes": report["episodesRun"],
+        "seconds_per_episode": report["secondsPerEpisode"],
+        "ops": report["ops"],
+        "wire_grants": report["grants"],
+        "faults_fired": report["faultsFired"],
+        "violations": report["violations"],
+        "shrink_steps": report["shrinkSteps"],
+        "episodes_per_sec": report["episodesPerSec"],
+        "wall_s": report["wallSeconds"],
+        "verdict_sha256": report["verdictSha256"],
+        "fault_sha256": report["faultSha256"],
+    }}
+
+
 def bench_degrade_1k() -> dict:
     """BASELINE eval config #2: 1k resources ALL carrying circuit
     breakers (slow-ratio and exception-ratio mixed) — the breaker state
@@ -1099,7 +1131,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_13.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_14.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1357,6 +1389,8 @@ def main() -> None:
         out.update(bench_fleet_scrape())
         persist(out)
         out.update(bench_sim_replay())
+        persist(out)
+        out.update(bench_chaos_campaign())
         persist(out)
         # BASELINE per-config sections (eval configs #2/#3 + the shim
         # loopback transport): each is individually guarded so one

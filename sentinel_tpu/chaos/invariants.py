@@ -1,0 +1,240 @@
+"""The SEMANTICS.md safety bounds as executable invariant checkers.
+
+Every checker is a pure function over an episode's recorded
+:class:`History` — no live mesh access — so the same checkers run (a)
+continuously during a campaign episode, (b) after it, and (c) in unit
+tests against HAND-BUILT violating histories (a checker that cannot
+fire is decoration; tests/test_chaos_campaign.py proves each one can).
+
+The catalogue (docs/SEMANTICS.md "Invariant catalogue" maps each to
+its prose proof):
+
+* ``conservation`` — pass + block + shed + dropped == offered, per flow
+* ``no_stranded`` — every offered op gets exactly ONE terminal verdict
+  (no stranded tickets/replies after connection death)
+* ``shed_not_half_admitted`` — a leader that shed an op consumed
+  nothing for it (shed is pre-admission)
+* ``overadmission`` — per (flow, window): effective wire grants <=
+  threshold + the handoff margin (grants already standing in the
+  window at each ownership transfer) — the per-slice fencing bound
+* ``degraded_bound`` — per (flow, window): degraded grants <= the
+  per-client share (threshold / divisor)
+* ``epoch_monotone`` — the client fence never ACCEPTS an epoch below
+  one it already accepted for the same slice lane
+* ``journal_monotone`` — each seat's durable journal seq stream is
+  strictly increasing, including across crash/restart recovery
+
+Deliberate asymmetries (also in SEMANTICS.md): a verdict granted
+server-side whose reply is lost (half-open swallow, fence rejection)
+is recorded as ``grantVoid`` — quota was consumed but no request was
+admitted, so it counts toward NEITHER conservation's pass column NOR
+the over-admission bound (the PR 6 lost-reply double-count stance).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Dict, List, NamedTuple, Tuple
+
+
+class Violation(NamedTuple):
+    invariant: str
+    detail: str
+    flow: object = None
+    second: object = None
+
+    def to_dict(self) -> dict:
+        return {"invariant": self.invariant, "detail": self.detail,
+                "flow": self.flow, "second": self.second}
+
+
+class History:
+    """An episode's ordered event log. Events are plain dicts with an
+    ``e`` kind tag — hand-buildable in tests, hashable for replay
+    oracles, and cheap to scan."""
+
+    __slots__ = ("events",)
+
+    # Terminal verdict categories (the conservation columns).
+    TERMINAL = ("pass", "block", "shed", "dropped")
+
+    def __init__(self):
+        self.events: List[dict] = []
+
+    def add(self, e: str, **fields) -> dict:
+        fields["e"] = e
+        self.events.append(fields)
+        return fields
+
+    def of(self, kind: str) -> List[dict]:
+        return [ev for ev in self.events if ev["e"] == kind]
+
+
+def check_conservation(history: History, thresholds, divisor) \
+        -> List[Violation]:
+    offered = Counter(ev["flow"] for ev in history.of("offered"))
+    out: List[Violation] = []
+    verdicts = history.of("verdict")
+    by_flow: Dict[object, Counter] = defaultdict(Counter)
+    for ev in verdicts:
+        if ev["status"] not in History.TERMINAL:
+            out.append(Violation(
+                "conservation",
+                f"op {ev.get('op')} carries unknown terminal status "
+                f"{ev['status']!r}", flow=ev.get("flow"),
+                second=ev.get("sec")))
+            continue
+        by_flow[ev["flow"]][ev["status"]] += 1
+    for flow, n_offered in sorted(offered.items(), key=lambda kv: str(kv[0])):
+        got = sum(by_flow[flow].values())
+        if got != n_offered:
+            out.append(Violation(
+                "conservation",
+                f"flow {flow}: offered {n_offered} != "
+                f"pass+block+shed+dropped {got} ({dict(by_flow[flow])})",
+                flow=flow))
+    return out
+
+
+def check_no_stranded(history: History, thresholds, divisor) \
+        -> List[Violation]:
+    offered = [ev["op"] for ev in history.of("offered")]
+    verdict_ops = Counter(ev["op"] for ev in history.of("verdict"))
+    out: List[Violation] = []
+    for op in offered:
+        n = verdict_ops.get(op, 0)
+        if n == 0:
+            out.append(Violation(
+                "no_stranded", f"op {op} never received a terminal "
+                "verdict (stranded ticket/reply)"))
+        elif n > 1:
+            out.append(Violation(
+                "no_stranded", f"op {op} received {n} terminal verdicts"))
+    return out
+
+
+def check_shed_not_half_admitted(history: History, thresholds, divisor) \
+        -> List[Violation]:
+    granted_at = {(ev["op"], ev["leader"])
+                  for ev in history.events
+                  if ev["e"] in ("grant", "grantVoid")}
+    out: List[Violation] = []
+    for ev in history.of("shedBy"):
+        if (ev["op"], ev["leader"]) in granted_at:
+            out.append(Violation(
+                "shed_not_half_admitted",
+                f"leader {ev['leader']} shed op {ev['op']} AND consumed "
+                "quota for it (half-admitted shed)", flow=ev.get("flow")))
+    return out
+
+
+def check_overadmission(history: History,
+                        thresholds: Dict[int, Tuple[float, int]],
+                        divisor) -> List[Violation]:
+    """Per (flow, window): effective wire grants <= threshold + margin.
+
+    The margin is credited at each ownership TRANSFER of the flow's
+    slice: everything already granted in the transfer's window (and the
+    one before it — restored stale rows rotate across the boundary) may
+    be re-admitted by the recipient up to the grants-since-last-publish
+    bound, so the allowance grows by the standing count. This is a
+    deliberately LOOSE (sound) version of the SEMANTICS.md per-slice
+    fencing bound: correct code can never exceed it, and an unfenced
+    double-granting donor blows through it within one window."""
+    counts: Dict[tuple, int] = defaultdict(int)
+    margins: Dict[tuple, float] = defaultdict(float)
+    for ev in history.events:
+        if ev["e"] == "grant":
+            counts[(ev["flow"], ev["win"])] += 1
+        elif ev["e"] == "transfer":
+            flow, win = ev["flow"], ev["win"]
+            interval = max(1, int(thresholds.get(flow, (0, 1000))[1]))
+            standing = counts[(flow, win)] + counts[(flow, win - interval)]
+            for w in (win, win + interval):
+                margins[(flow, w)] += standing
+    out: List[Violation] = []
+    for (flow, win), n in sorted(counts.items(), key=str):
+        info = thresholds.get(flow)
+        if info is None:
+            continue
+        allowed = float(info[0]) + margins.get((flow, win), 0.0)
+        if n > allowed + 1e-9:
+            out.append(Violation(
+                "overadmission",
+                f"flow {flow} window {win}: {n} wire grants > "
+                f"threshold {info[0]} + margin "
+                f"{margins.get((flow, win), 0.0)}", flow=flow))
+    return out
+
+
+def check_degraded_bound(history: History,
+                         thresholds: Dict[int, Tuple[float, int]],
+                         divisor: int) -> List[Violation]:
+    counts: Dict[tuple, int] = defaultdict(int)
+    for ev in history.of("degradedGrant"):
+        counts[(ev["flow"], ev["win"])] += 1
+    out: List[Violation] = []
+    for (flow, win), n in sorted(counts.items(), key=str):
+        info = thresholds.get(flow)
+        if info is None:
+            continue
+        share = float(info[0]) / max(1, int(divisor))
+        if n > share + 1e-9:
+            out.append(Violation(
+                "degraded_bound",
+                f"flow {flow} window {win}: {n} degraded grants > "
+                f"per-client share {share} (threshold {info[0]} / "
+                f"divisor {divisor})", flow=flow))
+    return out
+
+
+def check_epoch_monotone(history: History, thresholds, divisor) \
+        -> List[Violation]:
+    hi: Dict[object, int] = {}
+    out: List[Violation] = []
+    for ev in history.of("fence"):
+        if not ev.get("accepted"):
+            continue
+        scope, epoch = ev.get("scope"), int(ev["epoch"])
+        if epoch < hi.get(scope, 0):
+            out.append(Violation(
+                "epoch_monotone",
+                f"slice {scope}: accepted epoch {epoch} below the "
+                f"lane's high-water mark {hi[scope]} (fence regression)"))
+        hi[scope] = max(hi.get(scope, 0), epoch)
+    return out
+
+
+def check_journal_monotone(history: History, thresholds, divisor) \
+        -> List[Violation]:
+    out: List[Violation] = []
+    for ev in history.of("journal"):
+        seqs = list(ev.get("seqs") or ())
+        for a, b in zip(seqs, seqs[1:]):
+            if b <= a:
+                out.append(Violation(
+                    "journal_monotone",
+                    f"seat {ev.get('leader')}: durable journal seq "
+                    f"{b} after {a} (non-monotone across "
+                    "crash/restart)"))
+                break
+    return out
+
+
+CHECKERS = (
+    ("conservation", check_conservation),
+    ("no_stranded", check_no_stranded),
+    ("shed_not_half_admitted", check_shed_not_half_admitted),
+    ("overadmission", check_overadmission),
+    ("degraded_bound", check_degraded_bound),
+    ("epoch_monotone", check_epoch_monotone),
+    ("journal_monotone", check_journal_monotone),
+)
+
+
+def check_all(history: History, thresholds: Dict[int, Tuple[float, int]],
+              divisor: int) -> List[Violation]:
+    out: List[Violation] = []
+    for _name, fn in CHECKERS:
+        out.extend(fn(history, thresholds, divisor))
+    return out

@@ -43,6 +43,18 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from sentinel_tpu.telemetry.journal import causing as journal_causing
 
+# Known-fixed-bug reintroduction flags (chaos shrinker proof-of-life —
+# ISSUE 15). Bound ONCE at import: the check sits on the degraded-mode
+# request path, and a deployment that strips the chaos tooling must
+# keep serving with the seam permanently off.
+try:
+    from sentinel_tpu.chaos.regressions import (
+        reintroduced as _chaos_reintroduced,
+    )
+except ImportError:  # chaos package absent: the fixed behavior, always
+    def _chaos_reintroduced(_name: str) -> bool:
+        return False
+
 from sentinel_tpu.cluster.state import (
     CLUSTER_CLIENT,
     CLUSTER_SERVER,
@@ -146,6 +158,14 @@ class DegradedQuota:
         if info is None:
             return None
         thr, interval_ms = float(info[0]), max(1, int(info[1]))
+        if _chaos_reintroduced("degraded-amnesty"):
+            # Known-fixed bug, deliberately reintroducible (chaos
+            # shrinker proof-of-life — ISSUE 15): the pre-share behavior
+            # granted every degraded verdict, voiding the sum-of-shares
+            # bound the chaos campaign's invariant checker enforces.
+            with self._lock:
+                self.granted_count += 1
+            return TokenResult(TokenResultStatus.OK)
         share = thr / self.divisor
         now = now_ms if now_ms is not None else time_util.current_time_millis()
         with self._lock:

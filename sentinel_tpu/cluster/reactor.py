@@ -56,6 +56,7 @@ from sentinel_tpu.cluster.server import (
     mutate_reply,
     process_control_frame,
 )
+from sentinel_tpu.resilience import faults
 _LISTEN_BACKLOG = 256  # the legacy frontend's reconnect-storm headroom
 
 # Estimated bytes per PROMISED reply (an unfilled slot): the backlog
@@ -298,6 +299,17 @@ class WireReactor:
         return conn.out_bytes + len(conn.replies) * _REPLY_EST_BYTES
 
     def _read(self, conn: _Conn) -> None:
+        # Chaos seams (resilience/faults.py — ISSUE 15): conn.stall in
+        # delay mode wedges this read (a saturated loop / stuck peer);
+        # conn.drop in error mode kills the connection mid-stream — the
+        # peer sees a clean drop and the close path must strand nothing
+        # (remote entries exited, reply slots discarded).
+        try:
+            faults.fire("cluster.reactor.conn.stall")
+            faults.fire("cluster.reactor.conn.drop")
+        except OSError:
+            self._close(conn)
+            return
         try:
             chunk = conn.sock.recv(self.read_chunk)
         except (BlockingIOError, InterruptedError):

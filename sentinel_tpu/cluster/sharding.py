@@ -173,8 +173,16 @@ class ShardedTokenClient:
                  reconnect_interval_s: Optional[float] = None,
                  connect_timeout_s: float = 1.0,
                  health_gate=_CONFIG_GATE,
-                 spans=None):
+                 spans=None, clock=None):
         from sentinel_tpu.cluster.ha import DegradedQuota
+
+        # Clock-injection seam (the SentinelEngine(clock=) discipline —
+        # ISSUE 15): every backoff / lost->degraded / failover-stamp
+        # read goes through _now(), so the chaos campaign drives the
+        # routing state machines on its program-advanced timebase with
+        # NO process-global clock freeze. None = the process clock.
+        self._now = (clock if clock is not None
+                     else time_util.current_time_millis)
 
         # Cross-leader span stitching (ISSUE 14): with a SpanCollector
         # attached, any walk that does more than hit the owner (a
@@ -311,7 +319,7 @@ class ShardedTokenClient:
             clients = list(self._pool.values())
         for c in clients:
             c.stop()
-        now = time_util.current_time_millis()
+        now = self._now()
         with self._lock:
             for h in self._health.values():  # close open degraded spells
                 if h.degraded_since_ms >= 0:
@@ -343,7 +351,7 @@ class ShardedTokenClient:
         with self._lock:
             if h.degraded_since_ms >= 0:
                 self.degraded_total_ms += max(
-                    0, time_util.current_time_millis() - h.degraded_since_ms)
+                    0, self._now() - h.degraded_since_ms)
             h.degraded_since_ms = -1
             h.lost_at_ms = -1
 
@@ -351,7 +359,7 @@ class ShardedTokenClient:
         h = self._health.get(mid)
         if h is None:
             return False
-        now = time_util.current_time_millis()
+        now = self._now()
         with self._lock:
             if h.degraded_since_ms >= 0:
                 return True
@@ -378,7 +386,7 @@ class ShardedTokenClient:
 
     def degraded_seconds(self) -> float:
         total = self.degraded_total_ms
-        now = time_util.current_time_millis()
+        now = self._now()
         for h in self._health.values():
             if h.degraded_since_ms >= 0:
                 total += max(0, now - h.degraded_since_ms)
@@ -390,7 +398,7 @@ class ShardedTokenClient:
         with self._lock:
             self.overloaded_count += 1
             self._backoff_until_ms[mid] = (
-                time_util.current_time_millis() + backoff)
+                self._now() + backoff)
 
     # -- requests ----------------------------------------------------------
 
@@ -422,7 +430,7 @@ class ShardedTokenClient:
         hops: Optional[list] = [] if self.spans is not None else None
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
-        now_ms = time_util.current_time_millis()
+        now_ms = self._now()
         overload_hint = backed_off = None
         owner_alive = False  # owner answered OVERLOADED / is in backoff
         for mid in self._walk_order(sl):
@@ -477,7 +485,7 @@ class ShardedTokenClient:
                         self._learned[sl] = mid
                         self.failover_count += 1
                         self.last_failover_ms = \
-                            time_util.current_time_millis()
+                            self._now()
                     self._record_walk(trace, fid, sl, owner, hops,
                                       "self-healed", served_by=mid)
                 else:
@@ -613,7 +621,7 @@ class ShardedTokenClient:
         """The ha_stats() merge shape (superset of the PR 5 failover
         client's) + the ``shard`` routing block the exporter and
         dashboard consume."""
-        now = time_util.current_time_millis()
+        now = self._now()
         leaders = {}
         for spec in self.map.servers:
             mid = spec.machine_id

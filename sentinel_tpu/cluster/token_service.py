@@ -238,6 +238,24 @@ def acquire_step(
 # Host service
 # ---------------------------------------------------------------------------
 
+# One process-wide jit wrapper for the acquire step: every service shares
+# its compile cache, so the Nth DefaultTokenService of a process (an HA
+# re-promotion, a chaos-campaign episode's fresh mesh) pays ZERO XLA
+# compiles for shapes any earlier service already ran. Per-instance
+# wrappers each kept a private cache and re-traced identical shapes —
+# measurably the dominant cost of building a fresh in-process mesh.
+# Donation stays per-call (each service donates ITS OWN state buffer).
+_acquire_jit_shared = None
+
+
+def _shared_acquire_jit():
+    global _acquire_jit_shared
+    if _acquire_jit_shared is None:
+        _acquire_jit_shared = jax.jit(
+            acquire_step, static_argnames=("max_occupy_ratio",),
+            donate_argnums=(0,))
+    return _acquire_jit_shared
+
 
 class DefaultTokenService:
     """The server-side token service over the jitted acquire step."""
@@ -267,9 +285,7 @@ class DefaultTokenService:
         self._state: Optional[ClusterMetricState] = None
         self._slot_of: Dict[int, int] = {}
         self._ns_of: Dict[int, str] = {}
-        self._acquire_jit = jax.jit(
-            acquire_step, static_argnames=("max_occupy_ratio",),
-            donate_argnums=(0,))
+        self._acquire_jit = _shared_acquire_jit()
         # Param-flow cluster buckets: (flowId, param_hash) -> (window_start, used)
         self._param_buckets: Dict[Tuple[int, int], Tuple[int, float]] = {}
         # Server-side spans (telemetry/spans.py): every TRACED request

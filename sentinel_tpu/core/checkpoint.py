@@ -79,6 +79,8 @@ def _atomic_savez(path: str, header: dict, arrays: dict) -> None:
     at ``path`` — rename alone only orders the metadata, not the data
     blocks, and a restore-after-power-cut of a non-fsync'd file is
     exactly the truncated-file failure restore must never see."""
+    from sentinel_tpu.resilience import faults
+
     target_dir = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=target_dir, suffix=".ckpt.tmp")
     try:
@@ -87,6 +89,16 @@ def _atomic_savez(path: str, header: dict, arrays: dict) -> None:
                 json.dumps(header).encode("utf-8"), dtype=np.uint8), **arrays)
             f.flush()
             os.fsync(f.fileno())
+        # Torn-write seam (resilience/faults.py "checkpoint.torn.write"
+        # — ISSUE 15): error mode raises HERE, before the rename — the
+        # crash-before-publish case (the previous file survives intact);
+        # garbage mode TEARS the fully-fsync'd temp file to half its
+        # bytes and lets the rename publish the wreck — the power-cut-
+        # mid-data-blocks case restore must reject as ONE ValueError.
+        if faults.mutate("checkpoint.torn.write", b"\x01") != b"\x01":
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as tf:
+                tf.truncate(max(1, size // 2))
         os.replace(tmp, path)
         try:
             dfd = os.open(target_dir, os.O_RDONLY)

@@ -163,6 +163,21 @@ WIRE_RLS_BATCHED = "csp.sentinel.wire.rls.batched"
 SIM_EPOCH_MS = "csp.sentinel.sim.epoch.ms"
 SIM_MAX_BATCH = "csp.sentinel.sim.max.batch"
 SIM_DRILL_MAX_SECONDS = "csp.sentinel.sim.drill.max.seconds"
+# Chaos campaign engine (sentinel_tpu/chaos/ — no reference twin: the
+# reference has no fault-schedule search story). Every key MUST be read
+# through the accessors below and documented in docs/OPERATIONS.md
+# "Chaos campaign" (pinned by test_lint).
+# epoch.ms: the campaign timebase origin — like the simulator's,
+# deliberately far from any plausible wall clock (TWO days past 0, so
+# chaos and sim stamps are also distinguishable from each other);
+# episodes: default campaign length; seconds.per.episode: driven
+# seconds per episode; max.faults: schedule-size cap per episode;
+# max.episodes: bound on the synchronous `chaos op=run` ops command.
+CHAOS_EPOCH_MS = "csp.sentinel.chaos.epoch.ms"
+CHAOS_EPISODES = "csp.sentinel.chaos.episodes"
+CHAOS_SECONDS_PER_EPISODE = "csp.sentinel.chaos.seconds.per.episode"
+CHAOS_MAX_FAULTS = "csp.sentinel.chaos.max.faults"
+CHAOS_MAX_EPISODES = "csp.sentinel.chaos.max.episodes"
 # Control-plane audit journal (telemetry/journal.py — no reference
 # twin: the reference's rule pushes leave no durable record). Every key
 # MUST be read through the accessors below and documented in
@@ -275,6 +290,16 @@ DEFAULT_WIRE_WORKERS = 4
 DEFAULT_SIM_EPOCH_MS = 86_400_000
 DEFAULT_SIM_MAX_BATCH = 512
 DEFAULT_SIM_DRILL_MAX_SECONDS = 300
+# Chaos defaults. Two days past epoch 0 keeps campaign stamps far from
+# the wall clock AND from the simulator's one-day origin; 25 episodes
+# is the ops-command default (the bench phase runs 200); 12 driven
+# seconds covers crash -> degraded -> rebalance -> recovery inside one
+# episode; 6 faults bounds schedule size (ddmin cost is schedule-bound).
+DEFAULT_CHAOS_EPOCH_MS = 172_800_000
+DEFAULT_CHAOS_EPISODES = 25
+DEFAULT_CHAOS_SECONDS_PER_EPISODE = 12
+DEFAULT_CHAOS_MAX_FAULTS = 6
+DEFAULT_CHAOS_MAX_EPISODES = 50
 # SLO defaults. alpha=0.2 ≈ a ~5-second effective memory on the EWMA
 # baseline mean (fast enough to track diurnal drift, slow enough that a
 # one-second spike cannot hide itself); z>=4 on a per-second signal
@@ -600,6 +625,31 @@ class SentinelConfig:
         v = self.get_int(SIM_DRILL_MAX_SECONDS,
                          DEFAULT_SIM_DRILL_MAX_SECONDS)
         return v if v > 0 else DEFAULT_SIM_DRILL_MAX_SECONDS
+
+    # Chaos-campaign accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.chaos.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def chaos_epoch_ms(self) -> int:
+        v = self.get_int(CHAOS_EPOCH_MS, DEFAULT_CHAOS_EPOCH_MS)
+        return v if v > 0 else DEFAULT_CHAOS_EPOCH_MS
+
+    def chaos_episodes(self) -> int:
+        v = self.get_int(CHAOS_EPISODES, DEFAULT_CHAOS_EPISODES)
+        return v if v > 0 else DEFAULT_CHAOS_EPISODES
+
+    def chaos_seconds_per_episode(self) -> int:
+        v = self.get_int(CHAOS_SECONDS_PER_EPISODE,
+                         DEFAULT_CHAOS_SECONDS_PER_EPISODE)
+        return v if v > 0 else DEFAULT_CHAOS_SECONDS_PER_EPISODE
+
+    def chaos_max_faults(self) -> int:
+        v = self.get_int(CHAOS_MAX_FAULTS, DEFAULT_CHAOS_MAX_FAULTS)
+        return v if v > 0 else DEFAULT_CHAOS_MAX_FAULTS
+
+    def chaos_max_episodes(self) -> int:
+        v = self.get_int(CHAOS_MAX_EPISODES, DEFAULT_CHAOS_MAX_EPISODES)
+        return v if v > 0 else DEFAULT_CHAOS_MAX_EPISODES
 
     # SLO / alerting accessors (the ONLY sanctioned readers of the
     # csp.sentinel.slo.* and csp.sentinel.alert.* keys — test_lint

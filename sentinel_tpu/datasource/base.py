@@ -168,6 +168,12 @@ class AutoRefreshDataSource(AbstractDataSource[S, T]):
         (False = skipped as unmodified)."""
         if not force and not self.is_modified():
             return False
+        # Flap seam (resilience/faults.py "datasource.flap" — ISSUE 15):
+        # the SOURCE is healthy but the path to it flapped this cycle —
+        # the poll fails transiently and catches up on a later cadence
+        # tick (distinct from datasource.read, which models the read
+        # itself failing inside the connector).
+        faults.fire("datasource.flap")
         value = self.load_config()
         if value is not None:
             with self._acting():

@@ -11,8 +11,11 @@ Named fault points live at the repo's remote seams:
 A :class:`FaultInjector` arms specs per point — ``error`` (raise),
 ``delay`` (sleep), ``garbage`` (replace bytes) — triggered by a schedule
 (``after`` N calls, at most ``times`` fires) and/or a seeded
-probability. All randomness comes from one ``random.Random(seed)``, and
-un-armed points never consume from it, so a chaos run replays exactly.
+probability. Each armed point draws from its OWN ``random.Random``
+stream derived deterministically from ``(seed, point)`` — arming a new
+point mid-run (a chaos campaign composing schedules episode by episode)
+can therefore never shift the draw sequence of already-armed points,
+and un-armed points consume nothing, so a chaos run replays exactly.
 
 Zero overhead when disabled: the module-level ``fire``/``mutate`` hooks
 test one global against ``None`` and return. Production never installs
@@ -67,6 +70,36 @@ FAULT_POINTS = (
     "cluster.shard.handoff.stall",
     "cluster.shard.map.split",
     "cluster.shard.donor.zombie",
+    # Chaos-campaign seams (ISSUE 15 — sentinel_tpu/chaos/):
+    # * cluster.reactor.conn.drop — fired per connection read in the
+    #   wire reactor (cluster/reactor.py) and per loopback request in
+    #   the chaos mesh; an armed error kills that connection mid-stream
+    #   (the peer sees a clean drop, never a half-written frame).
+    # * cluster.reactor.conn.stall — same call sites; delay mode stalls
+    #   the read (a wedged peer / saturated loop), error mode makes the
+    #   loopback mesh record a verdict-free timeout.
+    # * checkpoint.torn.write — mutate seam inside the atomic
+    #   checkpoint writer (core/checkpoint.py): garbage mode TEARS the
+    #   temp file before the rename publishes it (a power cut midway
+    #   through the data blocks), error mode aborts before the rename
+    #   (crash-before-publish; the previous file survives).
+    # * journal.disk.full — fired before every durable journal append
+    #   (telemetry/journal.py); an armed error is the disk-full/EIO
+    #   path: the journal degrades to its in-memory tail, loudly.
+    # * datasource.flap — fired per auto-refresh poll cycle
+    #   (datasource/base.py) and per mesh map push (chaos/mesh.py); an
+    #   armed error makes that consumer miss the push and catch up on
+    #   a later cycle (distinct from datasource.read: the source is
+    #   healthy, the path to it flapped).
+    # * cluster.leader.clock.skew — fired by the chaos mesh when a
+    #   scheduled per-leader clock skew is applied; an armed error
+    #   vetoes the skew (the observability hook for skew drills).
+    "cluster.reactor.conn.drop",
+    "cluster.reactor.conn.stall",
+    "checkpoint.torn.write",
+    "journal.disk.full",
+    "datasource.flap",
+    "cluster.leader.clock.skew",
 )
 
 
@@ -90,6 +123,7 @@ class FaultSpec:
     garbage: Optional[bytes] = None  # garbage mode payload (None = random)
     calls: int = 0
     fires: int = 0
+    rng: object = None              # per-point stream, set by arm()
 
     def __post_init__(self):
         if self.mode not in ("error", "delay", "garbage"):
@@ -99,13 +133,37 @@ class FaultSpec:
 
 
 class FaultInjector:
-    def __init__(self, seed: int = 0):
-        import random
-
+    def __init__(self, seed: int = 0, scope_thread: bool = False):
         self.seed = seed
-        self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._specs: Dict[str, FaultSpec] = {}
+        # ``scope_thread=True`` arms the injector for the CONSTRUCTING
+        # thread only: every other thread's fire()/mutate() is a no-op
+        # that consumes nothing (no spec call/fire budget, no RNG draw).
+        # The chaos campaign (ISSUE 15) installs with this set — its
+        # whole fault surface fires on the single driver thread — so a
+        # campaign run inside a live process can neither inject faults
+        # into the host engine's own threads nor have them consume the
+        # schedule's budget (which would break bit-identical replay).
+        self._thread = threading.current_thread() if scope_thread else None
+
+    def _foreign_thread(self) -> bool:
+        return (self._thread is not None
+                and threading.current_thread() is not self._thread)
+
+    def _point_rng(self, point: str):
+        """The point's own deterministic stream: seeded from
+        ``(injector seed, point name)`` via a stable digest (no
+        ``hash()`` — process-stable), so each point's draws are a pure
+        function of the seed and ITS OWN call sequence. Arming a new
+        point mid-run can never shift another point's sequence — the
+        replay-stability contract chaos campaigns lean on (pinned by
+        tests/test_chaos.py)."""
+        import hashlib
+        import random
+
+        digest = hashlib.sha256(point.encode("utf-8")).digest()
+        return random.Random(self.seed ^ int.from_bytes(digest[:8], "big"))
 
     # -- configuration ----------------------------------------------------
 
@@ -118,7 +176,7 @@ class FaultInjector:
                 f"unknown fault point {point!r}; known: {FAULT_POINTS}")
         spec = FaultSpec(mode=mode, probability=probability, after=after,
                          times=times, delay_ms=delay_ms, error=error,
-                         garbage=garbage)
+                         garbage=garbage, rng=self._point_rng(point))
         with self._lock:
             self._specs[point] = spec
         return spec
@@ -144,12 +202,14 @@ class FaultInjector:
             return False
         if spec.times is not None and spec.fires >= spec.times:
             return False
-        if spec.probability < 1.0 and self._rng.random() >= spec.probability:
+        if spec.probability < 1.0 and spec.rng.random() >= spec.probability:
             return False
         spec.fires += 1
         return True
 
     def _fire(self, point: str) -> None:
+        if self._foreign_thread():
+            return
         with self._lock:
             spec = self._specs.get(point)
             if spec is None or not self._should_fire(spec):
@@ -163,6 +223,8 @@ class FaultInjector:
         # bytes to corrupt.
 
     def _mutate(self, point: str, data: bytes) -> bytes:
+        if self._foreign_thread():
+            return data
         with self._lock:
             spec = self._specs.get(point)
             if spec is None or not self._should_fire(spec):
@@ -172,7 +234,7 @@ class FaultInjector:
                 if spec.garbage is not None:
                     return spec.garbage
                 n = max(8, len(data))
-                return bytes(self._rng.randrange(256) for _ in range(n))
+                return bytes(spec.rng.randrange(256) for _ in range(n))
         if mode == "delay":
             time.sleep(delay_ms / 1000.0)
             return data

@@ -537,6 +537,34 @@ def render_engine_metrics(engine) -> str:
             b.sample("sentinel_tpu_sim_policy_score",
                      {"scenario": scen, "policy": pol}, run["score"])
 
+    # -- chaos campaign engine (sentinel_tpu/chaos/) -----------------------
+    # Process-wide like the simulator's: campaigns run on their own
+    # throwaway meshes; the counters land here for scrapers and CI. A
+    # deployment that strips the chaos tooling (the mode cluster/ha.py's
+    # regression guard supports) reports zeroed families, never a dead
+    # /metrics surface.
+    try:
+        from sentinel_tpu.chaos import counters as chaos_counters
+
+        chc = chaos_counters()
+    except ImportError:
+        chc = {"episodes": 0, "violations": 0, "faultsFired": 0,
+               "shrinkSteps": 0}
+    b.counter("sentinel_tpu_chaos_episodes",
+              "Chaos-campaign episodes completed in this process",
+              chc["episodes"])
+    b.counter("sentinel_tpu_chaos_violations",
+              "Invariant violations detected by chaos campaigns "
+              "(any growth is a finding, not noise)",
+              chc["violations"])
+    b.counter("sentinel_tpu_chaos_faults_fired",
+              "Faults fired / chaos actions executed across campaigns",
+              chc["faultsFired"])
+    b.counter("sentinel_tpu_chaos_shrink_steps",
+              "Delta-debugging re-runs spent minimizing violating "
+              "fault schedules",
+              chc["shrinkSteps"])
+
     # -- control-plane audit journal (telemetry/journal.py) ---------------
     jstats = engine.journal.stats()
     b.family("sentinel_tpu_journal_last_seq", "gauge",

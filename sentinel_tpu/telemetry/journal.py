@@ -260,6 +260,14 @@ class ControlPlaneJournal:
             self.appended += 1
             if self._file is not None:
                 try:
+                    # Disk-full seam (resilience/faults.py
+                    # "journal.disk.full" — ISSUE 15): an armed error is
+                    # an OSError, taken by the same degrade path a real
+                    # ENOSPC/EIO takes — durability drops, the in-memory
+                    # tail keeps recording, loudly.
+                    from sentinel_tpu.resilience import faults
+
+                    faults.fire("journal.disk.full")
                     line = json.dumps(rec, sort_keys=True,
                                       separators=(",", ":"), default=str)
                     self._file.write(line + "\n")

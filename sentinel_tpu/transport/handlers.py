@@ -637,6 +637,98 @@ def cmd_sim(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(str(ex))
 
 
+@command_mapping("chaos", "deterministic chaos campaigns: run/replay "
+                          "seeded episodes, shrink violations")
+def cmd_chaos(req: CommandRequest) -> CommandResponse:
+    """The chaos campaign engine (sentinel_tpu/chaos/ — no reference
+    twin). ``op`` selects the action:
+
+      * ``status`` (default) — process-wide counters (episodes,
+        violations, faults fired, shrink steps) + the last campaign
+        report's summary
+      * ``run`` — run a campaign NOW: ``seed=`` (+ ``episodes=``,
+        ``seconds=``). Synchronous and CPU-bound, bounded by
+        ``csp.sentinel.chaos.max.episodes``; the full 200-episode
+        acceptance campaign belongs in the bench (`chaos_campaign`
+        phase).
+      * ``replay`` — re-run ONE episode from ``seed=`` + ``episode=``
+        and return its verdict/fault hashes (bit-identical for the
+        same coordinates — the seed-replay contract). Schedules are a
+        function of the campaign's ``seconds`` too: pass the same
+        ``seconds=`` the original campaign ran with (default: the
+        config default the `run` op uses).
+      * ``shrink`` — replay ``seed=``/``episode=``(/``seconds=``) and,
+        if it violates, ddmin the schedule to a minimal repro bundle
+    """
+    from sentinel_tpu import chaos as chaos_pkg
+    from sentinel_tpu.chaos.campaign import ChaosCampaign
+
+    op = req.get_param("op", "status")
+    try:
+        if op == "status":
+            report = chaos_pkg.last_report()
+            summary = None
+            if report is not None:
+                summary = {k: report[k] for k in
+                           ("campaignSeed", "episodesRun", "violations",
+                            "shrinkSteps", "episodesPerSec",
+                            "verdictSha256")}
+                summary["bundles"] = len(report["bundles"])
+            return CommandResponse.of_success(
+                {"counters": chaos_pkg.counters(),
+                 "lastCampaign": summary})
+        if op == "run":
+            cap = config.chaos_max_episodes()
+            episodes = int(req.get_param("episodes",
+                                         str(config.chaos_episodes())))
+            if episodes > cap:
+                return CommandResponse.of_failure(
+                    f"episodes={episodes} exceeds the command cap {cap} "
+                    "(csp.sentinel.chaos.max.episodes); run long "
+                    "campaigns through bench.py's chaos_campaign phase")
+            seconds = req.get_param("seconds")
+            if seconds is not None and not 1 <= int(seconds) <= 60:
+                return CommandResponse.of_failure(
+                    f"seconds={seconds} outside [1, 60] — the synchronous "
+                    "command runs bounded episodes; size long campaigns "
+                    "through the library or the bench phase")
+            campaign = ChaosCampaign(
+                campaign_seed=int(req.get_param("seed", "0")),
+                episodes=episodes,
+                seconds=int(seconds) if seconds is not None else None)
+            report = campaign.run()
+            out = dict(report)
+            out["bundles"] = len(report["bundles"])
+            out.pop("firstEpisode", None)
+            return CommandResponse.of_success(out)
+        if op in ("replay", "shrink"):
+            episode = req.get_param("episode")
+            if episode is None:
+                return CommandResponse.of_failure(
+                    "missing parameter: episode")
+            seconds = req.get_param("seconds")
+            if seconds is not None and not 1 <= int(seconds) <= 60:
+                return CommandResponse.of_failure(
+                    f"seconds={seconds} outside [1, 60]")
+            campaign = ChaosCampaign(
+                campaign_seed=int(req.get_param("seed", "0")),
+                seconds=int(seconds) if seconds is not None else None)
+            result = campaign.run_episode(int(episode))
+            if op == "replay" or not result.violations:
+                return CommandResponse.of_success(result.to_dict())
+            bundle, _runs = campaign.shrink_and_bundle(int(episode),
+                                                       result=result)
+            return CommandResponse.of_success(bundle)
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except RuntimeError as ex:
+        # Overlapping campaigns: the process-wide injector slot is
+        # already taken (another run/replay in flight) — a clean
+        # failure reply, not a handler-thread traceback.
+        return CommandResponse.of_failure(str(ex))
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("journal", "control-plane audit journal: seq-cursored "
                             "record tail + causality walks")
 def cmd_journal(req: CommandRequest) -> CommandResponse:

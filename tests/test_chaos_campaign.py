@@ -1,0 +1,393 @@
+"""Deterministic chaos campaign engine (ISSUE 15 tentpole).
+
+Tier-1 keeps: one seeded replay-determinism oracle, a small
+zero-violation campaign at HEAD, every invariant checker FIRING against
+a hand-built violating history (a checker that cannot fail is
+decoration), shrinker determinism, the shrinker proof-of-life
+(known-fixed bug reintroduced -> caught -> shrunk to <= 3 faults), and
+the new seam pins (torn checkpoint write, journal disk-full, datasource
+flap). Full multi-episode campaigns are ``slow``-marked per the 870s
+tier-1 discipline — the 200-episode acceptance campaign is committed as
+BENCH_14.json's ``chaos_campaign`` phase.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from sentinel_tpu.chaos import counters
+from sentinel_tpu.chaos.campaign import ChaosCampaign
+from sentinel_tpu.chaos.invariants import (
+    CHECKERS,
+    History,
+    check_all,
+    check_conservation,
+    check_degraded_bound,
+    check_epoch_monotone,
+    check_journal_monotone,
+    check_no_stranded,
+    check_overadmission,
+    check_shed_not_half_admitted,
+)
+from sentinel_tpu.chaos.regressions import KNOWN, reintroduce, reintroduced
+from sentinel_tpu.chaos.scheduler import FaultScheduler, episode_seed
+from sentinel_tpu.chaos.shrink import ddmin
+
+pytestmark = pytest.mark.chaos
+
+THRESHOLDS = {9000: (6.0, 1000), 9001: (6.0, 1000)}
+DIVISOR = 2
+
+
+# -- invariant checkers: every one must FIRE on a violating history ----------
+
+
+def _clean_history() -> History:
+    h = History()
+    h.add("offered", op=0, flow=9000, sec=0)
+    h.add("grant", op=0, flow=9000, leader="A", win=0)
+    h.add("fence", scope=0, epoch=1, accepted=True)
+    h.add("verdict", op=0, flow=9000, status="pass", by="A", sec=0)
+    h.add("journal", leader="A", seqs=[1, 2, 3])
+    return h
+
+
+def test_clean_history_passes_every_checker():
+    assert check_all(_clean_history(), THRESHOLDS, DIVISOR) == []
+
+
+def test_conservation_checker_fires():
+    h = History()
+    h.add("offered", op=0, flow=9000, sec=0)
+    h.add("offered", op=1, flow=9000, sec=0)
+    h.add("verdict", op=0, flow=9000, status="pass", by="A", sec=0)
+    # op 1 vanished: offered 2 != terminal 1
+    vs = check_conservation(h, THRESHOLDS, DIVISOR)
+    assert vs and vs[0].invariant == "conservation"
+    # unknown terminal category is a violation too, never a silent bucket
+    h2 = History()
+    h2.add("offered", op=0, flow=9000, sec=0)
+    h2.add("verdict", op=0, flow=9000, status="granted??", by="A", sec=0)
+    assert check_conservation(h2, THRESHOLDS, DIVISOR)
+
+
+def test_no_stranded_checker_fires_on_missing_and_double():
+    h = History()
+    h.add("offered", op=0, flow=9000, sec=0)
+    assert check_no_stranded(h, THRESHOLDS, DIVISOR)  # stranded
+    h.add("verdict", op=0, flow=9000, status="pass", by="A", sec=0)
+    assert check_no_stranded(h, THRESHOLDS, DIVISOR) == []
+    h.add("verdict", op=0, flow=9000, status="dropped", by=None, sec=0)
+    vs = check_no_stranded(h, THRESHOLDS, DIVISOR)   # double verdict
+    assert vs and "2 terminal" in vs[0].detail
+
+
+def test_shed_half_admitted_checker_fires():
+    h = History()
+    h.add("offered", op=0, flow=9000, sec=0)
+    h.add("grant", op=0, flow=9000, leader="A", win=0)
+    h.add("shedBy", op=0, flow=9000, leader="A")  # shed AND consumed
+    h.add("verdict", op=0, flow=9000, status="shed", by="A", sec=0)
+    vs = check_shed_not_half_admitted(h, THRESHOLDS, DIVISOR)
+    assert vs and vs[0].invariant == "shed_not_half_admitted"
+    # a DIFFERENT leader consuming for the op is not the shedder's sin
+    h2 = History()
+    h2.add("grant", op=0, flow=9000, leader="B", win=0)
+    h2.add("shedBy", op=0, flow=9000, leader="A")
+    assert check_shed_not_half_admitted(h2, THRESHOLDS, DIVISOR) == []
+
+
+def test_overadmission_checker_fires_and_respects_margin():
+    h = History()
+    for i in range(7):  # threshold 6: the 7th grant in one window fires
+        h.add("grant", op=i, flow=9000, leader="A", win=1000)
+    vs = check_overadmission(h, THRESHOLDS, DIVISOR)
+    assert vs and vs[0].invariant == "overadmission"
+    # a handoff credits the standing grants as margin: same counts pass
+    h2 = History()
+    for i in range(4):
+        h2.add("grant", op=i, flow=9000, leader="A", win=1000)
+    h2.add("transfer", flow=9000, slice=6, frm="A", to="B", win=1000)
+    for i in range(4, 10):
+        h2.add("grant", op=i, flow=9000, leader="B", win=1000)
+    assert check_overadmission(h2, THRESHOLDS, DIVISOR) == []
+    # ...but the margin is bounded: exceed threshold + standing and it fires
+    h2.add("grant", op=10, flow=9000, leader="B", win=1000)
+    assert check_overadmission(h2, THRESHOLDS, DIVISOR)
+
+
+def test_degraded_bound_checker_fires():
+    h = History()
+    for i in range(3):  # share = 6 / divisor 2 = 3: the 4th fires
+        h.add("degradedGrant", op=i, flow=9000, win=0)
+    assert check_degraded_bound(h, THRESHOLDS, DIVISOR) == []
+    h.add("degradedGrant", op=3, flow=9000, win=0)
+    vs = check_degraded_bound(h, THRESHOLDS, DIVISOR)
+    assert vs and vs[0].invariant == "degraded_bound"
+
+
+def test_epoch_monotone_checker_fires():
+    h = History()
+    h.add("fence", scope=4, epoch=3, accepted=True)
+    h.add("fence", scope=4, epoch=2, accepted=False)  # rejected: fine
+    assert check_epoch_monotone(h, THRESHOLDS, DIVISOR) == []
+    h.add("fence", scope=4, epoch=2, accepted=True)   # ACCEPTED lower
+    vs = check_epoch_monotone(h, THRESHOLDS, DIVISOR)
+    assert vs and vs[0].invariant == "epoch_monotone"
+
+
+def test_journal_monotone_checker_fires():
+    h = History()
+    h.add("journal", leader="A", seqs=[1, 2, 5, 9])
+    assert check_journal_monotone(h, THRESHOLDS, DIVISOR) == []
+    h.add("journal", leader="B", seqs=[1, 2, 2])  # seq reuse after restart
+    vs = check_journal_monotone(h, THRESHOLDS, DIVISOR)
+    assert vs and vs[0].invariant == "journal_monotone"
+
+
+def test_checker_registry_is_complete():
+    assert len(CHECKERS) == 7
+    assert {name for name, _ in CHECKERS} == {
+        "conservation", "no_stranded", "shed_not_half_admitted",
+        "overadmission", "degraded_bound", "epoch_monotone",
+        "journal_monotone"}
+
+
+# -- scheduler: pure function of (campaign_seed, episode_index) --------------
+
+
+def test_schedule_is_pure_and_seed_sensitive():
+    s = FaultScheduler(seconds=12, max_faults=6)
+    a = s.schedule(14, 3)
+    assert a == s.schedule(14, 3)           # pure
+    assert a != s.schedule(14, 4) or a != s.schedule(15, 3)  # sensitive
+    assert episode_seed(14, 3) == episode_seed(14, 3)
+    assert episode_seed(14, 3) != episode_seed(14, 4)
+    for act in a:
+        assert 1 <= act["at"] < 12
+        assert act["kind"] in (
+            "conn.drop", "conn.stall", "halfopen", "stale.epoch",
+            "link.down", "crash", "rebalance", "publish", "torn.publish",
+            "ckpt.crash", "journal.full", "journal.restart", "flap",
+            "map.split", "zombie", "router.stale", "skew", "overload")
+
+
+def test_schedule_empty_for_one_second_episodes():
+    """A 1-second episode drives only sec 0; schedules fire from sec 1 —
+    the scheduler must return an honestly EMPTY schedule, never actions
+    the episode loop silently skips (false fault coverage)."""
+    assert FaultScheduler(seconds=1).schedule(14, 3) == []
+    assert FaultScheduler(seconds=2).schedule(14, 3) != []
+
+
+def test_initial_assignment_handles_colliding_flow_slices():
+    """Two flows hashing into the same slice must place it exactly once
+    (every slice one owner), or the scheduler plan and the mesh map
+    diverge on the first rebalance."""
+    from sentinel_tpu.chaos.mesh import initial_assignment
+    from sentinel_tpu.cluster.sharding import slice_of
+
+    flows = {9000: 6.0, 9002: 6.0}           # both hash to slice 6 (N=8)
+    assert slice_of(9000, 8) == slice_of(9002, 8)
+    assign = initial_assignment(("A", "B", "C"), flows, 8)
+    owners = [m for m, sls in assign.items() for s in sls
+              if s == slice_of(9000, 8)]
+    assert owners == ["A"]                   # placed once, first leader
+    all_slices = sorted(s for sls in assign.values() for s in sls)
+    assert all_slices == list(range(8))      # total, no double ownership
+
+
+# -- shrinker: deterministic ddmin -------------------------------------------
+
+
+def test_ddmin_minimizes_deterministically():
+    items = list(range(12))
+
+    def failing(subset):
+        # violation iff BOTH 3 and 7 present (a 2-fault interaction)
+        return 3 in subset and 7 in subset
+
+    minimal, runs = ddmin(failing, items)
+    assert sorted(minimal) == [3, 7]
+    again, runs2 = ddmin(failing, items)
+    assert again == minimal and runs2 == runs  # bit-deterministic
+    single, _ = ddmin(lambda s: 5 in s, items)
+    assert single == [5]
+
+
+# -- the real mesh: replay + zero violations at HEAD -------------------------
+
+
+def test_episode_replays_bit_identically():
+    """Acceptance: re-running any single episode from
+    ``(campaign_seed, episode_index)`` reproduces its fault firing
+    sequence and verdict-stream hash bit-identically."""
+    c = ChaosCampaign(campaign_seed=7, episodes=1, seconds=8, per_second=3)
+    a = c.run_episode(0)
+    b = c.run_episode(0)
+    assert a.verdict_sha256 == b.verdict_sha256
+    assert a.fault_sha256 == b.fault_sha256
+    assert a.schedule == b.schedule
+    assert a.violations == [] and b.violations == []
+    assert a.ops == 8 * 3 * 3 and a.ops == b.ops
+    assert a.grants == b.grants > 0
+
+
+def test_small_campaign_zero_violations_at_head():
+    before = counters()
+    report = ChaosCampaign(campaign_seed=14, episodes=3, seconds=8,
+                           per_second=3).run()
+    assert report["episodesRun"] == 3
+    assert report["violations"] == 0 and report["bundles"] == []
+    assert report["ops"] == 3 * 8 * 3 * 3
+    after = counters()
+    assert after["episodes"] - before["episodes"] == 3
+    assert after["faultsFired"] > before["faultsFired"]
+
+
+@pytest.mark.slow
+def test_medium_campaign_zero_violations_at_head():
+    report = ChaosCampaign(campaign_seed=14, episodes=25).run()
+    assert report["episodesRun"] == 25
+    assert report["violations"] == 0
+
+
+# -- shrinker proof-of-life (acceptance) -------------------------------------
+
+
+def test_reintroduced_known_bug_is_caught_and_shrunk():
+    """A deliberately re-introduced known-fixed bug (degraded mode
+    granting full-local amnesty instead of the per-client share) is
+    caught by the campaign and shrunk to a minimal schedule of <= 3
+    faults — and the shrink is deterministic."""
+    assert "degraded-amnesty" in KNOWN and not reintroduced(
+        "degraded-amnesty")
+    c = ChaosCampaign(campaign_seed=7, episodes=4, seconds=8,
+                      per_second=5, stop_on_violation=True)
+    with reintroduce("degraded-amnesty"):
+        report = c.run()
+        assert report["violations"] >= 1
+        assert len(report["bundles"]) == 1
+        bundle = report["bundles"][0]
+        assert {v["invariant"] for v in bundle["violations"]} \
+            == {"degraded_bound"}
+        assert 1 <= len(bundle["minimalSchedule"]) <= 3
+        assert bundle["minimalViolations"]
+        # forensic join: every seat's journal tail + causeSeq chain +
+        # the shard map in force at the violation second
+        for seat in ("A", "B", "C"):
+            j = bundle["journal"][seat]
+            assert j["lastSeq"] > 0 and j["tail"] and j["chain"]
+            assert j["mapInForce"]["kind"] == "shardMapApply"
+        # shrink determinism: same episode -> same minimal schedule
+        idx = bundle["episode"]
+        minimal2, final2, _runs = c.shrink_episode(
+            idx, c.episode_schedule(idx))
+        assert minimal2 == bundle["minimalSchedule"]
+        assert [v.to_dict() for v in final2.violations] \
+            == bundle["minimalViolations"]
+    # the flag is scoped: outside the block the fixed behavior is back
+    assert not reintroduced("degraded-amnesty")
+    clean = c.run_episode(idx)
+    assert clean.violations == []
+
+
+# -- new seam pins ------------------------------------------------------------
+
+
+def test_torn_checkpoint_write_seam(tmp_path, frozen_time):
+    from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.core import checkpoint as ckpt
+    from sentinel_tpu.models.flow import FlowRule
+    from sentinel_tpu.resilience import FaultInjected, FaultInjector
+
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [FlowRule(
+        resource="r", count=5, cluster_mode=True,
+        cluster_config={"flowId": 900, "thresholdType": 0})])
+    svc = DefaultTokenService(rules=rules)
+    svc.request_token(900)
+    path = str(tmp_path / "torn.ck")
+    ckpt.save_cluster_checkpoint(svc, path)  # a good file exists
+    with FaultInjector(seed=1) as inj:
+        # error mode: crash BEFORE the rename — the good file survives
+        inj.arm("checkpoint.torn.write", "error", times=1)
+        with pytest.raises(FaultInjected):
+            ckpt.save_cluster_checkpoint(svc, path)
+        svc2 = DefaultTokenService(rules=rules)
+        assert ckpt.restore_cluster_checkpoint(svc2, path) == 1
+        # garbage mode: the rename PUBLISHES a torn file — restore must
+        # reject it as one clear ValueError, never a zip traceback
+        inj.arm("checkpoint.torn.write", "garbage", times=1)
+        ckpt.save_cluster_checkpoint(svc, path)
+        svc3 = DefaultTokenService(rules=rules)
+        with pytest.raises(ValueError, match="corrupted or truncated"):
+            ckpt.restore_cluster_checkpoint(svc3, path)
+    assert not [p for p in os.listdir(tmp_path)
+                if p.endswith(".ckpt.tmp")]  # no temp litter either way
+
+
+def test_journal_disk_full_seam_degrades_then_restart_resumes(tmp_path):
+    from sentinel_tpu.resilience import FaultInjector
+    from sentinel_tpu.telemetry.journal import ControlPlaneJournal
+
+    path = str(tmp_path / "j.jsonl")
+    j = ControlPlaneJournal(lambda: 1000, path=path)
+    j.record("ruleLoad", family="flow")
+    assert j.stats()["durable"]
+    with FaultInjector(seed=1) as inj:
+        inj.arm("journal.disk.full", "error", times=1)
+        seq = j.record("ruleLoad", family="flow")  # disk full mid-append
+        assert seq == 2
+    stats = j.stats()
+    assert not stats["durable"]            # degraded to the memory tail
+    assert stats["lastSeq"] == 2           # which kept recording
+    j.close()
+    # restart: recovery resumes ABOVE the highest DURABLE seq — the
+    # journal-monotonicity invariant across crash/restart
+    j2 = ControlPlaneJournal(lambda: 2000, path=path)
+    assert j2.record("ruleLoad", family="flow") > 1
+    seqs = [r["seq"] for r in j2.replay()]
+    assert seqs == sorted(set(seqs))       # strictly monotone durable set
+    j2.close()
+
+
+def test_datasource_flap_seam_backs_off_like_a_failure(frozen_time):
+    from sentinel_tpu.datasource.base import AutoRefreshDataSource
+    from sentinel_tpu.resilience import FaultInjector
+
+    class _Src(AutoRefreshDataSource):
+        def __init__(self):
+            super().__init__(converter=lambda s: s,
+                             recommend_refresh_ms=100)
+            self.reads = 0
+
+        def read_source(self):
+            self.reads += 1
+            return ["v"]
+
+    src = _Src()
+    src.first_load()
+    reads_before = src.reads
+    with FaultInjector(seed=1) as inj:
+        inj.arm("datasource.flap", "error", times=1)
+        src._poll_once()                    # the flap: no read happened
+        assert src.reads == reads_before
+        assert src.consecutive_failures == 1
+        src._poll_once()                    # next cadence tick catches up
+        assert src.reads == reads_before + 1
+        assert src.consecutive_failures == 0
+
+
+def test_chaos_counters_reach_exporter(engine):
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    text = render_engine_metrics(engine)
+    for family in ("sentinel_tpu_chaos_episodes",
+                   "sentinel_tpu_chaos_violations",
+                   "sentinel_tpu_chaos_faults_fired",
+                   "sentinel_tpu_chaos_shrink_steps"):
+        assert family in text

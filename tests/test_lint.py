@@ -151,6 +151,93 @@ def test_sim_config_keys_accessor_only_and_documented():
         + ", ".join(undocumented))
 
 
+def test_fault_points_documented_and_wired():
+    """Every name in ``resilience.faults.FAULT_POINTS`` must (a) appear
+    in docs/OPERATIONS.md (the fault-point table operators arm in chaos
+    drills) and (b) have at least one ``fire(``/``mutate(`` call site in
+    the package — a fault point with no call site rots silently: tests
+    arm it, nothing ever fires, and the drill asserts nothing."""
+    import re
+    import sys
+
+    sys.path.insert(0, str(REPO))
+    from sentinel_tpu.resilience.faults import FAULT_POINTS
+
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(p for p in FAULT_POINTS if p not in ops)
+    assert not undocumented, (
+        "fault points missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+    package_text = "\n".join(
+        path.read_text()
+        for path in sorted((REPO / "sentinel_tpu").rglob("*.py")))
+    dead = []
+    for point in FAULT_POINTS:
+        pat = re.compile(
+            r"(?:fire|mutate)(?:_targeted)?\(\s*[\"']"
+            + re.escape(point) + r"[\"']")
+        if not pat.search(package_text):
+            dead.append(point)
+    assert not dead, (
+        "fault points with no fire(/mutate( call site (dead seams): "
+        + ", ".join(dead))
+
+
+def test_chaos_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.chaos.*`` config key must (a) be defined
+    and read ONLY in core/config.py — the rest of the package goes
+    through the ``SentinelConfig`` accessors — and (b) appear in
+    docs/OPERATIONS.md "Chaos campaign", so the runbook can never
+    silently drift from the knobs the code actually reads (same rule
+    shape as the cluster-HA / overload / sim gates)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.chaos\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.chaos.* literals outside core/config.py "
+        "(use the SentinelConfig chaos_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no chaos config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "chaos config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_no_wall_clock_in_chaos():
+    """Chaos campaigns must be deterministic BY CONSTRUCTION: everything
+    in sentinel_tpu/chaos/ runs on the engine timebase (the SimClock the
+    campaign advances), so an ambient wall-clock read anywhere in the
+    package would couple an episode's verdict stream to the host clock
+    and void the seed-replay contract. Same rule (and skip logic) as the
+    simulator/journal gates; ``time.perf_counter`` stays sanctioned — it
+    MEASURES episodes/s, it never drives an episode."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu" / "chaos").rglob("*.py")):
+        for lineno, code in _code_lines(path):
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in chaos code (ride the campaign SimClock; "
+        "perf_counter only for speed measurement): " + ", ".join(offenders))
+
+
 def test_exported_metric_names_registered_exactly_once():
     """Every ``sentinel_tpu_*`` metric family must be declared exactly
     once across the telemetry exporters — a name declared twice renders
@@ -231,6 +318,13 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_fleet_health",
                  "sentinel_tpu_fleet_skew_ms",
                  "sentinel_tpu_fleet_polls"):
+        assert name in seen, f"{name} not declared in the exporters"
+    # chaos-campaign families (ISSUE 15): declared exactly once (the
+    # dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_chaos_episodes",
+                 "sentinel_tpu_chaos_violations",
+                 "sentinel_tpu_chaos_faults_fired",
+                 "sentinel_tpu_chaos_shrink_steps"):
         assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
