@@ -24,7 +24,7 @@ sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
 and the queue-wait vs device-wait split.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_14.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_15.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -1082,6 +1082,250 @@ def bench_shard_mesh() -> dict:
     }}
 
 
+def bench_rebalance_drill() -> dict:
+    """ISSUE 16 acceptance: a GOVERNED rebalance (propose -> chaos
+    certify -> journal-audited apply) lands mid-run against live
+    traffic, and the post-move steady state holds the ``shard_mesh``
+    admission rate (within 10% of BENCH_14's 29680.3).
+
+    Same wire harness as ``bench_shard_mesh`` — 3 loopback leaders,
+    6 threads x 11 conns, 1536 total in-flight — but PLACEMENT is
+    skewed (A owns 32 of 64 slices; B, C 16 each) and DEMAND is
+    uniform per slice (one flowId per slice, each thread's pipeline
+    depth proportional to its leader's slice count), so A carries half
+    the offered load. The ShardRebalancer senses that skew, drains A
+    toward B/C under the movement cap, certifies the plan on the
+    seeded synthetic mesh, and applies through ``apply_via``: the
+    three live ``DefaultTokenService`` shards re-seat (epoch bumps on
+    moved slices only) BEFORE clients re-route, so the flip window
+    exercises real WRONG_SLICE rejections exactly like a production
+    handoff. Window 1 measures the skewed steady state; window 2 the
+    post-move steady state (the parity metric)."""
+    import socket as _socket
+
+    import sentinel_tpu as st
+    from sentinel_tpu.cluster import codec
+    from sentinel_tpu.cluster.constants import MSG_FLOW
+    from sentinel_tpu.cluster.ha import ClusterServerSpec
+    from sentinel_tpu.cluster.rebalance import ShardRebalancer
+    from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+    from sentinel_tpu.cluster.server import ClusterTokenServer
+    from sentinel_tpu.cluster.sharding import ShardMap, ShardState, slice_of
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.telemetry.journal import ControlPlaneJournal
+
+    n_slices = 64
+    leaders = ("A", "B", "C")
+    threads_per_leader, conns_per_thread = 2, 11
+    inflight_per_thread = 256  # x6 threads = shard_mesh's 1536 total
+    # Skewed placement: A owns the first half of the ring.
+    owner = ["A" if i < 32 else ("B" if i < 48 else "C")
+             for i in range(n_slices)]
+    # One flowId PER SLICE, found by the shared routing hash — uniform
+    # per-slice demand makes leader load proportional to slices owned.
+    fid_of_slice = {}
+    fid = 9000
+    while len(fid_of_slice) < n_slices:
+        sl = slice_of(fid, n_slices)
+        fid_of_slice.setdefault(sl, fid)
+        fid += 1
+    all_rules = [
+        st.FlowRule(resource=f"rd{f}", count=1e9, cluster_mode=True,
+                    cluster_config={"flowId": f, "thresholdType": 1})
+        for f in fid_of_slice.values()]
+    services, servers = {}, {}
+    for mid in leaders:
+        rules = ClusterFlowRuleManager()
+        rules.load_rules("default", list(all_rules))
+        svc = DefaultTokenService(rules, max_allowed_qps=1e12)
+        svc.set_shard(ShardState(n_slices, 2, {
+            i: 2 for i in range(n_slices) if owner[i] == mid}))
+        warm_fid = next(fid_of_slice[sl] for sl in range(n_slices)
+                        if owner[sl] == mid)
+        for w in (256, 1024, 4096):  # absorb the width-ladder jits
+            svc.request_tokens([(warm_fid, 1, False)] * w)
+        services[mid] = svc
+        servers[mid] = ClusterTokenServer(
+            svc, host="127.0.0.1", port=0).start()
+
+    # Shared routing state the apply path flips; workers re-encode on
+    # a generation bump (list writes are atomic under the GIL).
+    gen = [0]
+    owner_now = list(owner)
+    stop = threading.Event()
+    n_threads = len(leaders) * threads_per_leader
+    replies = [0] * n_threads
+    ok = [0] * n_threads
+    barrier = threading.Barrier(n_threads + 1)
+
+    def worker(tid: int) -> None:
+        mid = leaders[tid % len(leaders)]
+        conns = []
+        try:
+            for _c in range(conns_per_thread):
+                s = _socket.create_connection(
+                    ("127.0.0.1", servers[mid].bound_port), timeout=10)
+                s.settimeout(10)
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                conns.append((s, codec.FrameReader()))
+            my_gen, frames, expect = -1, b"", 0
+            barrier.wait()
+            while not stop.is_set():
+                if my_gen != gen[0]:
+                    my_gen = gen[0]
+                    fids = [fid_of_slice[sl] for sl in range(n_slices)
+                            if owner_now[sl] == mid]
+                    # Pipeline depth tracks ownership share so offered
+                    # load per leader stays proportional to its slices.
+                    expect = max(1, round(
+                        inflight_per_thread * len(leaders)
+                        * len(fids) / n_slices))
+                    frames = b"".join(
+                        codec.encode_request(
+                            xid + 1, MSG_FLOW,
+                            codec.encode_flow_request(
+                                fids[(tid * expect + xid) % len(fids)],
+                                1, False))
+                        for xid in range(expect))
+                for s, _ in conns:
+                    s.sendall(frames)
+                for s, reader in conns:
+                    got = 0
+                    while got < expect:
+                        data = s.recv(65536)
+                        if not data:
+                            return
+                        for body in reader.feed(data):
+                            resp = codec.decode_response(body)
+                            got += 1
+                            replies[tid] += 1
+                            if resp.status == 0:
+                                ok[tid] += 1
+        except (OSError, threading.BrokenBarrierError):
+            pass
+        finally:
+            for s, _ in conns:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # The governed control plane: real ShardRebalancer over the live
+    # services, with the bench as its fleet (uniform per-slice demand,
+    # which IS the offered load above) and an apply_via that re-seats
+    # the three running shards then flips client routing.
+    clock = lambda: int(time.time() * 1000)  # noqa: E731
+
+    class _Seat:
+        shard_map = ShardMap(
+            version=2, n_slices=n_slices,
+            servers=tuple(ClusterServerSpec(m, "127.0.0.1",
+                                            servers[m].bound_port)
+                          for m in leaders),
+            slice_owner=tuple(owner), slice_epoch=(2,) * n_slices)
+
+        def transition_pending(self):
+            return False
+
+    class _Fleet:
+        def settled_through_ms(self):
+            return clock() - 1000
+
+        def status(self):
+            return {"leaders": {
+                m: {"stale": False, "epochRegressed": False}
+                for m in leaders}}
+
+        def slice_loads(self, flow_of, n, window_seconds=None,
+                        settled_only=True):
+            return {"nSlices": n, "seconds": 4,
+                    "settledThroughMs": self.settled_through_ms(),
+                    "slices": {sl: 1000 for sl in range(n)},
+                    "observedByLeader": {}, "unattributed": 0}
+
+    seat = _Seat()
+
+    def apply_all(smap):
+        for mid in leaders:
+            services[mid].set_shard(ShardState(
+                smap.n_slices, smap.version,
+                {sl: smap.slice_epoch[sl] for sl in range(smap.n_slices)
+                 if smap.slice_owner[sl] == mid}))
+        seat.shard_map = smap
+        for sl in range(smap.n_slices):
+            owner_now[sl] = smap.slice_owner[sl]
+        gen[0] += 1  # servers re-seated first: clients flip AFTER
+
+    rb = ShardRebalancer(
+        ha=seat, fleet=_Fleet(),
+        journal=ControlPlaneJournal(clock, path=None),
+        flow_of=lambda r: None, clock=clock, apply_via=apply_all)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        barrier.wait(timeout=120)
+        time.sleep(5.0)  # jit settle, as in bench_shard_mesh
+        base_r, base_o = list(replies), sum(ok)
+        t0 = time.perf_counter()
+        time.sleep(4.0)
+        snap_r, snap_o = list(replies), sum(ok)
+        w1 = time.perf_counter() - t0
+
+        proposed = rb.propose()
+        if not proposed.get("ok"):
+            raise RuntimeError(f"rebalance propose vetoed: {proposed}")
+        plan_id = proposed["plan"]["planId"]
+        certified = rb.certify(plan_id, campaign_seed=0)
+        if not certified.get("ok"):
+            raise RuntimeError(f"rebalance certify vetoed: {certified}")
+        applied = rb.apply(plan_id)
+        if not applied.get("ok"):
+            raise RuntimeError(f"rebalance apply vetoed: {applied}")
+        plan = rb.plans[plan_id]
+
+        time.sleep(2.0)  # flip window: re-encode + WRONG_SLICE drains
+        mid_r, mid_o = list(replies), sum(ok)
+        t1 = time.perf_counter()
+        time.sleep(4.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        w2 = time.perf_counter() - t1
+    finally:
+        stop.set()
+        for srv in servers.values():
+            srv.stop()
+    rate_before = (sum(snap_r) - sum(base_r)) / w1
+    rate_after = (sum(replies) - sum(mid_r)) / w2
+    ok_after = (sum(ok) - mid_o) / w2
+    sensed = rb.sense()
+    cert = plan.cert or {}
+    return {"rebalance_drill": {
+        # Post-move steady state is THE parity metric.
+        "acquires_per_sec": round(rate_after, 1),
+        "ok_per_sec": round(ok_after, 1),
+        "acquires_per_sec_before": round(rate_before, 1),
+        "skew_before": round(plan.skew_before, 4),
+        "skew_after": round(float(sensed.get("skew", 0.0)), 4),
+        "slices_moved": len(plan.moves),
+        "moves": {str(sl): f"{frm}->{to}"
+                  for sl, (frm, to) in sorted(plan.moves.items())},
+        "certified": bool(plan.certified),
+        "certify_seed": cert.get("seed"),
+        "certify_verdict_sha256": cert.get("verdictSha256"),
+        "handoff_margin_grants": cert.get("handoffMarginGrants"),
+        "leaders": len(leaders),
+        "n_slices": n_slices,
+        "connections": n_threads * conns_per_thread,
+        "pipelined_total": inflight_per_thread * n_threads,
+        # BENCH_14 shard_mesh: 29680.3 acquires/s on this harness.
+        "vs_bench14_shard_mesh": round(rate_after / 29680.3, 2),
+    }}
+
+
 def _probe_backend(timeout_s: float = 90.0):
     """Probe jax backend init in a SUBPROCESS: when the axon tunnel is
     down, ``jax.devices()`` blocks forever inside ``make_c_api_client``
@@ -1131,7 +1375,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_14.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_15.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1293,9 +1537,10 @@ def main() -> None:
                if k not in ("PALLAS_AXON_POOL_IPS", "PYTHONPATH")}
         env["JAX_PLATFORMS"] = "cpu"
         env["BENCH_FORCED_CPU"] = "1"
-        # shard first: the ISSUE-12 acceptance metric takes the
-        # freshest slot in each sample.
-        for fn, key in (("bench_shard_mesh", "shard_mesh"),
+        # rebalance drill first (the ISSUE-16 acceptance metric takes
+        # the freshest slot), then shard (ISSUE-12), then wire.
+        for fn, key in (("bench_rebalance_drill", "rebalance_drill"),
+                        ("bench_shard_mesh", "shard_mesh"),
                         ("bench_wire_mesh", "wire_mesh")):
             try:
                 proc = subprocess.run(

@@ -27,3 +27,7 @@ for second in range(8):
         else:
             blocked += 1
     print(f"t={second}s  pass={passed:3d}  block={blocked:5d}")
+
+# Orderly engine shutdown: a daemon committer thread killed mid-XLA
+# call at interpreter exit aborts the process (core/lease.py).
+st.get_engine().close()

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 # EnvelopeDecision.reason values (stable strings — the decision log and
 # the ops command surface them verbatim).
@@ -52,6 +52,68 @@ FREEZE_DISABLED = "recorder-disabled"
 FREEZE_STALE = "telemetry-stale"
 FREEZE_FAULTED = "telemetry-faulted"
 FREEZE_BACKOFF = "abort-backoff"
+FREEZE_DEGRADED = "degraded-leader"
+
+
+class CooldownLedger:
+    """Per-key cooldown + direction-flip hysteresis — the shared
+    actuation-pacing primitive (ISSUE 16 extracted it from
+    :class:`SafetyEnvelope` so the shard rebalancer paces per-SLICE
+    moves with the same clauses the adaptive loop paces per-resource
+    threshold changes, instead of a second copy of the arithmetic).
+
+    A key is whatever the caller actuates on (a resource name, a slice
+    index); ``direction`` is any equality-comparable token (+1/-1 for
+    thresholds, the destination leader for a slice move). After a
+    :meth:`stamp`, the key is untouchable for ``cooldown_ms``, and a
+    DIFFERENT direction stays rejected for ``flip_cooldown_ms`` (2x by
+    default) — crossing back is where oscillation lives."""
+
+    def __init__(self, cooldown_ms: int,
+                 flip_cooldown_ms: Optional[int] = None):
+        self.cooldown_ms = int(cooldown_ms)
+        self.flip_cooldown_ms = (int(flip_cooldown_ms)
+                                 if flip_cooldown_ms is not None
+                                 else 2 * int(cooldown_ms))
+        self._lock = threading.Lock()
+        self._last: Dict = {}  # key -> (last stamped ms, direction)
+
+    def check(self, key, direction, now_ms: int) -> Optional[str]:
+        """REASON_COOLDOWN / REASON_FLIP when the key may not move
+        (in that precedence), None when it may."""
+        with self._lock:
+            last = self._last.get(key)
+        if last is None:
+            return None
+        last_ms, last_dir = last
+        if now_ms - last_ms < self.cooldown_ms:
+            return REASON_COOLDOWN
+        if direction != last_dir \
+                and now_ms - last_ms < self.flip_cooldown_ms:
+            return REASON_FLIP
+        return None
+
+    def stamp(self, key, direction, now_ms: int) -> None:
+        with self._lock:
+            self._last[key] = (int(now_ms), direction)
+
+    def state(self, now_ms: int) -> Dict:
+        """Ops view: per-key cooldown remaining (keys inside only the
+        longer flip window have served their plain cooldown and drop
+        out, matching the adaptive ``cooldown_state`` shape)."""
+        with self._lock:
+            items = dict(self._last)
+        out = {}
+        for key, (last_ms, direction) in items.items():
+            remaining = max(0, self.cooldown_ms - (now_ms - last_ms))
+            if remaining > 0:
+                out[key] = {"remainingMs": remaining,
+                            "direction": direction}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._last.clear()
 
 
 @dataclass(frozen=True)
@@ -76,15 +138,18 @@ class SafetyEnvelope:
     def __init__(self, step_pct: float, cooldown_ms: int,
                  flip_cooldown_ms: Optional[int] = None):
         self.step_pct = float(step_pct)
-        self.cooldown_ms = int(cooldown_ms)
-        # Direction flips wait out a longer window than same-direction
-        # refinement: crossing the target is where oscillation lives.
-        self.flip_cooldown_ms = (int(flip_cooldown_ms)
-                                 if flip_cooldown_ms is not None
-                                 else 2 * int(cooldown_ms))
-        self._lock = threading.Lock()
-        # resource -> (last promoted actuation ms, direction +1/-1)
-        self._last: Dict[str, Tuple[int, int]] = {}
+        # Cooldown + direction-flip hysteresis live in the shared
+        # ledger (the rebalancer paces slice moves through the same
+        # primitive); direction here is +1/-1 relative to current.
+        self._ledger = CooldownLedger(cooldown_ms, flip_cooldown_ms)
+
+    @property
+    def cooldown_ms(self) -> int:
+        return self._ledger.cooldown_ms
+
+    @property
+    def flip_cooldown_ms(self) -> int:
+        return self._ledger.flip_cooldown_ms
 
     def admit(self, resource: str, current: float, proposed: float,
               floor: float, ceiling: float, now_ms: int) -> EnvelopeDecision:
@@ -92,16 +157,10 @@ class SafetyEnvelope:
         part of the contract: cooldown/hysteresis (is actuation allowed
         AT ALL right now?) before clamps (how far may it go?), so a
         rejected resource never reports a misleading clamp reason."""
-        with self._lock:
-            last = self._last.get(resource)
         direction = 1 if proposed > current else -1
-        if last is not None:
-            last_ms, last_dir = last
-            if now_ms - last_ms < self.cooldown_ms:
-                return EnvelopeDecision(False, current, False, REASON_COOLDOWN)
-            if direction != last_dir \
-                    and now_ms - last_ms < self.flip_cooldown_ms:
-                return EnvelopeDecision(False, current, False, REASON_FLIP)
+        paced = self._ledger.check(resource, direction, now_ms)
+        if paced is not None:
+            return EnvelopeDecision(False, current, False, paced)
         if not floor <= current <= ceiling:
             # The LIVE value sits outside the band (an operator put it
             # there — e.g. an emergency clamp below the target's floor).
@@ -137,24 +196,14 @@ class SafetyEnvelope:
         Proposals that die in shadow/canary don't stamp — the post-abort
         backoff (FreezeGate) covers that quiet period instead."""
         direction = 1 if promoted > current else -1
-        with self._lock:
-            self._last[resource] = (int(now_ms), direction)
+        self._ledger.stamp(resource, direction, now_ms)
 
     def cooldown_state(self, now_ms: int) -> Dict[str, Dict]:
         """Ops view: per-resource cooldown remaining."""
-        with self._lock:
-            items = dict(self._last)
-        out = {}
-        for res, (last_ms, direction) in items.items():
-            remaining = max(0, self.cooldown_ms - (now_ms - last_ms))
-            if remaining > 0:
-                out[res] = {"remainingMs": remaining,
-                            "direction": direction}
-        return out
+        return self._ledger.state(now_ms)
 
     def reset(self) -> None:
-        with self._lock:
-            self._last.clear()
+        self._ledger.reset()
 
 
 @dataclass(frozen=True)
@@ -195,6 +244,41 @@ class FreezeGate:
             return FreezeState(True, FREEZE_STALE)
         if fault_delta > 0:
             return FreezeState(True, FREEZE_FAULTED)
+        if now_ms < backoff_until_ms:
+            return FreezeState(True, FREEZE_BACKOFF)
+        return FreezeState(False, None)
+
+
+class RebalanceFreezeGate:
+    """The shard rebalancer's freeze (ISSUE 16): same stateless-
+    predicate discipline as :class:`FreezeGate`, with the clauses a
+    PLACEMENT controller needs. Precedence: manual > stale-telemetry >
+    degraded-leader > abort-backoff — an operator's freeze is never
+    re-labelled, a skew computed from stale fleet series is never
+    trusted, and nothing moves while any leader is degraded (moving
+    slices around a sick leader amplifies the outage; fold-OUT plans
+    evaluate with ``degraded_leaders=()`` because the sick leader is
+    the reason to move, see cluster/rebalance.py)."""
+
+    def __init__(self, stale_after_ms: int):
+        self.stale_after_ms = int(stale_after_ms)
+
+    def evaluate(self, now_ms: int, *,
+                 manual_frozen: bool,
+                 settled_through_ms: int,
+                 degraded_leaders=(),
+                 backoff_until_ms: int = 0) -> FreezeState:
+        """``settled_through_ms`` is the newest second the fleet view
+        has settled federation-wide (<= 0 means none — stale by
+        definition); ``degraded_leaders`` the machine ids currently
+        stale/regressed/unhealthy."""
+        if manual_frozen:
+            return FreezeState(True, FREEZE_MANUAL)
+        if settled_through_ms <= 0 \
+                or now_ms - settled_through_ms > self.stale_after_ms:
+            return FreezeState(True, FREEZE_STALE)
+        if degraded_leaders:
+            return FreezeState(True, FREEZE_DEGRADED)
         if now_ms < backoff_until_ms:
             return FreezeState(True, FREEZE_BACKOFF)
         return FreezeState(False, None)

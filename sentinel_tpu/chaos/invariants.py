@@ -23,6 +23,11 @@ its prose proof):
   one it already accepted for the same slice lane
 * ``journal_monotone`` — each seat's durable journal seq stream is
   strictly increasing, including across crash/restart recovery
+* ``slice_conservation`` — every slice has exactly one owner at every
+  fence epoch (a move without an epoch bump cannot fence the donor),
+  and per-slice over-admission stays within the summed grants-since-
+  last-publish bound — the invariant the shard rebalancer (ISSUE 16)
+  certifies a plan against before apply
 
 Deliberate asymmetries (also in SEMANTICS.md): a verdict granted
 server-side whose reply is lost (half-open swallow, fence rejection)
@@ -221,6 +226,99 @@ def check_journal_monotone(history: History, thresholds, divisor) \
     return out
 
 
+def check_slice_conservation(history: History,
+                             thresholds: Dict[int, Tuple[float, int]],
+                             divisor) -> List[Violation]:
+    """Every slice has exactly one owner at every fence epoch, and
+    per-slice over-admission stays within the summed grants-since-
+    last-publish bound (ISSUE 16 — the invariant the shard rebalancer
+    certifies a plan against before it may touch the live mesh).
+
+    Evidence is the ``shardMap`` events each map application records
+    (full ownership + per-slice epochs + the flow->slice attribution):
+
+    * structurally, each map must assign every slice to exactly one
+      leader — a plan that drops or double-assigns a slice fires here
+      before a single request is even driven;
+    * across maps, one (slice, fence epoch) pair never names two
+      different owners — a move that reuses the standing epoch cannot
+      fence the donor (both seats grant at a fence the client
+      accepts), which is exactly the broken-plan shape certification
+      exists to veto;
+    * per (slice, window): total wire grants <= the sum of the slice's
+      per-flow allowances (threshold + transfer margin, the same
+      arithmetic as ``overadmission``) — the per-slice fold of the
+      SEMANTICS.md fencing bound, keyed by window interval so flows on
+      different cadences never share a window key."""
+    out: List[Violation] = []
+    maps = history.of("shardMap")
+    flow_slice: Dict[int, int] = {}
+    owner_at: Dict[tuple, object] = {}  # (slice, epoch) -> owner
+    for ev in maps:
+        n = int(ev.get("n", 0))
+        owners = ev.get("owners") or {}
+        claimed: Dict[int, list] = defaultdict(list)
+        for mid in sorted(owners):
+            for sl in owners[mid]:
+                claimed[int(sl)].append(mid)
+        for sl in range(n):
+            mids = claimed.get(sl, [])
+            if len(mids) != 1:
+                out.append(Violation(
+                    "slice_conservation",
+                    f"map v{ev.get('version')}: slice {sl} has "
+                    f"{len(mids)} owners ({mids if mids else 'none'})",
+                    second=ev.get("sec")))
+        epochs = {int(k): int(v)
+                  for k, v in (ev.get("epochs") or {}).items()}
+        for sl, mids in sorted(claimed.items()):
+            if len(mids) != 1 or sl not in epochs:
+                continue
+            key = (sl, epochs[sl])
+            prev = owner_at.setdefault(key, mids[0])
+            if prev != mids[0]:
+                out.append(Violation(
+                    "slice_conservation",
+                    f"slice {sl} changed owner {prev} -> {mids[0]} at "
+                    f"the SAME fence epoch {epochs[sl]} (a move without "
+                    "an epoch bump cannot fence the donor)",
+                    second=ev.get("sec")))
+        for f, sl in (ev.get("flows") or {}).items():
+            flow_slice[int(f)] = int(sl)
+    if not flow_slice:
+        return out
+    counts: Dict[tuple, int] = defaultdict(int)
+    margins: Dict[tuple, float] = defaultdict(float)
+    for ev in history.events:
+        if ev["e"] == "grant":
+            counts[(ev["flow"], ev["win"])] += 1
+        elif ev["e"] == "transfer":
+            flow, win = ev["flow"], ev["win"]
+            interval = max(1, int(thresholds.get(flow, (0, 1000))[1]))
+            standing = counts[(flow, win)] + counts[(flow, win - interval)]
+            for w in (win, win + interval):
+                margins[(flow, w)] += standing
+    got: Dict[tuple, int] = defaultdict(int)
+    allowed: Dict[tuple, float] = defaultdict(float)
+    for (flow, win), n_grants in counts.items():
+        info = thresholds.get(flow)
+        sl = flow_slice.get(int(flow))
+        if info is None or sl is None:
+            continue
+        key = (sl, int(info[1]), win)
+        got[key] += n_grants
+        allowed[key] += float(info[0]) + margins.get((flow, win), 0.0)
+    for key in sorted(got, key=str):
+        if got[key] > allowed[key] + 1e-9:
+            sl, _interval, win = key
+            out.append(Violation(
+                "slice_conservation",
+                f"slice {sl} window {win}: {got[key]} wire grants > "
+                f"summed per-flow allowance {allowed[key]} (per-slice "
+                "grants-since-last-publish bound)"))
+    return out
+
+
 CHECKERS = (
     ("conservation", check_conservation),
     ("no_stranded", check_no_stranded),
@@ -229,6 +327,7 @@ CHECKERS = (
     ("degraded_bound", check_degraded_bound),
     ("epoch_monotone", check_epoch_monotone),
     ("journal_monotone", check_journal_monotone),
+    ("slice_conservation", check_slice_conservation),
 )
 
 

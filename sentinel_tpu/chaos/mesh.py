@@ -290,6 +290,7 @@ class ChaosMesh:
         self.slice_epochs = {sl: 1 for sl in range(self.n_slices)}
         self.map_version = 1
         self.current_map = self._build_map()
+        self._record_map_event()
         for mid in self.leader_order:
             self.seats[mid].apply_map(self.current_map)
         quota = _RecordingQuota(self, divisor=self.divisor,
@@ -319,6 +320,19 @@ class ChaosMesh:
             slice_epoch=tuple(self.slice_epochs[sl]
                               for sl in range(self.n_slices)),
             clients=self.clients)
+
+    def _record_map_event(self) -> None:
+        """Evidence for the ``slice_conservation`` checker: the full
+        ownership/epoch picture at every map adoption, plus the flowId →
+        slice attribution (via the one ``slice_of``) so per-slice
+        over-admission can be folded from the grant stream."""
+        self.history.add(
+            "shardMap", version=int(self.map_version), n=int(self.n_slices),
+            owners={m: list(sls) for m, sls in self.assignment.items()},
+            epochs={int(sl): int(ep)
+                    for sl, ep in self.slice_epochs.items()},
+            flows={int(fid): slice_of(fid, self.n_slices)
+                   for fid in sorted(self.flows)})
 
     def fire_targeted(self, point: str, mid: str) -> None:
         if self.fault_target.get(point) in (None, mid):
@@ -462,6 +476,7 @@ class ChaosMesh:
         self.slice_epochs.update({int(s): int(e) for s, e in epochs.items()})
         self.map_version = max(self.map_version + 1, int(version))
         self.current_map = self._build_map()
+        self._record_map_event()
         for mid in self.leader_order:
             if mid in self.crashed:
                 continue  # a dead seat gets no pushes (it is dead)

@@ -42,6 +42,7 @@ import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from sentinel_tpu.telemetry.journal import causing as journal_causing
+from sentinel_tpu.telemetry.journal import current_cause as journal_cause
 
 # Known-fixed-bug reintroduction flags (chaos shrinker proof-of-life —
 # ISSUE 15). Bound ONCE at import: the check sits on the degraded-mode
@@ -580,6 +581,13 @@ class ClusterHAManager:
             self._retry_timer = None
         self.apply_map(cmap)
 
+    def transition_pending(self) -> bool:
+        """True while a failed map transition awaits its retry timer —
+        this seat is MID-HANDOFF and must not be a rebalance donor or
+        recipient (the rebalancer's veto input)."""
+        with self._lock:
+            return self._retry_timer is not None
+
     # -- role transitions --------------------------------------------------
 
     def _become_server(self, cmap: ClusterMap, me: ClusterServerSpec) -> None:
@@ -747,13 +755,19 @@ class ClusterHAManager:
                         len(set(cur_shard.epochs) - set(mine)))
                     return
             j = self._journal()
+            # An ambient cause (the rebalancer applying under
+            # ``causing(applySeq)``) outranks the per-kind back-pointer:
+            # the apply record then chains propose -> certify -> apply ->
+            # shardMapApply -> haRoleFlip instead of just map-to-map.
+            cause = journal_cause()
             jseq = j.record(
                 "shardMapApply", version=int(smap.version),
                 nSlices=int(smap.n_slices),
                 role="server" if (mine and spec is not None) else "client",
                 slicesOwned=sorted(int(s) for s in mine),
                 sliceEpochs={str(s): int(e) for s, e in sorted(mine.items())},
-                cause_seq=self._shard_jseq) if j is not None else None
+                cause_seq=cause if cause is not None
+                else self._shard_jseq) if j is not None else None
             try:
                 with (journal_causing(jseq) if j is not None
                       else contextlib.nullcontext()):

@@ -108,6 +108,35 @@ class ShardMap(NamedTuple):
                 for i, mid in enumerate(self.slice_owner)
                 if mid == machine_id}
 
+    def assignment(self) -> Dict[str, Tuple[int, ...]]:
+        """{machine_id: owned slices} for EVERY seat in ``servers`` —
+        seats owning nothing still appear (the rebalancer's fold-in
+        target set), unlike ``slices_of`` which is per-seat."""
+        out: Dict[str, Tuple[int, ...]] = {
+            s.machine_id: () for s in self.servers}
+        for mid in out:
+            out[mid] = self.slices_of(mid)
+        return out
+
+    def with_moves(self, moves: Dict[int, str],
+                   version: Optional[int] = None) -> "ShardMap":
+        """Minimal-movement successor map: ``moves`` is {slice: new
+        owner}.  Only moved slices change owner, and ONLY moved slices
+        get their fencing epoch bumped (to the new version — per-slice
+        fencing means untouched slices keep serving without a grant
+        round-trip).  ``version`` defaults to ``self.version + 1``."""
+        new_version = int(version) if version is not None \
+            else int(self.version) + 1
+        owner = list(self.slice_owner)
+        epoch = list(self.slice_epoch)
+        for sl, mid in moves.items():
+            sl = int(sl)
+            if owner[sl] != mid:
+                owner[sl] = mid
+                epoch[sl] = new_version
+        return self._replace(version=new_version, slice_owner=tuple(owner),
+                             slice_epoch=tuple(epoch))
+
 
 class ShardState(NamedTuple):
     """A leader's server-side slice ownership (``DefaultTokenService.

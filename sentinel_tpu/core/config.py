@@ -199,6 +199,23 @@ JOURNAL_ROTATE_BYTES = "csp.sentinel.journal.rotate.bytes"
 FLEET_HISTORY_SECONDS = "csp.sentinel.fleet.history.seconds"
 FLEET_STALE_MS = "csp.sentinel.fleet.stale.ms"
 FLEET_MAX_SECONDS = "csp.sentinel.fleet.max.seconds"
+# Self-driving shard rebalancer (cluster/rebalance.py — ISSUE 16).
+# Every key MUST be read through the accessors below and documented in
+# docs/OPERATIONS.md "Self-driving rebalancing" (pinned by test_lint).
+# max.slices.per.epoch: hard movement cap per applied plan;
+# cooldown.ms: per-slice quiet period stamped at apply (direction
+# flips wait 2x); skew.deadband.pct: relative leader-load spread below
+# which no plan is proposed; stale.ms: fleet-series age past which the
+# rebalancer freezes; abort.backoff.ms: quiet period after a vetoed
+# certification; certify.seconds: driven seconds per certification
+# episode; window.seconds: fleet-series fold window for sensing.
+REBALANCE_MAX_SLICES = "csp.sentinel.rebalance.max.slices.per.epoch"
+REBALANCE_COOLDOWN_MS = "csp.sentinel.rebalance.cooldown.ms"
+REBALANCE_DEADBAND_PCT = "csp.sentinel.rebalance.skew.deadband.pct"
+REBALANCE_STALE_MS = "csp.sentinel.rebalance.stale.ms"
+REBALANCE_BACKOFF_MS = "csp.sentinel.rebalance.abort.backoff.ms"
+REBALANCE_CERTIFY_SECONDS = "csp.sentinel.rebalance.certify.seconds"
+REBALANCE_WINDOW_SECONDS = "csp.sentinel.rebalance.window.seconds"
 SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
 SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
 SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
@@ -347,6 +364,20 @@ DEFAULT_JOURNAL_ROTATE_BYTES = 4 * 1024 * 1024
 DEFAULT_FLEET_HISTORY_SECONDS = 512
 DEFAULT_FLEET_STALE_MS = 5_000
 DEFAULT_FLEET_MAX_SECONDS = 16
+# Rebalancer defaults. 4 slices/epoch keeps any one plan's blast
+# radius under 1/16th of the default 64-slice ring; the 60s per-slice
+# cooldown means a slice's post-move load shows up in the fleet series
+# before it may be re-judged (the adaptive loop's discipline applied
+# to placement); 25% relative spread is the noise floor observed on
+# the loopback mesh; certification replays 8 driven seconds — past
+# the 1.5s failover deadline plus handoff, under the chaos cadence.
+DEFAULT_REBALANCE_MAX_SLICES = 4
+DEFAULT_REBALANCE_COOLDOWN_MS = 60_000
+DEFAULT_REBALANCE_DEADBAND_PCT = 0.25
+DEFAULT_REBALANCE_STALE_MS = 10_000
+DEFAULT_REBALANCE_BACKOFF_MS = 120_000
+DEFAULT_REBALANCE_CERTIFY_SECONDS = 8
+DEFAULT_REBALANCE_WINDOW_SECONDS = 30
 
 
 def _env_key(key: str) -> str:
@@ -787,6 +818,41 @@ class SentinelConfig:
     def fleet_max_seconds(self) -> int:
         v = self.get_int(FLEET_MAX_SECONDS, DEFAULT_FLEET_MAX_SECONDS)
         return v if v > 0 else DEFAULT_FLEET_MAX_SECONDS
+
+    # Rebalancer accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.rebalance.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def rebalance_max_slices_per_epoch(self) -> int:
+        v = self.get_int(REBALANCE_MAX_SLICES, DEFAULT_REBALANCE_MAX_SLICES)
+        return v if v > 0 else DEFAULT_REBALANCE_MAX_SLICES
+
+    def rebalance_cooldown_ms(self) -> int:
+        v = self.get_int(REBALANCE_COOLDOWN_MS, DEFAULT_REBALANCE_COOLDOWN_MS)
+        return v if v > 0 else DEFAULT_REBALANCE_COOLDOWN_MS
+
+    def rebalance_skew_deadband_pct(self) -> float:
+        v = self.get_float(REBALANCE_DEADBAND_PCT,
+                           DEFAULT_REBALANCE_DEADBAND_PCT)
+        return v if 0.0 < v <= 10.0 else DEFAULT_REBALANCE_DEADBAND_PCT
+
+    def rebalance_stale_ms(self) -> int:
+        v = self.get_int(REBALANCE_STALE_MS, DEFAULT_REBALANCE_STALE_MS)
+        return v if v > 0 else DEFAULT_REBALANCE_STALE_MS
+
+    def rebalance_abort_backoff_ms(self) -> int:
+        v = self.get_int(REBALANCE_BACKOFF_MS, DEFAULT_REBALANCE_BACKOFF_MS)
+        return v if v >= 0 else DEFAULT_REBALANCE_BACKOFF_MS
+
+    def rebalance_certify_seconds(self) -> int:
+        v = self.get_int(REBALANCE_CERTIFY_SECONDS,
+                         DEFAULT_REBALANCE_CERTIFY_SECONDS)
+        return v if v > 1 else DEFAULT_REBALANCE_CERTIFY_SECONDS
+
+    def rebalance_window_seconds(self) -> int:
+        v = self.get_int(REBALANCE_WINDOW_SECONDS,
+                         DEFAULT_REBALANCE_WINDOW_SECONDS)
+        return v if v > 0 else DEFAULT_REBALANCE_WINDOW_SECONDS
 
     def log_dir(self) -> str:
         d = self.get(LOG_DIR)

@@ -384,6 +384,13 @@ class SentinelEngine:
         from sentinel_tpu.adaptive.loop import AdaptiveLoop
 
         self.adaptive = AdaptiveLoop(self)
+        # Governed shard placement (ISSUE 16): senses the fleet plane,
+        # proposes minimal-movement map diffs, chaos-certifies them, and
+        # applies through the journal-audited HA path. Pure control
+        # plane — no background thread; ops drive it via `rebalance`.
+        from sentinel_tpu.cluster.rebalance import ShardRebalancer
+
+        self.rebalancer = ShardRebalancer(self)
         # Token-lease fast path (core/lease.py): host-admitted resources +
         # the async stats committer. Rebuilt on every rule push.
         self.lease_enabled = (
@@ -505,6 +512,9 @@ class SentinelEngine:
         adaptive = getattr(self, "adaptive", None)
         if adaptive is not None:
             adaptive.reset_timebase()
+        rebalancer = getattr(self, "rebalancer", None)
+        if rebalancer is not None:
+            rebalancer.reset_timebase()
         # Audit the swap itself — stamped with the NEW timebase (the
         # old one no longer exists to stamp with). seq stays monotone
         # across the swap even though timestamps may step backward;

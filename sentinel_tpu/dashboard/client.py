@@ -172,6 +172,14 @@ class SentinelApiClient:
         return json.loads(self.get(ip, port, "fleet",
                                    {"op": op, **(params or {})}))
 
+    def fetch_rebalance(self, ip: str, port: int, op: str = "status",
+                        params: Optional[Dict] = None) -> Dict:
+        """Shard rebalancer state (``rebalance`` command): freeze
+        state, counters and plan history (op=status) or the
+        slice-granular load fold + skew (op=sense)."""
+        return json.loads(self.get(ip, port, "rebalance",
+                                   {"op": op, **(params or {})}))
+
     def fetch_journal(self, ip: str, port: int,
                       params: Optional[Dict] = None) -> Dict:
         """Audit-journal tail (``journal`` command): seq-cursored

@@ -42,6 +42,8 @@ set as a small JSON API plus one static page:
     set + transition log (proxies the machines' ``alerts`` command)
   * ``GET  /sim.json?app=``                   trace-replay simulator: last
     policy-lab report / scenario catalog (proxies the ``sim`` command)
+  * ``GET  /rebalance.json?app=``             shard rebalancer: freeze state,
+    plan history (op=status) or slice-load fold (op=sense)
   * ``GET  /fleet.json?app=``                 fleet observability: federated
     per-leader staleness/skew/health + exact fleet series (proxies the
     machines' ``fleet`` command; ``op=series`` for the per-second sums,
@@ -275,6 +277,18 @@ class DashboardServer:
             raise ValueError(f"unsupported fleet op {op!r}")
         return self.api.fetch_fleet(m.ip, m.port, op=op,
                                     params=params or {})
+
+    def get_rebalance(self, app: str, op: str = "status",
+                      params: Optional[Dict[str, str]] = None):
+        """Rebalancer read path (``rebalance`` command status/sense)
+        from the first healthy machine. Read-only: plan/certify/apply/
+        rollback are governed actions and go through the machines'
+        command plane directly."""
+        if op not in ("status", "sense"):
+            raise ValueError(f"unsupported rebalance op {op!r}")
+        m = self._first_healthy(app)
+        return self.api.fetch_rebalance(m.ip, m.port, op=op,
+                                        params=params or {})
 
     def get_sim(self, app: str, op: str = "report"):
         """Simulator read path (``sim`` command report/scenarios) from
@@ -545,6 +559,12 @@ class _Handler(BaseHTTPRequestHandler):
                           if k not in ("app", "op")}
                 return self._ok(d.get_fleet(q.get("app", ""), op=op,
                                             params=params))
+            if path == "/rebalance.json":
+                op = q.get("op", "status")
+                params = {k: v for k, v in q.items()
+                          if k not in ("app", "op")}
+                return self._ok(d.get_rebalance(q.get("app", ""), op=op,
+                                                params=params))
             if path == "/alerts.json":
                 m = d._first_healthy(q.get("app", ""))
                 since = q.get("sinceSeq")

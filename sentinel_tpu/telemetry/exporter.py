@@ -623,6 +623,36 @@ def render_engine_metrics(engine) -> str:
               "Leader page pulls that returned no payload",
               fstatus["pollErrors"] if fstatus else 0)
 
+    # -- governed shard rebalancing (cluster/rebalance.py) ----------------
+    rb = getattr(engine, "rebalancer", None)
+    rstate = rb.metrics_state() if rb is not None else None
+    b.counter("sentinel_tpu_rebalance_plans",
+              "Rebalance plans proposed (skew / join / leave)",
+              rstate["plans"] if rstate else 0)
+    b.counter("sentinel_tpu_rebalance_applies",
+              "Certified plans applied through the HA map path",
+              rstate["applies"] if rstate else 0)
+    b.counter("sentinel_tpu_rebalance_rollbacks",
+              "Last-known-good ownership restores",
+              rstate["rollbacks"] if rstate else 0)
+    b.counter("sentinel_tpu_rebalance_vetoes",
+              "Plans/applies refused by the safety envelope (freeze, "
+              "cooldown, certification, stale plan)",
+              rstate["vetoes"] if rstate else 0)
+    b.counter("sentinel_tpu_rebalance_slices_moved",
+              "Slices whose owner changed via applied rebalance plans",
+              rstate["slices_moved"] if rstate else 0)
+    b.family("sentinel_tpu_rebalance_frozen", "gauge",
+             "1 while the freeze gate blocks new plans (manual, stale "
+             "telemetry, degraded leader, or abort backoff)")
+    b.sample("sentinel_tpu_rebalance_frozen", None,
+             rstate["frozen"] if rstate else 0)
+    b.family("sentinel_tpu_rebalance_skew", "gauge",
+             "Last sensed leader-load skew ((max-min)/mean over the "
+             "slice-granular fleet fold)")
+    b.sample("sentinel_tpu_rebalance_skew", None,
+             rstate["skew"] if rstate else 0)
+
     # -- span sampling health --------------------------------------------
     ssnap = engine.spans.snapshot(limit=0)
     b.counter("sentinel_tpu_spans_seen",

@@ -124,8 +124,8 @@ def leader_fleet_payload(server, since_ms: int, max_seconds: int) -> bytes:
 class _LeaderState:
     __slots__ = ("spec", "client", "cursor_ms", "last_stamp_ms",
                  "last_ok_ms", "skew_ms", "polls", "errors", "unsupported",
-                 "health", "shard", "epoch", "seconds_ingested",
-                 "seconds_skipped", "remote_name")
+                 "health", "shard", "epoch", "max_epoch", "epoch_regressed",
+                 "seconds_ingested", "seconds_skipped", "remote_name")
 
     def __init__(self, spec: LeaderSpec, client):
         self.spec = spec
@@ -140,6 +140,8 @@ class _LeaderState:
         self.health: Optional[Dict] = None
         self.shard: Optional[Dict] = None
         self.epoch = 0
+        self.max_epoch = 0          # high-water epoch ever reported
+        self.epoch_regressed = False
         self.seconds_ingested = 0
         self.seconds_skipped = 0   # fat seconds the leader couldn't frame
         self.remote_name: Optional[str] = None
@@ -263,6 +265,17 @@ class FleetView:
             ls.last_ok_ms = now
             ls.remote_name = payload.get("leader")
             ls.epoch = int(payload.get("epoch") or 0)
+            # Leader-restart blind spot (ISSUE 16 satellite): a leader
+            # that restarted with a stale epoch looks exactly like an
+            # idle-but-alive one on the series alone. Track the
+            # high-water epoch so status() can say which it is; the
+            # flag clears once the leader re-earns (or re-learns) an
+            # epoch at least as new as any it ever reported.
+            if ls.epoch < ls.max_epoch:
+                ls.epoch_regressed = True
+            else:
+                ls.max_epoch = ls.epoch
+                ls.epoch_regressed = False
             ls.health = payload.get("health")
             ls.shard = payload.get("shard")
             # Signed skew: positive = the leader's clock runs ahead of
@@ -355,6 +368,52 @@ class FleetView:
             out.append({"timestamp": stamp, "resources": resources})
         return out
 
+    def slice_loads(self, flow_of, n_slices: int,
+                    window_seconds: Optional[int] = None,
+                    settled_only: bool = True) -> Dict:
+        """Fold the federated series to SLICE granularity (ISSUE 16):
+        per-slice offered load (pass + block) over the newest
+        ``window_seconds`` settled seconds, attributed through
+        ``flow_of(resource) -> flowId`` and the one ``slice_of``
+        implementation — no second hash. Resources without a flowId
+        (local-only rules) are counted in ``unattributed`` rather than
+        silently dropped, so a skew computed from this fold can always
+        be audited against the raw series. ``observedByLeader`` is the
+        load each leader actually SERVED over the window (historical
+        routing, not current ownership — the rebalancer recomputes
+        leader loads from slice loads x the current map)."""
+        from sentinel_tpu.cluster.sharding import slice_of
+
+        n = int(n_slices)
+        horizon = self.settled_through_ms() if settled_only else None
+        secs = self.series()
+        if horizon is not None and horizon >= 0:
+            secs = [s for s in secs if s["timestamp"] <= horizon]
+        if window_seconds is not None and window_seconds > 0:
+            secs = secs[-int(window_seconds):]
+        slices: Dict[int, int] = {}
+        by_leader: Dict[str, int] = {}
+        unattributed = 0
+        for sec in secs:
+            for res, cell in sec["resources"].items():
+                fid = flow_of(res)
+                sl = slice_of(int(fid), n) if fid is not None else None
+                for mid, c in (cell.get("leaders") or {}).items():
+                    load = int(c.get("pass", 0)) + int(c.get("block", 0))
+                    by_leader[mid] = by_leader.get(mid, 0) + load
+                    if sl is None:
+                        unattributed += load
+                    else:
+                        slices[sl] = slices.get(sl, 0) + load
+        return {
+            "nSlices": n,
+            "seconds": len(secs),
+            "settledThroughMs": horizon if horizon is not None else -1,
+            "slices": slices,
+            "observedByLeader": by_leader,
+            "unattributed": unattributed,
+        }
+
     def _stale(self, ls: _LeaderState, now: int) -> bool:
         """Stale = out of CONTACT (no successful payload inside the
         bound) — an idle-but-alive leader answers every poll with zero
@@ -397,6 +456,11 @@ class FleetView:
                     "stalenessMs": (now - ls.last_stamp_ms
                                     if ls.last_stamp_ms >= 0 else None),
                     "lastContactMs": ls.last_ok_ms,
+                    # Age of last CONTACT (successful payload), not of
+                    # data: "idle but alive" has a small contactAgeMs
+                    # and an old lastStampMs; "dead" has both old.
+                    "contactAgeMs": (now - ls.last_ok_ms
+                                     if ls.last_ok_ms >= 0 else None),
                     "stale": self._stale(ls, now),
                     "skewMs": ls.skew_ms,
                     "polls": ls.polls,
@@ -405,6 +469,8 @@ class FleetView:
                     "secondsIngested": ls.seconds_ingested,
                     "secondsSkipped": ls.seconds_skipped,
                     "epoch": ls.epoch,
+                    "maxEpochSeen": ls.max_epoch,
+                    "epochRegressed": ls.epoch_regressed,
                     "health": ls.health,
                     "slicesOwned": (sorted(int(s) for s in
                                            (ls.shard or {}).get("slices", {}))

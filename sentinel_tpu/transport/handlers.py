@@ -862,6 +862,82 @@ def cmd_fleet(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(str(ex))
 
 
+@command_mapping("rebalance", "governed shard placement: sense, plan, "
+                              "chaos-certify, apply, rollback")
+def cmd_rebalance(req: CommandRequest) -> CommandResponse:
+    """The ShardRebalancer's ops surface (cluster/rebalance.py —
+    docs/OPERATIONS.md "Self-driving rebalancing"). ``op``:
+
+      * ``status`` (default) — freeze state, counters, plan history,
+        last-known-good version
+      * ``sense`` — slice-granular load fold + skew (``window=``)
+      * ``plan`` — propose a minimal-movement diff (``window=``)
+      * ``join`` — fold a new seat in (``machine=``, ``host=``,
+        ``port=``)
+      * ``leave`` — fold a seat out (``machine=``); the freeze gate
+        ignores degraded leaders here (the sick seat is WHY we move)
+      * ``certify`` — dry-run plan ``plan=`` as a seeded chaos-mesh
+        episode (``seed=``); any invariant violation vetoes + backs off
+      * ``apply`` — actuate a certified plan ``plan=`` (``force=true``
+        bypasses certification AND the freeze gate — break-glass only)
+      * ``rollback`` — restore last-known-good ownership, one command
+      * ``freeze`` / ``unfreeze`` — manual freeze (outranks everything)
+    """
+    rb = getattr(req.engine, "rebalancer", None)
+    if rb is None:
+        return CommandResponse.of_failure("no rebalancer on this engine")
+    op = req.get_param("op", "status")
+    try:
+        if op == "status":
+            return CommandResponse.of_success(rb.status())
+        if op == "sense":
+            win = req.get_param("window")
+            return CommandResponse.of_success(
+                rb.sense(int(win) if win is not None else None))
+        if op == "plan":
+            win = req.get_param("window")
+            return CommandResponse.of_success(
+                rb.propose(window_seconds=int(win) if win is not None
+                           else None))
+        if op == "join":
+            machine = req.get_param("machine")
+            host = req.get_param("host")
+            port = req.get_param("port")
+            if not machine or not host or port is None:
+                return CommandResponse.of_failure(
+                    "missing parameter: machine/host/port")
+            return CommandResponse.of_success(
+                rb.plan_join(machine, host, int(port)))
+        if op == "leave":
+            machine = req.get_param("machine")
+            if not machine:
+                return CommandResponse.of_failure(
+                    "missing parameter: machine")
+            return CommandResponse.of_success(rb.plan_leave(machine))
+        if op == "certify":
+            plan = req.get_param("plan")
+            if plan is None:
+                return CommandResponse.of_failure("missing parameter: plan")
+            seed = req.get_param("seed")
+            return CommandResponse.of_success(rb.certify(
+                int(plan),
+                campaign_seed=int(seed) if seed is not None else 0))
+        if op == "apply":
+            plan = req.get_param("plan")
+            if plan is None:
+                return CommandResponse.of_failure("missing parameter: plan")
+            force = (req.get_param("force") or "false").lower() == "true"
+            return CommandResponse.of_success(rb.apply(int(plan),
+                                                       force=force))
+        if op == "rollback":
+            return CommandResponse.of_success(rb.rollback())
+        if op in ("freeze", "unfreeze"):
+            return CommandResponse.of_success(rb.freeze(op == "freeze"))
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("metrics", "Prometheus/OpenMetrics exposition")
 def cmd_metrics(req: CommandRequest) -> CommandResponse:
     """``GET /metrics``: the whole engine — attribution counters, RT

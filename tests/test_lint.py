@@ -326,6 +326,16 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_chaos_faults_fired",
                  "sentinel_tpu_chaos_shrink_steps"):
         assert name in seen, f"{name} not declared in the exporters"
+    # governed-rebalancer families (ISSUE 16): declared exactly once
+    # (the dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_rebalance_plans",
+                 "sentinel_tpu_rebalance_applies",
+                 "sentinel_tpu_rebalance_rollbacks",
+                 "sentinel_tpu_rebalance_vetoes",
+                 "sentinel_tpu_rebalance_slices_moved",
+                 "sentinel_tpu_rebalance_frozen",
+                 "sentinel_tpu_rebalance_skew"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -754,6 +764,86 @@ def test_no_wall_clock_in_journal_and_fleet():
     assert not offenders, (
         "wall-clock read in journal/fleet code (ride the injected "
         "engine clock): " + ", ".join(offenders))
+
+
+def test_rebalance_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.rebalance.*`` config key must (a) be
+    defined and read ONLY in core/config.py — the rest of the package
+    goes through the ``SentinelConfig`` rebalance_* accessors — and
+    (b) appear in docs/OPERATIONS.md "Self-driving rebalancing", so the
+    runbook can never silently drift from the knobs the code actually
+    reads (same rule shape as the journal/fleet gate)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.rebalance\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.rebalance.* literals outside core/config.py (use "
+        "the SentinelConfig rebalance_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no rebalance config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "rebalance config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_rebalancer_actuates_only_through_ha_apply():
+    """The rebalancer's ONLY shard-state mutation is ``ha.apply_map``:
+    it must never call the HA internals, assign a shard map, or touch
+    a token service's shard state directly — everything it does to the
+    cluster flows through the same journal-audited, fault-seamed map
+    path the datasource uses (the provenance + veto story depends on
+    this single choke point)."""
+    import re
+
+    patterns = [
+        (re.compile(r"apply_shard_map\s*\("), "apply_shard_map call"),
+        (re.compile(r"\.shard_map\s*="), "shard_map assignment"),
+        (re.compile(r"_become_"), "HA transition internal"),
+        (re.compile(r"set_shard\s*\("), "set_shard call"),
+        (re.compile(r"\.slice_epochs\s*="), "epoch table assignment"),
+    ]
+    path = REPO / "sentinel_tpu" / "cluster" / "rebalance.py"
+    offenders = []
+    for lineno, code in _code_lines(path):
+        for pattern, what in patterns:
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno} ({what})")
+    assert not offenders, (
+        "rebalancer must mutate shard state only via ha.apply_map: "
+        + ", ".join(offenders))
+
+
+def test_no_wall_clock_in_rebalance():
+    """The rebalancer rides the injected clock / engine timebase only:
+    its cooldown stamps, freeze-gate staleness math, and certify
+    episodes must all replay deterministically — one ambient wall-clock
+    read would make a certify veto (or a cooldown) irreproducible from
+    the campaign seed. Same rule as the journal/fleet gate."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    path = REPO / "sentinel_tpu" / "cluster" / "rebalance.py"
+    offenders = []
+    for lineno, code in _code_lines(path):
+        if pattern.search(code):
+            offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in rebalance.py (ride the injected clock): "
+        + ", ".join(offenders))
 
 
 def test_journal_writes_append_only():

@@ -80,7 +80,15 @@ class _GlobalWindow:
         self.counts[idx] += c
 
 
-@pytest.mark.parametrize("seed", [2, 23, 61, 97])
+@pytest.mark.parametrize("seed", [
+    2,
+    # Redundant seeds slow-tier'd (ISSUE 16 tier-1 wall-time trim):
+    # 11-15s each for the same overshoot-envelope regimes as seed 2;
+    # the full sweep still runs with -m slow.
+    pytest.param(23, marks=pytest.mark.slow),
+    pytest.param(61, marks=pytest.mark.slow),
+    pytest.param(97, marks=pytest.mark.slow),
+])
 def test_pod_fuzz_overshoot_envelope(mesh, seed):
     rng = np.random.default_rng(seed)
     n_res = 4
