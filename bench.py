@@ -24,7 +24,7 @@ sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
 and the queue-wait vs device-wait split.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_15.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_16.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -670,6 +670,101 @@ def bench_chaos_campaign() -> dict:
         "verdict_sha256": report["verdictSha256"],
         "fault_sha256": report["faultSha256"],
     }}
+
+
+def bench_llm_admission() -> dict:
+    """LLM admission throughput + the ISSUE 17 acceptance drill.
+
+    Three numbers the TPS family is judged on: (1) mixed 1/4/16-token
+    weighted acquires/s through the lowered ``llm:*`` windows (the
+    chat/completion/batch-prompt cost classes riding the leased fast
+    path), (2) streaming-reservation cycle rate and p99 admit latency
+    through the gateway (open -> SSE ticks -> close, reconciliation
+    included), and (3) the in-sim end-to-end demo: hetero_cost streamed
+    load, ledger drained, zero silent drops, >= 1 adaptive per-model
+    TPS promote."""
+    import sentinel_tpu as st
+    from sentinel_tpu.adapters.llm_gateway import (
+        LLMGateway,
+        MockInferenceServer,
+        run_demo,
+    )
+    from sentinel_tpu.llm.rules import TpsRule
+    from sentinel_tpu.utils import time_util
+
+    time_util.freeze_time(1_700_000_000_000)
+    try:
+        st.reset(capacity=1024)
+        eng = st.get_engine()
+        # Effectively-unlimited budgets: this section measures the
+        # admission MECHANISM, not blocking (the demo covers contention).
+        eng.tps_rules.load_rules([
+            TpsRule(model=f"m{i}", tokens_per_second=1e9)
+            for i in range(8)])
+        # (1) mixed-count weighted acquires on the lowered resources.
+        counts = (1, 4, 16)
+        n_entries = 6000
+        for i in range(64):  # warm the leased path
+            eng.entry(f"llm:m{i % 8}", count=counts[i % 3]).exit()
+        t0 = time.perf_counter()
+        tokens = 0
+        for i in range(n_entries):
+            c = counts[i % 3]
+            eng.entry(f"llm:m{i % 8}", count=c).exit()
+            tokens += c
+        dt_entries = time.perf_counter() - t0
+        # Drain the entry phase's committer backlog BEFORE timing
+        # streams: each stream_open flushes the committer, and paying
+        # another phase's backlog there would bill ~2s of stats catch-up
+        # to the first few admit latencies.
+        eng._flush_committer()
+        # (2) gateway reservation cycles: open + chunked SSE ticks +
+        # close, p99 of the ADMIT (stream_open) step alone.
+        gw = LLMGateway(engine=eng, server=MockInferenceServer(seed=1))
+        n_streams = 400
+        admit_lat_us = []
+        streamed = 0
+        t0 = time.perf_counter()
+        for i in range(n_streams):
+            rid = f"bench-{i}"
+            model = f"m{i % 8}"
+            ta = time.perf_counter()
+            eng.stream_open(rid, model, 64)
+            admit_lat_us.append((time.perf_counter() - ta) * 1e6)
+            for line in gw.server.stream(rid, model, 64):
+                if line.startswith("data: {"):
+                    n = json.loads(line[len("data: "):])["tokens"]
+                    eng.stream_tick(rid, n)
+                    streamed += n
+            eng.stream_close(rid)
+        dt_streams = time.perf_counter() - t0
+        admit_lat_us.sort()
+        stats = eng.streams.stats()
+        demo = run_demo(seconds=60, seed=0)
+        return {"llm_admission": {
+            "mixed_acquire_tokens_per_sec": round(tokens / dt_entries, 1),
+            "mixed_acquires_per_sec": round(n_entries / dt_entries, 1),
+            "stream_cycles_per_sec": round(n_streams / dt_streams, 1),
+            "streamed_tokens_per_sec": round(streamed / dt_streams, 1),
+            "admit_p99_us": round(
+                admit_lat_us[int(0.99 * (len(admit_lat_us) - 1))], 1),
+            "admit_p50_us": round(
+                admit_lat_us[len(admit_lat_us) // 2], 1),
+            # Reconciliation delta: reservation tokens neither streamed
+            # nor released back — MUST be 0 after every close landed.
+            "reconciliation_delta": stats["outstandingTokens"],
+            "demo": {
+                "seconds": demo["seconds"],
+                "ledger_drained": demo["ledgerDrained"],
+                "silent_drops": demo["silentDrops"],
+                "tps_promotes": demo["tpsPromotes"],
+                "verdict_sha256": demo["verdictSha256"],
+                "objective": demo["objective"],
+            },
+        }}
+    finally:
+        time_util.unfreeze_time()
+        st.reset(capacity=1024)
 
 
 def bench_degrade_1k() -> dict:
@@ -1375,7 +1470,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_15.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_16.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1640,7 +1735,8 @@ def main() -> None:
         # BASELINE per-config sections (eval configs #2/#3 + the shim
         # loopback transport): each is individually guarded so one
         # failure costs its own row, not the record.
-        for section in (bench_degrade_1k, bench_param_cms_100k,
+        for section in (bench_llm_admission, bench_degrade_1k,
+                        bench_param_cms_100k,
                         bench_native_token_loopback):
             try:
                 out.update(section())

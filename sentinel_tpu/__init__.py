@@ -53,6 +53,7 @@ from sentinel_tpu.core.exceptions import (
 from sentinel_tpu.models.authority import AuthorityRule
 from sentinel_tpu.models.degrade import DegradeRule
 from sentinel_tpu.models.flow import FlowRule
+from sentinel_tpu.llm import TpsRule
 from sentinel_tpu.models.param_flow import ParamFlowItem, ParamFlowRule
 from sentinel_tpu.models.system import SystemRule
 
@@ -176,6 +177,10 @@ def load_system_rules(rules) -> None:
 
 def load_param_flow_rules(rules) -> None:
     get_engine().param_rules.load_rules(list(rules))
+
+
+def load_tps_rules(rules) -> None:
+    get_engine().tps_rules.load_rules(list(rules))
 
 
 from sentinel_tpu.core.checkpoint import (

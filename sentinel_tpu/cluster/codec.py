@@ -455,6 +455,46 @@ def decode_exit_request(entity: bytes) -> Tuple[int, bool, int]:
     return entry_id, bool(error), count
 
 
+# -- MSG_STREAM_TICK (TPU extension — ISSUE 17 streaming reservations) --------
+#
+# STREAM request:  op:u8 (0=OPEN 1=TICK 2=CLOSE 3=ABORT) | u8 slen |
+#                  streamId utf-8 | u8 mlen | model utf-8 (OPEN only,
+#                  empty otherwise) | tokens:i32 (OPEN: the estimate,
+#                  -1 = server default; TICK: output tokens streamed
+#                  since the last tick; CLOSE/ABORT: ignored).
+# STREAM response: remaining:i32 — the lease's remaining reserved
+#                  tokens (floored); status carries OK / BLOCKED (the
+#                  window rejected an open or an overflow tick) /
+#                  BAD_REQUEST (unknown stream / malformed frame) /
+#                  FAIL (no engine behind this server).
+
+_STREAM_TOKENS = struct.Struct(">i")
+
+
+def encode_stream_request(op: int, stream_id: str, model: str = "",
+                          tokens: int = -1) -> bytes:
+    return (bytes([int(op) & 0xFF]) + _pack_str8(stream_id)
+            + _pack_str8(model) + _STREAM_TOKENS.pack(int(tokens)))
+
+
+def decode_stream_request(entity: bytes) -> Tuple[int, str, str, int]:
+    op = entity[0]
+    stream_id, off = _unpack_str8(entity, 1)
+    model, off = _unpack_str8(entity, off)
+    (tokens,) = _STREAM_TOKENS.unpack_from(entity, off)
+    return op, stream_id, model, tokens
+
+
+def encode_stream_response(remaining: int) -> bytes:
+    return _STREAM_TOKENS.pack(int(remaining))
+
+
+def decode_stream_response(entity: bytes) -> int:
+    if len(entity) < _STREAM_TOKENS.size:
+        return 0
+    return _STREAM_TOKENS.unpack_from(entity)[0]
+
+
 # -- MSG_FLEET (TPU extension — ISSUE 14 fleet telemetry pull) ----------------
 #
 # FLEET request:  since_ms:i64 | max_seconds:i32 — "complete seconds

@@ -24,6 +24,19 @@ MSG_EXIT = 11   # exit/commit (RT, success, thread-count release)
 # reply page. Stock reference servers answer BAD_REQUEST; the
 # FleetView collector marks such leaders unsupported and moves on.
 MSG_FLEET = 12
+# Streaming-reservation ops (ISSUE 17 — sentinel_tpu/llm/): a remote
+# gateway drives stream_open / stream_tick / stream_close on the
+# engine's reservation ledger over the token-server wire, so tick
+# frames ride the same reactor + frontends as token requests. Stock
+# reference servers answer BAD_REQUEST; callers treat that as
+# "no reservation support" and fall back to plain weighted entries.
+MSG_STREAM_TICK = 13
+
+# Sub-ops inside a MSG_STREAM_TICK frame (first entity byte).
+STREAM_OP_OPEN = 0
+STREAM_OP_TICK = 1
+STREAM_OP_CLOSE = 2
+STREAM_OP_ABORT = 3
 
 # ClusterFlowConfig.thresholdType (reference: ClusterRuleConstant).
 THRESHOLD_AVG_LOCAL = 0  # effective threshold = count × connected clients

@@ -14,6 +14,7 @@ from __future__ import annotations
 import queue
 import socket
 import socketserver
+import struct
 import threading
 from typing import Optional, Tuple
 
@@ -25,6 +26,11 @@ from sentinel_tpu.cluster.constants import (
     MSG_FLOW,
     MSG_PARAM_FLOW,
     MSG_PING,
+    MSG_STREAM_TICK,
+    STREAM_OP_ABORT,
+    STREAM_OP_CLOSE,
+    STREAM_OP_OPEN,
+    STREAM_OP_TICK,
     TokenResultStatus,
 )
 from sentinel_tpu.cluster.token_service import DefaultTokenService
@@ -473,6 +479,51 @@ def process_control_frame(server: "ClusterTokenServer", req: codec.Request,
         except Exception:  # noqa: BLE001 — a read must never kill the conn
             return (codec.encode_response(
                 req.xid, MSG_FLEET, TokenResultStatus.FAIL), namespace)
+    if req.msg_type == MSG_STREAM_TICK:
+        # Streaming reservations (ISSUE 17 — sentinel_tpu/llm/): a
+        # remote gateway drives the engine's reservation ledger over
+        # the token wire. Shared by both frontends like every branch
+        # here; a read must never kill the connection.
+        from sentinel_tpu.core.exceptions import BlockException
+
+        try:
+            op, sid, model, tokens = codec.decode_stream_request(req.entity)
+        except (IndexError, ValueError, struct.error):
+            return (codec.encode_response(
+                req.xid, MSG_STREAM_TICK,
+                TokenResultStatus.BAD_REQUEST), namespace)
+        eng = server.engine
+        if eng is None:
+            return (codec.encode_response(
+                req.xid, MSG_STREAM_TICK, TokenResultStatus.FAIL), namespace)
+        try:
+            if op == STREAM_OP_OPEN:
+                lease = eng.stream_open(
+                    sid, model, None if tokens < 0 else tokens)
+                remaining = int(lease.remaining)
+            elif op == STREAM_OP_TICK:
+                remaining = int(eng.stream_tick(sid, max(0, tokens)))
+            elif op in (STREAM_OP_CLOSE, STREAM_OP_ABORT):
+                remaining = int(eng.stream_close(
+                    sid, aborted=op == STREAM_OP_ABORT))
+            else:
+                return (codec.encode_response(
+                    req.xid, MSG_STREAM_TICK,
+                    TokenResultStatus.BAD_REQUEST), namespace)
+        except BlockException:
+            return (codec.encode_response(
+                req.xid, MSG_STREAM_TICK, TokenResultStatus.BLOCKED,
+                codec.encode_stream_response(0)), namespace)
+        except (KeyError, ValueError, OverflowError):
+            return (codec.encode_response(
+                req.xid, MSG_STREAM_TICK,
+                TokenResultStatus.BAD_REQUEST), namespace)
+        except Exception:  # noqa: BLE001 — a tick must never kill the conn
+            return (codec.encode_response(
+                req.xid, MSG_STREAM_TICK, TokenResultStatus.FAIL), namespace)
+        return (codec.encode_response(
+            req.xid, MSG_STREAM_TICK, TokenResultStatus.OK,
+            codec.encode_stream_response(remaining)), namespace)
     if req.msg_type == MSG_EXIT:
         entry_id, error, count = codec.decode_exit_request(req.entity)
         handle = remote_entries.pop(entry_id, None)

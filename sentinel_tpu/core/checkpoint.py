@@ -163,6 +163,12 @@ def save_checkpoint(engine, path: str) -> None:
             # span misreads QPS until rotation flushes them.
             "w1_interval_ms": engine._spec1.interval_ms,
             "w1_sample_count": engine._spec1.buckets,
+            # Streaming-reservation leases (sentinel_tpu/llm/ — ISSUE
+            # 17): streamId-keyed rows, the flowId-row idiom — a restore
+            # grafts survivors, unknown streams start cold. Host-side
+            # JSON rows in the header, not a tensor: the ledger is tiny
+            # and never device-resident.
+            "llm_streams": engine.streams.checkpoint_rows(),
         }
         arrays = {k: np.asarray(v) for k, v in _state_arrays(state).items()}
     _atomic_savez(path, header, arrays)
@@ -260,6 +266,11 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
     # Lease mirrors must match the restored windows, or host admission
     # would re-grant quota the snapshot already spent.
     engine._seed_leases_from_state()
+    # Streaming reservations graft AFTER the windows: a restored lease's
+    # ticks reconcile against the restored debits. last_ms re-stamps to
+    # now so a restore never mass-evicts; a client that truly vanished
+    # evicts one idle period later (remainder returns as credit).
+    engine.streams.graft(header.get("llm_streams") or [], engine.now_ms())
 
 
 def save_pod_checkpoint(pod_state, path: str) -> None:

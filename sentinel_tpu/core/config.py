@@ -216,6 +216,18 @@ REBALANCE_STALE_MS = "csp.sentinel.rebalance.stale.ms"
 REBALANCE_BACKOFF_MS = "csp.sentinel.rebalance.abort.backoff.ms"
 REBALANCE_CERTIFY_SECONDS = "csp.sentinel.rebalance.certify.seconds"
 REBALANCE_WINDOW_SECONDS = "csp.sentinel.rebalance.window.seconds"
+# LLM admission (sentinel_tpu/llm/ — ISSUE 17). Every key MUST be read
+# through the accessors below and documented in docs/OPERATIONS.md
+# "LLM admission & streaming reservations" (pinned by test_lint).
+# max.streams: streaming-reservation ledger capacity (opens beyond it
+# block — bounded host state, never an unbounded dict);
+# idle.evict.ms: a lease untouched this long is an abandoned generation
+# and evicts on the spill cadence (remainder returns as credit);
+# default.estimate.tokens: the up-front reservation when the caller
+# gives no estimate (a typical completion's output budget).
+LLM_MAX_STREAMS = "csp.sentinel.llm.max.streams"
+LLM_IDLE_EVICT_MS = "csp.sentinel.llm.idle.evict.ms"
+LLM_DEFAULT_ESTIMATE_TOKENS = "csp.sentinel.llm.default.estimate.tokens"
 SLO_BASELINE_ALPHA = "csp.sentinel.slo.baseline.alpha"
 SLO_BASELINE_ZSCORE = "csp.sentinel.slo.baseline.zscore"
 SLO_BASELINE_WARMUP_SECONDS = "csp.sentinel.slo.baseline.warmup.seconds"
@@ -378,6 +390,14 @@ DEFAULT_REBALANCE_STALE_MS = 10_000
 DEFAULT_REBALANCE_BACKOFF_MS = 120_000
 DEFAULT_REBALANCE_CERTIFY_SECONDS = 8
 DEFAULT_REBALANCE_WINDOW_SECONDS = 30
+# LLM-admission defaults. 4096 concurrent reservations bounds ledger
+# memory (~100 KiB) far above any single-host serving fan-out; 30s idle
+# means a generation that streamed nothing for 30 seconds lost its
+# client (SSE keep-alives tick far faster); 128 tokens is a typical
+# completion budget when the caller estimates nothing.
+DEFAULT_LLM_MAX_STREAMS = 4096
+DEFAULT_LLM_IDLE_EVICT_MS = 30_000
+DEFAULT_LLM_DEFAULT_ESTIMATE_TOKENS = 128
 
 
 def _env_key(key: str) -> str:
@@ -681,6 +701,23 @@ class SentinelConfig:
     def chaos_max_episodes(self) -> int:
         v = self.get_int(CHAOS_MAX_EPISODES, DEFAULT_CHAOS_MAX_EPISODES)
         return v if v > 0 else DEFAULT_CHAOS_MAX_EPISODES
+
+    # LLM-admission accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.llm.* keys — test_lint forbids reading the literals
+    # anywhere else in the package).
+
+    def llm_max_streams(self) -> int:
+        v = self.get_int(LLM_MAX_STREAMS, DEFAULT_LLM_MAX_STREAMS)
+        return v if v > 0 else DEFAULT_LLM_MAX_STREAMS
+
+    def llm_idle_evict_ms(self) -> int:
+        v = self.get_int(LLM_IDLE_EVICT_MS, DEFAULT_LLM_IDLE_EVICT_MS)
+        return v if v > 0 else DEFAULT_LLM_IDLE_EVICT_MS
+
+    def llm_default_estimate_tokens(self) -> int:
+        v = self.get_int(LLM_DEFAULT_ESTIMATE_TOKENS,
+                         DEFAULT_LLM_DEFAULT_ESTIMATE_TOKENS)
+        return v if v > 0 else DEFAULT_LLM_DEFAULT_ESTIMATE_TOKENS
 
     # SLO / alerting accessors (the ONLY sanctioned readers of the
     # csp.sentinel.slo.* and csp.sentinel.alert.* keys — test_lint

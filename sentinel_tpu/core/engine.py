@@ -209,6 +209,23 @@ class SentinelEngine:
         self.system_rules.add_listener(lambda: self._mark_dirty("system"))
         self.param_rules = P.ParamFlowRuleManager()
         self.param_rules.add_listener(lambda: self._on_rules_changed("param"))
+        # LLM admission (sentinel_tpu/llm/ — ISSUE 17): the TPS family
+        # LOWERS onto flow rules (llm/rules.py) — the listener strips
+        # previously-derived rules and re-injects, so the device machinery
+        # gains no fourth tensor pack. The streaming-reservation ledger is
+        # host-side, engine-timebase only, evicted on the spill cadence.
+        from sentinel_tpu.llm.rules import TpsRuleManager
+        from sentinel_tpu.llm.streams import StreamLedger
+
+        self.tps_rules = TpsRuleManager()
+        self.tps_rules.add_listener(self._on_tps_rules_changed)
+        self._llm_max_streams: Dict[str, int] = {}
+        self._llm_window_budget: Dict[str, float] = {}
+        self._llm_default_estimate = _cfg.llm_default_estimate_tokens()
+        self.streams = StreamLedger(
+            capacity=_cfg.llm_max_streams(),
+            idle_evict_ms=_cfg.llm_idle_evict_ms(),
+            window_ms=interval)
         self.system_status = Y.SystemStatusListener()
         self._signals_refreshed_ms = 0
         self._sealed_sec = self.now_ms() // 1000 - 1
@@ -707,6 +724,7 @@ class SentinelEngine:
             "authority": (self.authority_rules, CV.authority_rule_to_dict),
             "system": (self.system_rules, CV.system_rule_to_dict),
             "param": (self.param_rules, CV.param_rule_to_dict),
+            "tps": (self.tps_rules, CV.tps_rule_to_dict),
         }[family]
         rules = list(mgr.get_rules())
         dicts = []
@@ -757,6 +775,42 @@ class SentinelEngine:
                 self._cluster_param_info = self._cluster_info(
                     self.param_rules.get_rules(), with_param_idx=True)
         self._journal_rule_load(family)
+
+    def _on_tps_rules_changed(self):
+        """TPS loads LOWER onto the flow family (llm/rules.py): strip the
+        previously-derived rules, re-inject the fresh lowering, keep every
+        operator rule (live and staged) untouched. The flow load below
+        fires the normal flow listener, so tensors/leases/cluster maps
+        rebuild with no TPS-specific compilation path. An operator flow
+        push replaces the whole flow list — lowered rules vanish until
+        the next TPS load re-lowers (documented contract)."""
+        from sentinel_tpu.llm import rules as LR
+
+        tps_live = self.tps_rules.get_rules()
+        tps_staged = [r for rs in self.tps_rules.get_staged().values()
+                      for r in rs]
+        lowered = LR.lower_tps_rules(tps_live) \
+            + LR.lower_tps_rules(tps_staged)
+        # Replaced wholesale, never mutated — entry()'s stream_open
+        # concurrency check reads it lock-free.
+        self._llm_max_streams = LR.max_streams_by_resource(tps_live)
+        # resource -> tightest per-window token budget: the reservation
+        # cap (an up-front reservation can never exceed one window's
+        # budget — the rest of a long generation pays live as it
+        # streams across later windows).
+        budgets: Dict[str, float] = {}
+        for r in LR.lower_tps_rules(tps_live):
+            cur = budgets.get(r.resource)
+            budgets[r.resource] = r.count if cur is None \
+                else min(cur, r.count)
+        self._llm_window_budget = budgets
+        keep = [r for r in self.flow_rules.get_rules()
+                if getattr(r, "derived_from", None) != LR.DERIVED_TPS]
+        keep += [r for rs in self.flow_rules.get_staged().values()
+                 for r in rs
+                 if getattr(r, "derived_from", None) != LR.DERIVED_TPS]
+        self.flow_rules.load_rules(keep + lowered)
+        self._journal_rule_load("tps")
 
     def _ensure_compiled(self):
         """(Re)build rule tensors + state after a config push (§3.2).
@@ -1085,6 +1139,127 @@ class SentinelEngine:
         """Current flowId -> (threshold, intervalMs) map for the HA
         client's DegradedQuota (lock-free: replaced wholesale on load)."""
         return self._cluster_thresholds
+
+    # -- LLM streaming reservations (sentinel_tpu/llm/ — ISSUE 17) ---------
+
+    def _llm_debit(self, resource: str, tokens: int) -> int:
+        """Debit ``tokens`` into the model's TPS window through the
+        normal entry path, chunked to MAX_ACQUIRE_COUNT (the device
+        kernels' exact-count ceiling). QPS PASS debits are
+        window-permanent; the immediate exit releases only the
+        concurrency channel. On a mid-chunk block the exception carries
+        ``llm_debited`` — the tokens already landed — so the caller can
+        refund them as expiring credit."""
+        remaining = int(tokens)
+        debited = 0
+        try:
+            while remaining > 0:
+                chunk = min(remaining, C.MAX_ACQUIRE_COUNT)
+                try:
+                    handle = self.entry(resource, count=chunk)
+                except BlockException as ex:
+                    ex.llm_debited = debited
+                    raise
+                handle.exit()
+                debited += chunk
+                remaining -= chunk
+        finally:
+            # Land the leased commits NOW, in this sim second: an
+            # injected-clock run (simulator replay) has no on_advance
+            # flush hook, so a background flush after clock.advance
+            # would stamp these debits into the WRONG window —
+            # nondeterministically.
+            self._flush_committer()
+        return debited
+
+    def stream_open(self, stream_id: str, model: str,
+                    estimate_tokens: Optional[int] = None,
+                    tenant: str = C.LIMIT_APP_DEFAULT):
+        """Open a streaming reservation: acquire the ESTIMATED output
+        budget up front as a lease that ticks down as tokens stream
+        (``stream_tick``) and reconciles on ``stream_close``. Raises a
+        ``BlockException`` subclass when the window (or the
+        maxConcurrentStreams cap / ledger capacity) rejects the open;
+        any partially-debited estimate is refunded as expiring credit,
+        so a rejected open never leaks budget."""
+        from sentinel_tpu.core.exceptions import FlowException
+        from sentinel_tpu.llm.rules import llm_resource
+
+        resource = llm_resource(model)
+        now = self.now_ms()
+        estimate = int(self._llm_default_estimate
+                       if estimate_tokens is None else estimate_tokens)
+        if estimate < 0:
+            raise ValueError("estimate_tokens must be >= 0")
+        cap = self._llm_max_streams.get(resource)
+        if (cap is not None and self.streams.active(resource) >= cap) \
+                or self.streams.at_capacity():
+            self.streams.open_blocked += 1
+            from sentinel_tpu.log.record_log import log_block
+
+            log_block(resource, "FlowException", tenant, estimate, now)
+            raise FlowException(resource)
+        # The up-front reservation caps at ONE window's token budget: a
+        # multi-second generation reserves its first window's worth and
+        # pays the rest live as it streams across later windows (the
+        # tick's overflow path) — which is also what keeps the abort
+        # over-admission bound ≤ one window of tokens (SEMANTICS.md).
+        budget = self._llm_window_budget.get(resource)
+        reserved = estimate if budget is None \
+            else min(estimate, int(budget))
+        credit = self.streams.take_credit(resource, reserved, now)
+        try:
+            debited = self._llm_debit(resource, reserved - int(credit))
+        except BlockException as ex:
+            # Refund what landed (live chunks + consumed credit): the
+            # tokens stay in the PASS window until it rolls, but the
+            # credit makes them reusable for that long — no budget leak.
+            refund = getattr(ex, "llm_debited", 0) + credit
+            self.streams.add_credit(resource, refund, now)
+            self.streams.open_blocked += 1
+            raise
+        return self.streams.open(stream_id, resource, tenant,
+                                 estimate, reserved, debited, now)
+
+    def stream_tick(self, stream_id: str, tokens: int) -> float:
+        """Reconcile ``tokens`` actually streamed against the
+        reservation. Output beyond the estimate debits LIVE (credit
+        first), so a runaway generation pays for every token; a block
+        on that overflow debit propagates as backpressure (the tokens
+        already streamed stay counted). Returns the remaining reserved
+        budget."""
+        now = self.now_ms()
+        covered, overflow = self.streams.tick(stream_id, tokens, now)
+        if overflow > 0:
+            lease = self.streams.get(stream_id)
+            credit = self.streams.take_credit(
+                lease.resource, overflow, now)
+            try:
+                debited = self._llm_debit(
+                    lease.resource, int(overflow - int(credit)))
+            except BlockException as ex:
+                self.streams.record_overflow_debit(
+                    getattr(ex, "llm_debited", 0))
+                raise
+            self.streams.record_overflow_debit(debited)
+        lease = self.streams.get(stream_id)
+        return lease.remaining if lease is not None else 0.0
+
+    def stream_close(self, stream_id: str, aborted: bool = False) -> float:
+        """Close (or abort) a streaming reservation. The unconsumed
+        remainder returns as per-resource credit expiring at the window
+        roll-off — the over-admission across an abort is bounded by the
+        unreconciled estimate for at most one window interval
+        (SEMANTICS.md "Streaming-reservation bound"). Returns the
+        released remainder."""
+        now = self.now_ms()
+        lease = self.streams.get(stream_id)
+        if lease is None:
+            raise KeyError(f"unknown stream {stream_id!r}")
+        remainder = self.streams.close(stream_id, now, aborted=aborted)
+        if remainder > 0:
+            self.streams.add_credit(lease.resource, remainder, now)
+        return remainder
 
     def _refresh_signals(self, now_ms: int) -> None:
         """Fold the latest host OS sample into device state (≤ 1 Hz).
@@ -2008,6 +2183,14 @@ class SentinelEngine:
         adaptive = getattr(self, "adaptive", None)
         if adaptive is not None:
             adaptive.on_spill(now)
+        # Streaming-reservation hygiene rides the same cadence: leases
+        # whose client vanished mid-generation evict (their remainder
+        # returns as expiring credit, the abort contract), and stale
+        # credit rolls off with its window.
+        streams = getattr(self, "streams", None)
+        if streams is not None:
+            for lease in streams.evict(now):
+                streams.add_credit(lease.resource, lease.remaining, now)
 
     def slo_refresh(self, now_ms: Optional[int] = None) -> None:
         """Bring SLO judgement current: land leased commits, fold + spill

@@ -103,6 +103,8 @@ from sentinel_tpu.datasource.converters import (
     param_rules_to_json,
     system_rules_from_json,
     system_rules_to_json,
+    tps_rules_from_json,
+    tps_rules_to_json,
 )
 
 __all__ = [
@@ -126,4 +128,5 @@ __all__ = [
     "flow_rules_from_json", "flow_rules_to_json",
     "param_rules_from_json", "param_rules_to_json",
     "system_rules_from_json", "system_rules_to_json",
+    "tps_rules_from_json", "tps_rules_to_json",
 ]

@@ -69,6 +69,7 @@ def flow_rule_from_dict(d: dict) -> FlowRule:
         max_queueing_time_ms=int(d.get("maxQueueingTimeMs", 500)),
         cluster_mode=bool(d.get("clusterMode", False)),
         cluster_config=d.get("clusterConfig"),
+        derived_from=d.get("derivedFrom"),
         **_rollout_fields(d),
     )
 
@@ -86,6 +87,8 @@ def flow_rule_to_dict(r: FlowRule) -> dict:
         d["refResource"] = r.ref_resource
     if r.cluster_config:
         d["clusterConfig"] = r.cluster_config
+    if getattr(r, "derived_from", None):
+        d["derivedFrom"] = r.derived_from
     return _emit_rollout(d, r)
 
 
@@ -95,6 +98,48 @@ def flow_rules_from_json(source) -> List[FlowRule]:
 
 def flow_rules_to_json(rules: List[FlowRule]) -> str:
     return json.dumps([flow_rule_to_dict(r) for r in rules])
+
+
+# -- tps (sentinel_tpu/llm/ — LLM token-budget admission) -------------------
+# Fourth rule family: per-(model, tenant) tokens-per-second budgets with
+# optional burst headroom and a concurrent-stream cap. Hot-reloadable
+# through any datasource exactly like the families above; the engine
+# lowers loads onto flow rules (llm/rules.py).
+
+def tps_rule_from_dict(d: dict):
+    from sentinel_tpu.llm.rules import TpsRule
+
+    return TpsRule(
+        model=d.get("model", ""),
+        tokens_per_second=float(d.get("tokensPerSecond", 0)),
+        burst_tokens=float(d.get("burstTokens", 0)),
+        tenant=d.get("tenant") or C.LIMIT_APP_DEFAULT,
+        max_concurrent_streams=int(d.get("maxConcurrentStreams", 0)),
+        cluster_mode=bool(d.get("clusterMode", False)),
+        cluster_config=d.get("clusterConfig"),
+        **_rollout_fields(d),
+    )
+
+
+def tps_rule_to_dict(r) -> dict:
+    d = {
+        "model": r.model, "tenant": r.tenant,
+        "tokensPerSecond": r.tokens_per_second,
+        "burstTokens": r.burst_tokens,
+        "maxConcurrentStreams": r.max_concurrent_streams,
+        "clusterMode": r.cluster_mode,
+    }
+    if r.cluster_config:
+        d["clusterConfig"] = r.cluster_config
+    return _emit_rollout(d, r)
+
+
+def tps_rules_from_json(source) -> list:
+    return [tps_rule_from_dict(d) for d in _loads(source)]
+
+
+def tps_rules_to_json(rules) -> str:
+    return json.dumps([tps_rule_to_dict(r) for r in rules])
 
 
 # -- degrade ----------------------------------------------------------------

@@ -1,0 +1,41 @@
+"""LLM-inference admission (ISSUE 17 — ROADMAP item 3).
+
+A tokens-per-second (TPS) rule family with streaming reservations, the
+cost-aware counterpart of the count-shaped flow family (SLINFER's
+workload: wildly varying per-request token cost, per-model and
+per-tenant budgets, pacing instead of binary reject — PAPERS.md).
+
+Layout:
+
+* ``rules.py``   — ``TpsRule`` + ``TpsRuleManager`` and the LOWERING:
+  every TPS rule compiles onto the existing flow-rule machinery as a
+  QPS-grade rule on the synthetic resource ``llm:{model}`` whose window
+  debits count *tokens*, not requests (the fused step's mixed-count
+  fixpoint path already carries N-token acquires exactly).  Degraded
+  tenant-fair shares reuse the HA ``DegradedQuota`` math.
+* ``streams.py`` — the host-side streaming-reservation ledger: an
+  occupy-style estimate acquired up front as a lease that ticks down as
+  output tokens stream, reconciled on completion/abort.
+
+Timebase discipline: nothing in this package reads the wall clock —
+every timestamp is the engine's ``now_ms()`` (pinned by test_lint), so
+the simulator can drive streams deterministically.
+"""
+
+from sentinel_tpu.llm.rules import (
+    DERIVED_TPS,
+    LLM_RESOURCE_PREFIX,
+    TpsRule,
+    TpsRuleManager,
+    degraded_tps_quota,
+    llm_resource,
+    lower_tps_rules,
+    max_streams_by_resource,
+)
+from sentinel_tpu.llm.streams import StreamLease, StreamLedger
+
+__all__ = [
+    "DERIVED_TPS", "LLM_RESOURCE_PREFIX", "TpsRule", "TpsRuleManager",
+    "degraded_tps_quota", "llm_resource", "lower_tps_rules",
+    "max_streams_by_resource", "StreamLease", "StreamLedger",
+]

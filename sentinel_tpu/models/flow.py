@@ -74,6 +74,11 @@ class FlowRule:
     # datasource-tagged candidates ("shadow" default, "canary").
     candidate_set: Optional[str] = None
     rollout_stage: Optional[str] = None
+    # LLM admission (sentinel_tpu/llm/): a rule lowered from another family
+    # carries the family tag here ("tps"). Lowered rules are live and
+    # enforced like any operator rule, but the lowering listener owns them:
+    # each TPS load strips previously-derived rules before re-injecting.
+    derived_from: Optional[str] = None
 
     def is_valid(self) -> bool:
         if not self.resource or self.count < 0:

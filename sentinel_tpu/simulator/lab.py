@@ -80,9 +80,17 @@ def default_targets(trace: Trace, max_block_rate: float = 0.05,
                     ceiling_factor: float = 16.0) -> List[AdaptiveTarget]:
     """One availability target per tunable flow rule the trace carries:
     hold block-rate at/below ``max_block_rate``, band = [count/4,
-    count*ceiling_factor] around the trace's initial limit."""
+    count*ceiling_factor] around the trace's initial limit. TPS rules
+    (ISSUE 17) target their LOWERED resource (``llm:<model>``) at the
+    lowered count (tps + burst) — the adaptive loop tunes the lowered
+    flow rule, which is how a per-model tokensPerSecond gets retuned."""
     out = []
-    for rule in trace.rules.get("flow", ()):
+    tps_lowered = [
+        {"resource": "llm:" + r.get("model", ""),
+         "count": float(r.get("tokensPerSecond", 0))
+         + float(r.get("burstTokens", 0))}
+        for r in trace.rules.get("tps", ())]
+    for rule in list(trace.rules.get("flow", ())) + tps_lowered:
         count = float(rule.get("count", 0))
         if count <= 0:
             continue

@@ -86,7 +86,7 @@ class ReplayResult:
     __slots__ = ("trace_meta", "seconds", "offered", "passed", "blocked",
                  "retried", "verdict_sha256", "series", "rt_hist",
                  "decisions", "counters", "final_counts", "band_violations",
-                 "journal", "replay_wall_s", "total_wall_s")
+                 "journal", "streams", "replay_wall_s", "total_wall_s")
 
     def __init__(self):
         self.trace_meta: Dict = {}
@@ -107,6 +107,10 @@ class ReplayResult:
         # in SIMULATED time, so two runs of one trace+seed produce
         # identical record streams — the journal-determinism oracle.
         self.journal: List[Dict] = []
+        # Streamed-generation outcomes (ISSUE 17): what the trace's "g"
+        # events did to the host-side reservation ledger. Empty unless
+        # the scenario carries streams.
+        self.streams: Dict[str, int] = {}
         # Wall timing (perf_counter, the one sanctioned wall read in
         # this package — it measures speed, it never drives replay):
         # replay_wall_s covers the second loop only (steady state, what
@@ -150,6 +154,7 @@ class ReplayResult:
             "bandViolations": self.band_violations,
             "decisions": len(self.decisions),
             "journalRecords": len(self.journal),
+            "streams": dict(self.streams),
         }
 
 
@@ -220,6 +225,7 @@ class ReplayEngine:
             "param": (eng.param_rules, CV.param_rules_from_json),
             "system": (eng.system_rules, CV.system_rules_from_json),
             "authority": (eng.authority_rules, CV.authority_rules_from_json),
+            "tps": (eng.tps_rules, CV.tps_rules_from_json),
         }
         for fam, rules in (self.rules or {}).items():
             mgr, from_json = loaders[fam]
@@ -294,6 +300,49 @@ class ReplayEngine:
                 passed = reason[i] == 0 or reason[i] == C.BlockReason.WAIT
                 out.append((res, count, attempt, bool(passed)))
         return out
+
+    def _dispatch_streams(self, eng, sec, now, sha,
+                          result: ReplayResult) -> None:
+        """Drive this second's streamed-generation events ("g" rows)
+        through the production reservation path (stream_open / tick /
+        close — ISSUE 17), folding each outcome into the verdict sha so
+        a reservation-semantics change breaks replay determinism
+        loudly. Blocked opens and blocked overflow ticks are outcomes,
+        not errors: impatient clients simply go away."""
+        from sentinel_tpu.core.exceptions import BlockException
+
+        events = sec.get("g")
+        if not events:
+            return
+        st = result.streams
+        for ev in events:
+            op = ev["op"]
+            try:
+                if op == "open":
+                    lease = eng.stream_open(ev["id"], ev["model"],
+                                            int(ev["est"]))
+                    outcome, val = 0, int(lease.remaining)
+                    st["opened"] = st.get("opened", 0) + 1
+                elif op == "tick":
+                    val = int(eng.stream_tick(ev["id"], int(ev["tok"])))
+                    outcome = 0
+                    st["ticks"] = st.get("ticks", 0) + 1
+                    st["tokens"] = st.get("tokens", 0) + int(ev["tok"])
+                else:  # close / abort
+                    val = int(eng.stream_close(
+                        ev["id"], aborted=op == "abort"))
+                    outcome = 0
+                    key = "aborted" if op == "abort" else "closed"
+                    st[key] = st.get(key, 0) + 1
+            except BlockException:
+                outcome, val = 1, 0
+                st["blocked"] = st.get("blocked", 0) + 1
+            except KeyError:
+                # The stream never opened (its open blocked): later
+                # ticks/closes of the same id are no-ops by design.
+                outcome, val = 2, 0
+            sha.update(b"g%d:%s:%d:%d" % (
+                outcome, ev["id"].encode(), now, val))
 
     def _dispatch_exits(self, eng, rows, exits, now) -> None:
         """(res, count, rt_ms, error) rows -> padded exit batches."""
@@ -412,6 +461,9 @@ class ReplayEngine:
                     result.retried += count * n
                 verdicts = self._dispatch_entries(eng, rows, entries,
                                                   now, sha)
+                # 2b. streamed-generation events ride the same second,
+                #     after the batched demand (fixed order = replayable).
+                self._dispatch_streams(eng, sec, now, sha, result)
                 # 3. fold outcomes; blocked demand feeds the retry model.
                 passes: Dict[str, int] = {}
                 blocked_by: Dict[tuple, int] = {}
@@ -479,6 +531,12 @@ class ReplayEngine:
         # produced. Deterministic given the trace + seed (the oracle in
         # tests/test_fleet.py pins it).
         result.journal = eng.journal.tail()
+        if result.streams:
+            # Ledger end-state: a drained run shows zero outstanding
+            # reservation tokens (the gateway demo's acceptance gate).
+            st = eng.streams.stats()
+            result.streams["outstandingTokens"] = st["outstandingTokens"]
+            result.streams["active"] = st["active"]
         for r in eng.flow_rules.get_rules():
             if _tunable(r):
                 result.final_counts[r.resource] = float(r.count)

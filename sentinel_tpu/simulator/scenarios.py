@@ -182,38 +182,114 @@ def correlated_overload(seconds: int = 240, seed: int = 0,
 
 def hetero_cost(seconds: int = 240, seed: int = 0, base_tokens: float = 200,
                 swing: float = 0.5, period_s: int = 80,
-                limit: float = 240) -> Trace:
+                limit: float = 240, streams_per_s: float = 0.0,
+                stream_len_s: int = 6, stream_tokens: int = 120,
+                abandon_rate: float = 0.0) -> Trace:
     """SLINFER-style heterogeneous inference admission: two model
     resources sharing the token-per-second currency, each second's
     demand split into acquire-count classes (chat=1, completion=4,
     batch-prompt=16 tokens) in model-specific proportions — the
-    mixed-count fixpoint regime of the fused step, driven at scale."""
+    mixed-count fixpoint regime of the fused step, driven at scale.
+
+    Streamed-generation mode (ISSUE 17, opt-in — ``streams_per_s > 0``):
+    the scenario switches to the TPS rule family (``llm:*`` lowered
+    resources) and adds Poisson streamed-generation arrivals — each
+    stream opens with a ``stream_tokens`` estimate, ticks its output
+    down over ``stream_len_s`` seconds, and closes; ``abandon_rate``
+    of streams abort mid-generation with their reservation
+    unreconciled (the over-admission bound's stress knob). All stream
+    draws happen AFTER the demand draws, so the default
+    (``streams_per_s=0``) trace stays bit-identical to pre-ISSUE-17
+    captures."""
     rng = np.random.default_rng(seed)
     t = np.arange(seconds)
     wave = 1 + swing * np.sin(2 * np.pi * t / period_s)
+    streamed = streams_per_s > 0
+    prefix = "llm:" if streamed else ""
+    small, large = prefix + "model-small", prefix + "model-large"
     demand = {
-        "model-small": rng.poisson(base_tokens * wave).astype(np.int64),
+        small: rng.poisson(base_tokens * wave).astype(np.int64),
         # The large model trails by half a period (tenants shift load).
-        "model-large": rng.poisson(
+        large: rng.poisson(
             base_tokens * 0.6 * (2 - wave)).astype(np.int64),
     }
     counts = {
-        "model-small": [[1, 6], [4, 3]],         # chat-heavy
-        "model-large": [[4, 2], [16, 3], [1, 1]],  # long generations
+        small: [[1, 6], [4, 3]],         # chat-heavy
+        large: [[4, 2], [16, 3], [1, 1]],  # long generations
     }
+    secs = _seconds_from_demand(demand, counts)
+    meta = {"scenario": "hetero_cost", "seed": seed,
+            "countClasses": counts,
+            "rtProfile": {
+                small: {"baseMs": 30, "loadedMs": 250,
+                        "kneeTps": int(base_tokens * 2)},
+                large: {"baseMs": 120, "loadedMs": 900,
+                        "kneeTps": int(base_tokens)}}}
+    if not streamed:
+        return Trace(
+            epoch_ms=DEFAULT_EPOCH_MS, duration_s=seconds,
+            meta=meta, resources=[small, large],
+            rules={"flow": [_flow_rule(small, limit),
+                            _flow_rule(large, limit * 0.6)]},
+            seconds=secs)
+    # Streamed-generation arrivals: all draws AFTER the demand draws,
+    # in a fixed (model-sorted, time-ordered) sequence — one seed names
+    # one event schedule forever.
+    meta["streams"] = {"perS": float(streams_per_s),
+                       "lenS": int(stream_len_s),
+                       "tokens": int(stream_tokens),
+                       "abandonRate": float(abandon_rate)}
+    by_t: Dict[int, list] = {}
+    sid = 0
+    for model in ("model-large", "model-small"):
+        arrivals = rng.poisson(streams_per_s, seconds)
+        for t0 in range(seconds):
+            for _ in range(int(arrivals[t0])):
+                sid += 1
+                stream_id = f"g{sid}"
+                length = max(1, int(stream_len_s))
+                per_tick = max(1, int(np.ceil(stream_tokens / length)))
+                aborts = bool(rng.random() < abandon_rate)
+                # An aborted stream dies after a prefix of its ticks,
+                # leaving the rest of its reservation unreconciled.
+                live_ticks = length if not aborts else \
+                    1 + int(rng.random() * max(1, length - 1))
+                by_t.setdefault(t0, []).append(
+                    {"op": "open", "id": stream_id, "model": model,
+                     "est": int(stream_tokens)})
+                left = int(stream_tokens)
+                end_t = t0
+                for k in range(1, live_ticks + 1):
+                    tk = t0 + k
+                    if tk >= seconds:
+                        break
+                    tok = min(per_tick, left) if k < length else left
+                    by_t.setdefault(tk, []).append(
+                        {"op": "tick", "id": stream_id, "tok": int(tok)})
+                    left -= int(tok)
+                    end_t = tk
+                close_t = min(end_t + 1, seconds - 1)
+                by_t.setdefault(close_t, []).append(
+                    {"op": "abort" if aborts else "close",
+                     "id": stream_id})
+    sec_by_t = {s["t"]: s for s in secs}
+    for t0, events in by_t.items():
+        rec = sec_by_t.get(t0)
+        if rec is None:
+            rec = {"t": t0, "d": {}}
+            sec_by_t[t0] = rec
+        rec["g"] = events
     return Trace(
         epoch_ms=DEFAULT_EPOCH_MS, duration_s=seconds,
-        meta={"scenario": "hetero_cost", "seed": seed,
-              "countClasses": counts,
-              "rtProfile": {
-                  "model-small": {"baseMs": 30, "loadedMs": 250,
-                                  "kneeTps": int(base_tokens * 2)},
-                  "model-large": {"baseMs": 120, "loadedMs": 900,
-                                  "kneeTps": int(base_tokens)}}},
-        resources=["model-small", "model-large"],
-        rules={"flow": [_flow_rule("model-small", limit),
-                        _flow_rule("model-large", limit * 0.6)]},
-        seconds=_seconds_from_demand(demand, counts))
+        meta=meta, resources=[small, large],
+        rules={"tps": [
+            {"model": "model-small", "tokensPerSecond": float(limit),
+             "burstTokens": 0.0, "maxConcurrentStreams": 0},
+            {"model": "model-large",
+             "tokensPerSecond": float(limit * 0.6),
+             "burstTokens": 0.0, "maxConcurrentStreams": 0},
+        ]},
+        seconds=sorted(sec_by_t.values(), key=lambda s: s["t"]))
 
 
 SCENARIOS = {

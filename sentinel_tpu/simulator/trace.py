@@ -38,7 +38,44 @@ TRACE_VERSION = 1
 TRACE_KIND = "sentinel-tpu-trace"
 
 # Rule families a trace may carry, in the converter vocabulary.
-_RULE_FAMILIES = ("flow", "degrade", "param", "system", "authority")
+_RULE_FAMILIES = ("flow", "degrade", "param", "system", "authority", "tps")
+
+# Streaming-reservation ops a trace second's "g" events may carry
+# (ISSUE 17): deterministic stream lifecycles the replay drives through
+# the engine's stream_open/stream_tick/stream_close calls.
+_STREAM_OPS = ("open", "tick", "close", "abort")
+
+
+def _validate_streams(events) -> list:
+    out = []
+    for ev in events or ():
+        op = ev.get("op")
+        if op not in _STREAM_OPS:
+            raise ValueError(f"trace stream event op {op!r} invalid "
+                             f"(one of {_STREAM_OPS})")
+        sid = ev.get("id")
+        if not isinstance(sid, str) or not sid:
+            raise ValueError(f"trace stream event id {sid!r} invalid")
+        clean = {"op": op, "id": sid}
+        if op == "open":
+            model = ev.get("model")
+            if not isinstance(model, str) or not model:
+                raise ValueError(
+                    f"trace stream open {sid!r} needs a model")
+            clean["model"] = model
+            est = int(ev.get("est", 0))
+            if est < 0:
+                raise ValueError(
+                    f"trace stream open {sid!r} estimate {est} < 0")
+            clean["est"] = est
+        elif op == "tick":
+            tok = int(ev.get("tok", 0))
+            if tok < 0:
+                raise ValueError(
+                    f"trace stream tick {sid!r} tokens {tok} < 0")
+            clean["tok"] = tok
+        out.append(clean)
+    return out
 
 
 def _validate_demand(d: Dict) -> Dict[str, list]:
@@ -133,6 +170,10 @@ class Trace:
                     exits[res] = {"rt": rt,
                                   "err": int(cell.get("err", 0))}
                 rec["x"] = exits
+            if sec.get("g"):
+                # Streamed-generation events (ISSUE 17) — preserved
+                # through the round-trip, replayed in list order.
+                rec["g"] = _validate_streams(sec["g"])
             seconds.append(rec)
         seconds.sort(key=lambda s: s["t"])
         stamps = [s["t"] for s in seconds]
@@ -242,6 +283,8 @@ def _rules_snapshot(engine) -> Dict[str, list]:
                    for r in engine.system_rules.get_rules()],
         "authority": [CV.authority_rule_to_dict(r)
                       for r in engine.authority_rules.get_rules()],
+        "tps": [CV.tps_rule_to_dict(r)
+                for r in engine.tps_rules.get_rules()],
     }
 
 

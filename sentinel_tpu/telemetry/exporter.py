@@ -508,6 +508,52 @@ def render_engine_metrics(engine) -> str:
         b.sample("sentinel_tpu_adaptive_target_delta",
                  {"resource": res}, delta)
 
+    # -- LLM admission & streaming reservations (sentinel_tpu/llm/) ------
+    st = engine.streams.stats()
+    b.family("sentinel_tpu_llm_rules", "gauge",
+             "Live TPS rules (per-model token budgets lowered onto the "
+             "flow family)")
+    b.sample("sentinel_tpu_llm_rules", None,
+             len(engine.tps_rules.get_rules()))
+    b.family("sentinel_tpu_llm_streams_active", "gauge",
+             "Streaming reservations currently open in the ledger")
+    b.sample("sentinel_tpu_llm_streams_active", None, st["active"])
+    b.counter("sentinel_tpu_llm_streams_opened",
+              "Streaming reservations admitted since engine start",
+              st["opened"])
+    b.counter("sentinel_tpu_llm_streams_blocked",
+              "Stream opens rejected (window, concurrency cap, or "
+              "ledger capacity)",
+              st["openBlocked"])
+    b.counter("sentinel_tpu_llm_streams_aborted",
+              "Streams closed by abort (the remainder returned as "
+              "expiring credit)",
+              st["aborted"])
+    b.counter("sentinel_tpu_llm_streams_evicted",
+              "Idle streams evicted by the spill-cadence sweep "
+              "(abandoned generations)",
+              st["evicted"])
+    b.counter("sentinel_tpu_llm_tokens_debited",
+              "Tokens debited into TPS windows (reservations + "
+              "overflow ticks)",
+              st["tokensDebited"])
+    b.counter("sentinel_tpu_llm_tokens_streamed",
+              "Actual output tokens reconciled through stream ticks",
+              st["tokensStreamed"])
+    b.counter("sentinel_tpu_llm_tokens_released",
+              "Unconsumed reservation tokens released at "
+              "close/abort/evict",
+              st["tokensReleased"])
+    b.family("sentinel_tpu_llm_reservation_outstanding", "gauge",
+             "Reserved-but-unstreamed tokens across open leases (the "
+             "reconciliation backlog; drains to zero when idle)")
+    b.sample("sentinel_tpu_llm_reservation_outstanding", None,
+             st["outstandingTokens"])
+    b.family("sentinel_tpu_llm_credit_tokens", "gauge",
+             "Released tokens still reusable before their window "
+             "rolls off")
+    b.sample("sentinel_tpu_llm_credit_tokens", None, st["creditTokens"])
+
     # -- trace-replay simulator (sentinel_tpu/simulator/) ----------------
     # Process-wide, not per-engine: the offline lab runs on its own sim
     # engines; this exposition is where its last verdict lands for

@@ -46,6 +46,8 @@ _FAMILIES = {
                   lambda rs: [CV.authority_rule_to_dict(r) for r in rs]),
     "paramFlow": ("param_rules", CV.param_rules_from_json,
                   lambda rs: [CV.param_rule_to_dict(r) for r in rs]),
+    "tps": ("tps_rules", CV.tps_rules_from_json,
+            lambda rs: [CV.tps_rule_to_dict(r) for r in rs]),
 }
 
 

@@ -336,6 +336,20 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_rebalance_frozen",
                  "sentinel_tpu_rebalance_skew"):
         assert name in seen, f"{name} not declared in the exporters"
+    # LLM-admission families (ISSUE 17): declared exactly once (the
+    # dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_llm_rules",
+                 "sentinel_tpu_llm_streams_active",
+                 "sentinel_tpu_llm_streams_opened",
+                 "sentinel_tpu_llm_streams_blocked",
+                 "sentinel_tpu_llm_streams_aborted",
+                 "sentinel_tpu_llm_streams_evicted",
+                 "sentinel_tpu_llm_tokens_debited",
+                 "sentinel_tpu_llm_tokens_streamed",
+                 "sentinel_tpu_llm_tokens_released",
+                 "sentinel_tpu_llm_reservation_outstanding",
+                 "sentinel_tpu_llm_credit_tokens"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -844,6 +858,59 @@ def test_no_wall_clock_in_rebalance():
     assert not offenders, (
         "wall-clock read in rebalance.py (ride the injected clock): "
         + ", ".join(offenders))
+
+
+def test_llm_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.llm.*`` config key must (a) be defined and
+    read ONLY in core/config.py — the rest of the package goes through
+    the ``SentinelConfig`` llm_* accessors — and (b) appear in
+    docs/OPERATIONS.md "LLM admission & streaming reservations", so the
+    runbook can never silently drift from the knobs the code actually
+    reads (same rule shape as the cluster-HA / overload / sim gates)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.llm\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.llm.* literals outside core/config.py "
+        "(use the SentinelConfig llm_* accessors): " + ", ".join(offenders))
+    assert keys, "no llm config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "llm config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_no_wall_clock_in_llm():
+    """The streaming-reservation ledger (sentinel_tpu/llm/) rides the
+    engine timebase only — every public entry point takes ``now_ms``.
+    An ambient wall-clock read would couple credit expiry / idle
+    eviction to the host clock and void both the replay-determinism
+    contract and the numpy differential oracle (tests/test_llm.py).
+    Same rule (and skip logic) as the simulator/chaos gates."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu" / "llm").rglob("*.py")):
+        for lineno, code in _code_lines(path):
+            if pattern.search(code):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in llm code (take now_ms from the engine "
+        "timebase): " + ", ".join(offenders))
 
 
 def test_journal_writes_append_only():

@@ -406,7 +406,14 @@ def test_fuzz_step_matches_serial_oracle(engine, frozen_time, seed, steps):
                 now_ms=now)
 
 
-@pytest.mark.parametrize("seed,steps", [(13, 50), (47, 50), (83, 80)])
+@pytest.mark.parametrize("seed,steps", [
+    (13, 50),
+    # Redundant 50-step seed slow-tier'd (ISSUE 17 tier-1 wall-time
+    # trim): ~19s for the same mixed-count fixpoint regimes as (13, 50);
+    # (83, 80) stays quick for the longer window-roll soak.
+    pytest.param(47, 50, marks=pytest.mark.slow),
+    (83, 80),
+])
 def test_fuzz_mixed_acquire_counts(engine, frozen_time, seed, steps):
     """Per-ENTRY random acquire counts (1-3) — the regime the original
     fuzz excluded. Round 5 made the flow sweep serially exact here via
