@@ -24,7 +24,7 @@ sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
 and the queue-wait vs device-wait split.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_16.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_17.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -878,6 +878,45 @@ def bench_native_token_loopback() -> dict:
         server.stop()
 
 
+def bench_waterfall_probe() -> dict:
+    """ISSUE 18 acceptance: the saturation probe drives the loopback
+    mesh across a (pipeline depth x connection count) grid, and the
+    per-stage latency budget is read back off the engine's waterfall
+    recorder (read->coalesce->queue->dispatch->device->harvest->reply->
+    flush, log2 histograms folded once per second). The committed
+    record is the empirical basis for the regression sentry's
+    per-stage budgets (``DEFAULT_STAGE_BUDGETS_MS``): p99 per stage,
+    rounded up to the next log2 edge."""
+    import sentinel_tpu as st
+    from sentinel_tpu.telemetry.waterfall import saturation_probe
+
+    engine = st.get_engine()  # boots the recorder the servers attach to
+    probe = saturation_probe(depths=(1, 2, 4), conns_grid=(2, 8, 32),
+                             window_s=2.0, settle_s=0.5)
+    engine.slo_refresh()  # seal the trailing second into the fold
+    snap = engine.waterfall.snapshot(limit=0)
+    stages = {
+        f"{lane}.{name}": {
+            "count": row["count"],
+            "p50Ms": row["p50Ms"],
+            "p99Ms": row["p99Ms"],
+        }
+        for lane, per_stage in snap["cumulative"].items()
+        for name, row in per_stage.items() if row["count"]
+    }
+    return {"waterfall_probe": {
+        "grid": probe["grid"],
+        "perDepth": probe["perDepth"],
+        "pipelinedPerConn": probe["pipelinedPerConn"],
+        "windowS": probe["windowS"],
+        "stages": stages,
+        "rtt": snap["rtt"],
+        "reconciliationRelativeError":
+            snap["reconciliation"]["relativeError"],
+        "observedRequests": snap["observedRequests"],
+    }}
+
+
 def bench_wire_mesh() -> dict:
     """ISSUE 11 acceptance: end-to-end wire QPS at mesh concurrency —
     64 pipelined TLV connections through the reactor frontend over real
@@ -1470,7 +1509,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_16.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_17.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1737,7 +1776,8 @@ def main() -> None:
         # failure costs its own row, not the record.
         for section in (bench_llm_admission, bench_degrade_1k,
                         bench_param_cms_100k,
-                        bench_native_token_loopback):
+                        bench_native_token_loopback,
+                        bench_waterfall_probe):
             try:
                 out.update(section())
             except Exception as ex:  # noqa: BLE001

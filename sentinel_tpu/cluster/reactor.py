@@ -113,7 +113,14 @@ class WireReactor:
         self._waker_r: Optional[socket.socket] = None
         self._waker_w: Optional[socket.socket] = None
         self._conns: Dict[int, _Conn] = {}
-        self._staged: List[tuple] = []  # (conn, xid, slot, req, t_arrival)
+        # (conn, xid, slot, req, t_arrival, t_staged)
+        self._staged: List[tuple] = []
+        # Latency-waterfall recorder (ISSUE 18): resolved by the owning
+        # server before it constructs us (engine-attached servers only —
+        # never boots the engine). None => per-request stamp work is
+        # skipped entirely; the A/B dispatch-count guard pins that the
+        # enabled path adds zero device work either way.
+        self._wf = getattr(server.batcher, "waterfall", None)
         self._dirty_lock = threading.Lock()
         self._dirty: set = set()
         self._stop = threading.Event()
@@ -142,6 +149,11 @@ class WireReactor:
     @property
     def bound_port(self) -> int:
         return self._listener.getsockname()[1] if self._listener else 0
+
+    def attach_waterfall(self, recorder) -> None:
+        """Late attach (engine booted after server start): subsequent
+        requests start carrying stage-stamp records."""
+        self._wf = recorder
 
     def start(self) -> "WireReactor":
         import concurrent.futures
@@ -323,13 +335,17 @@ class WireReactor:
         conn.last_active = time.monotonic()
         t_arrival = time.perf_counter()
         shed_retry = self.server.batcher.retry_after_ms
+        wf = self._wf
         for frame in conn.scanner.feed(chunk):
             try:
                 req = codec.decode_request(frame)
             except Exception:  # noqa: BLE001 — garbled frame: drop the conn
                 self._close(conn)
                 return
-            slot = [None]
+            # Slot ring cell: [reply_bytes, waterfall_stamp_record].
+            # _flush keys on [0]; [1] stays None for control frames,
+            # sheds, and stamp-disabled runs.
+            slot = [None, None]
             conn.replies.append(slot)
             if req.msg_type == MSG_FLOW:
                 if self._backlog(conn) > self.outbuf_max:
@@ -358,7 +374,12 @@ class WireReactor:
                     slot[0] = codec.encode_response(
                         req.xid, MSG_FLOW, TokenResultStatus.BAD_REQUEST)
                     continue
-                self._staged.append((conn, req.xid, slot, r, t_arrival))
+                # Waterfall "read" stage boundary: parse+stage done for
+                # THIS frame (per-frame stamp only while capturing).
+                t_staged = time.perf_counter() if wf is not None \
+                    else t_arrival
+                self._staged.append(
+                    (conn, req.xid, slot, r, t_arrival, t_staged))
             elif req.msg_type == MSG_PING and not conn.task_running \
                     and not conn.tasks:
                 # Cheap + ordering-safe inline (no compute work queued).
@@ -439,11 +460,22 @@ class WireReactor:
         results = box.get("results")
         shed_retry = box.get("shed_retry_after_ms")
         t_done = time.perf_counter()
+        # Waterfall stamps (ISSUE 18): admitted groups carry the
+        # batcher's drain/dispatch/device marks; together with the
+        # reactor-side marks they chain gap-free into the 8-stage
+        # record _flush observes. Sheds/fails carry no stamps.
+        wf_stamps = box.get("wfStamps") if self._wf is not None else None
         dirty = set()
         dropped = 0
-        for k, (conn, xid, slot, _req, t_arrival) in enumerate(routing):
+        for k, item in enumerate(routing):
+            conn, xid, slot, _req, t_arrival = item[0], item[1], item[2], \
+                item[3], item[4]
             result = results[k] if results else None
             slot[0] = build_flow_reply(self.server, xid, result, shed_retry)
+            if wf_stamps is not None:
+                ctx = _req[3] if len(_req) > 3 else None
+                slot[1] = (t_arrival, item[5], t_submit, wf_stamps, t_done,
+                           ctx.trace_id if ctx is not None else None)
             if conn.closed:
                 dropped += 1
             else:
@@ -496,16 +528,40 @@ class WireReactor:
 
     def _flush(self, conn: _Conn) -> None:
         """Coalesce the contiguous filled reply prefix into ONE buffer
-        (never a write per request) and push it down the socket."""
+        (never a write per request) and push it down the socket. Slots
+        carrying a waterfall stamp record complete their 8-stage chain
+        here (reply-slot wait ends at the pick, flush ends after the
+        bytes are handed to the socket layer) and land in the recorder."""
+        wf = self._wf
+        t_pick = time.perf_counter() if wf is not None else 0.0
         chunks = []
+        recs = None
         while conn.replies and conn.replies[0][0] is not None:
-            chunks.append(conn.replies.popleft()[0])
+            slot = conn.replies.popleft()
+            chunks.append(slot[0])
+            if slot[1] is not None:
+                if recs is None:
+                    recs = []
+                recs.append(slot[1])
         if chunks:
             data = mutate_reply(b"".join(chunks))
             if data:
                 conn.outq.append(data)
                 conn.out_bytes += len(data)
         self._try_send(conn)
+        if recs and wf is not None:
+            t_sent = time.perf_counter()
+            for (t_arr, t_stg, t_sub, (t_drn, t_dsp, t_dev), t_fill,
+                 trace_id) in recs:
+                wf.observe_wire((
+                    (t_stg - t_arr) * 1e3,   # read: recv -> parse+stage
+                    (t_sub - t_stg) * 1e3,   # coalesce: stage -> submit
+                    (t_drn - t_sub) * 1e3,   # queue: submit -> drain
+                    (t_dsp - t_drn) * 1e3,   # dispatch: drain -> device
+                    (t_dev - t_dsp) * 1e3,   # device: dispatch -> harvest
+                    (t_fill - t_dev) * 1e3,  # harvest: wake -> slot fill
+                    (t_pick - t_fill) * 1e3,  # reply: fill -> flush pick
+                    (t_sent - t_pick) * 1e3), trace_id)  # flush
 
     def _try_send(self, conn: _Conn) -> None:
         while conn.outq:

@@ -16,6 +16,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Optional, Tuple
 
 from sentinel_tpu.cluster import codec
@@ -121,6 +122,12 @@ class _Batcher:
         self.shed_deadline_expired = 0
         self.shed_requests = 0
         self.queue_depth_max = 0
+        # Latency waterfall recorder (ISSUE 18), attached by the owning
+        # server at start when an engine is already up. When set, each
+        # fused batch stamps drain/dispatch/device marks into its
+        # groups' boxes (three perf_counter reads per BATCH — nothing
+        # per request, and nothing at all on the shed path).
+        self.waterfall = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -205,14 +212,17 @@ class _Batcher:
         for _reqs, done, _box, _budget in groups:
             done.set()  # empty box -> handler replies FAIL
 
-    def _complete(self, groups, results) -> None:
+    def _complete(self, groups, results, wf_stamps=None) -> None:
         off = 0
         for reqs, done, box, _budget in groups:
             box["results"] = results[off:off + len(reqs)]
+            if wf_stamps is not None:
+                box["wfStamps"] = wf_stamps
             off += len(reqs)
             done.set()
 
-    def _harvest(self, ticket, groups, n_flat: int) -> None:
+    def _harvest(self, ticket, groups, n_flat: int,
+                 t_drain: float = 0.0, t_dispatch: float = 0.0) -> None:
         """Resolve one in-flight fused batch: the np readback happens
         here, outside the service lock — an async device death fails
         exactly this batch's groups (the drain loop keeps running)."""
@@ -224,12 +234,19 @@ class _Batcher:
             record_log.warn("token batch harvest failed: %r", ex)
             self._fail(groups)
             return
-        self._complete(groups, results)
+        wf = self.waterfall
+        if wf is not None:
+            t_device = time.perf_counter()
+            wf.observe_batch((t_device - t_dispatch) * 1e3, n_flat)
+            self._complete(groups, results, (t_drain, t_dispatch, t_device))
+        else:
+            self._complete(groups, results)
 
     def _run(self):
         from collections import deque
 
-        # In-flight fused batches (ticket, groups, n_flat), oldest first.
+        # In-flight fused batches (ticket, groups, n_flat, t_drain,
+        # t_dispatch), oldest first.
         inflight: "deque" = deque()
         while not self._stop.is_set():
             try:
@@ -238,6 +255,11 @@ class _Batcher:
                 while inflight:  # idle: resolve whatever still rides
                     self._harvest(*inflight.popleft())
                 continue
+            # Waterfall "queue" stage boundary: one drain stamp per
+            # fused batch (groups folded in during the linger below
+            # attribute their residual queue time to "dispatch" — the
+            # stage chain stays gap-free either way, SEMANTICS.md).
+            t_drain = time.perf_counter()
             groups = [first]
             try:
                 faults.fire("cluster.ha.leader.crash")
@@ -299,6 +321,7 @@ class _Batcher:
             if dispatch is None or self.inflight_depth <= 1:
                 # Synchronous drain: services without the dispatch/
                 # harvest split (stubs), or depth pinned to 1.
+                t_dispatch = time.perf_counter()
                 try:
                     results = self.service.request_tokens(padded)[:n_flat]
                 except Exception as ex:  # a poison batch must not kill the loop
@@ -307,7 +330,14 @@ class _Batcher:
                     record_log.warn("token batch failed: %r", ex)
                     self._fail(groups)
                     continue
-                self._complete(groups, results)
+                wf = self.waterfall
+                if wf is not None:
+                    t_device = time.perf_counter()
+                    wf.observe_batch((t_device - t_dispatch) * 1e3, n_flat)
+                    self._complete(groups, results,
+                                   (t_drain, t_dispatch, t_device))
+                else:
+                    self._complete(groups, results)
                 continue
             # Pipelined drain: keep at most inflight_depth fused batches
             # on the device stream. Each dispatch consumes the DONATED
@@ -324,7 +354,8 @@ class _Batcher:
                 record_log.warn("token batch dispatch failed: %r", ex)
                 self._fail(groups)
                 continue
-            inflight.append((ticket, groups, n_flat))
+            inflight.append((ticket, groups, n_flat,
+                             t_drain, time.perf_counter()))
             if self._queue.empty():
                 # Idle queue ⇒ immediate harvest: the no-concurrency
                 # latency floor stays one step, overlap only engages
@@ -776,11 +807,33 @@ class ClusterTokenServer:
             return self._reactor.bound_port
         return self._server.server_address[1] if self._server else self.port
 
+    def waterfall_recorder(self):
+        """The engine's latency-waterfall recorder WITHOUT booting the
+        engine singleton: an explicitly-passed engine wins; otherwise
+        only an ALREADY-booted process engine attaches (constructing a
+        bare token server must stay engine-free). None when there is no
+        engine yet or capture is disabled."""
+        eng = self._engine
+        if eng is None:
+            import sentinel_tpu
+
+            eng = sentinel_tpu._default_engine
+        wf = getattr(eng, "waterfall", None) if eng is not None else None
+        return wf if wf is not None and wf.enabled else None
+
+    def attach_waterfall(self, recorder) -> None:
+        """Late attach (an engine booted after ``start()``): hands the
+        recorder to the batcher and the reactor frontend."""
+        self.batcher.waterfall = recorder
+        if self._reactor is not None:
+            self._reactor.attach_waterfall(recorder)
+
     def start(self) -> "ClusterTokenServer":
         # Bind BEFORE starting the batcher drain thread: a failed bind
         # (EADDRINUSE on a role flip) must leave nothing running — the
         # caller retries, and a leaked drain thread per attempt would
         # accumulate (both frontends bind synchronously here).
+        self.batcher.waterfall = self.waterfall_recorder()
         if self.reactor_enabled:
             from sentinel_tpu.cluster.reactor import WireReactor
 

@@ -150,6 +150,19 @@ WIRE_OUTBUF_MAX_BYTES = "csp.sentinel.wire.outbuf.max.bytes"
 WIRE_READ_CHUNK_BYTES = "csp.sentinel.wire.read.chunk.bytes"
 WIRE_WORKERS = "csp.sentinel.wire.workers"
 WIRE_RLS_BATCHED = "csp.sentinel.wire.rls.batched"
+# Latency waterfall (sentinel_tpu/telemetry/waterfall.py — ISSUE 18).
+# Every key MUST be read through the accessors below and documented in
+# docs/OPERATIONS.md "Latency waterfall & saturation probe" (pinned by
+# test_lint). enabled: per-request stage stamping on the wire path;
+# history.seconds: sealed per-second records retained for the
+# `waterfall` command; exemplar.every: sampling cadence among TRACED
+# requests (outliers are always candidates); sentry.*: the per-stage
+# budget regression sentry riding the SLO burn windows.
+WATERFALL_ENABLED = "csp.sentinel.waterfall.enabled"
+WATERFALL_HISTORY_SECONDS = "csp.sentinel.waterfall.history.seconds"
+WATERFALL_EXEMPLAR_EVERY = "csp.sentinel.waterfall.exemplar.every"
+WATERFALL_SENTRY_ENABLED = "csp.sentinel.waterfall.sentry.enabled"
+WATERFALL_SENTRY_MIN_EVENTS = "csp.sentinel.waterfall.sentry.min.events"
 # Trace-replay simulator (sentinel_tpu/simulator/ — no reference twin:
 # the reference has no offline evaluation story). Every key here MUST be
 # read through the accessors below and documented in docs/OPERATIONS.md
@@ -312,6 +325,14 @@ DEFAULT_WIRE_INFLIGHT_DEPTH = 2
 DEFAULT_WIRE_OUTBUF_MAX_BYTES = 1_048_576
 DEFAULT_WIRE_READ_CHUNK_BYTES = 131_072
 DEFAULT_WIRE_WORKERS = 4
+# Waterfall defaults. 10 minutes of sealed seconds covers the widest
+# sentry burn window (300s) with drill headroom; exemplar cadence 8
+# keeps exemplar work off the common path while a busy second still
+# lands several; 50 events/s floors the sentry the same way burn-rate
+# objectives floor theirs (a trickle can't page).
+DEFAULT_WATERFALL_HISTORY_SECONDS = 600
+DEFAULT_WATERFALL_EXEMPLAR_EVERY = 8
+DEFAULT_WATERFALL_SENTRY_MIN_EVENTS = 50
 # Simulator defaults. One day past epoch 0 keeps simulated stamps far
 # from any plausible wall clock (the replay-honesty canary); 512 keeps
 # the per-second chunking on a mid-ladder width (fewer distinct XLA
@@ -659,6 +680,32 @@ class SentinelConfig:
 
     def wire_rls_batched(self) -> bool:
         return (self.get(WIRE_RLS_BATCHED) or "false").lower() == "true"
+
+    # Waterfall accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.waterfall.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def waterfall_enabled(self) -> bool:
+        return (self.get(WATERFALL_ENABLED) or "true").lower() != "false"
+
+    def waterfall_history_seconds(self) -> int:
+        v = self.get_int(WATERFALL_HISTORY_SECONDS,
+                         DEFAULT_WATERFALL_HISTORY_SECONDS)
+        return v if v > 0 else DEFAULT_WATERFALL_HISTORY_SECONDS
+
+    def waterfall_exemplar_every(self) -> int:
+        v = self.get_int(WATERFALL_EXEMPLAR_EVERY,
+                         DEFAULT_WATERFALL_EXEMPLAR_EVERY)
+        return v if v > 0 else DEFAULT_WATERFALL_EXEMPLAR_EVERY
+
+    def waterfall_sentry_enabled(self) -> bool:
+        return (self.get(WATERFALL_SENTRY_ENABLED)
+                or "true").lower() != "false"
+
+    def waterfall_sentry_min_events(self) -> int:
+        v = self.get_int(WATERFALL_SENTRY_MIN_EVENTS,
+                         DEFAULT_WATERFALL_SENTRY_MIN_EVENTS)
+        return v if v > 0 else DEFAULT_WATERFALL_SENTRY_MIN_EVENTS
 
     # Simulator accessors (the ONLY sanctioned readers of the
     # csp.sentinel.sim.* keys — test_lint forbids reading the literals

@@ -393,6 +393,13 @@ class SentinelEngine:
         from sentinel_tpu.slo.manager import SloManager
 
         self.slo = SloManager(self)
+        # Wire-to-device latency waterfall (ISSUE 18): per-stage log2
+        # histograms over perf_counter stage deltas, sealed once per
+        # second by _spill_flight's fold. Constructed AFTER slo — its
+        # regression sentry fires through slo.external_transition.
+        from sentinel_tpu.telemetry.waterfall import WaterfallRecorder
+
+        self.waterfall = WaterfallRecorder(self)
         # Closed-loop adaptive limiting (sentinel_tpu/adaptive/): the
         # acting half of the loop the SLO engine senses for. Constructed
         # AFTER rollout (it registers a lifecycle listener) and slo (its
@@ -532,6 +539,9 @@ class SentinelEngine:
         rebalancer = getattr(self, "rebalancer", None)
         if rebalancer is not None:
             rebalancer.reset_timebase()
+        waterfall = getattr(self, "waterfall", None)
+        if waterfall is not None:
+            waterfall.reset_timebase()
         # Audit the swap itself — stamped with the NEW timebase (the
         # old one no longer exists to stamp with). seq stays monotone
         # across the swap even though timestamps may step backward;
@@ -2174,6 +2184,13 @@ class SentinelEngine:
         # on EVERY spill (even with no fresh seconds: idle decay must
         # resolve alerts without requiring new traffic).
         self.slo.evaluate(now)
+        # The latency waterfall seals its staged seconds on the same
+        # fold (AFTER slo.evaluate: its sentry transitions land in the
+        # freshly-evaluated store). getattr for the same construction-
+        # order reachability reason as adaptive below.
+        waterfall = getattr(self, "waterfall", None)
+        if waterfall is not None:
+            waterfall.roll(now)
         # The adaptive loop rides the same cadence, AFTER judgement is
         # current (its freeze gate and proposal alert-gate read it).
         # Interval-gated + reentry-safe inside; getattr: _spill_flight

@@ -390,6 +390,13 @@ class Pipeline:
             depth=len(self._inflight) + 1,
             queue_wait_ms=rec.queue_wait_ms,
             device_wait_ms=device_wait_ms)
+        # The same split feeds the unified latency waterfall (ISSUE 18):
+        # pipeline queue/device waits share the wire stages' log2
+        # geometry and exporter family instead of a parallel one-off
+        # pair. getattr: harvest is reachable during engine construction.
+        waterfall = getattr(self.engine, "waterfall", None)
+        if waterfall is not None:
+            waterfall.observe_pipeline(rec.queue_wait_ms, device_wait_ms)
         for kind, buf in rec.bufs:
             self.pool.release(kind, buf)
 

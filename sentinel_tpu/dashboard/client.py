@@ -180,6 +180,14 @@ class SentinelApiClient:
         return json.loads(self.get(ip, port, "rebalance",
                                    {"op": op, **(params or {})}))
 
+    def fetch_waterfall(self, ip: str, port: int,
+                        params: Optional[Dict] = None) -> Dict:
+        """Wire-to-device latency waterfall (``waterfall`` command,
+        op=status): per-stage cumulative budget, RTT reconciliation,
+        exemplars and the regression sentry's alert state."""
+        return json.loads(self.get(ip, port, "waterfall",
+                                   {"op": "status", **(params or {})}))
+
     def fetch_journal(self, ip: str, port: int,
                       params: Optional[Dict] = None) -> Dict:
         """Audit-journal tail (``journal`` command): seq-cursored

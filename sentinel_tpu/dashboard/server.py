@@ -44,6 +44,9 @@ set as a small JSON API plus one static page:
     policy-lab report / scenario catalog (proxies the ``sim`` command)
   * ``GET  /rebalance.json?app=``             shard rebalancer: freeze state,
     plan history (op=status) or slice-load fold (op=sense)
+  * ``GET  /waterfall.json?app=``             wire-to-device latency
+    waterfall: per-stage budget, RTT reconciliation, exemplars + sentry
+    (proxies the machines' ``waterfall`` command, op=status)
   * ``GET  /fleet.json?app=``                 fleet observability: federated
     per-leader staleness/skew/health + exact fleet series (proxies the
     machines' ``fleet`` command; ``op=series`` for the per-second sums,
@@ -288,6 +291,16 @@ class DashboardServer:
             raise ValueError(f"unsupported rebalance op {op!r}")
         m = self._first_healthy(app)
         return self.api.fetch_rebalance(m.ip, m.port, op=op,
+                                        params=params or {})
+
+    def get_waterfall(self, app: str,
+                      params: Optional[Dict[str, str]] = None):
+        """Latency-waterfall read path (``waterfall`` command,
+        op=status) from the first healthy machine — the Waterfall
+        panel's source. Read-only: budget overrides and saturation
+        probes go through the machines' command plane directly."""
+        m = self._first_healthy(app)
+        return self.api.fetch_waterfall(m.ip, m.port,
                                         params=params or {})
 
     def get_sim(self, app: str, op: str = "report"):
@@ -564,6 +577,10 @@ class _Handler(BaseHTTPRequestHandler):
                 params = {k: v for k, v in q.items()
                           if k not in ("app", "op")}
                 return self._ok(d.get_rebalance(q.get("app", ""), op=op,
+                                                params=params))
+            if path == "/waterfall.json":
+                params = {k: v for k, v in q.items() if k != "app"}
+                return self._ok(d.get_waterfall(q.get("app", ""),
                                                 params=params))
             if path == "/alerts.json":
                 m = d._first_healthy(q.get("app", ""))

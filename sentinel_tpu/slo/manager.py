@@ -314,6 +314,18 @@ class SloManager:
 
     # -- alert state machine -----------------------------------------------
 
+    def external_transition(self, key: str, firing: bool, now_ms: int,
+                            fields: Dict) -> None:
+        """Public fire/refresh/resolve seam for sibling evaluators (the
+        waterfall regression sentry, ISSUE 18): alerts they judge land in
+        the SAME store, transition log, journal mirror, and webhook as
+        burn-rate rules — a wire-path budget breach pages exactly like an
+        availability breach. ``fields`` must carry the burn-alert keys
+        the read surfaces index (``key``/``kind``/``severity``/
+        ``resource``)."""
+        with self._lock:
+            self._transition(key, firing, int(now_ms), fields)
+
     def _transition(self, key: str, firing: bool, now_ms: int,
                     fields: Dict) -> None:
         """Caller holds the lock. Fire/refresh/resolve one alert key;

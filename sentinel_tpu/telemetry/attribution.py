@@ -157,13 +157,37 @@ def rt_bucket_index(rt_ms: jax.Array) -> jax.Array:
     return jnp.sum(rt_ms[:, None] > _EDGES[None, :], axis=1).astype(jnp.int32)
 
 
-def histogram_quantile(counts: Sequence[float], q: float) -> float:
-    """Estimate the q-quantile (0..1) from per-bucket counts.
+# ---------------------------------------------------------------------------
+# Waterfall geometry (ISSUE 18): the wire-to-device stage histograms need
+# sub-millisecond resolution (a reactor parse runs tens of microseconds)
+# while sharing the log2 ladder and +Inf overflow convention above. One
+# family, one geometry: pipeline queue/device waits and every wire stage
+# bucket into THIS ladder, 2^-6 ms (15.6us) .. 2^12 ms (4096ms).
+# ---------------------------------------------------------------------------
 
-    ``counts`` is indexed like :data:`RT_BUCKET_EDGES_MS` plus the
-    overflow bucket. Linear interpolation within the winning bucket
-    (Prometheus ``histogram_quantile`` convention); the overflow bucket
-    reports its lower edge. Returns 0.0 on an empty histogram.
+WF_BUCKET_EDGES_MS: Tuple[float, ...] = tuple(
+    float(2.0 ** k) for k in range(-6, 13))
+NUM_WF_BUCKETS = len(WF_BUCKET_EDGES_MS) + 1  # + overflow (+Inf)
+
+
+def bucket_index_of(value_ms: float,
+                    edges: Sequence[float] = WF_BUCKET_EDGES_MS) -> int:
+    """Host-side bucket index for one observation (``le`` semantics:
+    bucket b holds ``value <= edge_b``; past the last edge -> overflow)."""
+    for b, edge in enumerate(edges):
+        if value_ms <= edge:
+            return b
+    return len(edges)
+
+
+def histogram_quantile_edges(counts: Sequence[float], q: float,
+                             edges: Sequence[float]) -> float:
+    """Estimate the q-quantile (0..1) from per-bucket counts over an
+    arbitrary edge ladder (``counts`` = len(edges) buckets + overflow).
+
+    Linear interpolation within the winning bucket (Prometheus
+    ``histogram_quantile`` convention); the overflow bucket reports its
+    lower edge. Returns 0.0 on an empty histogram.
     """
     total = float(sum(counts))
     if total <= 0:
@@ -174,9 +198,19 @@ def histogram_quantile(counts: Sequence[float], q: float) -> float:
         prev = cum
         cum += float(cnt)
         if cum >= target and cnt > 0:
-            if b >= len(RT_BUCKET_EDGES_MS):  # overflow: no upper edge
-                return float(RT_BUCKET_EDGES_MS[-1])
-            lo = 0.0 if b == 0 else float(RT_BUCKET_EDGES_MS[b - 1])
-            hi = float(RT_BUCKET_EDGES_MS[b])
+            if b >= len(edges):  # overflow: no upper edge
+                return float(edges[-1])
+            lo = 0.0 if b == 0 else float(edges[b - 1])
+            hi = float(edges[b])
             return lo + (hi - lo) * (target - prev) / float(cnt)
-    return float(RT_BUCKET_EDGES_MS[-1])
+    return float(edges[-1])
+
+
+def histogram_quantile(counts: Sequence[float], q: float) -> float:
+    """Estimate the q-quantile (0..1) from per-bucket counts.
+
+    ``counts`` is indexed like :data:`RT_BUCKET_EDGES_MS` plus the
+    overflow bucket (the device RT geometry). Delegates to
+    :func:`histogram_quantile_edges`.
+    """
+    return histogram_quantile_edges(counts, q, RT_BUCKET_EDGES_MS)

@@ -372,6 +372,72 @@ def render_engine_metrics(engine) -> str:
                     [float(e) for e in STEP_DURATION_EDGES_MS],
                     [float(x) for x in row["buckets"]], row["sumMs"])
 
+    # -- latency waterfall (telemetry/waterfall.py — ISSUE 18) -------------
+    # Per-stage wire/pipeline latency on the shared log2 ladder
+    # (cumulative since engine start), the end-to-end RTT histogram with
+    # OpenMetrics exemplars joining slow buckets to stitched trace ids,
+    # the last sealed second's derived queueing gauges (-1 = no sealed
+    # second yet, the exporter's absent convention), and the regression
+    # sentry's committed stage budgets.
+    wf = getattr(engine, "waterfall", None)
+    if wf is not None:
+        from sentinel_tpu.telemetry.attribution import WF_BUCKET_EDGES_MS
+
+        wstate = wf.export_state()
+        wf_edges = [float(e) for e in WF_BUCKET_EDGES_MS]
+        b.family("sentinel_tpu_waterfall_stage_ms", "histogram",
+                 "Per-stage wire/pipeline latency (ms, shared log2 "
+                 "buckets, cumulative since engine start)")
+        for lane in sorted(wstate["hist"]):
+            for stage, (buckets, total) in wstate["hist"][lane].items():
+                b.histogram("sentinel_tpu_waterfall_stage_ms",
+                            {"lane": lane, "stage": stage}, wf_edges,
+                            [float(x) for x in buckets], total)
+        rtt_buckets, rtt_sum = wstate["rtt"]
+        wf_exemplars = {
+            bi: ({"trace_id": ex["traceId"]}, ex["valueMs"],
+                 ex["timestampMs"] / 1000.0)
+            for bi, ex in wstate["rttExemplars"].items()}
+        b.family("sentinel_tpu_waterfall_rtt_ms", "histogram",
+                 "End-to-end wire RTT, arrival to flush (ms, log2 "
+                 "buckets) with trace-id exemplars on sampled slow "
+                 "requests")
+        b.histogram("sentinel_tpu_waterfall_rtt_ms", {}, wf_edges,
+                    [float(x) for x in rtt_buckets], rtt_sum,
+                    exemplars=wf_exemplars)
+        last_wf = wstate["last"]
+        b.family("sentinel_tpu_waterfall_stage_concurrency", "gauge",
+                 "Little's-law inferred in-stage concurrency over the "
+                 "last sealed second, per lane/stage")
+        if last_wf is not None:
+            for lane, stages in sorted(last_wf["lanes"].items()):
+                for stage, cell in stages.items():
+                    b.sample("sentinel_tpu_waterfall_stage_concurrency",
+                             {"lane": lane, "stage": stage},
+                             cell["concurrency"])
+        b.family("sentinel_tpu_waterfall_device_utilization", "gauge",
+                 "Fused-batch device busy fraction of the last sealed "
+                 "second (-1 = none sealed yet)")
+        b.sample("sentinel_tpu_waterfall_device_utilization", None,
+                 last_wf["deviceUtilization"] if last_wf is not None else -1)
+        b.family("sentinel_tpu_waterfall_coalesce_efficiency", "gauge",
+                 "Requests per fused batch in the last sealed second "
+                 "(-1 = none sealed yet)")
+        b.sample("sentinel_tpu_waterfall_coalesce_efficiency", None,
+                 last_wf["coalesce"]["efficiency"]
+                 if last_wf is not None else -1)
+        b.counter("sentinel_tpu_waterfall_seconds",
+                  "Sealed waterfall seconds", wstate["sealedSeconds"])
+        b.counter("sentinel_tpu_waterfall_exemplars",
+                  "Exemplars captured from traced slow requests",
+                  wstate["exemplarsCaptured"])
+        b.family("sentinel_tpu_waterfall_budget_ms", "gauge",
+                 "Committed per-stage latency budget the regression "
+                 "sentry burns against (ms)")
+        for key, budget in sorted(wstate["budgetsMs"].items()):
+            b.sample("sentinel_tpu_waterfall_budget_ms", {"stage": key},
+                     budget)
+
     # -- flight recorder (per-second series) ------------------------------
     # The LAST complete second per resource as gauges: scrapers that
     # cannot ingest the `timeseries` command still get a per-second

@@ -72,21 +72,41 @@ class OpenMetricsBuilder:
 
     def histogram(self, name: str, labels: Dict[str, str],
                   edges: Sequence[float], bucket_counts: Sequence[float],
-                  total_sum: float) -> None:
+                  total_sum: float,
+                  exemplars: Optional[Dict[int, Tuple[Dict[str, str],
+                                                      float,
+                                                      Optional[float]]]]
+                  = None) -> None:
         """Histogram samples for ONE label set of an already-declared
         family: per-bucket counts (same indexing as ``edges`` plus one
         overflow) render as cumulative ``le`` buckets + ``+Inf`` +
-        ``_count`` / ``_sum``."""
+        ``_count`` / ``_sum``.
+
+        ``exemplars`` (OpenMetrics 1.0): bucket index -> (labelset,
+        observed value, optional unix timestamp in SECONDS); renders as
+        the ``# {trace_id="..."} value ts`` suffix on that bucket line —
+        the waterfall's latency-bucket -> stitched-trace join."""
         cum = 0.0
-        for edge, cnt in zip(edges, bucket_counts):
+        for b, (edge, cnt) in enumerate(zip(edges, bucket_counts)):
             cum += float(cnt)
-            self.sample(name + "_bucket", {**labels, "le": _fmt_value(edge)},
-                        cum)
+            self._bucket_line(name, {**labels, "le": _fmt_value(edge)},
+                              cum, exemplars.get(b) if exemplars else None)
         cum += float(bucket_counts[len(edges)]) \
             if len(bucket_counts) > len(edges) else 0.0
-        self.sample(name + "_bucket", {**labels, "le": "+Inf"}, cum)
+        self._bucket_line(name, {**labels, "le": "+Inf"}, cum,
+                          exemplars.get(len(edges)) if exemplars else None)
         self.sample(name + "_count", labels, cum)
         self.sample(name + "_sum", labels, total_sum)
+
+    def _bucket_line(self, name: str, labels: Dict[str, str], value,
+                     exemplar) -> None:
+        line = f"{name}_bucket{_labels(labels)} {_fmt_value(value)}"
+        if exemplar is not None:
+            ex_labels, ex_value, ex_ts = exemplar
+            line += f" # {_labels(ex_labels)} {_fmt_value(ex_value)}"
+            if ex_ts is not None:
+                line += f" {_fmt_value(ex_ts)}"
+        self._lines.append(line)
 
     def render(self) -> str:
         return "\n".join(self._lines + ["# EOF", ""])
@@ -106,6 +126,9 @@ def parse_families(text: str) -> Dict[str, List[Tuple[str, Dict, float]]]:
             continue
         if not line or line.startswith("#"):
             continue
+        # Strip any exemplar suffix (``... # {labels} value ts``) —
+        # this fallback reads sample values, not exemplars.
+        line = line.split(" # ", 1)[0]
         head, _, val = line.rpartition(" ")
         labels: Dict[str, str] = {}
         name = head

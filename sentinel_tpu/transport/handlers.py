@@ -445,6 +445,65 @@ def cmd_slo(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(str(ex))
 
 
+@command_mapping("waterfall", "wire-to-device latency waterfall: per-stage "
+                              "budget, exemplars, sentry, saturation probe")
+def cmd_waterfall(req: CommandRequest) -> CommandResponse:
+    """The latency waterfall's control + status plane
+    (sentinel_tpu/telemetry/waterfall.py — ISSUE 18). ``op`` selects:
+
+      * ``status`` (default) — cumulative + recent per-second per-stage
+        budgets, RTT reconciliation, exemplars, and the regression
+        sentry's burn snapshot (refreshes judgement first so staged
+        seconds seal); ``limit=`` caps the recent seconds returned
+      * ``budgets`` — merge sentry budget overrides: JSON object in
+        ``data=``/body mapping ``lane.stage`` to ms (<= 0 removes a
+        budget); journaled as a config action
+      * ``saturate`` — run the loopback saturation probe inline across
+        a (depth x connections) grid: ``depths=``/``conns=`` comma
+        lists, ``windowS=`` per-cell window (grid capped at 6 cells,
+        window at 2s — the BENCH phase runs the full grid)
+    """
+    waterfall = getattr(req.engine, "waterfall", None)
+    if waterfall is None:
+        return CommandResponse.of_failure("waterfall recorder unavailable")
+    op = req.get_param("op", "status")
+    try:
+        if op == "status":
+            req.engine.slo_refresh()
+            limit = int(req.get_param("limit", "60"))
+            return CommandResponse.of_success(waterfall.snapshot(limit=limit))
+        if op == "budgets":
+            import json as _json
+
+            from sentinel_tpu.telemetry.journal import acting
+
+            data = req.get_param("data") or req.body
+            overrides = _json.loads(data or "{}")
+            if not isinstance(overrides, dict):
+                return CommandResponse.of_failure(
+                    "budgets payload must be a JSON object")
+            with acting("ops:waterfallBudgets"):
+                budgets = waterfall.sentry.set_budgets(overrides)
+                req.engine.journal.record("waterfallBudgets",
+                                          budgets=dict(budgets))
+            return CommandResponse.of_success({"budgetsMs": budgets})
+        if op == "saturate":
+            from sentinel_tpu.telemetry.waterfall import saturation_probe
+
+            depths = [int(x) for x in
+                      (req.get_param("depths") or "1,2").split(",") if x]
+            conns = [int(x) for x in
+                     (req.get_param("conns") or "2,8").split(",") if x]
+            window_s = min(2.0, float(req.get_param("windowS", "1.0")))
+            out = saturation_probe(depths=depths, conns_grid=conns,
+                                   window_s=window_s, settle_s=0.5,
+                                   max_cells=6)
+            return CommandResponse.of_success(out)
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("adaptive", "closed-loop adaptive limiting: status, "
                              "enable/freeze, targets, decision log")
 def cmd_adaptive(req: CommandRequest) -> CommandResponse:

@@ -21,7 +21,12 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 SELF_TERMINATING = [
-    "flow_qps_demo.py",
+    # Redundant subprocess smoke slow-tier'd (ISSUE 18 tier-1 wall-time
+    # trim, ~15s): the demo's exact admission scenario is pinned
+    # in-process by tests/test_flow.py::test_flow_qps_demo_golden, so
+    # the subprocess run only re-verifies interpreter startup; the full
+    # demo sweep still runs with -m slow.
+    pytest.param("flow_qps_demo.py", marks=pytest.mark.slow),
     "warm_up_demo.py",
     "degrade_demo.py",
     "param_flow_demo.py",

@@ -350,6 +350,17 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_llm_reservation_outstanding",
                  "sentinel_tpu_llm_credit_tokens"):
         assert name in seen, f"{name} not declared in the exporters"
+    # latency-waterfall families (ISSUE 18): declared exactly once (the
+    # dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_waterfall_stage_ms",
+                 "sentinel_tpu_waterfall_rtt_ms",
+                 "sentinel_tpu_waterfall_stage_concurrency",
+                 "sentinel_tpu_waterfall_device_utilization",
+                 "sentinel_tpu_waterfall_coalesce_efficiency",
+                 "sentinel_tpu_waterfall_seconds",
+                 "sentinel_tpu_waterfall_exemplars",
+                 "sentinel_tpu_waterfall_budget_ms"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -778,6 +789,61 @@ def test_no_wall_clock_in_journal_and_fleet():
     assert not offenders, (
         "wall-clock read in journal/fleet code (ride the injected "
         "engine clock): " + ", ".join(offenders))
+
+
+def test_waterfall_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.waterfall.*`` config key must (a) be
+    defined and read ONLY in core/config.py — the rest of the package
+    goes through the ``SentinelConfig`` ``waterfall_*`` accessors — and
+    (b) appear in docs/OPERATIONS.md "Latency waterfall & saturation
+    probe", so the runbook can never silently drift from the knobs the
+    code actually reads (same rule shape as the journal/fleet gate)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.waterfall\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.waterfall.* literals outside core/config.py (use "
+        "the SentinelConfig waterfall_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no waterfall config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "waterfall config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_no_wall_clock_in_waterfall():
+    """The waterfall recorder must ride the ENGINE timebase only: its
+    per-second staging cells are what the simulator-inertness contract
+    (ISSUE 13) seals, and an ambient wall-clock read would stamp them
+    with a second clock. ``time.perf_counter`` stays sanctioned — it is
+    the module's DURATION source (stage deltas, probe windows), never a
+    timestamp. Same rule shape as the journal/fleet gate."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    path = REPO / "sentinel_tpu" / "telemetry" / "waterfall.py"
+    offenders = []
+    for lineno, code in _code_lines(path):
+        if pattern.search(code):
+            offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in the waterfall recorder (ride the injected "
+        "engine clock; perf_counter is for durations only): "
+        + ", ".join(offenders))
 
 
 def test_rebalance_config_keys_accessor_only_and_documented():

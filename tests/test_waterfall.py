@@ -1,0 +1,446 @@
+"""Latency-waterfall tests (ISSUE 18): per-second fold exactness against
+an independent oracle, the O(1) log2 bucketer vs the linear-scan oracle,
+exemplar -> stitched-span joins (unit + real loopback sockets), the
+regression sentry's fire/resolve cycle (stubbed sink + the real
+SloManager path), the A/B zero-device-work guard, timebase-reset
+inertness under injected clocks (ISSUE 13), and the ops command."""
+
+import socket
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import codec
+from sentinel_tpu.cluster.constants import MSG_FLOW, TokenResultStatus
+from sentinel_tpu.cluster.rules import ClusterFlowRuleManager
+from sentinel_tpu.cluster.server import ClusterTokenServer
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.telemetry.attribution import (
+    NUM_WF_BUCKETS,
+    WF_BUCKET_EDGES_MS,
+    bucket_index_of,
+    histogram_quantile_edges,
+)
+from sentinel_tpu.telemetry.spans import new_trace_context
+from sentinel_tpu.telemetry.waterfall import (
+    LANE_STAGES,
+    WIRE_STAGES,
+    WaterfallRecorder,
+    _fast_bucket,
+)
+from sentinel_tpu.utils import time_util
+
+BASE_MS = 1_700_000_100_000
+FLOW_ID = 8400
+
+
+# -- bucket geometry ----------------------------------------------------------
+
+
+def test_fast_bucket_matches_linear_oracle():
+    """Differential: the O(1) ceil-log2 bucketer == the linear ``le``
+    scan on every edge (exactly, one ulp above, one below) and on a
+    dense random sweep across the whole range plus both overflows."""
+    rng = np.random.default_rng(7)
+    probes = [0.0, -1.0, 1e-9, 1e9]
+    for e in WF_BUCKET_EDGES_MS:
+        probes += [e, np.nextafter(e, 0), np.nextafter(e, np.inf)]
+    probes += list(rng.uniform(0.0, WF_BUCKET_EDGES_MS[-1] * 4, 20_000))
+    probes += list(np.exp(rng.uniform(np.log(1e-4), np.log(1e5), 20_000)))
+    for v in probes:
+        v = float(v)
+        assert _fast_bucket(v) == bucket_index_of(v), v
+
+
+# -- per-second fold exactness ------------------------------------------------
+
+
+def _scripted_stream(seed, n_secs, max_rps):
+    """Deterministic observation stream: [(sec_ms, kind, payload)]."""
+    rng = np.random.default_rng(seed)
+    events = []
+    for si in range(n_secs):
+        sec = BASE_MS + si * 1000
+        for _ in range(int(rng.integers(1, max_rps + 1))):
+            durs = np.exp(rng.uniform(np.log(1e-3), np.log(500.0), 8))
+            if rng.random() < 0.05:
+                durs[int(rng.integers(0, 8))] = -1.0  # clamp path
+            events.append((sec, "wire", [float(d) for d in durs]))
+        for _ in range(int(rng.integers(0, max_rps // 2 + 1))):
+            events.append((sec, "pipeline",
+                           [float(np.exp(rng.uniform(-5, 5))),
+                            float(np.exp(rng.uniform(-5, 5)))]))
+    return events
+
+
+def _oracle_fold(events):
+    """Independent fold: per-second per-stage bucket counts + sums via
+    the linear-scan bucketer, same clamp convention."""
+    per_sec = {}
+    for sec, kind, durs in events:
+        rec = per_sec.setdefault(sec, {
+            lane: ([[0] * NUM_WF_BUCKETS for _ in stages],
+                   [0.0] * len(stages))
+            for lane, stages in LANE_STAGES.items()})
+        rec.setdefault("rtt", None)
+        lane = "wire" if kind == "wire" else "pipeline"
+        counts, sums = rec[lane]
+        total = 0.0
+        for i, d in enumerate(durs):
+            d = d if d > 0.0 else 0.0
+            counts[i][bucket_index_of(d)] += 1
+            sums[i] += d
+            total += d
+        if kind == "wire":
+            rtt = rec.get("rtt") or ([0] * NUM_WF_BUCKETS, [0.0])
+            rtt[0][bucket_index_of(total)] += 1
+            rtt[1][0] += total
+            rec["rtt"] = rtt
+    return per_sec
+
+
+@pytest.mark.parametrize("seed,n_secs,max_rps", [
+    (5, 20, 40),
+    pytest.param(29, 120, 200, marks=pytest.mark.slow),
+    pytest.param(83, 120, 200, marks=pytest.mark.slow),
+])
+def test_fold_matches_oracle(seed, n_secs, max_rps):
+    """The recorder's sealed seconds are EXACT: bucket counts, stage
+    sums, RTT histogram, quantiles, and the stage-sum == RTT-sum
+    reconciliation all match an independent oracle fold."""
+    clock = {"now": BASE_MS}
+    wf = WaterfallRecorder(now_ms=lambda: clock["now"])
+    assert wf.enabled
+    events = _scripted_stream(seed, n_secs, max_rps)
+    for sec, kind, durs in events:
+        clock["now"] = sec + 137  # mid-second stamp
+        if kind == "wire":
+            wf.observe_wire(durs)
+        else:
+            wf.observe_pipeline(durs[0], durs[1])
+        if sec > BASE_MS:  # interleave folds with writes: idempotent
+            wf.roll(sec)
+    clock["now"] = BASE_MS + (n_secs + 1) * 1000
+    wf.roll(clock["now"])
+
+    oracle = _oracle_fold(events)
+    snap = wf.snapshot(limit=n_secs + 5)
+    recent = {r["timestamp"]: r for r in snap["recent"]}
+    assert set(recent) == set(oracle)
+    assert snap["stagedSeconds"] == 0
+    for sec, orec in oracle.items():
+        rec = recent[sec]
+        for lane, stages in LANE_STAGES.items():
+            counts, sums = orec[lane]
+            if not any(sum(row) for row in counts):
+                assert lane not in rec["lanes"]
+                continue
+            for i, name in enumerate(stages):
+                cell = rec["lanes"][lane][name]
+                assert cell["buckets"] == counts[i], (sec, lane, name)
+                assert cell["count"] == sum(counts[i])
+                assert cell["sumMs"] == round(sums[i], 4)
+                assert cell["p50Ms"] == round(histogram_quantile_edges(
+                    counts[i], 0.5, WF_BUCKET_EDGES_MS), 4)
+                assert cell["p99Ms"] == round(histogram_quantile_edges(
+                    counts[i], 0.99, WF_BUCKET_EDGES_MS), 4)
+                assert cell["concurrency"] == round(sums[i] / 1000.0, 4)
+        rtt = orec["rtt"]
+        assert rec["rtt"]["buckets"] == rtt[0]
+        assert rec["rtt"]["count"] == sum(rtt[0])
+        assert rec["rtt"]["sumMs"] == round(rtt[1][0], 4)
+    # Cumulative == sum over sealed seconds; the eight wire stages
+    # telescope, so their summed time IS the summed RTT (float fuzz
+    # only — different addition order).
+    n_wire = sum(1 for _, k, _ in events if k == "wire")
+    assert snap["observedRequests"] == n_wire
+    assert snap["rtt"]["count"] == n_wire
+    assert snap["reconciliation"]["relativeError"] <= 1e-9
+
+
+def test_late_observation_after_seal_is_dropped_not_misfiled():
+    """An observation stamped into an already-sealed second increments
+    ``lateDrops`` and never lands in cumulative (exactness guarantee:
+    sealed histograms are immutable)."""
+    clock = {"now": BASE_MS}
+    wf = WaterfallRecorder(now_ms=lambda: clock["now"])
+    wf.observe_wire([1.0] * 8)
+    wf.roll(BASE_MS + 2000)
+    before = wf.snapshot()["rtt"]["count"]
+    clock["now"] = BASE_MS  # stale stamp, second already sealed
+    wf.observe_wire([1.0] * 8)
+    wf.roll(BASE_MS + 3000)
+    snap = wf.snapshot()
+    assert snap["lateDrops"] == 1
+    assert snap["rtt"]["count"] == before
+
+
+# -- exemplars ----------------------------------------------------------------
+
+
+def test_exemplar_retention_slowest_and_cadence():
+    """Traced requests emit exemplars: the per-second slowest always
+    qualifies, the bounded set keeps the slowest, and the cumulative
+    per-RTT-bucket map retains the latest per bucket."""
+    clock = {"now": BASE_MS}
+    wf = WaterfallRecorder(now_ms=lambda: clock["now"])
+    for i in range(10):
+        durs = [0.0] * 7 + [float(i + 1)]  # RTT = 1..10ms
+        wf.observe_wire(durs, trace_id=f"{i:032x}")
+    wf.observe_wire([100.0] * 8)  # untraced: never an exemplar
+    wf.roll(BASE_MS + 2000)
+    snap = wf.snapshot()
+    assert 0 < snap["exemplarsCaptured"] <= 4
+    assert snap["exemplars"], "no exemplar retained"
+    got = {ex["traceId"] for ex in snap["exemplars"]}
+    assert f"{9:032x}" in got  # the slowest traced request
+    for ex in snap["exemplars"]:
+        assert ex["bucket"] == bucket_index_of(ex["valueMs"])
+        assert ex["timestampMs"] == BASE_MS
+
+
+def test_exemplar_joins_stitched_span_over_loopback():
+    """End to end over real sockets: traced wire requests produce RTT
+    exemplars whose trace ids resolve to the server span collector's
+    stitched traces — the exemplar is a forensic pointer INTO the span
+    store, not a free-floating id. Also pins the acceptance
+    reconciliation: stage sums == summed RTT for the run."""
+    rules = ClusterFlowRuleManager()
+    rules.load_rules("default", [st.FlowRule(
+        resource="wf-join", count=1e9, cluster_mode=True,
+        cluster_config={"flowId": FLOW_ID, "thresholdType": 1})])
+    svc = DefaultTokenService(rules)
+    svc.request_tokens([(FLOW_ID, 1, False)] * 4)  # absorb compiles
+    server = ClusterTokenServer(svc, host="127.0.0.1", port=0).start()
+    wf = WaterfallRecorder()  # perf_counter-derived ms timebase
+    server.attach_waterfall(wf)
+    n = 24
+    ctxs = [new_trace_context() for _ in range(n)]
+    try:
+        with socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=10) as sock:
+            sock.settimeout(10)
+            for xid, ctx in enumerate(ctxs, start=1):
+                body = codec.encode_flow_request(FLOW_ID, 1, False)
+                body = codec.append_trace_tlv(body, ctx.traceparent())
+                sock.sendall(codec.encode_request(xid, MSG_FLOW, body))
+            reader = codec.FrameReader()
+            got = []
+            while len(got) < n:
+                data = sock.recv(65536)
+                assert data, "server closed early"
+                got += [codec.decode_response(b) for b in reader.feed(data)]
+        assert all(r.status == TokenResultStatus.OK for r in got)
+    finally:
+        server.stop()
+    wf.roll(wf._now_ms() + 2000)  # seal everything observed
+    snap = wf.snapshot()
+    assert snap["observedRequests"] == n
+    assert snap["reconciliation"]["relativeError"] <= 1e-6
+    assert snap["exemplars"], "traced requests produced no exemplar"
+    trace_ids = {t["traceId"] for t in svc.spans.traces()}
+    assert {c.trace_id for c in ctxs} == trace_ids
+    for ex in snap["exemplars"]:
+        assert ex["traceId"] in trace_ids, "exemplar lost its span join"
+
+
+# -- regression sentry --------------------------------------------------------
+
+
+def _sentry_feed(wf, clock, secs, device_ms, per_sec=60):
+    for _ in range(secs):
+        for _ in range(per_sec):
+            wf.observe_wire([0.1, 0.1, 0.1, 0.1, device_ms, 0.1, 0.1, 0.1])
+        clock["now"] += 1000
+        wf.roll(clock["now"])
+
+
+def test_sentry_fires_on_breach_and_resolves_on_recovery():
+    """Scripted breach: a sustained wire.device budget breach fires the
+    60s/5s page pair through the injected sink; sustained recovery
+    resolves it. Counting is exact off the sealed histograms with the
+    budget snapped up to its log2 edge."""
+    transitions = []
+
+    def sink(key, firing, now_ms, fields):
+        transitions.append((key, firing, now_ms, dict(fields)))
+
+    clock = {"now": BASE_MS}
+    wf = WaterfallRecorder(now_ms=lambda: clock["now"], transition=sink)
+    budget = wf.sentry.budgets["wire.device"]
+    _sentry_feed(wf, clock, secs=8, device_ms=budget * 4)  # all breaching
+    fired = [t for t in transitions if t[1]
+             and t[3]["severity"] == "page"
+             and t[3]["stage"] == "wire.device"]
+    assert fired, "sustained breach never paged"
+    assert fired[0][3]["kind"] == "waterfall_budget"
+    assert fired[0][3]["resource"] == "waterfall:wire.device"
+    assert fired[0][3]["burnLong"] >= 14.4
+    # Recovery: long window (60s) must drain below burn threshold.
+    transitions.clear()
+    _sentry_feed(wf, clock, secs=70, device_ms=0.5)
+    page_states = [t[1] for t in transitions
+                   if t[3]["severity"] == "page"
+                   and t[3]["stage"] == "wire.device"]
+    assert page_states and page_states[-1] is False, "breach never resolved"
+    burn = wf.sentry.snapshot()["burn"]["wire.device"]
+    assert all(not r["firing"] for r in burn)
+
+
+def test_sentry_respects_min_events_floor():
+    """Sparse traffic (below ``sentry.min.events`` per long window)
+    never fires, no matter how slow: a regression verdict needs
+    evidence, not three unlucky requests."""
+    transitions = []
+    clock = {"now": BASE_MS}
+    wf = WaterfallRecorder(
+        now_ms=lambda: clock["now"],
+        transition=lambda *a: transitions.append(a))
+    budget = wf.sentry.budgets["wire.device"]
+    # 5 breaching requests/s * 8s = 40 < the 50-event floor.
+    _sentry_feed(wf, clock, secs=8, device_ms=budget * 4, per_sec=5)
+    assert not any(firing for _, firing, *_ in transitions)
+
+
+def test_sentry_alert_lands_in_slo_store(engine):
+    """The real sink: a breach fed through ``engine.waterfall`` pages
+    via ``SloManager.external_transition`` — same alert store, journal
+    stream, and health-score surface as an availability burn."""
+    wf = engine.waterfall
+    budget = wf.sentry.budgets["wire.device"]
+    now = BASE_MS
+    for _ in range(8):
+        time_util.freeze_time(now)
+        for _ in range(60):
+            wf.observe_wire([0.1, 0.1, 0.1, 0.1, budget * 4,
+                             0.1, 0.1, 0.1])
+        now += 1000
+        time_util.freeze_time(now)
+        engine.slo_refresh(now_ms=now)
+    snap = engine.slo.alerts_snapshot()
+    assert snap["counters"]["fired"] > 0
+    active = [a for a in snap["active"]
+              if a.get("kind") == "waterfall_budget"]
+    assert active and active[0]["resource"] == "waterfall:wire.device"
+    assert "waterfall:wire.device" in engine.slo.health_scores()["resources"]
+    # Removing the budget RESOLVES its fired alerts (verify-drive catch:
+    # evaluate stops iterating a removed key, so without the explicit
+    # resolve in set_budgets the alert would sit active forever).
+    wf.sentry.set_budgets({"wire.device": -1})
+    snap = engine.slo.alerts_snapshot()
+    assert not [a for a in snap["active"]
+                if a.get("kind") == "waterfall_budget"]
+    assert snap["counters"]["resolved"] > 0
+
+
+# -- A/B guard: zero device work ----------------------------------------------
+
+
+def test_waterfall_fold_adds_no_device_work():
+    """A/B guard: the same admission stream dispatches the SAME number
+    of device programs with the waterfall enabled (folding + sentry
+    paging every second) and disabled — the whole subsystem is host
+    arithmetic riding the existing per-second spill."""
+    from sentinel_tpu.core.config import config
+    from tests.test_telemetry import _batch
+
+    def run(enabled):
+        from sentinel_tpu.core.context import replace_context
+
+        config.set("csp.sentinel.waterfall.enabled",
+                   "true" if enabled else "false")
+        replace_context(None)
+        eng = st.reset(capacity=256)
+        assert eng.waterfall.enabled is enabled
+        st.load_flow_rules([st.FlowRule(resource="wfab", count=1e9)])
+        budget = eng.waterfall.sentry.budgets["wire.device"]
+        now = BASE_MS
+        for _ in range(6):
+            time_util.freeze_time(now)
+            eng._run_entry_batch(_batch(eng, [("wfab", "", None)] * 4))
+            for _ in range(60):  # wire stream riding the same seconds
+                eng.waterfall.observe_wire(
+                    [0.1, 0.1, 0.1, 0.1, budget * 4, 0.1, 0.1, 0.1])
+            eng.slo_refresh(now_ms=now)
+            now += 1000
+        time_util.freeze_time(now)
+        eng.slo_refresh(now_ms=now)
+        dispatches = {k: v["dispatches"]
+                      for k, v in eng.step_timer.snapshot().items()}
+        return dispatches, eng.slo.alerts_snapshot()["counters"]["fired"]
+
+    time_util.freeze_time(BASE_MS)
+    try:
+        on_dispatches, on_fired = run(True)
+        off_dispatches, off_fired = run(False)
+    finally:
+        config.set("csp.sentinel.waterfall.enabled", "true")
+        time_util.unfreeze_time()
+        st.reset(capacity=512)
+    assert on_fired > 0, "the A/B run never exercised the sentry"
+    assert off_fired == 0
+    assert on_dispatches == off_dispatches
+
+
+# -- injected-clock inertness (ISSUE 13) --------------------------------------
+
+
+def test_set_clock_resets_waterfall_timebase(engine):
+    """A clock swap (simulator attach) drops staged cells and sealed
+    history — stamps of the OLD timebase must never leak into the new
+    one — while cumulative counters survive (they are totals, not
+    stamps)."""
+    wf = engine.waterfall
+    wf.observe_wire([1.0] * 8)
+    engine.slo_refresh(now_ms=engine.now_ms() + 2000)
+    assert wf.snapshot()["sealedSeconds"] == 1
+    wf.observe_wire([1.0] * 8)  # staged, unsealed: dropped by the swap
+    engine.set_clock(lambda: 5_000_000)
+    snap = wf.snapshot()
+    assert snap["stagedSeconds"] == 0 and not snap["recent"]
+    assert snap["rtt"]["count"] == 1  # SEALED cumulative survives
+    # The new timebase records cleanly from zero.
+    wf.observe_wire([1.0] * 8)
+    wf.roll(5_000_000 + 2000)
+    assert wf.snapshot()["recent"][-1]["timestamp"] == 5_000_000
+
+
+# -- ops command --------------------------------------------------------------
+
+
+def test_waterfall_command_status_and_budgets(engine):
+    """``waterfall`` op=status serves the snapshot; op=budgets merges
+    operator overrides (journaled), rejects unknown stages, and <= 0
+    removes a budget."""
+    import json
+
+    import sentinel_tpu.transport.handlers  # noqa: F401 — registers cmds
+    from sentinel_tpu.transport.command_center import (
+        CommandRequest,
+        get_handler,
+    )
+
+    h = get_handler("waterfall")
+    assert h is not None
+    engine.waterfall.observe_wire([1.0] * 8)
+    resp = h(CommandRequest(parameters={"op": "status"}, engine=engine))
+    assert resp.success
+    snap = json.loads(resp.result)
+    assert snap["enabled"] and snap["stages"]["wire"] == list(WIRE_STAGES)
+    assert snap["sentry"]["budgetsMs"]
+
+    resp = h(CommandRequest(
+        parameters={"op": "budgets",
+                    "data": json.dumps({"wire.read": 8.0,
+                                        "wire.queue": -1})},
+        engine=engine))
+    assert resp.success
+    budgets = json.loads(resp.result)["budgetsMs"]
+    assert budgets["wire.read"] == 8.0 and "wire.queue" not in budgets
+    assert engine.journal.tail(kind="waterfallBudgets"), "not journaled"
+
+    resp = h(CommandRequest(
+        parameters={"op": "budgets", "data": '{"wire.nope": 5}'},
+        engine=engine))
+    assert not resp.success
