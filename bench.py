@@ -24,7 +24,7 @@ sustained entries/s with overlapped cycles (achieved in-flight depth ≥ 2)
 and the queue-wait vs device-wait split.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
-AND persists the same record to a per-PR artifact (``BENCH_17.json`` by
+AND persists the same record to a per-PR artifact (``BENCH_18.json`` by
 default, override with ``$BENCH_ARTIFACT``) so re-anchors can track the
 perf trajectory across PRs (ROADMAP item 3a). The artifact is written
 progressively — whatever sections completed survive a kill.
@@ -917,6 +917,118 @@ def bench_waterfall_probe() -> dict:
     }}
 
 
+def bench_population_probe() -> dict:
+    """ISSUE 19 acceptance capture, three numbers:
+
+    (1) fold overhead as the distinct-key rate sweeps decades — the
+        telescope's whole cost is this host-side fold (hashing +
+        sketch updates on the once-per-second spill), so ms/fold vs
+        distinct keys/fold is THE overhead curve;
+    (2) projection accuracy: a seeded Zipf stream through the REAL
+        engine, ``population_report(slot_budget=N)`` vs an exact
+        oracle's measured hot-set hit rate (the <=5%-absolute
+        acceptance, captured per budget);
+    (3) the A/B guard: the same stream with the telescope off must
+        dispatch the SAME device programs (observation stages host
+        pairs; the fold is host arithmetic).
+    """
+    import random
+
+    import jax.numpy as jnp
+
+    import sentinel_tpu as st
+    from sentinel_tpu.core.batch import EntryBatch, make_entry_batch_np
+    from sentinel_tpu.core.config import config
+    from sentinel_tpu.core.context import replace_context
+    from sentinel_tpu.telemetry.population import PopulationTracker
+    from sentinel_tpu.utils import time_util
+
+    base = 1_700_000_000_000
+
+    # (1) standalone tracker: fold cost needs no engine.
+    overhead = {}
+    for distinct in (100, 1_000, 10_000):
+        tr = PopulationTracker(now_ms=lambda: base)
+        rng = random.Random(distinct)
+        folds = 20
+        for i in range(folds):
+            tr.observe_pairs([(f"f{rng.randrange(distinct)}", 1)
+                              for _ in range(distinct)])
+            tr.roll(base + i * 1000)
+        overhead[f"{distinct}_keys_per_fold"] = {
+            "foldMsMean": round(tr.fold_ms_total / folds, 4),
+            "foldedKeys": tr.folded_keys,
+            "distinct": round(tr._hll.estimate(), 1),
+        }
+
+    # (2)+(3) Zipf stream through the real engine, telescope on/off.
+    n_res, per_sec, seconds = 300, 512, 20
+
+    def run(enabled: bool):
+        replace_context(None)
+        config.set("csp.sentinel.population.enabled",
+                   "" if enabled else "false")
+        eng = st.reset(capacity=2048)
+        reg = eng.registry
+        rows = np.asarray([reg.cluster_row(f"pop{i}")
+                           for i in range(n_res)])
+        rng = np.random.default_rng(19)
+        truth = np.zeros(n_res, dtype=np.int64)
+        now = base
+        for _ in range(seconds):
+            time_util.freeze_time(now)
+            pick = np.minimum(rng.zipf(1.2, size=per_sec), n_res) - 1
+            np.add.at(truth, pick, 1)
+            buf = make_entry_batch_np(per_sec)
+            buf["cluster_row"][:] = rows[pick]
+            buf["dn_row"][:] = -1
+            buf["count"][:] = 1
+            eng._run_entry_batch(EntryBatch(
+                **{k: jnp.asarray(v) for k, v in buf.items()}))
+            eng.slo_refresh(now_ms=now)
+            now += 1000
+        time_util.freeze_time(now)
+        eng.slo_refresh(now_ms=now)
+        dispatches = {k: v["dispatches"]
+                      for k, v in eng.step_timer.snapshot().items()}
+        projection = {}
+        if enabled:
+            ranked = np.sort(truth)[::-1]
+            total = int(truth.sum())
+            for budget in (8, 32, 64, 256):
+                rep = eng.population_report(slot_budget=budget,
+                                            now_ms=now)
+                measured = float(ranked[:budget].sum()) / total
+                projection[str(budget)] = {
+                    "predictedHitRate": rep["hitRate"],
+                    "measuredHitRate": round(measured, 6),
+                    "absError": round(abs(rep["hitRate"] - measured), 6),
+                    "extrapolated": rep["extrapolated"],
+                }
+        observed = eng.population.observed_total
+        fold_ms = round(eng.population.fold_ms_total, 3)
+        return dispatches, projection, observed, fold_ms
+
+    time_util.freeze_time(base)
+    try:
+        off_disp, _, off_observed, _ = run(False)
+        on_disp, projection, on_observed, fold_ms = run(True)
+    finally:
+        config.set("csp.sentinel.population.enabled", "")
+        time_util.unfreeze_time()
+        replace_context(None)
+    return {"population_probe": {
+        "foldOverhead": overhead,
+        "projection": projection,
+        "engineFoldMsTotal": fold_ms,
+        "abGuard": {
+            "dispatchesEqual": on_disp == off_disp,
+            "observedWithTelescope": on_observed,
+            "observedWithout": off_observed,
+        },
+    }}
+
+
 def bench_wire_mesh() -> dict:
     """ISSUE 11 acceptance: end-to-end wire QPS at mesh concurrency —
     64 pipelined TLV connections through the reactor frontend over real
@@ -1509,7 +1621,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_17.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_18.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1777,7 +1889,8 @@ def main() -> None:
         for section in (bench_llm_admission, bench_degrade_1k,
                         bench_param_cms_100k,
                         bench_native_token_loopback,
-                        bench_waterfall_probe):
+                        bench_waterfall_probe,
+                        bench_population_probe):
             try:
                 out.update(section())
             except Exception as ex:  # noqa: BLE001

@@ -470,6 +470,40 @@ class ClusterTokenClient:
             payload["wireEpoch"] = epoch
         return payload
 
+    def request_population_page(self, timeout_s: Optional[float] = None
+                                ) -> Optional[dict]:
+        """Pull this leader's namespace-telescope page (ISSUE 19) —
+        the ``MSG_FLEET`` message with the ``max_seconds == -1``
+        sentinel. None on disconnect/timeout/garbled payload;
+        ``{"unsupported": True}`` when the server predates the message
+        entirely (BAD_REQUEST) OR answered with a plain seconds page
+        (a pre-telescope fleet server that ignored the sentinel).
+
+        Same stance as :meth:`request_fleet_telemetry`: NOT behind the
+        health gate — a telescope scrape failing must never trip the
+        breaker the token path relies on."""
+        resp = self._call(
+            MSG_FLEET, codec.encode_fleet_request(0, -1), timeout_s)
+        if resp is None:
+            return None
+        if resp.status == TokenResultStatus.BAD_REQUEST:
+            return {"unsupported": True}
+        if resp.status != TokenResultStatus.OK:
+            return None
+        payload, end = codec.decode_json_entity(resp.entity)
+        if payload is None:
+            return None
+        if "population" not in payload:
+            return {"unsupported": True}
+        epoch = codec.read_epoch_tlv(resp.entity, end)
+        if epoch is not None:
+            payload["wireEpoch"] = epoch
+        page = payload.get("population")
+        if page:
+            page["leader"] = payload.get("leader")
+            page["nowMs"] = payload.get("nowMs")
+        return page or {"unsupported": True}
+
     def request_param_token(self, flow_id: int, count: int, params: Sequence,
                             timeout_s: Optional[float] = None,
                             gate_neutral: bool = False,

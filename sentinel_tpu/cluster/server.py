@@ -499,12 +499,24 @@ def process_control_frame(server: "ClusterTokenServer", req: codec.Request,
         # spill page + instance health + shard ownership, epoch-stamped
         # like any token reply. Shared by both frontends, so the reactor
         # serves it off its worker pool with zero-copy ingest for free.
-        from sentinel_tpu.telemetry.fleet import leader_fleet_payload
+        from sentinel_tpu.telemetry.fleet import (
+            leader_fleet_payload,
+            leader_population_payload,
+        )
 
         try:
             since_ms, max_s = codec.decode_fleet_request(req.entity)
-            entity = stamp_epoch(
-                server, leader_fleet_payload(server, since_ms, max_s))
+            # max_seconds == -1 is the population-page sentinel (ISSUE
+            # 19): same message, different page — a pre-telescope server
+            # falls through to a normal seconds page, which the client
+            # detects by the missing "population" key. No new opcode, so
+            # mixed-version fleets keep scraping.
+            if max_s == -1:
+                entity = stamp_epoch(server, leader_population_payload(
+                    server))
+            else:
+                entity = stamp_epoch(
+                    server, leader_fleet_payload(server, since_ms, max_s))
             return (codec.encode_response(
                 req.xid, MSG_FLEET, TokenResultStatus.OK, entity), namespace)
         except Exception:  # noqa: BLE001 — a read must never kill the conn

@@ -244,6 +244,11 @@ class ClusterStateManager:
                 service=service, host=host, port=port,
                 engine=self.engine).start()
             self.token_server.service.epoch = int(epoch)
+            # Bind the namespace telescope: leader-side flowId traffic
+            # stages into the SAME tracker the engine's spill fold
+            # rolls, so one population page covers both key axes.
+            self.token_server.service.population = getattr(
+                self.engine, "population", None)
             self.epoch = int(epoch)
             self.mode = CLUSTER_SERVER
             self.mode_flips += 1

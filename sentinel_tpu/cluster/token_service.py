@@ -294,6 +294,11 @@ class DefaultTokenService:
         from sentinel_tpu.telemetry.spans import SpanCollector
 
         self.spans = SpanCollector(sample_every=0)
+        # Namespace telescope (telemetry/population.py): the leader's
+        # flowId-axis observation point. Bound to the engine's tracker
+        # by ClusterStateManager.set_to_server; None (standalone seats,
+        # unit harnesses) disables observation entirely.
+        self.population = None
 
     # -- sharded ownership (cluster/sharding.py) ---------------------------
 
@@ -427,6 +432,8 @@ class DefaultTokenService:
         now = now_ms if now_ms is not None else time_util.current_time_millis()
         traces = tuple(r[3] if len(r) > 3 else None for r in requests)
         shard = self._shard
+        population = self.population
+        pop_rows = [] if population is not None else None
         with self._lock:
             self._ensure_compiled()
             pre: List[Optional[TokenResult]] = [None] * len(requests)
@@ -456,6 +463,11 @@ class DefaultTokenService:
                             wait_ms=shard.version)
                         continue
                 ns = self._ns_of.get(flow_id)
+                if pop_rows is not None:
+                    # Offered load on OWNED slices only (a mis-routed
+                    # request is counted by the leader that admits it) —
+                    # staged as raw triples, hashed on the spill fold.
+                    pop_rows.append((ns, flow_id, count))
                 if ns is not None and not self.limiter.try_pass(ns, now):
                     pre[i] = TokenResult(CC.TokenResultStatus.TOO_MANY_REQUEST,
                                          epoch=slice_epoch)
@@ -478,6 +490,8 @@ class DefaultTokenService:
                 self._state = None
                 self._compiled_version = -1
                 raise
+            if pop_rows:
+                population.observe_flows(pop_rows)
             return TokenTicket(tuple(requests), traces, tuple(pre),
                                status, extra, now, t0, shard=shard)
 

@@ -163,6 +163,27 @@ WATERFALL_HISTORY_SECONDS = "csp.sentinel.waterfall.history.seconds"
 WATERFALL_EXEMPLAR_EVERY = "csp.sentinel.waterfall.exemplar.every"
 WATERFALL_SENTRY_ENABLED = "csp.sentinel.waterfall.sentry.enabled"
 WATERFALL_SENTRY_MIN_EVENTS = "csp.sentinel.waterfall.sentry.min.events"
+# Namespace telescope (sentinel_tpu/telemetry/population.py — ISSUE
+# 19). Every key MUST be read through the accessors below and
+# documented in docs/OPERATIONS.md "Namespace telescope & admission
+# readiness" (pinned by test_lint). enabled: population sensing on the
+# spill fold; topk: Space-Saving summary size (error floor total/k);
+# cms.*: count-min geometry (cold-tail error (e/width)*total at
+# confidence 1-e^-depth); hll.precision: global cardinality registers
+# (2^p, stderr 1.04/sqrt(2^p)); slice.precision: the cheaper per-slice
+# and per-window register sets; window.seconds: churn-window length;
+# churn.history: sealed windows retained; baseline.*: the EWMA
+# cardinality-growth alarm (z-score vs prior baseline).
+POPULATION_ENABLED = "csp.sentinel.population.enabled"
+POPULATION_TOPK = "csp.sentinel.population.topk"
+POPULATION_CMS_DEPTH = "csp.sentinel.population.cms.depth"
+POPULATION_CMS_WIDTH = "csp.sentinel.population.cms.width"
+POPULATION_HLL_PRECISION = "csp.sentinel.population.hll.precision"
+POPULATION_SLICE_PRECISION = "csp.sentinel.population.slice.precision"
+POPULATION_WINDOW_SECONDS = "csp.sentinel.population.window.seconds"
+POPULATION_CHURN_HISTORY = "csp.sentinel.population.churn.history"
+POPULATION_BASELINE_ALPHA = "csp.sentinel.population.baseline.alpha"
+POPULATION_BASELINE_ZSCORE = "csp.sentinel.population.baseline.zscore"
 # Trace-replay simulator (sentinel_tpu/simulator/ — no reference twin:
 # the reference has no offline evaluation story). Every key here MUST be
 # read through the accessors below and documented in docs/OPERATIONS.md
@@ -333,6 +354,22 @@ DEFAULT_WIRE_WORKERS = 4
 DEFAULT_WATERFALL_HISTORY_SECONDS = 600
 DEFAULT_WATERFALL_EXEMPLAR_EVERY = 8
 DEFAULT_WATERFALL_SENTRY_MIN_EVENTS = 50
+# Namespace-telescope defaults. k=64 keeps the top-k ring exact for
+# Zipf hot sets while a full fleet page stays well under the 64 KB
+# entity budget; CMS 4x512 bounds cold-tail error to ~0.53% of total
+# at 98% confidence; HLL p=11 (2 KB) gives 2.3% cardinality stderr,
+# p=7 (128 B) per slice/window gives 9% — churn and placement signals,
+# not billing; 10 s windows x 360 retained = one hour of churn series;
+# the baseline alarm uses the SLO anomaly defaults (alpha 0.2, z 4).
+DEFAULT_POPULATION_TOPK = 64
+DEFAULT_POPULATION_CMS_DEPTH = 4
+DEFAULT_POPULATION_CMS_WIDTH = 512
+DEFAULT_POPULATION_HLL_PRECISION = 11
+DEFAULT_POPULATION_SLICE_PRECISION = 7
+DEFAULT_POPULATION_WINDOW_SECONDS = 10
+DEFAULT_POPULATION_CHURN_HISTORY = 360
+DEFAULT_POPULATION_BASELINE_ALPHA = 0.2
+DEFAULT_POPULATION_BASELINE_ZSCORE = 4.0
 # Simulator defaults. One day past epoch 0 keeps simulated stamps far
 # from any plausible wall clock (the replay-honesty canary); 512 keeps
 # the per-second chunking on a mid-ladder width (fewer distinct XLA
@@ -706,6 +743,55 @@ class SentinelConfig:
         v = self.get_int(WATERFALL_SENTRY_MIN_EVENTS,
                          DEFAULT_WATERFALL_SENTRY_MIN_EVENTS)
         return v if v > 0 else DEFAULT_WATERFALL_SENTRY_MIN_EVENTS
+
+    # Namespace-telescope accessors (the ONLY sanctioned readers of the
+    # csp.sentinel.population.* keys — test_lint forbids reading the
+    # literals anywhere else in the package).
+
+    def population_enabled(self) -> bool:
+        return (self.get(POPULATION_ENABLED) or "true").lower() != "false"
+
+    def population_topk(self) -> int:
+        v = self.get_int(POPULATION_TOPK, DEFAULT_POPULATION_TOPK)
+        return v if v > 0 else DEFAULT_POPULATION_TOPK
+
+    def population_cms_depth(self) -> int:
+        v = self.get_int(POPULATION_CMS_DEPTH, DEFAULT_POPULATION_CMS_DEPTH)
+        return v if v > 0 else DEFAULT_POPULATION_CMS_DEPTH
+
+    def population_cms_width(self) -> int:
+        v = self.get_int(POPULATION_CMS_WIDTH, DEFAULT_POPULATION_CMS_WIDTH)
+        return v if v >= 8 else DEFAULT_POPULATION_CMS_WIDTH
+
+    def population_hll_precision(self) -> int:
+        v = self.get_int(POPULATION_HLL_PRECISION,
+                         DEFAULT_POPULATION_HLL_PRECISION)
+        return v if 4 <= v <= 16 else DEFAULT_POPULATION_HLL_PRECISION
+
+    def population_slice_precision(self) -> int:
+        v = self.get_int(POPULATION_SLICE_PRECISION,
+                         DEFAULT_POPULATION_SLICE_PRECISION)
+        return v if 4 <= v <= 16 else DEFAULT_POPULATION_SLICE_PRECISION
+
+    def population_window_seconds(self) -> int:
+        v = self.get_int(POPULATION_WINDOW_SECONDS,
+                         DEFAULT_POPULATION_WINDOW_SECONDS)
+        return v if v > 0 else DEFAULT_POPULATION_WINDOW_SECONDS
+
+    def population_churn_history(self) -> int:
+        v = self.get_int(POPULATION_CHURN_HISTORY,
+                         DEFAULT_POPULATION_CHURN_HISTORY)
+        return v if v > 0 else DEFAULT_POPULATION_CHURN_HISTORY
+
+    def population_baseline_alpha(self) -> float:
+        v = self.get_float(POPULATION_BASELINE_ALPHA,
+                           DEFAULT_POPULATION_BASELINE_ALPHA)
+        return v if 0.0 < v <= 1.0 else DEFAULT_POPULATION_BASELINE_ALPHA
+
+    def population_baseline_zscore(self) -> float:
+        v = self.get_float(POPULATION_BASELINE_ZSCORE,
+                           DEFAULT_POPULATION_BASELINE_ZSCORE)
+        return v if v > 0.0 else DEFAULT_POPULATION_BASELINE_ZSCORE
 
     # Simulator accessors (the ONLY sanctioned readers of the
     # csp.sentinel.sim.* keys — test_lint forbids reading the literals

@@ -400,6 +400,14 @@ class SentinelEngine:
         from sentinel_tpu.telemetry.waterfall import WaterfallRecorder
 
         self.waterfall = WaterfallRecorder(self)
+        # Namespace telescope (ISSUE 19): population sensing over the
+        # unbounded (resource, flowId) key space — top-k / CMS / HLL /
+        # churn riding the same spill fold. Constructed AFTER slo for
+        # the same reason as the waterfall: its cardinality alarm fires
+        # through slo.external_transition.
+        from sentinel_tpu.telemetry.population import PopulationTracker
+
+        self.population = PopulationTracker(self)
         # Closed-loop adaptive limiting (sentinel_tpu/adaptive/): the
         # acting half of the loop the SLO engine senses for. Constructed
         # AFTER rollout (it registers a lifecycle listener) and slo (its
@@ -542,6 +550,9 @@ class SentinelEngine:
         waterfall = getattr(self, "waterfall", None)
         if waterfall is not None:
             waterfall.reset_timebase()
+        population = getattr(self, "population", None)
+        if population is not None:
+            population.reset_timebase()
         # Audit the swap itself — stamped with the NEW timebase (the
         # old one no longer exists to stamp with). seq stays monotone
         # across the swap even though timestamps may step backward;
@@ -1690,6 +1701,7 @@ class SentinelEngine:
         # Sampled decision traces: enqueue only (the worker materializes
         # off this thread) — never blocks the step stream.
         self.traces.submit(batch, dec, now)
+        self._observe_population(batch)
         return dec
 
     def _run_entry_batch(self, batch: EntryBatch) -> Decisions:
@@ -1879,6 +1891,7 @@ class SentinelEngine:
                 raise DeviceDispatchError(
                     f"entry dispatch failed: {ex!r:.200}") from ex
             self.traces.submit(batch, dec, now)
+            self._observe_population(batch)
             return dec
 
     def complete_batch(self, batch: ExitBatch, now_ms: Optional[int] = None) -> None:
@@ -2191,6 +2204,12 @@ class SentinelEngine:
         waterfall = getattr(self, "waterfall", None)
         if waterfall is not None:
             waterfall.roll(now)
+        # The namespace telescope folds its staged (key, count) pairs
+        # into the population sketches on the same cadence (AFTER slo
+        # for the same sentry-transition reason as the waterfall).
+        population = getattr(self, "population", None)
+        if population is not None:
+            population.roll(now)
         # The adaptive loop rides the same cadence, AFTER judgement is
         # current (its freeze gate and proposal alert-gate read it).
         # Interval-gated + reentry-safe inside; getattr: _spill_flight
@@ -2208,6 +2227,27 @@ class SentinelEngine:
         if streams is not None:
             for lease in streams.evict(now):
                 streams.add_credit(lease.resource, lease.remaining, now)
+
+    def _observe_population(self, batch: EntryBatch) -> None:
+        """Stage this admission batch's (row, tokens) traffic for the
+        namespace telescope — a dict fold on arrays the batch already
+        carries host-side, next to the existing ``traces.submit``; the
+        A/B guard in tests/test_population.py pins that this adds ZERO
+        device dispatches."""
+        population = getattr(self, "population", None)
+        if population is not None and population.enabled:
+            population.observe_rows(batch.cluster_row, batch.count,
+                                    self.registry.meta)
+
+    def population_report(self, slot_budget: int = 1024,
+                          now_ms: Optional[int] = None) -> Dict:
+        """Admission-readiness projection for a hypothetical slot
+        budget (ROADMAP item 1's sizing input): bring the telescope
+        current on the fold it rides, then project hot-set hit rate,
+        eviction/steal rate, and cold-tail mass from the sketches."""
+        self._flush_committer()
+        self._spill_flight(now_ms)
+        return self.population.report(slot_budget)
 
     def slo_refresh(self, now_ms: Optional[int] = None) -> None:
         """Bring SLO judgement current: land leased commits, fold + spill

@@ -188,6 +188,15 @@ class SentinelApiClient:
         return json.loads(self.get(ip, port, "waterfall",
                                    {"op": "status", **(params or {})}))
 
+    def fetch_population(self, ip: str, port: int, op: str = "status",
+                         params: Optional[Dict] = None) -> Dict:
+        """Namespace telescope (``population`` command): cardinality +
+        top-k + churn (op=status), admission-readiness projection
+        (op=report, budget=), the budget-ladder curve (op=curve), or
+        the fleet-merged view (op=fleet)."""
+        return json.loads(self.get(ip, port, "population",
+                                   {"op": op, **(params or {})}))
+
     def fetch_journal(self, ip: str, port: int,
                       params: Optional[Dict] = None) -> Dict:
         """Audit-journal tail (``journal`` command): seq-cursored

@@ -47,6 +47,10 @@ set as a small JSON API plus one static page:
   * ``GET  /waterfall.json?app=``             wire-to-device latency
     waterfall: per-stage budget, RTT reconciliation, exemplars + sentry
     (proxies the machines' ``waterfall`` command, op=status)
+  * ``GET  /population.json?app=``            namespace telescope:
+    cardinality, top-k with error bars, churn, slot-budget projection
+    (proxies the machines' ``population`` command; op=status/report/
+    curve/fleet)
   * ``GET  /fleet.json?app=``                 fleet observability: federated
     per-leader staleness/skew/health + exact fleet series (proxies the
     machines' ``fleet`` command; ``op=series`` for the per-second sums,
@@ -302,6 +306,17 @@ class DashboardServer:
         m = self._first_healthy(app)
         return self.api.fetch_waterfall(m.ip, m.port,
                                         params=params or {})
+
+    def get_population(self, app: str, op: str = "status",
+                       params: Optional[Dict[str, str]] = None):
+        """Namespace-telescope read path (``population`` command) from
+        the first healthy machine — the Namespace population panel's
+        source. Read-only ops only (the tracker has no mutating ops)."""
+        if op not in ("status", "report", "curve", "fleet"):
+            raise ValueError(f"unsupported population op {op!r}")
+        m = self._first_healthy(app)
+        return self.api.fetch_population(m.ip, m.port, op=op,
+                                         params=params or {})
 
     def get_sim(self, app: str, op: str = "report"):
         """Simulator read path (``sim`` command report/scenarios) from
@@ -582,6 +597,12 @@ class _Handler(BaseHTTPRequestHandler):
                 params = {k: v for k, v in q.items() if k != "app"}
                 return self._ok(d.get_waterfall(q.get("app", ""),
                                                 params=params))
+            if path == "/population.json":
+                op = q.get("op", "status")
+                params = {k: v for k, v in q.items()
+                          if k not in ("app", "op")}
+                return self._ok(d.get_population(q.get("app", ""), op=op,
+                                                 params=params))
             if path == "/alerts.json":
                 m = d._first_healthy(q.get("app", ""))
                 since = q.get("sinceSeq")

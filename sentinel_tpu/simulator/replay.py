@@ -86,7 +86,8 @@ class ReplayResult:
     __slots__ = ("trace_meta", "seconds", "offered", "passed", "blocked",
                  "retried", "verdict_sha256", "series", "rt_hist",
                  "decisions", "counters", "final_counts", "band_violations",
-                 "journal", "streams", "replay_wall_s", "total_wall_s")
+                 "journal", "streams", "population", "replay_wall_s",
+                 "total_wall_s")
 
     def __init__(self):
         self.trace_meta: Dict = {}
@@ -111,6 +112,11 @@ class ReplayResult:
         # events did to the host-side reservation ledger. Empty unless
         # the scenario carries streams.
         self.streams: Dict[str, int] = {}
+        # Namespace-telescope output (ISSUE 19): the sealed churn-window
+        # series plus the final top-k — folded at SIMULATED time on the
+        # same spill cadence as judgement, so two runs of one trace+seed
+        # produce identical population series (the determinism oracle).
+        self.population: Dict = {}
         # Wall timing (perf_counter, the one sanctioned wall read in
         # this package — it measures speed, it never drives replay):
         # replay_wall_s covers the second loop only (steady state, what
@@ -155,6 +161,11 @@ class ReplayResult:
             "decisions": len(self.decisions),
             "journalRecords": len(self.journal),
             "streams": dict(self.streams),
+            "population": ({
+                "observed": self.population.get("observed", 0),
+                "distinct": self.population.get("distinct", 0.0),
+                "windows": len(self.population.get("windows", ())),
+            } if self.population else {}),
         }
 
 
@@ -540,6 +551,17 @@ class ReplayEngine:
         for r in eng.flow_rules.get_rules():
             if _tunable(r):
                 result.final_counts[r.resource] = float(r.count)
+        # Population series (ISSUE 19): sealed churn windows + the
+        # final top-k with error bars, all stamped in simulated time.
+        population = getattr(eng, "population", None)
+        if population is not None and population.enabled:
+            result.population = {
+                "windows": population.series(),
+                "topk": [{"key": k, "count": c, "err": e}
+                         for k, c, e in population._ss.top()],
+                "observed": population.observed_total,
+                "distinct": round(population._hll.estimate(), 2),
+            }
         # Safety-envelope audit: every promoted change AND the final
         # live counts must sit inside the declared [floor, ceiling]
         # band. The envelope guarantees this by construction; the lab's

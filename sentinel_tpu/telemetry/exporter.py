@@ -469,6 +469,65 @@ def render_engine_metrics(engine) -> str:
     b.sample("sentinel_tpu_timeseries_retained_seconds", None,
              ts["retainedSeconds"])
 
+    # -- namespace telescope (telemetry/population.py — ISSUE 19) ---------
+    # (AFTER the timeseries_view read: its fold rolled the tracker,
+    # so the snapshot is current through the newest complete second.)
+    # Population sensing as gauges/counters: cardinality (global HLL),
+    # hot-set mass and the Space-Saving floor (the exact-vs-bounded
+    # seam), churn turnover, the cardinality-growth alarm, and the
+    # fold-overhead self-measurement the bench phase trends.
+    population = getattr(engine, "population", None)
+    if population is not None:
+        pstate = population.snapshot(windows=1)
+        b.family("sentinel_tpu_population_enabled", "gauge",
+                 "Namespace telescope enabled (0/1)")
+        b.sample("sentinel_tpu_population_enabled", None,
+                 1 if pstate["enabled"] else 0)
+        b.counter("sentinel_tpu_population_observed",
+                  "Total (key, count) traffic folded into the "
+                  "population sketches", pstate["observed"])
+        b.family("sentinel_tpu_population_distinct", "gauge",
+                 "HyperLogLog distinct-key estimate since engine start "
+                 "(stderr 1.04/sqrt(2^p))")
+        b.sample("sentinel_tpu_population_distinct", None,
+                 pstate["distinct"])
+        b.family("sentinel_tpu_population_window_distinct", "gauge",
+                 "Distinct-key estimate of the last sealed churn "
+                 "window (-1 = none sealed yet)")
+        b.sample("sentinel_tpu_population_window_distinct", None,
+                 pstate["churn"][-1]["distinct"] if pstate["churn"] else -1)
+        b.family("sentinel_tpu_population_ss_floor", "gauge",
+                 "Space-Saving eviction floor: upper bound on any "
+                 "absent key's true count (0 = summary unsaturated, "
+                 "every entry exact)")
+        b.sample("sentinel_tpu_population_ss_floor", None,
+                 pstate["ssFloor"])
+        b.family("sentinel_tpu_population_hot_mass", "gauge",
+                 "Fraction of observed traffic held by the top-k "
+                 "summary (upper estimates)")
+        total_obs = pstate["observed"]
+        hot = sum(e["count"] for e in pstate["topk"])
+        b.sample("sentinel_tpu_population_hot_mass", None,
+                 round(hot / total_obs, 6) if total_obs else 0.0)
+        b.counter("sentinel_tpu_population_churn_entered",
+                  "Cumulative top-k ring entries across sealed churn "
+                  "windows", pstate["enteredTotal"])
+        b.counter("sentinel_tpu_population_churn_exited",
+                  "Cumulative top-k ring exits across sealed churn "
+                  "windows", pstate["exitedTotal"])
+        b.family("sentinel_tpu_population_cardinality_z", "gauge",
+                 "Last churn window's cardinality z-score against the "
+                 "EWMA baseline")
+        b.sample("sentinel_tpu_population_cardinality_z", None,
+                 pstate["baseline"]["lastZ"])
+        b.family("sentinel_tpu_population_cardinality_alarm", "gauge",
+                 "Cardinality-growth alarm firing (0/1)")
+        b.sample("sentinel_tpu_population_cardinality_alarm", None,
+                 1 if pstate["alarm"] else 0)
+        b.counter("sentinel_tpu_population_fold_ms",
+                  "Cumulative host milliseconds spent folding staged "
+                  "pairs into the sketches", pstate["foldMsTotal"])
+
     # -- SLO engine + alerting (sentinel_tpu/slo/) ------------------------
     # The timeseries_view read above already refreshed judgement (spill
     # feeds the SLO manager and re-evaluates burn rules), so these render

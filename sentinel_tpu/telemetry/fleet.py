@@ -118,6 +118,34 @@ def leader_fleet_payload(server, since_ms: int, max_seconds: int) -> bytes:
         return codec.encode_json_entity(payload)
 
 
+def leader_population_payload(server) -> bytes:
+    """One encoded population page (ISSUE 19) for this leader: the
+    namespace telescope's mergeable sketches, current through the spill
+    fold, sized to the same frame budget as a telemetry page. Served
+    through the SAME ``MSG_FLEET`` message — a request with the
+    ``max_seconds == -1`` sentinel selects this page, so a pre-telescope
+    server transparently answers with a normal seconds page instead
+    (the missing ``population`` key marks it unsupported client-side)."""
+    from sentinel_tpu.cluster import codec
+    from sentinel_tpu.core.config import config as _cfg
+
+    engine = server.engine
+    tracker = getattr(engine, "population", None) if engine is not None \
+        else None
+    if engine is not None:
+        engine.slo_refresh()  # fold first: the page is current
+    payload = {
+        "v": 1,
+        "leader": _cfg.cluster_ha_machine_id() or _cfg.app_name(),
+        "nowMs": engine.now_ms() if engine is not None else 0,
+        "epoch": int(getattr(server.service, "epoch", 0)),
+        "population": (tracker.page(max_bytes=MAX_ENTITY_BYTES - 512)
+                       if tracker is not None and tracker.enabled
+                       else None),
+    }
+    return codec.encode_json_entity(payload)
+
+
 # -- collector side -----------------------------------------------------------
 
 
@@ -125,7 +153,9 @@ class _LeaderState:
     __slots__ = ("spec", "client", "cursor_ms", "last_stamp_ms",
                  "last_ok_ms", "skew_ms", "polls", "errors", "unsupported",
                  "health", "shard", "epoch", "max_epoch", "epoch_regressed",
-                 "seconds_ingested", "seconds_skipped", "remote_name")
+                 "seconds_ingested", "seconds_skipped", "remote_name",
+                 "population", "population_at_ms", "population_polls",
+                 "population_errors", "population_unsupported")
 
     def __init__(self, spec: LeaderSpec, client):
         self.spec = spec
@@ -145,6 +175,11 @@ class _LeaderState:
         self.seconds_ingested = 0
         self.seconds_skipped = 0   # fat seconds the leader couldn't frame
         self.remote_name: Optional[str] = None
+        self.population: Optional[Dict] = None  # latest page, VERBATIM
+        self.population_at_ms = -1
+        self.population_polls = 0
+        self.population_errors = 0
+        self.population_unsupported = False
 
 
 class FleetView:
@@ -257,6 +292,71 @@ class FleetView:
             if payload.get("moreAfterMs") is None:
                 break
         return ingested
+
+    def poll_population(self) -> Dict[str, bool]:
+        """One population scrape (ISSUE 19): pull every leader's current
+        telescope page and store it VERBATIM (merging happens at read
+        time from unmodified pages — the bit-exactness stance the
+        telemetry cells already take). Returns per-leader success."""
+        out: Dict[str, bool] = {}
+        for name, ls in list(self._leaders.items()):
+            if ls.population_unsupported:
+                out[name] = False
+                continue
+            page = ls.client.request_population_page()
+            ls.population_polls += 1
+            if page is None:
+                ls.population_errors += 1
+                out[name] = False
+                continue
+            if page.get("unsupported"):
+                ls.population_unsupported = True
+                out[name] = False
+                continue
+            with self._lock:
+                ls.population = page
+                ls.population_at_ms = self._clock()
+            out[name] = True
+        return out
+
+    def fleet_population(self, slot_budget: Optional[int] = None,
+                         budgets: Optional[List[int]] = None) -> Dict:
+        """The fleet-wide telescope: per-leader page summaries plus the
+        EXACT merge of every stored page (CMS cell-wise add, HLL
+        register max, Space-Saving union with summed floors — see
+        docs/SEMANTICS.md). ``slot_budget`` adds an admission-readiness
+        report over the merged page; ``budgets`` adds the projection
+        curve the dashboard charts."""
+        from sentinel_tpu.telemetry import population as pop
+
+        with self._lock:
+            pages = []
+            leaders: Dict[str, Dict] = {}
+            for name, ls in self._leaders.items():
+                row: Dict = {
+                    "polls": ls.population_polls,
+                    "errors": ls.population_errors,
+                    "unsupported": ls.population_unsupported,
+                    "atMs": ls.population_at_ms,
+                }
+                if ls.population:
+                    pages.append(ls.population)
+                    row.update(pop.page_summary(ls.population))
+                leaders[name] = row
+        merged = pop.merge_pages(pages) if pages else {}
+        win_s = max(1, int(merged.get("geom", {}).get("windowMs", 1000))
+                    // 1000) if merged else 1
+        out: Dict = {
+            "leaders": leaders,
+            "pagesMerged": len(pages),
+            "merged": merged,
+            "summary": pop.page_summary(merged) if merged else {},
+        }
+        if merged and slot_budget is not None:
+            out["report"] = pop.report_from_page(merged, slot_budget, win_s)
+        if merged and budgets:
+            out["curve"] = pop.projection_curve(merged, budgets, win_s)
+        return out
 
     def _ingest(self, ls: _LeaderState, payload: Dict) -> int:
         name = ls.spec.name

@@ -504,6 +504,78 @@ def cmd_waterfall(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(str(ex))
 
 
+@command_mapping("population", "namespace telescope: top-k, cardinality, "
+                               "churn, admission-readiness projection")
+def cmd_population(req: CommandRequest) -> CommandResponse:
+    """The namespace telescope's read plane
+    (sentinel_tpu/telemetry/population.py — ISSUE 19). ``op`` selects:
+
+      * ``status`` (default) — totals, HLL cardinalities (global +
+        per-slice), top-k with error bars, churn series, baseline +
+        alarm, fold-overhead counters (refreshes the fold first);
+        ``topk=`` / ``windows=`` cap the lists
+      * ``report`` — admission-readiness projection for a hypothetical
+        slot budget (``budget=``, default 1024): hit rate with
+        guaranteed/upper bounds, eviction/steal rate, cold-tail mass
+      * ``curve`` — ``report`` across a budget ladder (``budgets=``
+        comma list) — the dashboard's projection curve
+      * ``page`` — the raw mergeable wire page (federation debugging)
+      * ``fleet`` — scrape + exactly merge every watched leader's page
+        (needs a ``fleet op=watch`` collector); ``budget=``/``budgets=``
+        add the merged report/curve
+    """
+    population = getattr(req.engine, "population", None)
+    if population is None:
+        return CommandResponse.of_failure("population tracker unavailable")
+    op = req.get_param("op", "status")
+    try:
+        if op == "status":
+            req.engine.slo_refresh()
+            topk = req.get_param("topk")
+            windows = int(req.get_param("windows", "60"))
+            return CommandResponse.of_success(population.snapshot(
+                topk=int(topk) if topk is not None else None,
+                windows=windows))
+        if op == "report":
+            budget = int(req.get_param("budget", "1024"))
+            return CommandResponse.of_success(
+                req.engine.population_report(slot_budget=budget))
+        if op == "curve":
+            from sentinel_tpu.telemetry.population import projection_curve
+
+            req.engine.slo_refresh()
+            budgets = [int(x) for x in
+                       (req.get_param("budgets")
+                        or "16,32,64,128,256,512,1024,4096").split(",") if x]
+            page = population.page()
+            return CommandResponse.of_success({
+                "curve": projection_curve(
+                    page, budgets,
+                    window_seconds=population.window_ms // 1000),
+                "alarm": population.alarm,
+            })
+        if op == "page":
+            req.engine.slo_refresh()
+            return CommandResponse.of_success(population.page())
+        if op == "fleet":
+            fleet = getattr(req.engine, "fleet", None)
+            if fleet is None:
+                return CommandResponse.of_success(
+                    {"watching": False,
+                     "hint": "no collector attached (fleet op=watch first)"})
+            if (req.get_param("poll") or "true").lower() != "false":
+                fleet.poll_population()
+            budget = req.get_param("budget")
+            budgets = req.get_param("budgets")
+            return CommandResponse.of_success(fleet.fleet_population(
+                slot_budget=int(budget) if budget is not None else None,
+                budgets=([int(x) for x in budgets.split(",") if x]
+                         if budgets else None)))
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("adaptive", "closed-loop adaptive limiting: status, "
                              "enable/freeze, targets, decision log")
 def cmd_adaptive(req: CommandRequest) -> CommandResponse:

@@ -361,6 +361,20 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_waterfall_exemplars",
                  "sentinel_tpu_waterfall_budget_ms"):
         assert name in seen, f"{name} not declared in the exporters"
+    # namespace-telescope families (ISSUE 19): declared exactly once
+    # (the dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_population_enabled",
+                 "sentinel_tpu_population_observed",
+                 "sentinel_tpu_population_distinct",
+                 "sentinel_tpu_population_window_distinct",
+                 "sentinel_tpu_population_ss_floor",
+                 "sentinel_tpu_population_hot_mass",
+                 "sentinel_tpu_population_churn_entered",
+                 "sentinel_tpu_population_churn_exited",
+                 "sentinel_tpu_population_cardinality_z",
+                 "sentinel_tpu_population_cardinality_alarm",
+                 "sentinel_tpu_population_fold_ms"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -844,6 +858,96 @@ def test_no_wall_clock_in_waterfall():
         "wall-clock read in the waterfall recorder (ride the injected "
         "engine clock; perf_counter is for durations only): "
         + ", ".join(offenders))
+
+
+def test_population_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.population.*`` config key must (a) be
+    defined and read ONLY in core/config.py — the rest of the package
+    goes through the ``SentinelConfig`` ``population_*`` accessors —
+    and (b) appear in docs/OPERATIONS.md "Namespace telescope &
+    admission readiness", so the runbook can never silently drift from
+    the knobs the code actually reads (same rule shape as the
+    waterfall gate above)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.population\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.population.* literals outside core/config.py (use "
+        "the SentinelConfig population_* accessors): "
+        + ", ".join(offenders))
+    assert keys, "no population config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "population config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_no_wall_clock_in_population():
+    """The namespace telescope must ride the ENGINE timebase only: its
+    churn windows and cardinality series are part of the replay-
+    determinism contract (two runs of the same trace produce identical
+    population series), and an ambient wall-clock read would stamp
+    them with a second clock. ``time.perf_counter`` stays sanctioned —
+    it is the fold's DURATION source (self-timed overhead counter),
+    never a timestamp. Same rule shape as the waterfall gate."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    path = REPO / "sentinel_tpu" / "telemetry" / "population.py"
+    offenders = []
+    for lineno, code in _code_lines(path):
+        if pattern.search(code):
+            offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in the namespace telescope (ride the injected "
+        "engine clock; perf_counter is for durations only): "
+        + ", ".join(offenders))
+
+
+def test_sketch_hashing_only_in_the_population_module():
+    """Leader pages merge EXACTLY only if every tracker places a given
+    key in the same count-min cells and HLL register, so there is
+    exactly one sketch-hash implementation: ``population.sketch_hash``
+    plus its splitmix64 row finalizer. A re-implementation anywhere
+    else in the package (a copied mix constant or a second
+    ``sketch_hash`` definition) can silently diverge and void the
+    cell-wise merge identity (same rule shape as the slice-hashing
+    gate)."""
+    import re
+
+    helper = Path("sentinel_tpu") / "telemetry" / "population.py"
+    mix = re.compile(r"0xBF58476D1CE4E5B9", re.IGNORECASE)
+    defn = re.compile(r"^def\s+sketch_hash\s*\(")
+    offenders = []
+    seen_helper = False
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        is_helper = rel == helper
+        for lineno, code in _code_lines(path):
+            if is_helper:
+                seen_helper = seen_helper or bool(defn.search(code))
+                continue
+            for pat, what in ((mix, "the sketch-mix constant"),
+                              (defn, "a second sketch_hash definition")):
+                if pat.search(code):
+                    offenders.append(f"{rel}:{lineno} carries {what}")
+    assert seen_helper, "population.sketch_hash not found (helper moved?)"
+    assert not offenders, (
+        "sketch hashing outside telemetry/population.py (route through "
+        "population.sketch_hash): " + ", ".join(offenders))
 
 
 def test_rebalance_config_keys_accessor_only_and_documented():

@@ -278,7 +278,13 @@ def _drive_differential(engine, frozen_time, seed, steps):
     assert stats["active"] == 0
 
 
-@pytest.mark.parametrize("seed,steps", [(3, 70), (11, 70)])
+# One quick seed per oracle keeps tier-1 honest without paying twice
+# for the same code paths; the second short seed rides the slow tier
+# with the soak pair (tier-1 wall-time trim, ISSUE 19 satellite).
+@pytest.mark.parametrize("seed,steps", [
+    (3, 70),
+    pytest.param(11, 70, marks=pytest.mark.slow),
+])
 def test_tps_differential_oracle(engine, frozen_time, seed, steps):
     _drive_differential(engine, frozen_time, seed, steps)
 

@@ -1,0 +1,467 @@
+"""Namespace telescope (ISSUE 19): differential oracles for the
+sketches, bit-exact merge semantics, churn/alarm behaviour, the
+engine/fleet wiring, and the Zipf admission-readiness acceptance.
+
+The sketch tests are DIFFERENTIAL: every randomized stream is counted
+twice — once by the sketch under test, once by a plain dict — and the
+published error bound is checked against the exact answer. Determinism
+is structural (blake2b hashing, insertion-order folds), so the same
+seeds always exercise the same cells.
+"""
+
+import hashlib
+import json
+import math
+import random
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core.config import config
+from sentinel_tpu.core.context import replace_context
+from sentinel_tpu.telemetry.population import (
+    CountMinSketch,
+    HyperLogLog,
+    PopulationTracker,
+    SpaceSaving,
+    _hll_b64_estimate,
+    merge_pages,
+    page_summary,
+    report_from_page,
+    sketch_hash,
+)
+from sentinel_tpu.transport.command_center import CommandRequest
+from sentinel_tpu.transport.handlers import cmd_population
+from sentinel_tpu.utils import time_util
+from tests.test_telemetry import _batch
+
+BASE_MS = 1_700_000_000_000
+WIN_MS = 10_000  # csp.sentinel.population.window.seconds default
+
+
+def _res(out):
+    return json.loads(out.result)
+
+
+# -- hashing: pinned and seed-independent ---------------------------------
+
+
+def test_sketch_hash_is_pinned_and_seed_independent():
+    """The sketch hash is a WIRE contract (fleet merge identity): pin
+    the construction AND a literal value so a silent swap fails here
+    before it mis-merges a mixed fleet."""
+    expect = int.from_bytes(
+        hashlib.blake2b(b"ns#1234", digest_size=8).digest(), "big")
+    assert sketch_hash("ns#1234") == expect
+    assert sketch_hash("ns#1234") == 0xB01304D4E2C7A057
+
+
+# -- Space-Saving vs exact oracle -----------------------------------------
+
+
+@pytest.mark.parametrize("seed,n_keys,k", [(3, 300, 50), (17, 120, 32)])
+def test_space_saving_guarantee_vs_exact_oracle(seed, n_keys, k):
+    rng = random.Random(seed)
+    ss = SpaceSaving(k)
+    truth = {}
+    keys = [f"key{i}" for i in range(n_keys)]
+    weights = [1.0 / (i + 1) ** 1.05 for i in range(n_keys)]
+    for key in rng.choices(keys, weights, k=6000):
+        inc = rng.randint(1, 4)
+        ss.update(key, inc)
+        truth[key] = truth.get(key, 0) + inc
+    total = sum(truth.values())
+    entries = {key: (cnt, err) for key, cnt, err in ss.top()}
+    # (a) any key heavier than total/k is guaranteed present
+    for key, true in truth.items():
+        if true > total / k:
+            assert key in entries, f"heavy hitter {key} evicted"
+    # (b) per-entry bracket: count - err <= true <= count
+    for key, (cnt, err) in entries.items():
+        true = truth.get(key, 0)
+        assert cnt - err <= true <= cnt, (key, cnt, err, true)
+    # (c) the floor bounds every ABSENT key's true count
+    floor = ss.floor()
+    for key, true in truth.items():
+        if key not in entries:
+            assert true <= floor, (key, true, floor)
+
+
+# -- count-min vs exact oracle --------------------------------------------
+
+
+def test_cms_overestimates_only_and_within_epsilon():
+    rng = random.Random(29)
+    cms = CountMinSketch(4, 512)
+    truth = {}
+    for i in rng.choices(range(2000), k=8000):
+        h = sketch_hash(f"cms{i}")
+        cms.update(h, 1)
+        truth[h] = truth.get(h, 0) + 1
+    total = sum(truth.values())
+    bound = cms.epsilon_total(total)
+    violations = 0
+    for h, true in truth.items():
+        got = cms.query(h)
+        assert got >= true, "count-min must never undercount"
+        if got - true > bound:
+            violations += 1
+    # The (e/width)*total bound holds per query with confidence
+    # 1 - e^-depth (~98% at depth 4); allow the tail its due.
+    assert violations / len(truth) < 0.05, (violations, len(truth), bound)
+
+
+# -- HyperLogLog vs exact oracle ------------------------------------------
+
+
+@pytest.mark.parametrize("card", [100, 1000, 5000])
+def test_hll_within_standard_error(card):
+    hll = HyperLogLog(11)
+    for i in range(card):
+        hll.add(sketch_hash(f"hll{card}:{i}"))
+    est = hll.estimate()
+    # stderr = 1.04/sqrt(2^11) ~ 2.3%; allow ~3.5 sigma
+    assert abs(est - card) / card < 0.08, (est, card)
+
+
+# -- standalone tracker: fold, windows, churn -----------------------------
+
+
+def _tracker(transition=None):
+    return PopulationTracker(now_ms=lambda: BASE_MS, transition=transition)
+
+
+def test_tracker_fold_windows_and_churn_series():
+    tr = _tracker()
+    tr.observe_pairs([("a", 6), ("b", 4)])
+    tr.roll(BASE_MS)
+    tr.observe("a", 2)
+    tr.roll(BASE_MS + 1000)           # same window: no seal yet
+    assert tr.windows_sealed == 0
+    tr.observe("c", 1)
+    tr.roll(BASE_MS + WIN_MS)         # seals window 0, folds c into w1
+    tr.roll(BASE_MS + 2 * WIN_MS)     # seals window 1
+    series = tr.series()
+    assert [w["windowMs"] for w in series] == [BASE_MS, BASE_MS + WIN_MS]
+    assert series[0]["observed"] == 12 and series[0]["entered"] == 2
+    assert series[1]["observed"] == 1
+    assert series[1]["entered"] == 1 and series[1]["exited"] == 0
+    assert tr.observed_total == 13 and tr.folded_keys == 4
+    snap = tr.snapshot()
+    assert snap["topk"][0] == {"key": "a", "count": 8, "err": 0}
+    assert snap["ssFloor"] == 0      # below capacity: summary is exact
+    assert 2.5 < snap["distinct"] < 3.5
+
+
+def test_cardinality_baseline_alarm_fires_and_resolves():
+    fired = []
+    tr = _tracker(transition=lambda *a: fired.append(a))
+    steady = [(f"s{i}", 1) for i in range(6)]
+    now = BASE_MS
+    for i in range(13):               # 12 sealed steady windows (> warmup)
+        tr.observe_pairs(steady[:5 + i % 2])  # tiny jitter: variance > 0
+        tr.roll(now)
+        now += WIN_MS
+    assert not any(f[1] for f in fired)
+    tr.observe_pairs([(f"blow{i}", 1) for i in range(400)])
+    tr.roll(now)                      # folds the blowup into the open window
+    tr.roll(now + WIN_MS)             # seals it -> alarm
+    assert tr.alarm is True
+    firing = [f for f in fired if f[1]]
+    assert firing and firing[-1][0] == PopulationTracker.ALERT_KEY
+    fields = firing[-1][3]
+    assert fields["kind"] == "population" and fields["z"] > 4.0
+    tr.observe_pairs(steady[:5])
+    tr.roll(now + 2 * WIN_MS)         # a calm window seals -> resolve
+    assert tr.alarm is False
+    assert any(not f[1] for f in fired[len(firing):] or fired)
+
+
+def test_no_observation_when_disabled():
+    config.set("csp.sentinel.population.enabled", "false")
+    try:
+        tr = _tracker()
+        assert tr.enabled is False
+        tr.observe("x", 5)
+        tr.observe_pairs([("y", 1)])
+        tr.roll(BASE_MS)
+        assert tr.observed_total == 0 and tr.fold_count == 0
+    finally:
+        config.set("csp.sentinel.population.enabled", "")
+
+
+# -- merge semantics: exact, associative, commutative ---------------------
+
+
+def _page_from(stream, windows=2):
+    """A page from a standalone tracker fed ``stream`` across
+    ``windows`` churn windows."""
+    tr = _tracker()
+    per = max(1, len(stream) // windows)
+    now = BASE_MS
+    for i in range(0, len(stream), per):
+        tr.observe_pairs(stream[i:i + per])
+        tr.roll(now)
+        now += WIN_MS
+    tr.roll(now)                      # seal the last window
+    return tr.page()
+
+
+def _canon(page):
+    return json.dumps(page, sort_keys=True, separators=(",", ":"))
+
+
+def test_merge_is_associative_and_commutative_bit_exact():
+    rng = random.Random(77)
+    pool = [f"f{i}" for i in range(160)]
+    pages = [
+        _page_from([(k, rng.randint(1, 5))
+                    for k in rng.choices(pool[:120], k=400)]),
+        _page_from([(k, rng.randint(1, 5))
+                    for k in rng.choices(pool[40:], k=300)]),
+        _page_from([(k, 2) for k in rng.choices(pool, k=200)]),
+    ]
+    a, b, c = pages
+    left = merge_pages([merge_pages([a, b]), c])
+    right = merge_pages([a, merge_pages([b, c])])
+    flat = merge_pages([a, b, c])
+    shuffled = merge_pages([c, a, b])
+    assert _canon(left) == _canon(right) == _canon(flat) == _canon(shuffled)
+    # conservation: exact totals sum
+    assert flat["observed"] == sum(p["observed"] for p in pages)
+    assert flat["leaders"] == 3
+
+
+def test_merge_identity_and_error_bound_summation():
+    rng = random.Random(5)
+    stream = [(f"q{i}", rng.randint(1, 3))
+              for i in rng.choices(range(40), k=200)]
+    page = _page_from(stream)
+    solo = merge_pages([page])
+    assert solo["observed"] == page["observed"]
+    assert solo["ss"]["floor"] == page["ss"]["floor"]
+    assert {e[0]: e[1] for e in solo["ss"]["entries"]} == \
+        {e[0]: e[1] for e in page["ss"]["entries"]}
+    s1, s2 = page_summary(page), page_summary(solo)
+    assert (s1["observed"], s1["distinct"], s1["hotMass"]) == \
+        (s2["observed"], s2["distinct"], s2["hotMass"])
+    # The SEMANTICS asymmetry: a key absent from one page widens its
+    # merged bracket by that page's floor — never below the truth.
+    other = _page_from([(f"other{i}", 4) for i in range(70)])
+    merged = merge_pages([page, other])
+    assert merged["ss"]["floor"] == \
+        page["ss"]["floor"] + other["ss"]["floor"]
+    ent = {e[0]: (e[1], e[2]) for e in merged["ss"]["entries"]}
+    truth = {}
+    for k, c in stream:
+        truth[k] = truth.get(k, 0) + c
+    for key, true in truth.items():
+        if key in ent:
+            cnt, err = ent[key]
+            assert cnt - err <= true <= cnt
+
+
+def test_merge_rejects_geometry_mismatch():
+    page = _page_from([("a", 1)])
+    bad = _page_from([("b", 1)])
+    bad["geom"] = dict(bad["geom"], cmsWidth=128)
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        merge_pages([page, bad])
+
+
+def test_page_shrinks_loudly_under_byte_cap():
+    tr = _tracker()
+    tr.observe_pairs([(f"pp{i}", 1) for i in range(300)])
+    tr.roll(BASE_MS)
+    full = len(json.dumps(tr.page(), separators=(",", ":")))
+    small = tr.page(max_bytes=9000)
+    assert full > 9000, "stream too small to force a shrink (test rot)"
+    assert len(json.dumps(small, separators=(",", ":"))) <= 9000
+    assert "sliceHll" in small["truncated"]
+    assert small["observed"] == 300       # totals survive truncation
+    assert len(small["ss"]["entries"]) >= 8  # the top-k head is kept
+
+
+# -- fleet federation: stub leaders, bit-exact merged view ----------------
+
+
+class _PopClient:
+    def __init__(self, page):
+        self._page = page
+
+    def request_population_page(self, timeout_s=None):
+        return json.loads(json.dumps(self._page)) \
+            if self._page is not None else None
+
+    def is_connected(self):
+        return True
+
+    def stop(self):
+        pass
+
+
+def test_fleet_population_merges_bit_exactly_and_latches_unsupported():
+    from sentinel_tpu.telemetry.fleet import FleetView
+
+    rng = random.Random(42)
+    pa = _page_from([(f"x{i}", rng.randint(1, 6))
+                     for i in rng.choices(range(90), k=300)])
+    pb = _page_from([(f"x{i}", 1) for i in rng.choices(range(150), k=250)])
+    clients = {1: _PopClient(pa), 2: _PopClient(pb),
+               3: _PopClient({"unsupported": True})}
+    fv = FleetView([("LA", "h", 1), ("LB", "h", 2), ("LOLD", "h", 3)],
+                   clock=lambda: BASE_MS,
+                   client_factory=lambda h, p: clients[p])
+    try:
+        ok = fv.poll_population()
+        assert ok == {"LA": True, "LB": True, "LOLD": False}
+        view = fv.fleet_population(slot_budget=8, budgets=[4, 16])
+        assert view["pagesMerged"] == 2
+        assert view["leaders"]["LOLD"]["unsupported"] is True
+        # read-time merge == a direct merge of the same pages, bit-exact
+        assert _canon(view["merged"]) == _canon(merge_pages([pa, pb]))
+        assert view["report"]["slotBudget"] == 8
+        assert [c["slotBudget"] for c in view["curve"]] == [4, 16]
+        # unsupported leaders are never polled again
+        fv.poll_population()
+        assert fv._leaders["LOLD"].population_polls == 1
+    finally:
+        fv.stop()
+
+
+# -- engine wiring: A/B device-work guard, report, ops command ------------
+
+
+def _drive_second(eng, lanes, now):
+    time_util.freeze_time(now)
+    eng._run_entry_batch(_batch(eng, lanes))
+    eng.slo_refresh(now_ms=now)
+
+
+def test_population_fold_adds_no_device_work():
+    """A/B guard (acceptance): the same stream with the telescope on
+    and off dispatches the SAME device programs — observation stages
+    host-side pairs and the fold is host arithmetic on the spill."""
+
+    def run(enabled):
+        replace_context(None)
+        config.set("csp.sentinel.population.enabled",
+                   "" if enabled else "false")
+        eng = st.reset(capacity=256)
+        st.load_flow_rules([st.FlowRule(resource="ab", count=100)])
+        now = BASE_MS
+        for _ in range(5):
+            _drive_second(eng, [("ab", "", None)] * 4, now)
+            now += 1000
+        time_util.freeze_time(now)
+        eng.slo_refresh(now_ms=now)
+        dispatches = {k: v["dispatches"]
+                      for k, v in eng.step_timer.snapshot().items()}
+        return dispatches, eng.population.observed_total
+
+    time_util.freeze_time(BASE_MS)
+    try:
+        off_dispatches, off_observed = run(False)
+        on_dispatches, on_observed = run(True)
+    finally:
+        config.set("csp.sentinel.population.enabled", "")
+        time_util.unfreeze_time()
+        replace_context(None)
+        st.reset(capacity=512)
+    assert off_observed == 0
+    assert on_observed == 20, "the A/B run never exercised the telescope"
+    assert on_dispatches == off_dispatches
+
+
+def test_zipf_replay_hit_rate_projection_within_5pct(engine):
+    """Acceptance: seeded Zipf stream through the REAL engine; the
+    admission-readiness report predicts the measured hot-set hit rate
+    within 5% absolute for three slot budgets."""
+    rng = random.Random(1234)
+    n_res = 150
+    resources = [f"z{i:03d}" for i in range(n_res)]
+    weights = [1.0 / (r + 1) ** 1.1 for r in range(n_res)]
+    truth = {}
+    now = BASE_MS
+    for _ in range(25):
+        draws = rng.choices(resources, weights, k=200)
+        for res in draws:
+            truth[res] = truth.get(res, 0) + 1
+        time_util.freeze_time(now)
+        for i in range(0, len(draws), 100):
+            engine._run_entry_batch(_batch(
+                engine, [(res, "", None) for res in draws[i:i + 100]]))
+        engine.slo_refresh(now_ms=now)
+        now += 1000
+    time_util.freeze_time(now)
+    total = sum(truth.values())
+    ranked = sorted(truth.values(), reverse=True)
+    for budget in (4, 12, 32):
+        rep = engine.population_report(slot_budget=budget, now_ms=now)
+        measured = sum(ranked[:budget]) / total
+        assert abs(rep["hitRate"] - measured) <= 0.05, (budget, rep, measured)
+        assert rep["hitRateGuaranteed"] <= rep["hitRate"] \
+            <= rep["hitRateUpper"] + 1e-9
+    assert engine.population.observed_total == total
+    # beyond-k budgets extrapolate and say so
+    wide = engine.population_report(slot_budget=4096, now_ms=now)
+    assert wide["extrapolated"] is True and wide["hitRate"] <= 1.0
+
+
+def test_population_command_surface(engine):
+    now = BASE_MS
+    for _ in range(3):
+        _drive_second(engine, [("cmdA", "", None)] * 3
+                      + [("cmdB", "", None)], now)
+        now += 1000
+    time_util.freeze_time(now)
+    engine.slo_refresh(now_ms=now)
+    out = _res(cmd_population(CommandRequest(
+        parameters={"op": "status"}, engine=engine)))
+    assert out["enabled"] is True and out["observed"] == 12
+    assert out["topk"][0]["key"] == "cmdA"
+    rep = _res(cmd_population(CommandRequest(
+        parameters={"op": "report", "budget": "1"}, engine=engine)))
+    assert rep["slotBudget"] == 1 and rep["hitRate"] == 0.75
+    curve = _res(cmd_population(CommandRequest(
+        parameters={"op": "curve", "budgets": "1,2"}, engine=engine)))
+    assert [c["slotBudget"] for c in curve["curve"]] == [1, 2]
+    page = _res(cmd_population(CommandRequest(
+        parameters={"op": "page"}, engine=engine)))
+    assert page["observed"] == 12 and "cms" in page
+    bad = cmd_population(CommandRequest(
+        parameters={"op": "report", "budget": "wat"}, engine=engine))
+    assert not bad.success
+
+
+def test_exporter_ships_population_families(engine):
+    from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+    _drive_second(engine, [("exp", "", None)] * 2, BASE_MS)
+    time_util.freeze_time(BASE_MS + 1000)
+    engine.slo_refresh(now_ms=BASE_MS + 1000)
+    text = render_engine_metrics(engine)
+    assert "sentinel_tpu_population_enabled 1" in text
+    assert "sentinel_tpu_population_observed_total 2" in text
+    for fam in ("sentinel_tpu_population_distinct",
+                "sentinel_tpu_population_ss_floor",
+                "sentinel_tpu_population_cardinality_alarm",
+                "sentinel_tpu_population_fold_ms_total"):
+        assert fam in text, fam
+
+
+# -- replay determinism ----------------------------------------------------
+
+
+def test_replay_population_series_deterministic():
+    from sentinel_tpu.simulator import ReplayEngine, build_scenario
+
+    tr = build_scenario("flash_crowd", seconds=20, seed=7)
+    # spill every simulated second (the live cadence) so churn windows
+    # seal inside a 20s trace — the open-loop default spills sparsely.
+    r1 = ReplayEngine(tr, spill_every_s=1).run()
+    r2 = ReplayEngine(tr, spill_every_s=1).run()
+    assert r1.population == r2.population
+    assert r1.population["observed"] > 0
+    assert r1.population["windows"], "no churn window sealed in 20s"
+    assert r1.population["topk"]
