@@ -1029,6 +1029,80 @@ def bench_population_probe() -> dict:
     }}
 
 
+def bench_slot_churn() -> dict:
+    """ISSUE 20 acceptance capture, two numbers:
+
+    (1) slot-table admission under a seeded Zipf stream at budgets
+        8/32/256: the MEASURED hot-set hit rate (core/slots.py
+        ``hit_rate()``) against the telescope's ``population_report``
+        PROJECTION for the same budget — the <=5%-absolute acceptance
+        that the PR 18 readiness probe actually predicts the PR 20
+        machinery it was built to size;
+    (2) the A/B guard: the same stream with the invariant event sink
+        attached must dispatch the SAME device programs and land the
+        IDENTICAL counters — observability is free, and the whole
+        slot pipeline replays deterministically.
+    """
+    from sentinel_tpu.core.context import replace_context
+    from sentinel_tpu.core.engine import SentinelEngine
+    from sentinel_tpu.simulator.clock import SimClock
+
+    n_res, per_sec, seconds = 300, 256, 16
+    base = 1_700_000_000_000
+
+    def run(budget: int, sink: bool):
+        replace_context(None)
+        clk = SimClock(base)
+        # +2: rows 0/1 are reserved, so the USABLE hot set matches the
+        # projection's budget exactly.
+        eng = SentinelEngine(clock=clk.now_ms, journal_path="",
+                             slot_budget=budget + 2)
+        if sink:
+            events = []
+            eng.slots.event_sink = events.append
+        rng = np.random.default_rng(20)
+        try:
+            for _ in range(seconds):
+                picks = np.minimum(rng.zipf(1.2, size=per_sec), n_res) - 1
+                for i in picks.tolist():
+                    eng.entry(f"churn{i}").exit()
+                clk.advance(1000)
+                eng.slo_refresh(now_ms=clk.now_ms())
+            rep = eng.population_report(slot_budget=budget,
+                                        now_ms=clk.now_ms())
+            status = eng.slots.status()
+            dispatches = {k: v["dispatches"]
+                          for k, v in eng.step_timer.snapshot().items()}
+        finally:
+            eng.close()
+            replace_context(None)
+        return status, rep, dispatches
+
+    budgets = {}
+    for budget in (8, 32, 256):
+        status, rep, _ = run(budget, sink=False)
+        budgets[str(budget)] = {
+            "measuredHitRate": status["hitRate"],
+            "predictedHitRate": rep["hitRate"],
+            "absError": round(abs(status["hitRate"] - rep["hitRate"]), 6),
+            "evictions": status["evictionsTotal"],
+            "steals": status["stealsTotal"],
+            "coldPass": status["coldPassTotal"],
+            "coldBlock": status["coldBlockTotal"],
+        }
+    s1, _, d1 = run(32, sink=False)
+    s2, _, d2 = run(32, sink=True)
+    return {"slot_churn": {
+        "budgets": budgets,
+        "abGuard": {
+            "dispatchesEqual": d1 == d2,
+            "hitRateEqual": s1["hitRate"] == s2["hitRate"],
+            "evictionsEqual":
+                s1["evictionsTotal"] == s2["evictionsTotal"],
+        },
+    }}
+
+
 def bench_wire_mesh() -> dict:
     """ISSUE 11 acceptance: end-to-end wire QPS at mesh concurrency —
     64 pipelined TLV connections through the reactor frontend over real
@@ -1621,7 +1695,7 @@ def _write_artifact(record: dict) -> None:
     line. Best-effort — an unwritable CWD must not kill the record."""
     import os
 
-    path = os.environ.get("BENCH_ARTIFACT", "BENCH_18.json")
+    path = os.environ.get("BENCH_ARTIFACT", "BENCH_19.json")
     try:
         # tmp + rename: a hard kill (SIGKILL/OOM — uncatchable) landing
         # mid-dump must truncate the TMP file, never the last complete
@@ -1890,7 +1964,7 @@ def main() -> None:
                         bench_param_cms_100k,
                         bench_native_token_loopback,
                         bench_waterfall_probe,
-                        bench_population_probe):
+                        bench_population_probe, bench_slot_churn):
             try:
                 out.update(section())
             except Exception as ex:  # noqa: BLE001

@@ -28,6 +28,14 @@ its prose proof):
   and per-slice over-admission stays within the summed grants-since-
   last-publish bound — the invariant the shard rebalancer (ISSUE 16)
   certifies a plan against before apply
+* ``slot_conservation`` — the slot-table admission ledger (ISSUE 20):
+  per device slot, admits and evicts strictly alternate at strictly
+  increasing generations; every ``slotVerdict`` is attributed to
+  exactly ONE (resource, generation) — the slot's standing tenant at
+  that point in the stream, never a stale or future occupant of a
+  reused slot; and every evict→rehydrate round trip conserves window
+  state (grafted + stale window passes never exceed the pass count
+  spilled at eviction, and a TORN spill can only rehydrate cold)
 
 Deliberate asymmetries (also in SEMANTICS.md): a verdict granted
 server-side whose reply is lost (half-open swallow, fence rejection)
@@ -319,6 +327,130 @@ def check_slice_conservation(history: History,
     return out
 
 
+def check_slot_conservation(history: History, thresholds, divisor) \
+        -> List[Violation]:
+    """The slot-table admission ledger (core/slots.py, ISSUE 20).
+
+    Scans the ordered event stream once, replaying tenancy:
+
+    * ``slotAdmit``/``slotEvict`` strictly alternate per slot, the
+      evict names the standing tenant's exact (resource, generation),
+      and admit generations are strictly increasing per slot.
+    * ``slotVerdict`` attribution: the verdict's (resource, slot, gen)
+      must equal the slot's standing tenant — a reused slot must never
+      book a verdict against the evicted resource's series (the
+      generation-leak defense made executable).
+    * ``slotRehydrate`` conservation: ``graftedPass + stalePass`` never
+      exceeds the ``spilledPass`` recorded by that resource's most
+      recent untorn evict (window passes are a subset of the cumulative
+      passes spilled), a from-record graft requires such an evict to
+      exist, and a TORN evict forces the next rehydrate cold
+      (``fromRecord`` false, nothing grafted).
+    """
+    out: List[Violation] = []
+    standing: Dict[int, Tuple[object, int]] = {}   # slot -> (resource, gen)
+    last_gen: Dict[int, int] = {}                  # slot -> last admit gen
+    last_evict: Dict[object, dict] = {}            # resource -> evict event
+    pending_graft: Dict[int, dict] = {}            # slot -> rehydrate event
+    for ev in history.events:
+        kind = ev["e"]
+        if kind == "slotAdmit":
+            slot, gen, res = int(ev["slot"]), int(ev["gen"]), ev["resource"]
+            if slot in standing:
+                out.append(Violation(
+                    "slot_conservation",
+                    f"slot {slot}: admit of {res!r}@g{gen} while "
+                    f"{standing[slot][0]!r}@g{standing[slot][1]} still "
+                    "standing (admits/evicts must alternate)",
+                    second=ev.get("sec")))
+            if slot in last_gen and gen <= last_gen[slot]:
+                out.append(Violation(
+                    "slot_conservation",
+                    f"slot {slot}: admit generation g{gen} not above the "
+                    f"previous admit g{last_gen[slot]} (generations must "
+                    "strictly increase per slot)"))
+            graft = pending_graft.pop(slot, None)
+            if graft is not None and (graft["resource"] != res
+                                      or int(graft["gen"]) != gen):
+                out.append(Violation(
+                    "slot_conservation",
+                    f"slot {slot}: rehydrate of {graft['resource']!r}"
+                    f"@g{graft['gen']} not claimed by the admit that "
+                    f"followed it ({res!r}@g{gen})"))
+            standing[slot] = (res, gen)
+            last_gen[slot] = gen
+        elif kind == "slotEvict":
+            slot, gen, res = int(ev["slot"]), int(ev["gen"]), ev["resource"]
+            cur = standing.pop(slot, None)
+            if cur is None:
+                out.append(Violation(
+                    "slot_conservation",
+                    f"slot {slot}: evict of {res!r}@g{gen} from an "
+                    "unoccupied slot"))
+            elif cur != (res, gen):
+                out.append(Violation(
+                    "slot_conservation",
+                    f"slot {slot}: evict names {res!r}@g{gen} but the "
+                    f"standing tenant is {cur[0]!r}@g{cur[1]}"))
+            last_evict[res] = ev
+        elif kind == "slotRehydrate":
+            slot, res = int(ev["slot"]), ev["resource"]
+            grafted = int(ev.get("graftedPass", 0))
+            stale = int(ev.get("stalePass", 0))
+            prior = last_evict.get(res)
+            if ev.get("fromRecord"):
+                if prior is None:
+                    out.append(Violation(
+                        "slot_conservation",
+                        f"{res!r}: rehydrate claims a spill record but no "
+                        "evict of that resource precedes it"))
+                elif prior.get("torn"):
+                    out.append(Violation(
+                        "slot_conservation",
+                        f"{res!r}: rehydrate claims a spill record but the "
+                        "most recent evict was TORN (a torn spill must "
+                        "rehydrate cold)"))
+                elif grafted + stale > int(prior.get("spilledPass", 0)):
+                    out.append(Violation(
+                        "slot_conservation",
+                        f"{res!r}: rehydrate grafted {grafted}+{stale} "
+                        f"window passes > {prior.get('spilledPass')} "
+                        "passes spilled at eviction (round-trip must "
+                        "conserve window state)"))
+            elif grafted or stale:
+                out.append(Violation(
+                    "slot_conservation",
+                    f"{res!r}: cold rehydrate (no record) reports "
+                    f"grafted={grafted} stale={stale} — nothing may be "
+                    "grafted without a spill record"))
+            pending_graft[slot] = ev
+        elif kind == "slotVerdict":
+            slot, gen, res = int(ev["slot"]), int(ev["gen"]), ev["resource"]
+            if slot < 0:
+                # Cold-lane verdict: attributed to the COLD generation,
+                # never to device-slot tenancy — but it must SAY so.
+                if gen >= 0:
+                    out.append(Violation(
+                        "slot_conservation",
+                        f"{res!r}: cold-lane verdict (slot {slot}) claims "
+                        f"device generation g{gen}", second=ev.get("sec")))
+                continue
+            cur = standing.get(slot)
+            if cur is None:
+                out.append(Violation(
+                    "slot_conservation",
+                    f"slot {slot}: verdict for {res!r}@g{gen} booked "
+                    "against an unoccupied slot", second=ev.get("sec")))
+            elif cur != (res, gen):
+                out.append(Violation(
+                    "slot_conservation",
+                    f"slot {slot}: verdict for {res!r}@g{gen} but the "
+                    f"standing tenant is {cur[0]!r}@g{cur[1]} (every "
+                    "verdict must attribute to exactly one "
+                    "(resource, generation))", second=ev.get("sec")))
+    return out
+
+
 CHECKERS = (
     ("conservation", check_conservation),
     ("no_stranded", check_no_stranded),
@@ -328,6 +460,7 @@ CHECKERS = (
     ("epoch_monotone", check_epoch_monotone),
     ("journal_monotone", check_journal_monotone),
     ("slice_conservation", check_slice_conservation),
+    ("slot_conservation", check_slot_conservation),
 )
 
 

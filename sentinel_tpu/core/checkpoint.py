@@ -170,6 +170,15 @@ def save_checkpoint(engine, path: str) -> None:
             # and never device-resident.
             "llm_streams": engine.streams.checkpoint_rows(),
         }
+        # Slot mode (core/slots.py): the saved device arrays are SLOT-
+        # indexed, so the assignment + generations that bind slots to
+        # resources travel in the header. Spill records and cold-tail
+        # tallies are NOT persisted: the cold tail cold-restarts across
+        # a process restart (the reference's "restart = cold stats"
+        # stance, bounded to resources OUTSIDE the hot set) —
+        # docs/SEMANTICS.md "Eviction conservation bound".
+        if engine.slots is not None:
+            header["slots"] = engine.slots.checkpoint_dict()
         arrays = {k: np.asarray(v) for k, v in _state_arrays(state).items()}
     _atomic_savez(path, header, arrays)
 
@@ -211,6 +220,14 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
         raise ValueError(
             f"checkpoint capacity {header.get('capacity')} != engine "
             f"capacity {engine.capacity}")
+    ck_slots = header.get("slots")
+    if (ck_slots is not None) != (engine.slots is not None):
+        raise ValueError(
+            "checkpoint slot mode does not match the engine: "
+            f"checkpoint {'has' if ck_slots is not None else 'lacks'} a "
+            "slot assignment, engine is in "
+            f"{'slot' if engine.slots is not None else 'fixed-capacity'} "
+            "mode")
     ck_spec = (header.get("w1_interval_ms", 1000),
                header.get("w1_sample_count",
                           engine._spec1.buckets))
@@ -238,6 +255,11 @@ def restore_checkpoint(engine, path: str, force: bool = False) -> None:
 
     with engine._lock:
         engine.registry = NodeRegistry.from_dict(header["registry"])
+        if ck_slots is not None:
+            # Re-bind the slot assignment BEFORE the recompile below:
+            # rule rows resolve through the slot table, so ruled
+            # resources must already sit at their checkpointed slots.
+            engine.slots.restore_assignment(ck_slots)
         engine._sealed_sec = int(header["sealed_sec"])
         # Rebuild rule tensors + fresh rule state against the restored
         # registry, then graft the persisted statistics tensors in.

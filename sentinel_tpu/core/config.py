@@ -184,6 +184,23 @@ POPULATION_WINDOW_SECONDS = "csp.sentinel.population.window.seconds"
 POPULATION_CHURN_HISTORY = "csp.sentinel.population.churn.history"
 POPULATION_BASELINE_ALPHA = "csp.sentinel.population.baseline.alpha"
 POPULATION_BASELINE_ZSCORE = "csp.sentinel.population.baseline.zscore"
+# Dynamic slot-table admission (core/slots.py — ROADMAP item 1). Every
+# key here MUST be read through the accessors below and documented in
+# docs/OPERATIONS.md "Slot-table admission" (pinned by test_lint).
+# budget: device slot-table size (0 = off: registry rows == device rows,
+# the pre-slot engine); registry.capacity: the host name-table size in
+# slot mode (the namespace the engine can serve, hot + cold);
+# max.steals: steal ceiling per rebalance cycle (anti-thrash);
+# hysteresis.pct: a challenger must beat the victim's observed rate by
+# this margin before a steal; spill.max: spilled-row records retained
+# host-side (LRU past it — a dropped record rehydrates cold, counted);
+# stale.seconds: telescope staleness horizon for the freeze gate.
+SLOTS_BUDGET = "csp.sentinel.slots.budget"
+SLOTS_REGISTRY_CAPACITY = "csp.sentinel.slots.registry.capacity"
+SLOTS_MAX_STEALS = "csp.sentinel.slots.max.steals"
+SLOTS_HYSTERESIS_PCT = "csp.sentinel.slots.hysteresis.pct"
+SLOTS_SPILL_MAX = "csp.sentinel.slots.spill.max"
+SLOTS_STALE_SECONDS = "csp.sentinel.slots.stale.seconds"
 # Trace-replay simulator (sentinel_tpu/simulator/ — no reference twin:
 # the reference has no offline evaluation story). Every key here MUST be
 # read through the accessors below and documented in docs/OPERATIONS.md
@@ -370,6 +387,21 @@ DEFAULT_POPULATION_WINDOW_SECONDS = 10
 DEFAULT_POPULATION_CHURN_HISTORY = 360
 DEFAULT_POPULATION_BASELINE_ALPHA = 0.2
 DEFAULT_POPULATION_BASELINE_ZSCORE = 4.0
+# Slot-table defaults. budget 0 keeps the slot table OFF unless asked
+# for (the unbounded engine is the compatibility default); the 16384
+# registry ceiling matches the fixed-tensor cap the slot table exists
+# to outgrow — in slot mode that many NAMES fit host-side while only
+# `budget` rows are device-resident; 8 steals/cycle bounds eviction
+# churn to 8 Hz at the 1 Hz fold; 20% hysteresis keeps rank jitter in
+# the telescope's error bars from thrashing slots; 4096 spill records
+# ≈ a few MB of host window rows; a telescope silent for 30 s is a
+# stale feed — steals freeze rather than act on dead rankings.
+DEFAULT_SLOTS_BUDGET = 0
+DEFAULT_SLOTS_REGISTRY_CAPACITY = 16384
+DEFAULT_SLOTS_MAX_STEALS = 8
+DEFAULT_SLOTS_HYSTERESIS_PCT = 20.0
+DEFAULT_SLOTS_SPILL_MAX = 4096
+DEFAULT_SLOTS_STALE_SECONDS = 30
 # Simulator defaults. One day past epoch 0 keeps simulated stamps far
 # from any plausible wall clock (the replay-honesty canary); 512 keeps
 # the per-second chunking on a mid-ladder width (fewer distinct XLA
@@ -792,6 +824,35 @@ class SentinelConfig:
         v = self.get_float(POPULATION_BASELINE_ZSCORE,
                            DEFAULT_POPULATION_BASELINE_ZSCORE)
         return v if v > 0.0 else DEFAULT_POPULATION_BASELINE_ZSCORE
+
+    # Slot-table admission (core/slots.py — ROADMAP item 1). These are
+    # the ONLY sanctioned readers of the csp.sentinel.slots.* keys.
+
+    def slots_budget(self) -> int:
+        v = self.get_int(SLOTS_BUDGET, DEFAULT_SLOTS_BUDGET)
+        return v if v >= 0 else DEFAULT_SLOTS_BUDGET
+
+    def slots_registry_capacity(self) -> int:
+        v = self.get_int(SLOTS_REGISTRY_CAPACITY,
+                         DEFAULT_SLOTS_REGISTRY_CAPACITY)
+        return v if v > 0 else DEFAULT_SLOTS_REGISTRY_CAPACITY
+
+    def slots_max_steals(self) -> int:
+        v = self.get_int(SLOTS_MAX_STEALS, DEFAULT_SLOTS_MAX_STEALS)
+        return v if v > 0 else DEFAULT_SLOTS_MAX_STEALS
+
+    def slots_hysteresis_pct(self) -> float:
+        v = self.get_float(SLOTS_HYSTERESIS_PCT,
+                           DEFAULT_SLOTS_HYSTERESIS_PCT)
+        return v if v >= 0.0 else DEFAULT_SLOTS_HYSTERESIS_PCT
+
+    def slots_spill_max(self) -> int:
+        v = self.get_int(SLOTS_SPILL_MAX, DEFAULT_SLOTS_SPILL_MAX)
+        return v if v > 0 else DEFAULT_SLOTS_SPILL_MAX
+
+    def slots_stale_seconds(self) -> int:
+        v = self.get_int(SLOTS_STALE_SECONDS, DEFAULT_SLOTS_STALE_SECONDS)
+        return v if v > 0 else DEFAULT_SLOTS_STALE_SECONDS
 
     # Simulator accessors (the ONLY sanctioned readers of the
     # csp.sentinel.sim.* keys — test_lint forbids reading the literals

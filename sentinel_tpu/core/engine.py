@@ -84,7 +84,7 @@ class EntryHandle:
     __slots__ = (
         "engine", "resource", "context", "cluster_row", "dn_row", "origin_row",
         "entry_in", "count", "created_ms", "error", "exited", "params",
-        "leased",
+        "leased", "slot_gen",
     )
 
     def __init__(self, engine, resource, context, cluster_row, dn_row,
@@ -105,6 +105,11 @@ class EntryHandle:
         self.exited = False
         self.params = params
         self.leased = leased
+        # Slot-mode tenancy stamp (core/slots.py): the generation of the
+        # slot this entry committed under, COLD_GEN (-2) for a cold-path
+        # entry that must tally its exit host-side, -1 in fixed-capacity
+        # mode / for pass-through handles.
+        self.slot_gen = -1
 
     def trace(self, ex: Optional[BaseException] = None) -> None:
         """Record a business exception (reference: ``Tracer.trace``)."""
@@ -137,7 +142,8 @@ class SentinelEngine:
     """
 
     def __init__(self, capacity: int = 4096, clock=None,
-                 journal_path: Optional[str] = None):
+                 journal_path: Optional[str] = None,
+                 slot_budget: int = 0):
         # Clock-injection seam (ISSUE 13): every internal wall-clock read
         # goes through now_ms(), so a simulator can drive a REAL engine on
         # a program-advanced clock (sentinel_tpu/simulator/replay.py) with
@@ -146,8 +152,29 @@ class SentinelEngine:
         # timebase. The device step already takes ``now`` as an explicit
         # argument — this seam closes the host-side reads.
         self._clock = clock
-        self.registry = NodeRegistry(capacity)
-        self.capacity = capacity
+        # Slot-table admission (core/slots.py — ROADMAP 1): slot_budget
+        # > 0 (or csp.sentinel.slots.budget) bounds the DEVICE tensor to
+        # ``budget`` rows and maps the live hot resource set into them
+        # dynamically, with evict/rehydrate and a loud cold-tail degrade
+        # past the budget. 0 = classic fixed-capacity mode, bit-for-bit
+        # the pre-slot behavior. In slot mode the registry keeps a much
+        # larger capacity for name interning + metadata (it no longer
+        # sizes any device tensor); the device capacity IS the budget.
+        from sentinel_tpu.core.config import config as _slots_cfg
+
+        if not slot_budget:
+            slot_budget = _slots_cfg.slots_budget()
+        if slot_budget:
+            from sentinel_tpu.core.slots import SlotTable
+
+            self.registry = NodeRegistry(
+                _slots_cfg.slots_registry_capacity())
+            self.capacity = int(slot_budget)
+            self.slots = SlotTable(self, int(slot_budget))
+        else:
+            self.registry = NodeRegistry(capacity)
+            self.capacity = capacity
+            self.slots = None
         # Instant-window geometry (reference: IntervalProperty /
         # SampleCountProperty — core:node/). Config-seeded, runtime-tunable
         # via set_window_geometry(); the minute window stays fixed (as
@@ -664,7 +691,7 @@ class SentinelEngine:
                 starts = np.asarray(state.w1.starts)
             rows = {}
             for res in targets:
-                row = self.registry.get_cluster_row(res)
+                row = self._device_row_of(res)
                 if row is not None:
                     rows[res] = row
         committer = self._committer
@@ -727,6 +754,7 @@ class SentinelEngine:
             self._dirty[family] = True
             self._sync_rollout_sources()
             self._rebuild_leases()
+        self._slots_sync_pins()
         self._journal_rule_load(family)
 
     def _journal_rule_load(self, family: str) -> None:
@@ -795,6 +823,7 @@ class SentinelEngine:
             else:
                 self._cluster_param_info = self._cluster_info(
                     self.param_rules.get_rules(), with_param_idx=True)
+        self._slots_sync_pins()
         self._journal_rule_load(family)
 
     def _on_tps_rules_changed(self):
@@ -855,16 +884,16 @@ class SentinelEngine:
                 self._dirty[k] = False
             now = self.now_ms()
             ft, named = F.compile_flow_rules(
-                self.flow_rules.get_rules(), self.registry, self.capacity,
-                min_slots=self._slot_floor["flow"])
+                self.flow_rules.get_rules(), self._rule_registry(),
+                self.capacity, min_slots=self._slot_floor["flow"])
             dt, di = D.compile_degrade_rules(
-                self.degrade_rules.get_rules(), self.registry, self.capacity,
-                min_slots=self._slot_floor["degrade"])
+                self.degrade_rules.get_rules(), self._rule_registry(),
+                self.capacity, min_slots=self._slot_floor["degrade"])
             pt = P.compile_param_rules(
-                self.param_rules.get_rules(), self.registry, self.capacity,
-                min_slots=self._slot_floor["param"])
+                self.param_rules.get_rules(), self._rule_registry(),
+                self.capacity, min_slots=self._slot_floor["param"])
             at = A.compile_authority_rules(
-                self.authority_rules.get_rules(), self.registry,
+                self.authority_rules.get_rules(), self._rule_registry(),
                 self.capacity, min_slots=self._slot_floor["authority"])
             self._ratchet_slots(flow=ft, degrade=dt, param=pt, authority=at)
             self._named_origins = {r: set(o) for r, o in named.items()}
@@ -887,8 +916,8 @@ class SentinelEngine:
         if self._dirty["flow"]:
             self._dirty["flow"] = False
             ft, named = F.compile_flow_rules(
-                self.flow_rules.get_rules(), self.registry, self.capacity,
-                min_slots=self._slot_floor["flow"])
+                self.flow_rules.get_rules(), self._rule_registry(),
+                self.capacity, min_slots=self._slot_floor["flow"])
             self._ratchet_slots(flow=ft)
             self._named_origins = {r: set(o) for r, o in named.items()}
             self._rules = self._rules._replace(flow=ft)
@@ -896,15 +925,15 @@ class SentinelEngine:
         if self._dirty["degrade"]:
             self._dirty["degrade"] = False
             dt, di = D.compile_degrade_rules(
-                self.degrade_rules.get_rules(), self.registry, self.capacity,
-                min_slots=self._slot_floor["degrade"])
+                self.degrade_rules.get_rules(), self._rule_registry(),
+                self.capacity, min_slots=self._slot_floor["degrade"])
             self._ratchet_slots(degrade=dt)
             self._rules = self._rules._replace(degrade=dt)
             self._state = self._state._replace(degrade=D.make_degrade_state(dt, di))
         if self._dirty["authority"]:
             self._dirty["authority"] = False
             at = A.compile_authority_rules(
-                self.authority_rules.get_rules(), self.registry,
+                self.authority_rules.get_rules(), self._rule_registry(),
                 self.capacity, min_slots=self._slot_floor["authority"])
             self._ratchet_slots(authority=at)
             self._rules = self._rules._replace(authority=at)
@@ -916,8 +945,8 @@ class SentinelEngine:
         if self._dirty["param"]:
             self._dirty["param"] = False
             pt = P.compile_param_rules(
-                self.param_rules.get_rules(), self.registry, self.capacity,
-                min_slots=self._slot_floor["param"])
+                self.param_rules.get_rules(), self._rule_registry(),
+                self.capacity, min_slots=self._slot_floor["param"])
             self._ratchet_slots(param=pt)
             self._rules = self._rules._replace(param=pt)
             self._state = self._state._replace(param=P.make_param_state(pt.num_rules))
@@ -947,16 +976,16 @@ class SentinelEngine:
                 self._state = self._state._replace(shadow=None)
             return
         ft, _ = F.compile_flow_rules(
-            spec["flow"], self.registry, self.capacity,
+            spec["flow"], self._rule_registry(), self.capacity,
             min_slots=self._slot_floor["flow"])
         dt, di = D.compile_degrade_rules(
-            spec["degrade"], self.registry, self.capacity,
+            spec["degrade"], self._rule_registry(), self.capacity,
             min_slots=self._slot_floor["degrade"])
         at = A.compile_authority_rules(
-            spec["authority"], self.registry, self.capacity,
+            spec["authority"], self._rule_registry(), self.capacity,
             min_slots=self._slot_floor["authority"])
         pt = P.compile_param_rules(
-            spec["param"], self.registry, self.capacity,
+            spec["param"], self._rule_registry(), self.capacity,
             min_slots=self._slot_floor["param"])
         self._shadow_rules = S.RulePack(
             flow=ft, degrade=dt, authority=at,
@@ -1325,6 +1354,15 @@ class SentinelEngine:
             return EntryHandle(self, resource, ctx, -1, -1, -1,
                                entry_type == C.EntryType.IN, count, ())
 
+        if self.slots is not None:
+            # Slot mode: admission routes through the bounded hot set
+            # (core/slots.py) — hot resources take the normal lease /
+            # device machinery at their SLOT row, cold-tail resources
+            # degrade loudly to the host lease path; nothing raises at
+            # capacity.
+            return self._slot_entry(resource, ctx, entry_type, count,
+                                    args, prioritized)
+
         reg = self.registry
         if ctx.entrance_row < 0:
             ctx.entrance_row = reg.entrance_row(ctx.name)
@@ -1474,6 +1512,343 @@ class SentinelEngine:
                              origin_row, entry_in, count, params)
         ctx.entry_stack.append(handle)
         return handle
+
+    # -- slot-table admission (core/slots.py — ROADMAP 1) ------------------
+
+    def _slot_entry(self, resource: str, ctx, entry_type: int, count: int,
+                    args: Sequence, prioritized: bool) -> EntryHandle:
+        """entry() in slot mode. Hot resources run the standard lease /
+        device machinery at their slot row; cold-tail resources degrade
+        LOUDLY: leaseable-ruled -> host-exact lease verdict, everything
+        else -> counted pass (unenforced if device-only-ruled). Handles
+        carry (slot, generation) so exits can never land on a reused
+        slot's successor."""
+        from sentinel_tpu.core.slots import COLD_GEN
+        from sentinel_tpu.log.record_log import log_block
+
+        slots = self.slots
+        entry_in = entry_type == C.EntryType.IN
+        params = tuple(_hash_param(a) for a in args[:MAX_PARAMS]) \
+            if args else ()
+        now = self.now_ms()
+        # Intern the name host-side: metadata only (entry/resource type
+        # for the metas view, the ops-plane name table) — never a device
+        # row. Past registry capacity this degrades loudly (overflow
+        # counter) and admission continues: the slot table never needs
+        # the registry row to exist.
+        self.registry.cluster_row(resource, int(entry_type))
+        # The telescope feed drives admit/steal, so it must see EVERY
+        # entry at resource grain — cold ones never reach a device batch.
+        population = getattr(self, "population", None)
+        if population is not None and population.enabled:
+            population.observe_pairs(((resource, count),))
+        cur = slots.current(resource)
+        if cur is None:
+            cur = slots.try_admit(resource, now)
+        fp = self._fastpath
+        lease = fp.leases.get(resource)
+
+        if cur is None:
+            # ---- cold tail: no slot, no raise -------------------------
+            if lease is not None:
+                # Host-exact verdict through the existing lease path —
+                # eviction costs stats continuity, never rule fidelity.
+                block_reason = lease.admit(count, now, params)
+                if block_reason:
+                    slots.cold_block(resource, count)
+                    slots.note_verdict(resource, -1, COLD_GEN, now // 1000,
+                                       "block", block_reason)
+                    ctx_mod.auto_exit_context()
+                    ex = exception_for_reason(block_reason, resource)
+                    log_block(resource, type(ex).__name__, ctx.origin,
+                              count, now)
+                    raise ex
+                slots.cold_pass(resource, count)
+            else:
+                # Device-only-ruled (guarded) cold resources pass
+                # UNENFORCED behind a counter — loud, bounded, and fixed
+                # by the pin machinery in steady state; plain unruled
+                # cold resources just pass counted.
+                unenforced = resource in fp.guarded or not fp.unruled
+                slots.cold_pass(resource, count, unenforced=unenforced)
+            slots.note_verdict(resource, -1, COLD_GEN, now // 1000,
+                               "pass", 0)
+            handle = EntryHandle(self, resource, ctx, -1, -1, -1, entry_in,
+                                 count, params, now_ms=now)
+            handle.slot_gen = COLD_GEN
+            ctx.entry_stack.append(handle)
+            return handle
+
+        slots.hot_hits_total += 1
+        slot, gen = cur
+        fast_ok = (not self._spi.host_slots()
+                   and not self._spi.device_checkers())
+        if lease is not None and not prioritized and fast_ok:
+            # ---- leased-hot: host verdict, committer commit -----------
+            block_reason = lease.admit(count, now, params)
+            # Committer BEFORE gate: its lazy construction takes _lock,
+            # and the lock order is _lock -> gate, never the reverse.
+            committer = self._ensure_committer()
+            with slots.gate:
+                cur2 = slots._hot.get(resource)
+                if cur2 is not None:
+                    # Re-translated under the gate: the enqueue can never
+                    # target a slot whose tenancy already changed.
+                    committer.add_entry(cur2[0], -1, -1, entry_in, count,
+                                        block_reason == 0, block_reason)
+                    slot, gen = cur2
+            if cur2 is None:
+                # Evicted between translation and enqueue: the verdict
+                # stands (host-exact), the stats tally cold.
+                if block_reason:
+                    slots.cold_block(resource, count)
+                else:
+                    slots.cold_pass(resource, count)
+            if block_reason:
+                slots.note_verdict(resource, slot if cur2 else -1,
+                                   gen if cur2 else COLD_GEN, now // 1000,
+                                   "block", block_reason)
+                ctx_mod.auto_exit_context()
+                ex = exception_for_reason(block_reason, resource)
+                log_block(resource, type(ex).__name__, ctx.origin, count,
+                          now)
+                raise ex
+            slots.note_verdict(resource, slot if cur2 else -1,
+                               gen if cur2 else COLD_GEN, now // 1000,
+                               "pass", 0)
+            handle = EntryHandle(self, resource, ctx, cur2[0] if cur2
+                                 else -1, -1, -1, entry_in, count, params,
+                                 leased=cur2 is not None, now_ms=now)
+            handle.slot_gen = gen if cur2 else COLD_GEN
+            ctx.entry_stack.append(handle)
+            return handle
+
+        # ---- device path at the slot row ------------------------------
+        # SPI host slots keep their veto (the reference's custom-slot
+        # chain): a BlockException pre-blocks the device commit.
+        pre_blocked = False
+        custom_ex = None
+        spi_slots = self._spi.host_slots()
+        if spi_slots:
+            info = self._spi.EntryInfo(
+                resource=resource, origin=ctx.origin, count=count,
+                entry_type=int(entry_type), prioritized=prioritized,
+                args=tuple(args), context_name=ctx.name)
+            for spi_slot in spi_slots:
+                try:
+                    spi_slot.on_entry(info)
+                except BlockException as ex:
+                    custom_ex, pre_blocked = ex, True
+                    break
+                except Exception:
+                    ctx_mod.auto_exit_context()
+                    raise
+        if lease is not None:
+            # Pending leased commits must land before the device check.
+            self._flush_committer()
+        skip_cluster, cluster_blocked = self._cluster_token_check(
+            resource, count, prioritized, args)
+        oid = self.registry.origin_id(ctx.origin)
+        fields = dict(
+            cluster_row=-1, dn_row=-1, origin_row=-1, origin_id=oid,
+            origin_named=oid in self._named_origins.get(resource, ()),
+            context_id=self.registry.context_id(ctx.name), count=count,
+            prioritized=prioritized, entry_in=entry_in,
+            skip_cluster=skip_cluster,
+            pre_blocked=pre_blocked or cluster_blocked, params=params)
+        reason, wait_us, cur2 = self._slot_submit(resource, fields)
+        if custom_ex is not None:
+            ctx_mod.auto_exit_context()
+            log_block(resource, type(custom_ex).__name__, ctx.origin,
+                      count, now)
+            raise custom_ex
+        if cur2 is None:
+            # Tenancy changed between translation and dispatch: nothing
+            # committed — serve the entry as a counted cold pass.
+            slots.cold_pass(resource, count)
+            slots.note_verdict(resource, -1, COLD_GEN, now // 1000,
+                               "pass", 0)
+            handle = EntryHandle(self, resource, ctx, -1, -1, -1, entry_in,
+                                 count, params, now_ms=now)
+            handle.slot_gen = COLD_GEN
+            ctx.entry_stack.append(handle)
+            return handle
+        slot, gen = cur2
+        if reason > 0 and reason != C.BlockReason.WAIT:
+            slots.note_verdict(resource, slot, gen, now // 1000, "block",
+                               int(reason))
+            ctx_mod.auto_exit_context()
+            ex = exception_for_reason(reason, resource)
+            log_block(resource, type(ex).__name__, ctx.origin, count,
+                      self.now_ms())
+            raise ex
+        if wait_us > 0:
+            time.sleep(wait_us / 1e6)
+        if lease is not None:
+            lease.add(count, self.now_ms(), params)
+        slots.note_verdict(resource, slot, gen, now // 1000, "pass", 0)
+        handle = EntryHandle(self, resource, ctx, slot, -1, -1, entry_in,
+                             count, params, now_ms=now)
+        handle.slot_gen = gen
+        ctx.entry_stack.append(handle)
+        return handle
+
+    def _slot_submit(self, resource: str,
+                     fields: Dict) -> Tuple[int, int, Optional[Tuple[int, int]]]:
+        """Width-1 device dispatch with in-lock tenancy re-validation:
+        the slot row is resolved INSIDE ``_lock`` (steal surgery holds
+        it), so a commit can only land under live tenancy. Returns
+        (reason, wait_us, (slot, gen) committed under) — (0, 0, None)
+        when the resource went cold first (nothing committed)."""
+        slots = self.slots
+        with self._lock:
+            cur = slots.current(resource)
+            if cur is None:
+                return 0, 0, None
+            fields = dict(fields, cluster_row=cur[0])
+            buf = make_entry_batch_np(1)
+            for k, v in fields.items():
+                if k == "params":
+                    for i, h in enumerate(v):
+                        buf["param_hash"][0, i] = h
+                        buf["param_present"][0, i] = True
+                else:
+                    buf[k][0] = v
+            try:
+                dec = self._run_entry_batch_locked(EntryBatch(**buf))
+            except DeviceDispatchError as ex:
+                self._note_fail_open(str(ex))
+                return 0, 0, cur
+            return int(dec.reason[0]), int(dec.wait_us[0]), cur
+
+    def _slot_exit(self, handle: EntryHandle, count: int) -> None:
+        """_do_exit in slot mode. A resource hot NOW (any generation)
+        exits at its CURRENT slot — the grafted cur_threads gauge nets
+        to zero there; evicted-and-still-cold exits decrement the spill
+        record and tally host-side; cold-path entries always tally
+        host-side."""
+        from sentinel_tpu.core.slots import COLD_GEN
+
+        slots = self.slots
+        now = self.now_ms()
+        rt = min(max(0, now - handle.created_ms), C.DEFAULT_MAX_RT_MS)
+        if handle.slot_gen == COLD_GEN:
+            slots.cold_exit(handle.resource, count, rt, handle.error)
+            ctx_mod.auto_exit_context()
+            return
+        committer = self._committer  # one read: close() nulls it
+        if handle.leased and committer is not None:
+            with slots.gate:
+                cur = slots._hot.get(handle.resource)
+                if cur is not None:
+                    committer.add_exit(cur[0], -1, -1, handle.entry_in,
+                                       count, rt, True, handle.error)
+            if cur is None:
+                slots.evicted_exit(handle.resource, count, rt,
+                                   handle.error, now)
+            ctx_mod.auto_exit_context()
+            return
+        with self._lock:
+            cur = slots.current(handle.resource)
+            if cur is not None:
+                buf = make_exit_batch_np(1)
+                buf["cluster_row"][0] = cur[0]
+                buf["dn_row"][0] = -1
+                buf["origin_row"][0] = -1
+                buf["entry_in"][0] = handle.entry_in
+                buf["count"][0] = count
+                buf["rt_ms"][0] = rt
+                buf["success"][0] = True
+                buf["error"][0] = handle.error
+                for i, h in enumerate(handle.params):
+                    buf["param_hash"][0, i] = h
+                    buf["param_present"][0, i] = True
+                try:
+                    self._run_exit_batch(ExitBatch(**buf))
+                except DeviceDispatchError as ex:
+                    self._note_fail_open(str(ex))
+        if cur is None:
+            slots.evicted_exit(handle.resource, count, rt, handle.error,
+                               now)
+        ctx_mod.auto_exit_context()
+
+    def _device_metas(self):
+        """Row-indexed meta view of the DEVICE tensor: the registry in
+        fixed-capacity mode, the slot table's tenancy view in slot mode.
+        Every consumer that renders device rows to names reads through
+        here, so a reused slot renders as its CURRENT occupant only."""
+        slots = getattr(self, "slots", None)
+        return self.registry.meta if slots is None else slots.device_metas()
+
+    def _device_resources(self) -> Dict[str, int]:
+        """resource -> device row of everything with a live device row."""
+        slots = getattr(self, "slots", None)
+        return self.registry.resources() if slots is None \
+            else slots.resources()
+
+    def _device_row_of(self, resource: str) -> Optional[int]:
+        """Current device row for one resource, or None (cold / never
+        registered). Delegates to the slot table's single translation
+        implementation in slot mode."""
+        slots = getattr(self, "slots", None)
+        if slots is None:
+            return self.registry.get_cluster_row(resource)
+        return slots.device_row(resource)
+
+    def _rule_registry(self):
+        """What the rule compilers resolve rows through: the registry in
+        fixed-capacity mode, the slot table's facade in slot mode (rows
+        are slots; a cold ruled resource compiles inert — the pin
+        machinery prevents that outside pin overflow)."""
+        slots = getattr(self, "slots", None)
+        return self.registry if slots is None else slots.rule_registry_view()
+
+    def _slot_pinned_resources(self) -> set:
+        """Resources compiled rules target (live + rollout candidate):
+        PINNED hot — the rule tensors hold their slot indices, so
+        evicting one would apply its rule to the slot's successor."""
+        slots = getattr(self, "slots", None)
+        if slots is None:
+            return set()
+        pinned: set = set()
+
+        def _add(rules) -> None:
+            for r in rules:
+                res = getattr(r, "resource", "")
+                if res:
+                    pinned.add(res)
+                ref = getattr(r, "ref_resource", "")
+                if ref:
+                    pinned.add(ref)
+
+        _add(self.flow_rules.get_rules())
+        _add(self.degrade_rules.get_rules())
+        _add(self.param_rules.get_rules())
+        _add(self.authority_rules.get_rules())
+        rollout = getattr(self, "rollout", None)
+        spec = rollout.device_spec() if rollout is not None else None
+        if spec:
+            for fam in ("flow", "degrade", "authority", "param"):
+                _add(spec.get(fam) or ())
+        return pinned
+
+    def _slots_sync_pins(self) -> None:
+        """Config-plane hook on every rule push: admit (stealing if
+        needed) every newly ruled resource BEFORE its rules compile.
+        Runs OUTSIDE the config lock's critical section is fine too —
+        lock order stays config -> engine -> gate throughout. If pinning
+        changed occupancy, every family re-dirties: the pin admits were
+        published AFTER any compile the admission surgery itself ran, so
+        the next dispatch must recompile against the final mapping."""
+        slots = self.slots
+        if slots is None:
+            return
+        before = slots.admits_total
+        slots.ensure_pinned(self._slot_pinned_resources(), self.now_ms())
+        if slots.admits_total != before:
+            with self._config_lock:
+                for fam in ("flow", "degrade", "authority", "param"):
+                    self._dirty[fam] = True
 
     def _note_fail_open(self, why: str) -> None:
         """Count + rate-limited log of an unguarded pass-through."""
@@ -1751,6 +2126,12 @@ class SentinelEngine:
         ``csp.sentinel.pipeline.*`` config keys."""
         from sentinel_tpu.core.pipeline import Pipeline
 
+        if self.slots is not None:
+            raise RuntimeError(
+                "pipelined admission is not supported in slot mode: the "
+                "pipeline resolves rows outside the slot-tenancy "
+                "re-validation protocol (run slot mode synchronous, or "
+                "fixed-capacity mode pipelined)")
         with self._lock:
             if self._pipeline is None:
                 self._ensure_compiled()  # compile before the loop starts
@@ -1813,6 +2194,11 @@ class SentinelEngine:
             ctx.entry_stack.pop()
         elif handle in ctx.entry_stack:
             ctx.entry_stack.remove(handle)
+        if self.slots is not None and handle.slot_gen != -1:
+            # Slot mode: generation-stamped exit accounting (current-slot
+            # device exit / spill-record decrement / cold tally).
+            self._slot_exit(handle, count)
+            return
         if handle.cluster_row < 0:
             ctx_mod.auto_exit_context()
             return
@@ -1951,7 +2337,7 @@ class SentinelEngine:
             slices = np.asarray(self._w60_read_jit(
                 self._state, jnp.asarray(now, jnp.int64), idx))[:, :k]
             threads = np.asarray(self._state.cur_threads)    # [R]
-            metas = self.registry.meta
+            metas = self._device_metas()
         # Vectorized active scan: only (row, second) pairs with any
         # pass/block/success/exception produce a MetricNode.
         ev = [C.MetricEvent.PASS, C.MetricEvent.BLOCK,
@@ -2090,7 +2476,7 @@ class SentinelEngine:
         rt_hist = counts["rtHist"]
         active = totals.any(axis=0) | by_reason.any(axis=0)
         resources: Dict[str, Dict] = {}
-        for row, meta in enumerate(self.registry.meta):
+        for row, meta in enumerate(self._device_metas()):
             if meta.kind != KIND_CLUSTER or row >= active.shape[0] \
                     or not active[row]:
                 continue
@@ -2172,10 +2558,16 @@ class SentinelEngine:
                     ev, attr, hist, slot = (
                         np.asarray(x)[:k] for x in
                         self._flight_read_jit(self._state, idx))
-        metas = self.registry.meta
+        metas = self._device_metas()
+        slots_tbl = getattr(self, "slots", None)
         for j, (stamp, _i) in enumerate(fresh):
             rec = compact_second(stamp, ev[j], attr[j], hist[j], slot[j])
             self.timeseries.append(rec)
+            if slots_tbl is not None:
+                # Pin the tenancy this second spilled under, so history
+                # renders forever attribute a reused slot's PAST seconds
+                # to the evicted occupant, never the successor.
+                slots_tbl.remember_metas(stamp, metas)
             # Judgement rides the spill: each complete second feeds the
             # SLO manager's objective series + anomaly baselines (host
             # arithmetic, outside the engine lock).
@@ -2210,6 +2602,11 @@ class SentinelEngine:
         population = getattr(self, "population", None)
         if population is not None:
             population.roll(now)
+        # Slot-table rebalance rides the same cadence, AFTER the
+        # telescope folded (its top-k ranking drives admit/steal) —
+        # 1/s-throttled and freeze-gated inside.
+        if slots_tbl is not None:
+            slots_tbl.on_spill(now)
         # The adaptive loop rides the same cadence, AFTER judgement is
         # current (its freeze gate and proposal alert-gate read it).
         # Interval-gated + reentry-safe inside; getattr: _spill_flight
@@ -2235,7 +2632,11 @@ class SentinelEngine:
         A/B guard in tests/test_population.py pins that this adds ZERO
         device dispatches."""
         population = getattr(self, "population", None)
-        if population is not None and population.enabled:
+        if population is not None and population.enabled \
+                and self.slots is None:
+            # Slot mode feeds the telescope at RESOURCE grain inside
+            # _slot_entry (cold entries never reach a device batch);
+            # observing rows here too would double-count the hot set.
             population.observe_rows(batch.cluster_row, batch.count,
                                     self.registry.meta)
 
@@ -2281,17 +2682,35 @@ class SentinelEngine:
         # second stays staged (exactness = COMPLETE seconds only).
         self._spill_flight(now_ms)
         recs = self.timeseries.query(start_ms, end_ms)
-        metas = self.registry.meta
+        metas = self._device_metas()
+        slots_tbl = getattr(self, "slots", None)
         # Filter + paginate on the compact RECORDS, render only the
         # served page: a periodic caller (the exporter's limit=1, each
         # SSE poll) must not pay a full-history JSON render per read.
+        # (In slot mode a resource's row varies per tenancy epoch, so
+        # the row pre-filter only drops records where the CURRENT row
+        # has no data — rendered seconds filter exactly below.)
         if resource is not None:
-            row = self.registry.get_cluster_row(resource)
-            recs = ([r for r in recs if row in r.rows]
-                    if row is not None else [])
+            row = self._device_row_of(resource)
+            if slots_tbl is None:
+                recs = ([r for r in recs if row in r.rows]
+                        if row is not None else [])
         total = len(recs)
         recs = page_newest_first(recs, limit, offset)
-        seconds = [second_to_dict(r, metas, resource) for r in recs]
+        if slots_tbl is None:
+            seconds = [second_to_dict(r, metas, resource) for r in recs]
+        else:
+            # Render each second under the tenancy it was RECORDED
+            # under (the per-stamp snapshot _spill_flight pinned): a
+            # reused slot's old seconds keep the evicted occupant's
+            # name — the generation-leak defense for history reads.
+            seconds = [
+                second_to_dict(
+                    r, slots_tbl.recall_metas(r.stamp_ms) or metas,
+                    resource)
+                for r in recs]
+            if resource is not None:
+                seconds = [s for s in seconds if s.get("resources")]
         return {
             "seconds": seconds,
             "total": total,
@@ -2390,9 +2809,10 @@ class SentinelEngine:
         from sentinel_tpu.core.registry import ROOT_ROW
 
         totals, threads = self.row_stats()
+        metas = self._device_metas()
 
         def render(row: int) -> Dict:
-            m = self.registry.meta[row]
+            m = metas[row]
             t = totals[row]
             succ = float(t[C.MetricEvent.SUCCESS])
             return {
@@ -2423,7 +2843,7 @@ class SentinelEngine:
             totals = np.asarray(totals)
             threads = np.asarray(threads)
         out = {}
-        for res, row in self.registry.resources().items():
+        for res, row in self._device_resources().items():
             t = totals[row]
             succ = float(t[C.MetricEvent.SUCCESS])
             out[res] = {

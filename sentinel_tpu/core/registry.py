@@ -63,6 +63,12 @@ class NodeRegistry:
         self._entrance: Dict[str, int] = {}
         self._origin_ids: Dict[str, int] = {}
         self._context_ids: Dict[str, int] = {}
+        # Capacity-exhaustion accounting: registration past capacity is a
+        # LOUD counted degrade (pass-through row -1), never a raise mid-
+        # admission — `sentinel_tpu_registry_overflow_total` exports it,
+        # and the throttled warn keeps a miss-storm out of the logs.
+        self.overflow_count = 0
+        self._overflow_logged_ms = 0.0
         # fixed rows
         self._alloc(KIND_ROOT, resource="machine-root")
         self._alloc(KIND_ENTRY, resource="__entry_node__", parent_row=ROOT_ROW)
@@ -99,6 +105,7 @@ class NodeRegistry:
 
     def _alloc(self, kind: int, **kw) -> int:
         if len(self.meta) >= self.capacity:
+            self._note_overflow(kind, kw.get("resource", ""))
             return -1
         row = len(self.meta)
         self.meta.append(NodeMeta(row=row, kind=kind, **kw))
@@ -107,6 +114,27 @@ class NodeRegistry:
             self.meta[parent].children.append(row)
         self.version = getattr(self, "version", 0) + 1
         return row
+
+    def _note_overflow(self, kind: int, resource: str) -> None:
+        """Count + throttled-log a registration refused at capacity.
+
+        Callers already treat row -1 as pass-through (the reference's
+        MAX_SLOT_CHAIN_SIZE stance); this makes the degrade OBSERVABLE:
+        a silent -1 looks identical to healthy traffic until someone
+        notices a resource with no stats. monotonic() is a log-throttle
+        duration source only, never a recorded timestamp."""
+        import time
+
+        self.overflow_count += 1
+        now = time.monotonic()
+        if now - self._overflow_logged_ms >= 1.0:
+            self._overflow_logged_ms = now
+            from sentinel_tpu.log.record_log import record_log
+
+            record_log.warn(
+                "node registry FULL (capacity=%d): %r (kind=%d) degrades "
+                "to pass-through; overflow_count=%d",
+                self.capacity, resource, kind, self.overflow_count)
 
     def cluster_row(self, resource: str, entry_type: int = int(EntryType.OUT),
                     resource_type: int = 0) -> int:

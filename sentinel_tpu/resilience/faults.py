@@ -94,12 +94,22 @@ FAULT_POINTS = (
     # * cluster.leader.clock.skew — fired by the chaos mesh when a
     #   scheduled per-leader clock skew is applied; an armed error
     #   vetoes the skew (the observability hook for skew drills).
+    # * slots.evict.storm — fired at the top of every slot-table
+    #   rebalance tick (core/slots.py, ABOVE the freeze gate); an armed
+    #   error evicts EVERY unpinned occupant that cycle — worst-case
+    #   churn for the slot_conservation invariant.
+    # * slots.spill.torn — mutate seam inside the per-victim eviction
+    #   spill: garbage OR error mode tears the spill record, so the
+    #   victim's window state drops on the floor (counted) and it
+    #   rehydrates cold — the documented bounded-loud loss.
     "cluster.reactor.conn.drop",
     "cluster.reactor.conn.stall",
     "checkpoint.torn.write",
     "journal.disk.full",
     "datasource.flap",
     "cluster.leader.clock.skew",
+    "slots.evict.storm",
+    "slots.spill.torn",
 )
 
 

@@ -576,7 +576,7 @@ class RolloutManager:
         cand = self.active_set()
         if counts is None or cand is None:
             return {"active": cand.name if cand else None, "resources": {}}
-        rows = self.engine.registry.resources()
+        rows = self.engine._device_resources()
         out = {}
         for res, row in rows.items():
             c = counts[:, row]

@@ -47,7 +47,7 @@ def _active_rows(engine, counts: Dict[str, np.ndarray]) -> Dict[str, int]:
     by_reason = counts["blockByReason"]
     active = (totals.any(axis=0) | by_reason.any(axis=0))
     out: Dict[str, int] = {}
-    for row, meta in enumerate(engine.registry.meta):
+    for row, meta in enumerate(engine._device_metas()):
         if meta.kind == KIND_CLUSTER and row < active.shape[0] \
                 and active[row]:
             out[meta.resource] = row
@@ -527,6 +527,84 @@ def render_engine_metrics(engine) -> str:
         b.counter("sentinel_tpu_population_fold_ms",
                   "Cumulative host milliseconds spent folding staged "
                   "pairs into the sketches", pstate["foldMsTotal"])
+
+    # -- slot-table admission (core/slots.py — ISSUE 20) ------------------
+    # Registry overflow is loud in BOTH modes (classic interning can
+    # saturate too); the slot families render only in slot mode.
+    b.counter("sentinel_tpu_registry_overflow",
+              "Node registrations refused at registry capacity and "
+              "degraded to pass-through rows", engine.registry.overflow_count)
+    slots = getattr(engine, "slots", None)
+    if slots is not None:
+        sstate = slots.status()
+        b.family("sentinel_tpu_slots_budget", "gauge",
+                 "Device slot-table budget (rows, incl. the 2 reserved)")
+        b.sample("sentinel_tpu_slots_budget", None, sstate["budget"])
+        b.family("sentinel_tpu_slots_hot", "gauge",
+                 "Resources currently holding a device slot")
+        b.sample("sentinel_tpu_slots_hot", None, sstate["hot"])
+        b.family("sentinel_tpu_slots_free", "gauge",
+                 "Unoccupied device slots")
+        b.sample("sentinel_tpu_slots_free", None, sstate["free"])
+        b.family("sentinel_tpu_slots_pinned", "gauge",
+                 "Resources pinned hot by compiled rules (never stolen)")
+        b.sample("sentinel_tpu_slots_pinned", None, sstate["pinnedNow"])
+        b.family("sentinel_tpu_slots_frozen", "gauge",
+                 "Manual steal freeze in force (0/1; churn-alarm and "
+                 "telemetry-stale freezes are visible in `slots` status)")
+        b.sample("sentinel_tpu_slots_frozen", None,
+                 1 if sstate["frozen"] else 0)
+        b.counter("sentinel_tpu_slots_admits",
+                  "Resources admitted into a device slot",
+                  sstate["admitsTotal"])
+        b.counter("sentinel_tpu_slots_evictions",
+                  "Occupants evicted from a device slot (spilled "
+                  "host-side)", sstate["evictionsTotal"])
+        b.counter("sentinel_tpu_slots_rehydrations",
+                  "Admissions that grafted (or cold-started) a "
+                  "previously spilled resource", sstate["rehydrationsTotal"])
+        b.counter("sentinel_tpu_slots_rehydrations_cold",
+                  "Rehydrations with NO usable spill record (torn, "
+                  "dropped, or first touch)", sstate["rehydrationsColdTotal"])
+        b.counter("sentinel_tpu_slots_steals",
+                  "Slots stolen from a colder occupant by a "
+                  "telescope-ranked challenger", sstate["stealsTotal"])
+        b.counter("sentinel_tpu_slots_storms",
+                  "Chaos eviction storms executed (slots.evict.storm)",
+                  sstate["stormsTotal"])
+        b.counter("sentinel_tpu_slots_hot_hits",
+                  "Entries admitted through a device slot or hot lease",
+                  sstate["hotHitsTotal"])
+        b.counter("sentinel_tpu_slots_cold_pass",
+                  "Cold-tail entries passed on the host lease path",
+                  sstate["coldPassTotal"])
+        b.counter("sentinel_tpu_slots_cold_block",
+                  "Cold-tail entries blocked host-exact by their lease",
+                  sstate["coldBlockTotal"])
+        b.counter("sentinel_tpu_slots_cold_unenforced",
+                  "Cold-tail passes whose GUARDED rules could not be "
+                  "enforced off-device (the loud degradation)",
+                  sstate["coldUnenforcedTotal"])
+        b.counter("sentinel_tpu_slots_spill_torn",
+                  "Spill records torn in flight (victim rehydrates cold)",
+                  sstate["spillTornTotal"])
+        b.counter("sentinel_tpu_slots_spill_dropped",
+                  "Spill records dropped at the LRU retention cap",
+                  sstate["spillDroppedTotal"])
+        b.counter("sentinel_tpu_slots_late_exits",
+                  "Exits landing after their slot tenancy was evicted "
+                  "(reconciled host-side)", sstate["lateExitsTotal"])
+        b.counter("sentinel_tpu_slots_pin_overflow",
+                  "Rule-pinned resources that exceeded the slot budget "
+                  "(rule enforced cold, loudly)", sstate["pinOverflowTotal"])
+        b.family("sentinel_tpu_slots_hit_rate", "gauge",
+                 "Hot-set hit rate since start: hot admissions over all "
+                 "admissions")
+        b.sample("sentinel_tpu_slots_hit_rate", None, sstate["hitRate"])
+        b.family("sentinel_tpu_slots_spill_records", "gauge",
+                 "Spill records currently retained host-side")
+        b.sample("sentinel_tpu_slots_spill_records", None,
+                 sstate["spillRecords"])
 
     # -- SLO engine + alerting (sentinel_tpu/slo/) ------------------------
     # The timeseries_view read above already refreshed judgement (spill

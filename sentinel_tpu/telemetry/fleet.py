@@ -77,7 +77,7 @@ def leader_fleet_payload(server, since_ms: int, max_seconds: int) -> bytes:
     # O(retention) JSON render per 16-second page).
     engine.slo_refresh()
     recs = engine.timeseries.query(start_ms=int(since_ms) + 1)
-    metas = engine.registry.meta
+    metas = engine._device_metas()
     service = server.service
     shard = getattr(service, "shard", None)
     base = {

@@ -203,7 +203,11 @@ class DecisionTraceBuffer:
         if not picked:
             return
         window = self._window_snapshot([int(rows[i]) for i in picked])
-        metas = self.engine.registry.meta
+        # Device-row view: in slot mode this is CURRENT tenancy — a
+        # trace materializing across an eviction may name the successor
+        # (documented bounded race; the flight-recorder history is the
+        # leak-proof surface, via per-stamp tenancy snapshots).
+        metas = self.engine._device_metas()
         for i in picked:
             row = int(rows[i])
             orow = int(origin_rows[i])

@@ -576,6 +576,57 @@ def cmd_population(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(str(ex))
 
 
+@command_mapping("slots", "slot-table admission: status, hot map, "
+                          "freeze/thaw the steal plane")
+def cmd_slots(req: CommandRequest) -> CommandResponse:
+    """The bounded device hot set's ops plane (core/slots.py —
+    ISSUE 20). ``op`` selects:
+
+      * ``status`` (default) — budget/hot/free/pinned, every counter
+        (evictions, rehydrations, steals, storms, cold-tail verdicts,
+        torn spills, late exits), the measured hit rate, and the
+        freeze reason currently in force (manual > churn-alarm >
+        telemetry-stale)
+      * ``hot`` — the live resource -> (slot, generation) map
+      * ``freeze`` — manual steal freeze (``reason=`` optional);
+        journaled; first-touch admits keep flowing
+      * ``thaw`` — lift a manual freeze (journaled; automation gates
+        may still hold steals)
+    """
+    slots = getattr(req.engine, "slots", None)
+    if slots is None:
+        return CommandResponse.of_failure(
+            "engine is not in slot mode (csp.sentinel.slots.budget=0)")
+    op = req.get_param("op", "status")
+    try:
+        if op == "status":
+            out = slots.status()
+            out["freezeReason"] = slots.freeze_reason(req.engine.now_ms())
+            return CommandResponse.of_success(out)
+        if op == "hot":
+            snap = slots.checkpoint_dict()
+            hot = {res: {"slot": sg[0], "generation": sg[1]}
+                   for res, sg in sorted(snap["hot"].items())}
+            return CommandResponse.of_success(
+                {"budget": slots.budget, "hot": hot})
+        if op == "freeze":
+            reason = req.get_param("reason", "manual")
+            slots.freeze(reason)
+            req.engine.journal.record("slotsFreeze", reason=reason)
+            return CommandResponse.of_success(
+                {"frozen": True, "reason": reason})
+        if op == "thaw":
+            slots.thaw()
+            req.engine.journal.record("slotsThaw")
+            return CommandResponse.of_success({
+                "frozen": False,
+                "freezeReason": slots.freeze_reason(req.engine.now_ms()),
+            })
+        return CommandResponse.of_failure(f"unknown op {op!r}")
+    except (ValueError, KeyError, TypeError) as ex:
+        return CommandResponse.of_failure(str(ex))
+
+
 @command_mapping("adaptive", "closed-loop adaptive limiting: status, "
                              "enable/freeze, targets, decision log")
 def cmd_adaptive(req: CommandRequest) -> CommandResponse:

@@ -148,11 +148,11 @@ def test_journal_monotone_checker_fires():
 
 
 def test_checker_registry_is_complete():
-    assert len(CHECKERS) == 8
+    assert len(CHECKERS) == 9
     assert {name for name, _ in CHECKERS} == {
         "conservation", "no_stranded", "shed_not_half_admitted",
         "overadmission", "degraded_bound", "epoch_monotone",
-        "journal_monotone", "slice_conservation"}
+        "journal_monotone", "slice_conservation", "slot_conservation"}
 
 
 # -- scheduler: pure function of (campaign_seed, episode_index) --------------

@@ -375,6 +375,31 @@ def test_exported_metric_names_registered_exactly_once():
                  "sentinel_tpu_population_cardinality_alarm",
                  "sentinel_tpu_population_fold_ms"):
         assert name in seen, f"{name} not declared in the exporters"
+    # slot-table admission families (ISSUE 20): declared exactly once
+    # (the dupe gate above) and every family the ISSUE names exists
+    for name in ("sentinel_tpu_slots_budget",
+                 "sentinel_tpu_slots_hot",
+                 "sentinel_tpu_slots_free",
+                 "sentinel_tpu_slots_pinned",
+                 "sentinel_tpu_slots_frozen",
+                 "sentinel_tpu_slots_admits",
+                 "sentinel_tpu_slots_evictions",
+                 "sentinel_tpu_slots_rehydrations",
+                 "sentinel_tpu_slots_rehydrations_cold",
+                 "sentinel_tpu_slots_steals",
+                 "sentinel_tpu_slots_storms",
+                 "sentinel_tpu_slots_hot_hits",
+                 "sentinel_tpu_slots_cold_pass",
+                 "sentinel_tpu_slots_cold_block",
+                 "sentinel_tpu_slots_cold_unenforced",
+                 "sentinel_tpu_slots_spill_torn",
+                 "sentinel_tpu_slots_spill_dropped",
+                 "sentinel_tpu_slots_spill_records",
+                 "sentinel_tpu_slots_late_exits",
+                 "sentinel_tpu_slots_pin_overflow",
+                 "sentinel_tpu_slots_hit_rate",
+                 "sentinel_tpu_registry_overflow"):
+        assert name in seen, f"{name} not declared in the exporters"
     # pipelined-admission families (ISSUE 8): declared exactly once (the
     # dupe gate above) and the load-bearing ones exist
     for name in ("sentinel_tpu_pipeline_active",
@@ -948,6 +973,95 @@ def test_sketch_hashing_only_in_the_population_module():
     assert not offenders, (
         "sketch hashing outside telemetry/population.py (route through "
         "population.sketch_hash): " + ", ".join(offenders))
+
+
+def test_slots_config_keys_accessor_only_and_documented():
+    """Every ``csp.sentinel.slots.*`` config key must (a) be defined
+    and read ONLY in core/config.py — the rest of the package goes
+    through the ``SentinelConfig`` ``slots_*`` accessors — and (b)
+    appear in docs/OPERATIONS.md "Slot-table admission", so the
+    runbook can never silently drift from the knobs the code actually
+    reads (same rule shape as the population gate above)."""
+    import re
+
+    pattern = re.compile(r"[\"']csp\.sentinel\.slots\.[a-z.]+[\"']")
+    keys = set()
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            for m in pattern.findall(code):
+                key = m.strip("\"'")
+                keys.add(key)
+                if path.name != "config.py":
+                    offenders.append(f"{rel}:{lineno} reads {key!r}")
+    assert not offenders, (
+        "csp.sentinel.slots.* literals outside core/config.py (use the "
+        "SentinelConfig slots_* accessors): " + ", ".join(offenders))
+    assert keys, "no slots config keys found (regex rot?)"
+    ops = (REPO / "docs" / "OPERATIONS.md").read_text()
+    undocumented = sorted(k for k in keys if k not in ops)
+    assert not undocumented, (
+        "slots config keys missing from docs/OPERATIONS.md: "
+        + ", ".join(undocumented))
+
+
+def test_no_wall_clock_in_slots():
+    """The slot table must ride the ENGINE timebase only: admit/evict
+    stamps, spill-record ages, the rebalance throttle, and the
+    staleness freeze gate are all part of the replay-determinism
+    contract (the SlotStormCampaign's sha256 oracles replay episodes
+    bit-identically), and an ambient wall-clock read would stamp them
+    with a second clock. Same rule shape as the population gate."""
+    import re
+
+    pattern = re.compile(
+        r"\btime\.time\(|\bdatetime\.now\(|\btime\.monotonic\(|"
+        r"\btime_util\.current_time_millis\(")
+    path = REPO / "sentinel_tpu" / "core" / "slots.py"
+    offenders = []
+    for lineno, code in _code_lines(path):
+        if pattern.search(code):
+            offenders.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not offenders, (
+        "wall-clock read in the slot table (take now_ms from the "
+        "caller — the engine clock): " + ", ".join(offenders))
+
+
+def test_slot_translation_single_implementation():
+    """There is exactly ONE resource -> device-slot translation:
+    ``SlotTable.device_row`` (plus the engine's thin ``_device_row_of``
+    dispatcher that falls back to the registry in fixed-capacity
+    mode). A second ``def device_row`` — or any module outside
+    core/slots.py and core/engine.py reaching into the private
+    ``_hot`` tenancy map — could translate against stale tenancy and
+    book state onto a reused slot's successor, the exact leak the
+    generation stamps exist to prevent."""
+    import re
+
+    defn = re.compile(r"^\s*def\s+device_row\s*\(")
+    hot = re.compile(r"\bslots?\._hot\b|\.slots\._hot\b")
+    sanctioned = {Path("sentinel_tpu") / "core" / "slots.py"}
+    hot_ok = sanctioned | {Path("sentinel_tpu") / "core" / "engine.py"}
+    defs = []
+    offenders = []
+    for path in sorted((REPO / "sentinel_tpu").rglob("*.py")):
+        rel = path.relative_to(REPO)
+        for lineno, code in _code_lines(path):
+            if defn.search(code):
+                defs.append((rel, lineno))
+            if rel not in hot_ok and hot.search(code):
+                offenders.append(f"{rel}:{lineno} touches the private "
+                                 "tenancy map")
+    assert [d for d in defs if d[0] in sanctioned], \
+        "SlotTable.device_row not found (helper moved?)"
+    stray = [f"{rel}:{line}" for rel, line in defs
+             if rel not in sanctioned]
+    assert not stray, ("second device_row translation implementation: "
+                      + ", ".join(stray))
+    assert not offenders, (
+        "slot tenancy read outside the sanctioned modules (go through "
+        "SlotTable's accessors): " + ", ".join(offenders))
 
 
 def test_rebalance_config_keys_accessor_only_and_documented():

@@ -1,0 +1,477 @@
+"""Slot-table admission (ISSUE 20): differential verdict oracle vs a
+never-evicting twin, generation-leak pins on the rendered history, the
+evict -> rehydrate round trip, graceful cold-tail degradation, registry
+overflow accounting, the ``slot_conservation`` checker's teeth, the
+eviction-storm chaos campaign, checkpoint round-trip, and the ops
+command surface.
+
+The oracle tests are DIFFERENTIAL: the same seeded stream is served
+twice — once by a tightly budgeted slot engine that must evict and
+rehydrate to keep up, once by a large-budget twin that never evicts —
+and the verdict streams must match bit-for-bit. Eviction is an
+implementation detail; the moment it leaks into a verdict, these fail.
+"""
+
+import json
+import random
+
+import pytest
+
+from sentinel_tpu.chaos.invariants import (
+    CHECKERS,
+    History,
+    check_all,
+    check_slot_conservation,
+)
+from sentinel_tpu.chaos.slot_storm import SlotStormCampaign
+from sentinel_tpu.core.checkpoint import restore_checkpoint, save_checkpoint
+from sentinel_tpu.core.context import replace_context
+from sentinel_tpu.core.engine import SentinelEngine
+from sentinel_tpu.core.exceptions import BlockException, FlowException
+from sentinel_tpu.core.registry import NodeRegistry
+from sentinel_tpu.models.flow import FlowRule
+from sentinel_tpu.resilience import FaultInjector
+from sentinel_tpu.simulator.clock import SimClock
+from sentinel_tpu.transport.command_center import CommandRequest
+from sentinel_tpu.transport.handlers import cmd_slots
+
+BASE_MS = 1_700_000_000_000
+
+
+def _res(out):
+    return json.loads(out.result)
+
+
+def _engine(slot_budget, epoch_ms=BASE_MS, **kw):
+    clk = SimClock(epoch_ms)
+    eng = SentinelEngine(clock=clk.now_ms, journal_path="",
+                         slot_budget=slot_budget, **kw)
+    return eng, clk
+
+
+def _serve(eng, res):
+    """One entry/exit; returns 'P' or 'B' (the verdict stream symbol)."""
+    try:
+        eng.entry(res).exit()
+        return "P"
+    except BlockException:
+        return "B"
+
+
+# -- differential oracle: tiny budget vs never-evicting twin ---------------
+
+
+def test_differential_oracle_verdicts_bit_identical_to_unevicted_twin():
+    """A 6-usable-slot engine under a 16-resource Zipf stream must
+    evict/rehydrate constantly; a 62-usable-slot twin never evicts.
+    Same clock, same stream -> the verdict streams must be identical
+    (ruled resources are leaseable, so BOTH lanes are host-exact — the
+    slot table may only decide WHERE a verdict is computed, never WHAT
+    it is)."""
+    replace_context(None)
+    names = [f"oracle{i}" for i in range(16)]
+    rules = [FlowRule(resource=names[i], count=3) for i in (0, 5, 10)]
+    weights = [1.0 / (i + 1) ** 1.2 for i in range(16)]
+    streams, statuses = [], []
+    for budget in (8, 64):
+        eng, clk = _engine(budget)
+        try:
+            eng.flow_rules.load_rules(list(rules))
+            rng = random.Random(1234)  # identical draws per engine
+            verdicts = []
+            for _sec in range(10):
+                for _ in range(20):
+                    verdicts.append(
+                        _serve(eng, rng.choices(names, weights=weights)[0]))
+                clk.advance(1000)
+                eng.slo_refresh(now_ms=clk.now_ms())
+            streams.append("".join(verdicts))
+            statuses.append(eng.slots.status())
+        finally:
+            eng.close()
+            replace_context(None)
+    assert streams[0] == streams[1], (
+        "eviction/rehydration changed a verdict:\n"
+        f"  small {streams[0]}\n  twin  {streams[1]}")
+    assert "B" in streams[0] and "P" in streams[0]  # both verdicts exercised
+    # the small engine actually churned; the twin provably never evicted
+    assert statuses[0]["evictionsTotal"] > 0, statuses[0]
+    assert statuses[0]["coldPassTotal"] > 0, statuses[0]
+    assert statuses[1]["evictionsTotal"] == 0, statuses[1]
+    assert statuses[1]["coldPassTotal"] == 0, statuses[1]
+
+
+# -- storm drill: one scenario, several pins -------------------------------
+
+
+@pytest.fixture(scope="module")
+def storm_drill():
+    """One deterministic evict -> cold -> rehydrate drill, shared by the
+    generation-leak, round-trip, and invariant pins below.
+
+    Budget 3 = ONE usable slot. alpha runs two seconds, an armed
+    ``slots.evict.storm`` evicts it, beta takes the slot (new
+    generation), a second storm evicts beta, and alpha re-admits from
+    its spill record while beta degrades to the cold tail."""
+    replace_context(None)
+    history = History()
+    eng, clk = _engine(3)
+    try:
+        with FaultInjector(seed=99, scope_thread=True) as injector:
+            injector.arm("slots.evict.storm", mode="error", after=2, times=2)
+            eng.slots.event_sink = history.events.append
+            plan = [["alpha"] * 3,           # sec 1
+                    ["alpha"] * 2,           # sec 2
+                    [],                      # sec 3: storm #1 evicts alpha
+                    ["beta"] * 3,            # sec 4: beta admits; storm #2
+                    ["alpha"] * 2 + ["beta"],  # sec 5: alpha rehydrates,
+                    ["alpha", "beta"]]       #   beta rides the cold tail
+            for second in plan:
+                for res in second:
+                    _serve(eng, res)
+                clk.advance(1000)
+                eng.slo_refresh(now_ms=clk.now_ms())
+        # injector uninstalled: downstream tests may arm their own
+        view = eng.timeseries_view(now_ms=clk.now_ms())
+        yield {"history": history, "view": view,
+               "status": eng.slots.status()}
+    finally:
+        eng.close()
+        replace_context(None)
+
+
+def test_generation_leak_pin_history_renders_under_recorded_tenancy(
+        storm_drill):
+    """Seconds recorded while alpha held the slot must STILL name alpha
+    after beta reuses the same slot row — without the per-stamp meta
+    recall, every historical second would re-render under the current
+    tenant and book alpha's traffic against beta."""
+    by_res = {}
+    for sec in storm_drill["view"]["seconds"]:
+        names = sorted(sec.get("resources", {}))
+        for name in names:
+            by_res.setdefault(name, 0)
+            by_res[name] += 1
+        assert names in (["alpha"], ["beta"], []), (
+            "a second attributed to both tenants of one slot", sec)
+    # alpha's pre-eviction seconds survived beta's tenancy, and beta's
+    # cold-tail entries never landed in a device-attributed second
+    assert by_res.get("alpha", 0) >= 3, by_res   # sec 1, 2, 5
+    assert by_res.get("beta", 0) == 1, by_res    # sec 4 only
+
+
+def test_evict_rehydrate_round_trip_conserves_window_state(storm_drill):
+    history = storm_drill["history"]
+    rehydrates = history.of("slotRehydrate")
+    evicts = history.of("slotEvict")
+    # alpha was spilled by storm #1 and came back FROM ITS RECORD
+    alpha_evict = next(e for e in evicts if e["resource"] == "alpha")
+    assert alpha_evict["spilledPass"] >= 5 and not alpha_evict["torn"]
+    warm = [r for r in rehydrates
+            if r["resource"] == "alpha" and r["fromRecord"]]
+    assert len(warm) == 1, rehydrates
+    grafted = warm[0]["graftedPass"] + warm[0]["stalePass"]
+    assert 0 < grafted <= alpha_evict["spilledPass"], (warm, alpha_evict)
+    status = storm_drill["status"]
+    assert status["stormsTotal"] == 2
+    assert status["evictionsTotal"] >= 2          # alpha + beta
+    assert status["rehydrationsTotal"] >= 3       # every admit rehydrates
+    assert status["rehydrationsColdTotal"] >= 2   # first touches
+    assert status["coldPassTotal"] >= 2, status   # beta's cold-tail rides
+    # LOUD degrade, zero raises: cold passes were verdicted, not dropped
+    cold = [v for v in history.of("slotVerdict") if v["slot"] < 0]
+    assert cold and all(v["gen"] < 0 for v in cold)
+
+
+def test_storm_drill_history_passes_every_invariant(storm_drill):
+    assert check_all(storm_drill["history"], {}, 1) == []
+
+
+# -- cold tail: host-exact leases past the budget --------------------------
+
+
+def test_cold_ruled_resource_enforced_host_exact_past_pin_capacity():
+    """Four leaseable rules over TWO usable slots: the overflow rules
+    cannot pin, so their resources live on the cold tail — and their
+    limits must still hold host-exactly (cold means slower, never
+    unenforced, for leaseable shapes)."""
+    replace_context(None)
+    eng, clk = _engine(4)
+    try:
+        eng.flow_rules.load_rules(
+            [FlowRule(resource=f"ruled{i}", count=2) for i in range(4)])
+        for i in (0, 1):  # first touches take the two usable slots
+            _serve(eng, f"ruled{i}")
+        hot = set(eng.slots.checkpoint_dict()["hot"])
+        cold_ruled = next(r for r in ("ruled2", "ruled3") if r not in hot)
+        verdicts = "".join(_serve(eng, cold_ruled) for _ in range(6))
+        assert verdicts == "PPBBBB", verdicts  # count=2, host-exact
+        status = eng.slots.status()
+        assert status["coldBlockTotal"] >= 4, status
+        assert status["coldPassTotal"] >= 2, status
+    finally:
+        eng.close()
+        replace_context(None)
+
+
+def test_namespace_10x_budget_zero_registration_failures():
+    """The headline acceptance: a namespace 10x the usable budget runs
+    with ZERO failed registrations and zero raises — extra resources
+    degrade to counted cold-tail passes, never to errors."""
+    replace_context(None)
+    eng, clk = _engine(8)
+    try:
+        names = [f"wide{i}" for i in range(60)]
+        for _sec in range(3):
+            for res in names:
+                assert _serve(eng, res) == "P"  # unruled: never blocked
+            clk.advance(1000)
+            eng.slo_refresh(now_ms=clk.now_ms())
+        status = eng.slots.status()
+        assert status["hot"] <= 6, status
+        assert status["coldPassTotal"] > 0, status
+        assert 0.0 < status["hitRate"] < 1.0, status
+        assert eng.registry.overflow_count == 0
+    finally:
+        eng.close()
+        replace_context(None)
+
+
+def test_registry_overflow_is_counted_not_raised():
+    reg = NodeRegistry(capacity=4)  # ROOT + ENTRY pre-allocated
+    assert reg.cluster_row("fits-a") >= 0
+    assert reg.cluster_row("fits-b") >= 0
+    for i in range(3):  # past capacity: pass-through row, loud counter
+        assert reg.cluster_row(f"over-{i}") == -1
+    assert reg.overflow_count == 3
+    assert reg.cluster_row("fits-a") >= 0  # existing rows keep resolving
+    assert reg.overflow_count == 3
+
+
+# -- the slot_conservation checker must FIRE -------------------------------
+
+
+def _hist(events):
+    h = History()
+    for ev in events:
+        ev = dict(ev)
+        h.add(ev.pop("e"), **ev)
+    return h
+
+
+def _admit(res, slot, gen):
+    return {"e": "slotAdmit", "resource": res, "slot": slot, "gen": gen}
+
+
+def _evict(res, slot, gen, torn=False, spilled=0):
+    return {"e": "slotEvict", "resource": res, "slot": slot, "gen": gen,
+            "torn": torn, "spilledPass": spilled}
+
+
+def _rehydrate(res, slot, gen, from_record=False, grafted=0, stale=0):
+    return {"e": "slotRehydrate", "resource": res, "slot": slot, "gen": gen,
+            "fromRecord": from_record, "graftedPass": grafted,
+            "stalePass": stale, "coldPass": 0}
+
+
+def _verdict(res, slot, gen, sec=1):
+    return {"e": "slotVerdict", "resource": res, "slot": slot, "gen": gen,
+            "sec": sec, "verdict": "pass", "reason": 0}
+
+
+def test_slot_conservation_accepts_a_clean_round_trip():
+    clean = _hist([
+        _rehydrate("a", 2, 1), _admit("a", 2, 1), _verdict("a", 2, 1),
+        _evict("a", 2, 1, spilled=5),
+        _rehydrate("b", 2, 2), _admit("b", 2, 2), _verdict("b", 2, 2),
+        _evict("b", 2, 2, torn=True, spilled=3),
+        _rehydrate("a", 2, 3, from_record=True, grafted=3, stale=2),
+        _admit("a", 2, 3), _verdict("a", 2, 3),
+        _verdict("cold-tail", -1, -2),
+    ])
+    assert check_slot_conservation(clean, {}, 1) == []
+
+
+@pytest.mark.parametrize("label,events", [
+    ("double admit without evict",
+     [_admit("a", 2, 1), _admit("b", 2, 2)]),
+    ("generation does not increase",
+     [_admit("a", 2, 1), _evict("a", 2, 1), _admit("b", 2, 1)]),
+    ("evict names the wrong tenant",
+     [_admit("a", 2, 1), _evict("b", 2, 1)]),
+    ("evict from an unoccupied slot",
+     [_evict("a", 2, 1)]),
+    ("verdict leaks to the evicted generation",
+     [_admit("a", 2, 1), _evict("a", 2, 1), _admit("b", 2, 2),
+      _verdict("a", 2, 1)]),
+    ("verdict on an unoccupied slot",
+     [_verdict("a", 2, 1)]),
+    ("cold-lane verdict claims a device generation",
+     [_verdict("a", -1, 0)]),
+    ("rehydrate claims a record with no prior evict",
+     [_rehydrate("a", 2, 1, from_record=True), _admit("a", 2, 1)]),
+    ("torn spill rehydrates warm",
+     [_admit("a", 2, 1), _evict("a", 2, 1, torn=True, spilled=5),
+      _rehydrate("a", 2, 2, from_record=True), _admit("a", 2, 2)]),
+    ("round trip grafts more than was spilled",
+     [_admit("a", 2, 1), _evict("a", 2, 1, spilled=3),
+      _rehydrate("a", 2, 2, from_record=True, grafted=3, stale=1),
+      _admit("a", 2, 2)]),
+    ("cold rehydrate reports grafted window state",
+     [_rehydrate("a", 2, 1, grafted=1), _admit("a", 2, 1)]),
+    ("admit does not claim the rehydrate that preceded it",
+     [_rehydrate("a", 2, 1), _admit("b", 2, 1)]),
+])
+def test_slot_conservation_fires(label, events):
+    """A checker that cannot fire is decoration: every clause must
+    produce a violation on a history hand-built to break it."""
+    violations = check_slot_conservation(_hist(events), {}, 1)
+    assert violations, label
+    assert all(v.invariant == "slot_conservation" for v in violations)
+
+
+def test_slot_conservation_registered_in_check_all():
+    assert "slot_conservation" in {name for name, _fn in CHECKERS}
+    bad = _hist([_admit("a", 2, 1), _admit("b", 2, 2)])
+    assert any(v.invariant == "slot_conservation"
+               for v in check_all(bad, {}, 1))
+
+
+# -- eviction-storm campaign: smoke + replay stability ---------------------
+
+
+def test_storm_campaign_smoke_and_replay_stable():
+    replace_context(None)
+    camp = SlotStormCampaign(campaign_seed=7, episodes=2, seconds=5)
+    try:
+        r0 = camp.run_episode(0)
+        r1 = camp.run_episode(1)
+        assert not r0.violations and not r1.violations
+        assert r0.entries == r1.entries == 5 * camp.per_second
+        # both faults actually landed somewhere in the pair
+        storms = sum(r.status["stormsTotal"] for r in (r0, r1))
+        assert storms >= 2 and sum(
+            r.status["evictionsTotal"] for r in (r0, r1)) > 0
+        # distinct seeds draw distinct streams...
+        assert (r0.verdict_sha256, r0.tenancy_sha256) != (
+            r1.verdict_sha256, r1.tenancy_sha256)
+        # ...and one seed replays BIT-identically
+        again = camp.run_episode(0)
+        assert again.verdict_sha256 == r0.verdict_sha256
+        assert again.tenancy_sha256 == r0.tenancy_sha256
+        assert not again.violations
+    finally:
+        replace_context(None)
+
+
+@pytest.mark.slow
+def test_storm_campaign_certification_100_episodes():
+    """The ISSUE 20 acceptance run: 100 eviction-storm episodes with
+    both slots.* faults armed — zero invariant violations, replayable
+    hashes. (~10 min of engine compiles; tier-1 runs the 2-episode
+    smoke above instead.)"""
+    replace_context(None)
+    try:
+        rep = SlotStormCampaign(campaign_seed=20, episodes=100,
+                                seconds=6).run()
+    finally:
+        replace_context(None)
+    assert rep["episodes"] == 100
+    assert rep["violations"] == 0, rep["firstViolation"]
+    assert rep["storms"] >= 100 and rep["spillTorn"] > 0
+    assert rep["evictions"] > 0 and rep["rehydrations"] > 0
+    assert len(rep["verdictSha256"]) == 64
+    assert len(rep["tenancySha256"]) == 64
+
+
+# -- checkpoint round trip -------------------------------------------------
+
+
+def test_checkpoint_round_trip_restores_slot_assignment(tmp_path):
+    replace_context(None)
+    path = str(tmp_path / "slots.ckpt")
+    eng, clk = _engine(8)
+    try:
+        for _sec in range(2):
+            for res in ("ck-a", "ck-b", "ck-c"):
+                _serve(eng, res)
+            clk.advance(1000)
+            eng.slo_refresh(now_ms=clk.now_ms())
+        save_checkpoint(eng, path)
+        saved = eng.slots.checkpoint_dict()
+    finally:
+        eng.close()
+        replace_context(None)
+    assert len(saved["hot"]) == 3
+    twin, clk2 = _engine(8)
+    try:
+        restore_checkpoint(twin, path)
+        assert twin.slots.checkpoint_dict() == saved
+        # the restored table serves: hot resources stay on their slots
+        assert _serve(twin, "ck-a") == "P"
+        assert twin.slots.checkpoint_dict()["hot"]["ck-a"] == \
+            saved["hot"]["ck-a"]
+    finally:
+        twin.close()
+        replace_context(None)
+    # mode mismatch is a refusal, not a corruption
+    fixed = SentinelEngine(capacity=8, clock=clk2.now_ms, journal_path="")
+    try:
+        with pytest.raises(ValueError, match="slot"):
+            restore_checkpoint(fixed, path)
+    finally:
+        fixed.close()
+        replace_context(None)
+
+
+# -- ops surface -----------------------------------------------------------
+
+
+def test_cmd_slots_status_hot_freeze_thaw():
+    replace_context(None)
+    eng, clk = _engine(8)
+    try:
+        _serve(eng, "ops-res")
+        out = _res(cmd_slots(CommandRequest(
+            parameters={"op": "status"}, engine=eng)))
+        assert out["budget"] == 8 and out["hot"] == 1
+        assert out["freezeReason"] is None
+        hot = _res(cmd_slots(CommandRequest(
+            parameters={"op": "hot"}, engine=eng)))
+        assert set(hot["hot"]) == {"ops-res"}
+        assert hot["hot"]["ops-res"]["slot"] >= 2  # reserved rows skipped
+        frozen = _res(cmd_slots(CommandRequest(
+            parameters={"op": "freeze", "reason": "drill"}, engine=eng)))
+        assert frozen["frozen"] is True
+        out = _res(cmd_slots(CommandRequest(
+            parameters={"op": "status"}, engine=eng)))
+        assert out["freezeReason"] == "manual: drill"
+        _res(cmd_slots(CommandRequest(
+            parameters={"op": "thaw"}, engine=eng)))
+        out = _res(cmd_slots(CommandRequest(
+            parameters={"op": "status"}, engine=eng)))
+        assert out["freezeReason"] is None
+        bad = cmd_slots(CommandRequest(
+            parameters={"op": "wat"}, engine=eng))
+        assert not bad.success
+        # the exporter ships the families the runbook names
+        from sentinel_tpu.telemetry.exporter import render_engine_metrics
+
+        text = render_engine_metrics(eng)
+        assert "sentinel_tpu_slots_budget 8" in text
+        assert "sentinel_tpu_slots_admits_total" in text
+        assert "sentinel_tpu_registry_overflow_total 0" in text
+    finally:
+        eng.close()
+        replace_context(None)
+
+
+def test_cmd_slots_refuses_fixed_capacity_engines():
+    replace_context(None)
+    eng = SentinelEngine(capacity=64, journal_path="")
+    try:
+        out = cmd_slots(CommandRequest(parameters={}, engine=eng))
+        assert not out.success and "slot mode" in out.result
+    finally:
+        eng.close()
+        replace_context(None)
